@@ -1,0 +1,21 @@
+"""TPU-native distributed SDDMM / SpMM framework.
+
+A ground-up JAX/XLA/Pallas re-design of the capabilities of
+PASSIONLab/distributed_sddmm ("Half-and-Half"): communication-avoiding 1.5D /
+2.5D distributed algorithms for SpMM (sparse x tall-skinny dense) and SDDMM
+(sampled dense-dense matmul), two SDDMM->SpMM fusion strategies, a pluggable
+local-kernel boundary, and the ALS-CG / GAT driver applications.
+
+Where the reference uses MPI communicators (FlexibleGrid.hpp), this framework
+uses a named 3-D `jax.sharding.Mesh`; where it ring-shifts buffers with
+`MPI_Sendrecv` / `MPI_Isend` (distributed_sparse.h:351-361, SpmatLocal.hpp:200-259),
+this framework uses `jax.lax.ppermute` inside `shard_map`; replication /
+reduction (`MPI_Allgather` / `MPI_Reduce_scatter`) become `lax.all_gather` /
+`lax.psum_scatter` over named mesh axes.
+"""
+
+from distributed_sddmm_tpu.common import KernelMode, MatMode
+
+__version__ = "0.1.0"
+
+__all__ = ["KernelMode", "MatMode", "__version__"]

@@ -1,0 +1,46 @@
+"""Shared enums and small utilities.
+
+Mirrors the role of the reference's ``common.h`` / ``common.cpp``
+(`/root/reference/common.h:21-33`, `common.cpp:16-27`): kernel-mode and
+matrix-mode enums plus integer helpers. The reference's ``BufferPair``
+double-buffer and MPI datatype registration have no equivalent here — XLA
+double-buffers ``ppermute`` internally and sharded ``jax.Array``s need no wire
+types.
+"""
+
+from __future__ import annotations
+
+import enum
+
+
+class KernelMode(enum.Enum):
+    """The four distributed-op modes (reference `sparse_kernels.h:13`).
+
+    * ``SDDMM_A`` — ``out_vals = S_vals * (A @ B^T sampled at pattern(S))``
+    * ``SPMM_A``  — ``A += S @ B``
+    * ``SPMM_B``  — ``B += S^T @ A``
+    * ``SDDMM_B`` — SDDMM computed against the transposed representation
+      (values returned in S^T's canonical nonzero order).
+    """
+
+    SDDMM_A = "sddmmA"
+    SPMM_A = "spmmA"
+    SPMM_B = "spmmB"
+    SDDMM_B = "sddmmB"
+
+
+class MatMode(enum.Enum):
+    """Which dense matrix plays the output role (reference `common.h:21`)."""
+
+    A = "Amat"
+    B = "Bmat"
+
+
+def p_mod(num: int, denom: int) -> int:
+    """Positive modulus (reference `common.cpp:16-18`)."""
+    return ((num % denom) + denom) % denom
+
+
+def divide_round_up(num: int, denom: int) -> int:
+    """Ceiling division (reference `common.cpp:24-27`)."""
+    return -(-num // denom)

@@ -1,0 +1,3 @@
+from distributed_sddmm_tpu.ops.kernels import LocalKernel, XlaKernel, get_kernel
+
+__all__ = ["LocalKernel", "XlaKernel", "get_kernel"]

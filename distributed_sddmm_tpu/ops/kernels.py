@@ -1,0 +1,93 @@
+"""Pluggable local SDDMM / SpMM kernels (single device, one sparse tile).
+
+This is the framework's counterpart of the reference's plugin boundary
+``KernelImplementation`` (`/root/reference/sparse_kernels.h:15-79`): the
+distributed algorithms are written against the :class:`LocalKernel` interface
+and any implementation can be swapped in. Implementations:
+
+* :class:`XlaKernel` — pure jax.numpy gather-dot SDDMM and segment-sum SpMM.
+  Works on every backend (CPU test meshes included); XLA fuses the gather with
+  the rowwise multiply-reduce. This replaces the reference's OpenMP COO loop
+  (`sparse_kernels.cpp:13-57`) and MKL ``mkl_sparse_d_mm``
+  (`sparse_kernels.cpp:94-121`).
+* ``PallasKernel`` (``ops/pallas_kernels.py``) — blocked kernels for peak TPU
+  throughput on row-sorted tiles.
+
+Tile convention: a tile is a struct-of-arrays ``(rows, cols, vals)`` of static
+length ``max_nnz``, padded with inert entries ``row = col = 0, val = 0``.
+Zero-valued padding is harmless in both ops: SDDMM multiplies dots by the
+input values (0 at pads) and SpMM scatters ``val * B[col]`` (0 contribution).
+This mirrors the reference's own max_nnz double-buffering for in-flight
+sparse shifts (`SpmatLocal.hpp:153-169`) — its solution to variable nnz is
+already the static-shape solution XLA requires.
+"""
+
+from __future__ import annotations
+
+from typing import Protocol
+
+import jax
+import jax.numpy as jnp
+
+
+class LocalKernel(Protocol):
+    """Local kernel plugin boundary (reference `sparse_kernels.h:15-79`)."""
+
+    def sddmm(
+        self,
+        rows: jax.Array,
+        cols: jax.Array,
+        vals: jax.Array,
+        A: jax.Array,
+        B: jax.Array,
+    ) -> jax.Array:
+        """Return ``vals * rowwise_dot(A[rows], B[cols])``, shape [max_nnz]."""
+        ...
+
+    def spmm(
+        self,
+        rows: jax.Array,
+        cols: jax.Array,
+        vals: jax.Array,
+        B: jax.Array,
+        out_rows: int,
+    ) -> jax.Array:
+        """Return ``S_tile @ B`` as a dense [out_rows, R] array.
+
+        Accumulate (beta=1) semantics are the caller's job: callers add the
+        returned partial into their accumulation buffer, matching the
+        reference's ``beta=1`` MKL call (`sparse_kernels.cpp:104-107`).
+        """
+        ...
+
+
+class XlaKernel:
+    """Gather-dot SDDMM + segment-sum SpMM in pure XLA ops."""
+
+    name = "xla"
+
+    def sddmm(self, rows, cols, vals, A, B):
+        dots = jnp.sum(A[rows] * B[cols], axis=-1)
+        return vals * dots.astype(vals.dtype)
+
+    def spmm(self, rows, cols, vals, B, out_rows: int):
+        contrib = vals[:, None] * B[cols]
+        return jax.ops.segment_sum(contrib, rows, num_segments=out_rows)
+
+
+_REGISTRY = {"xla": XlaKernel}
+
+
+def get_kernel(name: str) -> LocalKernel:
+    """Kernel factory; Pallas registers lazily to keep CPU imports light."""
+    if name == "pallas" and "pallas" not in _REGISTRY:
+        try:
+            from distributed_sddmm_tpu.ops.pallas_kernels import PallasKernel
+        except ImportError as e:
+            raise NotImplementedError(
+                "the 'pallas' kernel is not available in this build"
+            ) from e
+        _REGISTRY["pallas"] = PallasKernel
+    if name not in _REGISTRY:
+        raise ValueError(f"unknown kernel {name!r}; available: {sorted(_REGISTRY)}")
+    return _REGISTRY[name]()

@@ -1,0 +1,3 @@
+from distributed_sddmm_tpu.parallel.mesh import GridSpec, make_grid
+
+__all__ = ["GridSpec", "make_grid"]

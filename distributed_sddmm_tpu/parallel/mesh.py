@@ -1,0 +1,116 @@
+"""3-D named device mesh with adjacency (rank-ordering) control.
+
+TPU-native replacement for the reference's ``FlexibleGrid``
+(`/root/reference/FlexibleGrid.hpp:12-202`): instead of six MPI
+subcommunicators, we build one named 3-D :class:`jax.sharding.Mesh` with axes
+``("rows", "cols", "layers")``. Every communicator becomes a named axis (or
+axis tuple) passed to collectives:
+
+================  ===========================================================
+reference world    mesh equivalent
+================  ===========================================================
+``row_world``      axis ``"cols"`` (ranks in the same grid row vary j)
+``col_world``      axis ``"rows"`` (ranks in the same grid column vary i)
+``fiber_world``    axis ``"layers"``
+``rowcol_slice``   axis tuple ``("rows", "cols")``
+``rowfiber_slice`` axis tuple ``("rows", "layers")``
+``colfiber_slice`` axis tuple ``("cols", "layers")``
+================  ===========================================================
+
+``adjacency`` (1..6, `FlexibleGrid.hpp:29-41`) selects which grid axis is
+fastest-varying in flat device order — i.e. which axis rides the most-adjacent
+ICI links when ``jax.devices()`` enumerates a torus. Adjacency 3 ("rcf") is
+the reference's recommended default.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+ROWS, COLS, LAYERS = "rows", "cols", "layers"
+
+# adjacency -> permutation, most-adjacent grid axis first (0=i/rows, 1=j/cols,
+# 2=k/layers). Matches `FlexibleGrid.hpp:53-72`.
+_ADJACENCY_PERMUTATIONS = {
+    1: (0, 1, 2),  # crf
+    2: (0, 2, 1),  # cfr
+    3: (1, 0, 2),  # rcf
+    4: (1, 2, 0),  # rfc
+    5: (2, 0, 1),  # fcr
+    6: (2, 1, 0),  # frc
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class GridSpec:
+    """A named 3-D mesh plus its construction metadata."""
+
+    mesh: Mesh
+    nr: int
+    nc: int
+    nh: int
+    adjacency: int
+
+    @property
+    def p(self) -> int:
+        return self.nr * self.nc * self.nh
+
+    def sharding(self, *spec) -> NamedSharding:
+        return NamedSharding(self.mesh, P(*spec))
+
+    def flat_rank(self, i: int, j: int, k: int) -> int:
+        """Grid coordinate -> flat device index (`FlexibleGrid.hpp:124-135`)."""
+        perm = _ADJACENCY_PERMUTATIONS[self.adjacency]
+        dims = (self.nr, self.nc, self.nh)
+        coord = (i, j, k)
+        rank = coord[perm[0]]
+        rank += coord[perm[1]] * dims[perm[0]]
+        rank += coord[perm[2]] * dims[perm[0]] * dims[perm[1]]
+        return rank
+
+    def grid_coords(self, rank: int) -> tuple[int, int, int]:
+        """Flat device index -> grid coordinate (`FlexibleGrid.hpp:105-117`)."""
+        perm = _ADJACENCY_PERMUTATIONS[self.adjacency]
+        dims = (self.nr, self.nc, self.nh)
+        coord = [0, 0, 0]
+        coord[perm[0]] = rank % dims[perm[0]]
+        coord[perm[1]] = (rank // dims[perm[0]]) % dims[perm[1]]
+        coord[perm[2]] = (rank // (dims[perm[0]] * dims[perm[1]])) % dims[perm[2]]
+        return tuple(coord)
+
+
+def make_grid(
+    nr: int,
+    nc: int,
+    nh: int = 1,
+    adjacency: int = 3,
+    devices=None,
+) -> GridSpec:
+    """Build an ``nr x nc x nh`` named mesh over ``devices``.
+
+    Asserts ``nr * nc * nh == len(devices)`` exactly as the reference grid
+    does (`FlexibleGrid.hpp:41-44`).
+    """
+    if adjacency not in _ADJACENCY_PERMUTATIONS:
+        raise ValueError(f"adjacency must be 1..6, got {adjacency}")
+    if devices is None:
+        devices = jax.devices()
+    devices = list(devices)
+    if nr * nc * nh != len(devices):
+        raise ValueError(
+            f"grid {nr}x{nc}x{nh} needs {nr * nc * nh} devices, have {len(devices)}"
+        )
+
+    spec = GridSpec(mesh=None, nr=nr, nc=nc, nh=nh, adjacency=adjacency)  # temp
+    dev_arr = np.empty((nr, nc, nh), dtype=object)
+    for i in range(nr):
+        for j in range(nc):
+            for k in range(nh):
+                dev_arr[i, j, k] = devices[spec.flat_rank(i, j, k)]
+    mesh = Mesh(dev_arr, (ROWS, COLS, LAYERS))
+    return dataclasses.replace(spec, mesh=mesh)

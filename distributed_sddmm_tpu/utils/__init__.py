@@ -1,0 +1,4 @@
+from distributed_sddmm_tpu.utils.coo import HostCOO
+from distributed_sddmm_tpu.utils import oracle
+
+__all__ = ["HostCOO", "oracle"]

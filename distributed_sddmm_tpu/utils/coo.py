@@ -1,0 +1,197 @@
+"""Host-side sparse matrix container and synthetic generators.
+
+TPU-native counterpart of the reference's ``SpmatLocal`` ingest paths
+(`/root/reference/SpmatLocal.hpp:467-533`): matrix-market IO, Graph500-style
+R-mat generation (uniform 0.25 initiator, `SpmatLocal.hpp:502-505`), and an
+Erdos-Renyi generator. Everything here is plain numpy on the host — one-time
+setup cost, deliberately kept out of XLA (SURVEY.md section 7 "Setup-time
+all-to-all stays on host").
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+
+@dataclasses.dataclass
+class HostCOO:
+    """COO sparse matrix in host memory (struct-of-arrays).
+
+    Equivalent capability to the reference's ``SpmatLocal`` coords vector +
+    global metadata (`SpmatLocal.hpp:267-312`), minus the MPI distribution —
+    on a single-controller JAX program the whole matrix is visible at ingest
+    and device placement happens later via layouts (see
+    ``distributed_sddmm_tpu.parallel.sharding``).
+    """
+
+    rows: np.ndarray  # int64 [nnz]
+    cols: np.ndarray  # int64 [nnz]
+    vals: np.ndarray  # float64 [nnz]
+    M: int
+    N: int
+
+    def __post_init__(self) -> None:
+        self.rows = np.asarray(self.rows, dtype=np.int64)
+        self.cols = np.asarray(self.cols, dtype=np.int64)
+        self.vals = np.asarray(self.vals, dtype=np.float64)
+        if not (self.rows.shape == self.cols.shape == self.vals.shape):
+            raise ValueError("rows/cols/vals must have identical shapes")
+        if self.rows.size:
+            if self.rows.min() < 0 or self.rows.max() >= self.M:
+                raise ValueError("row index out of range")
+            if self.cols.min() < 0 or self.cols.max() >= self.N:
+                raise ValueError("col index out of range")
+
+    @property
+    def nnz(self) -> int:
+        return int(self.rows.size)
+
+    # ------------------------------------------------------------------ #
+    # Conversions
+    # ------------------------------------------------------------------ #
+
+    @classmethod
+    def from_scipy(cls, mat) -> "HostCOO":
+        coo = mat.tocoo()
+        return cls(
+            rows=coo.row.astype(np.int64),
+            cols=coo.col.astype(np.int64),
+            vals=coo.data.astype(np.float64),
+            M=int(coo.shape[0]),
+            N=int(coo.shape[1]),
+        )
+
+    def to_scipy(self):
+        import scipy.sparse as sp
+
+        return sp.coo_matrix(
+            (self.vals, (self.rows, self.cols)), shape=(self.M, self.N)
+        ).tocsr()
+
+    def transpose(self) -> "HostCOO":
+        return HostCOO(
+            rows=self.cols.copy(),
+            cols=self.rows.copy(),
+            vals=self.vals.copy(),
+            M=self.N,
+            N=self.M,
+        )
+
+    def with_values(self, vals: np.ndarray) -> "HostCOO":
+        return HostCOO(self.rows, self.cols, np.asarray(vals), self.M, self.N)
+
+    def sorted_by_row(self) -> "HostCOO":
+        order = np.lexsort((self.cols, self.rows))
+        return HostCOO(
+            self.rows[order], self.cols[order], self.vals[order], self.M, self.N
+        )
+
+    def deduplicated(self) -> "HostCOO":
+        """Drop duplicate (row, col) entries, keeping the first occurrence."""
+        keys = self.rows * self.N + self.cols
+        _, idx = np.unique(keys, return_index=True)
+        idx.sort()
+        return HostCOO(self.rows[idx], self.cols[idx], self.vals[idx], self.M, self.N)
+
+    def random_permuted(self, seed: int = 0) -> "HostCOO":
+        """Apply a random row + column permutation for load balance.
+
+        Capability parity with the reference's ``random_permute`` tool
+        (`/root/reference/random_permute.cpp:42-57`), used as preprocessing
+        for power-law graphs.
+        """
+        rng = np.random.default_rng(seed)
+        row_perm = rng.permutation(self.M)
+        col_perm = rng.permutation(self.N)
+        return HostCOO(
+            row_perm[self.rows], col_perm[self.cols], self.vals.copy(), self.M, self.N
+        )
+
+    # ------------------------------------------------------------------ #
+    # Generators (reference SpmatLocal::loadTuples, SpmatLocal.hpp:467-533)
+    # ------------------------------------------------------------------ #
+
+    @classmethod
+    def erdos_renyi(
+        cls,
+        M: int,
+        N: int,
+        nnz_per_row: int,
+        seed: int = 0,
+        values: str = "ones",
+    ) -> "HostCOO":
+        """Uniform random sparse matrix with ~``nnz_per_row`` entries per row."""
+        rng = np.random.default_rng(seed)
+        n_edges = M * nnz_per_row
+        rows = rng.integers(0, M, size=n_edges, dtype=np.int64)
+        cols = rng.integers(0, N, size=n_edges, dtype=np.int64)
+        if values == "ones":
+            vals = np.ones(n_edges)
+        elif values == "normal":
+            vals = rng.standard_normal(n_edges)
+        else:
+            raise ValueError(f"values must be 'ones' or 'normal', got {values!r}")
+        return cls(rows, cols, vals, M, N).deduplicated()
+
+    @classmethod
+    def rmat(
+        cls,
+        log_m: int,
+        edge_factor: int,
+        a: float = 0.25,
+        b: float = 0.25,
+        c: float = 0.25,
+        d: float = 0.25,
+        seed: int = 0,
+    ) -> "HostCOO":
+        """Graph500-style R-mat generator.
+
+        The reference calls CombBLAS ``GenGraph500Data`` with a uniform
+        ``{0.25, 0.25, 0.25, 0.25}`` initiator (`SpmatLocal.hpp:500-507`),
+        which degenerates to uniform random edges; the general skewed
+        initiator is supported here too. Vectorized recursive-quadrant
+        sampling, one vector op per scale level.
+        """
+        if not np.isclose(a + b + c + d, 1.0):
+            raise ValueError("initiator probabilities must sum to 1")
+        M = 1 << log_m
+        n_edges = M * edge_factor
+        rng = np.random.default_rng(seed)
+        rows = np.zeros(n_edges, dtype=np.int64)
+        cols = np.zeros(n_edges, dtype=np.int64)
+        for _ in range(log_m):
+            u = rng.random(n_edges)
+            rbit = (u >= a + b).astype(np.int64)
+            # Conditional column bit: P(cbit=1 | rbit) per initiator quadrant.
+            # Guard zero-mass halves (e.g. c+d == 0): that branch is never
+            # selected when its mass is zero, but the division still runs.
+            top = b / max(a + b, 1e-300)
+            bot = d / max(c + d, 1e-300)
+            cprob = np.where(rbit == 0, top, bot)
+            cbit = (rng.random(n_edges) < cprob).astype(np.int64)
+            rows = (rows << 1) | rbit
+            cols = (cols << 1) | cbit
+        mat = cls(rows, cols, np.ones(n_edges), M, M).deduplicated()
+        # Graph500 permutes vertex names to de-skew locality
+        # (PermEdges + RenameVertices, SpmatLocal.hpp:505-506).
+        return mat.random_permuted(seed=seed + 1)
+
+    # ------------------------------------------------------------------ #
+    # Matrix-market IO (reference ParallelReadMM / ParallelWriteMM usage)
+    # ------------------------------------------------------------------ #
+
+    @classmethod
+    def load_mtx(cls, path: str) -> "HostCOO":
+        import scipy.io
+
+        return cls.from_scipy(scipy.io.mmread(path))
+
+    def save_mtx(self, path: str) -> None:
+        import scipy.io
+        import scipy.sparse as sp
+
+        scipy.io.mmwrite(
+            path, sp.coo_matrix((self.vals, (self.rows, self.cols)), shape=(self.M, self.N))
+        )

@@ -1,0 +1,22 @@
+"""Test configuration: run everything on a virtual 8-device CPU mesh.
+
+The reference tested "distributed" behavior by oversubscribing MPI ranks on
+one host (SURVEY.md section 4); our equivalent is XLA's forced host platform
+device count. Env vars must be set before jax is first imported.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "--xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+# Some environments pre-import jax from sitecustomize with a hardware
+# platform pinned; the config update wins over the stale env var as long as
+# no backend has been initialized yet.
+jax.config.update("jax_platforms", "cpu")
+
+assert jax.device_count() == 8, jax.devices()

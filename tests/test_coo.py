@@ -1,0 +1,70 @@
+import numpy as np
+import pytest
+
+from distributed_sddmm_tpu.utils.coo import HostCOO
+
+
+def test_erdos_renyi_shapes():
+    S = HostCOO.erdos_renyi(64, 32, nnz_per_row=4, seed=0)
+    assert S.M == 64 and S.N == 32
+    assert 0 < S.nnz <= 64 * 4
+    assert S.rows.max() < 64 and S.cols.max() < 32
+
+
+def test_dedup():
+    S = HostCOO(
+        rows=[0, 0, 1], cols=[1, 1, 2], vals=[1.0, 2.0, 3.0], M=4, N=4
+    )
+    D = S.deduplicated()
+    assert D.nnz == 2
+    assert D.vals[0] == 1.0  # keeps first
+
+
+def test_rmat_dims_and_balance():
+    S = HostCOO.rmat(log_m=6, edge_factor=4, seed=1)
+    assert S.M == 64 and S.N == 64
+    assert S.nnz > 64  # dedup removes some of 256 edges but most survive
+    keys = S.rows * S.N + S.cols
+    assert len(np.unique(keys)) == S.nnz
+
+
+def test_rmat_skewed_initiator():
+    S = HostCOO.rmat(log_m=6, edge_factor=4, a=0.57, b=0.19, c=0.19, d=0.05, seed=2)
+    assert S.M == 64
+    with pytest.raises(ValueError):
+        HostCOO.rmat(4, 2, a=0.9, b=0.9, c=0.1, d=0.1)
+
+
+def test_transpose_roundtrip():
+    S = HostCOO.erdos_renyi(32, 16, 4, seed=3)
+    T = S.transpose()
+    assert T.M == S.N and T.N == S.M
+    np.testing.assert_array_equal(T.rows, S.cols)
+
+
+def test_scipy_roundtrip():
+    S = HostCOO.erdos_renyi(32, 16, 4, seed=4, values="normal")
+    S2 = HostCOO.from_scipy(S.to_scipy())
+    assert S2.nnz == S.nnz
+    np.testing.assert_allclose(S.to_scipy().toarray(), S2.to_scipy().toarray())
+
+
+def test_mtx_roundtrip(tmp_path):
+    S = HostCOO.erdos_renyi(16, 16, 2, seed=5, values="normal")
+    path = str(tmp_path / "m.mtx")
+    S.save_mtx(path)
+    S2 = HostCOO.load_mtx(path)
+    np.testing.assert_allclose(S.to_scipy().toarray(), S2.to_scipy().toarray(), rtol=1e-12)
+
+
+def test_random_permuted_preserves_values():
+    S = HostCOO.erdos_renyi(32, 32, 4, seed=6, values="normal")
+    Sp = S.random_permuted(seed=7)
+    assert Sp.nnz == S.nnz
+    np.testing.assert_allclose(np.sort(Sp.vals), np.sort(S.vals))
+    assert not np.array_equal(Sp.rows, S.rows)
+
+
+def test_bounds_check():
+    with pytest.raises(ValueError):
+        HostCOO(rows=[5], cols=[0], vals=[1.0], M=4, N=4)

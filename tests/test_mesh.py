@@ -1,0 +1,53 @@
+import numpy as np
+import pytest
+
+import jax
+
+from distributed_sddmm_tpu.parallel.mesh import make_grid, _ADJACENCY_PERMUTATIONS
+
+
+def test_basic_grid():
+    g = make_grid(4, 2, 1)
+    assert g.p == 8
+    assert g.mesh.axis_names == ("rows", "cols", "layers")
+    assert g.mesh.shape == {"rows": 4, "cols": 2, "layers": 1}
+
+
+def test_wrong_size_raises():
+    with pytest.raises(ValueError):
+        make_grid(3, 2, 1)
+    with pytest.raises(ValueError):
+        make_grid(4, 2, 1, adjacency=7)
+
+
+@pytest.mark.parametrize("adjacency", list(_ADJACENCY_PERMUTATIONS))
+def test_rank_coord_roundtrip(adjacency):
+    g = make_grid(2, 2, 2, adjacency=adjacency)
+    seen = set()
+    for i in range(2):
+        for j in range(2):
+            for k in range(2):
+                r = g.flat_rank(i, j, k)
+                assert g.grid_coords(r) == (i, j, k)
+                seen.add(r)
+    assert seen == set(range(8))
+
+
+def test_adjacency_orders_devices():
+    devices = jax.devices()
+    # adjacency 1: rows (i) fastest-varying in flat order
+    g1 = make_grid(4, 2, 1, adjacency=1)
+    assert g1.flat_rank(1, 0, 0) == 1
+    # adjacency 3: cols (j) fastest-varying
+    g3 = make_grid(4, 2, 1, adjacency=3)
+    assert g3.flat_rank(0, 1, 0) == 1
+    # mesh device placement honors the permutation
+    assert g3.mesh.devices[0, 1, 0] == devices[1]
+    assert g1.mesh.devices[1, 0, 0] == devices[1]
+
+
+def test_sharding_helper():
+    g = make_grid(8, 1, 1)
+    s = g.sharding("rows", None)
+    x = jax.device_put(np.zeros((16, 4)), s)
+    assert x.sharding.is_equivalent_to(s, ndim=2)

@@ -1,0 +1,58 @@
+import numpy as np
+
+from distributed_sddmm_tpu.utils import oracle
+from distributed_sddmm_tpu.utils.coo import HostCOO
+
+
+def _setup(M=24, N=16, R=8, seed=0):
+    S = HostCOO.erdos_renyi(M, N, 4, seed=seed, values="normal")
+    rng = np.random.default_rng(seed + 1)
+    A = rng.standard_normal((M, R))
+    B = rng.standard_normal((N, R))
+    return S, A, B
+
+
+def test_sddmm_matches_dense():
+    S, A, B = _setup()
+    dense = A @ B.T
+    expected = S.vals * dense[S.rows, S.cols]
+    np.testing.assert_allclose(oracle.sddmm(S, A, B), expected, rtol=1e-12)
+
+
+def test_spmm_a_matches_dense():
+    S, A, B = _setup()
+    expected = S.to_scipy() @ B
+    np.testing.assert_allclose(oracle.spmm_a(S, B), expected, rtol=1e-12)
+
+
+def test_spmm_b_matches_dense():
+    S, A, B = _setup()
+    expected = S.to_scipy().T @ A
+    np.testing.assert_allclose(oracle.spmm_b(S, A), expected, rtol=1e-12)
+
+
+def test_spmm_accumulates():
+    S, A, B = _setup()
+    out = oracle.spmm_a(S, B, A_in=A)
+    np.testing.assert_allclose(out, A + S.to_scipy() @ B, rtol=1e-12)
+
+
+def test_fused():
+    S, A, B = _setup()
+    mid = oracle.sddmm(S, A, B)
+    np.testing.assert_allclose(
+        oracle.fused_spmm_a(S, A, B),
+        S.with_values(mid).to_scipy() @ B,
+        rtol=1e-12,
+    )
+    np.testing.assert_allclose(
+        oracle.fused_spmm_b(S, A, B),
+        S.with_values(mid).to_scipy().T @ A,
+        rtol=1e-12,
+    )
+
+
+def test_dummy_dense_and_fingerprint():
+    X = oracle.dummy_dense(4, 3)
+    assert X[2, 1] == 2 * 3 + 1
+    assert oracle.fingerprint(np.array([1.0, 2.0])) == 5.0
