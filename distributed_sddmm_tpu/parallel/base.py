@@ -1,0 +1,251 @@
+"""Abstract distributed SDDMM/SpMM strategy: public API, buffers, perf.
+
+TPU-native counterpart of the reference's ``Distributed_Sparse``
+(`/root/reference/distributed_sparse.h:32-388`). Differences by design:
+
+* **Functional, global-array API.** Dense operands are global ``jax.Array``s
+  with a ``NamedSharding`` instead of per-rank Eigen buffers + submatrix
+  descriptors; ops return new arrays instead of mutating. The reference's
+  ``DenseSubmatrix`` bookkeeping (`distributed_sparse.h:20-30`) disappears:
+  ``dummy_initialize``'s fill ``value = globalRow * R + globalCol``
+  (`distributed_sparse.h:322-346`) becomes a global iota expression that XLA
+  materializes shard-locally.
+* **Sparse values travel in tile structure.** ``like_S_values`` returns a
+  sharded padded array aligned with the tile layout (see
+  ``parallel/sharding.py``) rather than a per-rank flat vector.
+* **Perf counters time whole public calls** around ``block_until_ready``; the
+  reference's intra-call region timers (`distributed_sparse.h:205-261`)
+  cannot exist inside one fused XLA program — use ``jax.profiler`` traces for
+  region-level attribution instead.
+"""
+
+from __future__ import annotations
+
+import abc
+import collections
+import time
+from typing import Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from distributed_sddmm_tpu.common import KernelMode, MatMode
+from distributed_sddmm_tpu.ops.kernels import LocalKernel, XlaKernel
+from distributed_sddmm_tpu.parallel.mesh import GridSpec
+from distributed_sddmm_tpu.parallel.sharding import TileSet
+
+
+class DistributedSparse(abc.ABC):
+    """Base class for the four communication-avoiding strategies."""
+
+    algorithm_name: str = ""
+    proc_grid_names: tuple = ()
+
+    def __init__(
+        self,
+        grid: GridSpec,
+        M: int,
+        N: int,
+        R: int,
+        c: int,
+        kernel: Optional[LocalKernel] = None,
+        dtype=jnp.float32,
+    ):
+        self.grid = grid
+        self.p = grid.p
+        self.M, self.N, self.R, self.c = M, N, R, c
+        self.kernel = kernel if kernel is not None else XlaKernel()
+        self.dtype = dtype
+        self.r_split = False  # overridden by R-splitting strategies
+        self.call_count: dict = collections.defaultdict(int)
+        self.total_time: dict = collections.defaultdict(float)
+        self._programs: dict = {}
+
+        # Subclasses must set these before use:
+        self.M_pad: int = -1
+        self.N_pad: int = -1
+        self.a_spec: P = None
+        self.b_spec: P = None
+        self.S_tiles: TileSet = None
+        self.ST_tiles: TileSet = None
+
+    # ------------------------------------------------------------------ #
+    # Dense buffer factories (reference `distributed_sparse.h:197-203`)
+    # ------------------------------------------------------------------ #
+
+    def a_sharding(self) -> NamedSharding:
+        return NamedSharding(self.grid.mesh, self.a_spec)
+
+    def b_sharding(self) -> NamedSharding:
+        return NamedSharding(self.grid.mesh, self.b_spec)
+
+    def like_a_matrix(self, value: float) -> jax.Array:
+        return jax.jit(
+            lambda: jnp.full((self.M_pad, self.R), value, self.dtype),
+            out_shardings=self.a_sharding(),
+        )()
+
+    def like_b_matrix(self, value: float) -> jax.Array:
+        return jax.jit(
+            lambda: jnp.full((self.N_pad, self.R), value, self.dtype),
+            out_shardings=self.b_sharding(),
+        )()
+
+    def dummy_initialize(self, mode: MatMode) -> jax.Array:
+        """Deterministic ``value = globalRow * R + globalCol`` fill.
+
+        Layout-independent by construction — the verification protocol
+        requires every strategy to produce identical global results from it
+        (`distributed_sparse.h:322-346`, `scratch.cpp:26-76`).
+        """
+        n_rows = self.M_pad if mode == MatMode.A else self.N_pad
+        sharding = self.a_sharding() if mode == MatMode.A else self.b_sharding()
+
+        def make():
+            r = jnp.arange(n_rows, dtype=self.dtype)[:, None]
+            col = jnp.arange(self.R, dtype=self.dtype)[None, :]
+            return r * self.R + col
+
+        return jax.jit(make, out_shardings=sharding)()
+
+    def put_a(self, host: np.ndarray) -> jax.Array:
+        """Place a host (M, R) matrix (padded to M_pad) onto the mesh."""
+        buf = np.zeros((self.M_pad, self.R), dtype=self.dtype)
+        buf[: host.shape[0]] = host
+        return jax.device_put(buf, self.a_sharding())
+
+    def put_b(self, host: np.ndarray) -> jax.Array:
+        buf = np.zeros((self.N_pad, self.R), dtype=self.dtype)
+        buf[: host.shape[0]] = host
+        return jax.device_put(buf, self.b_sharding())
+
+    def host_a(self, A: jax.Array) -> np.ndarray:
+        """Fetch A to host, stripping row padding."""
+        return np.asarray(A)[: self.M]
+
+    def host_b(self, B: jax.Array) -> np.ndarray:
+        return np.asarray(B)[: self.N]
+
+    # ------------------------------------------------------------------ #
+    # Sparse value factories (reference `distributed_sparse.h:189-195`)
+    # ------------------------------------------------------------------ #
+
+    def like_s_values(self, value: float) -> jax.Array:
+        return self.S_tiles.like_values(value)
+
+    def like_st_values(self, value: float) -> jax.Array:
+        return self.ST_tiles.like_values(value)
+
+    def scatter_s_values(self, host_vals: np.ndarray) -> jax.Array:
+        return self.S_tiles.scatter_values(host_vals)
+
+    def gather_s_values(self, dev_vals: jax.Array) -> np.ndarray:
+        return self.S_tiles.gather_values(dev_vals)
+
+    def scatter_st_values(self, host_vals: np.ndarray) -> jax.Array:
+        return self.ST_tiles.scatter_values(host_vals)
+
+    def gather_st_values(self, dev_vals: jax.Array) -> np.ndarray:
+        return self.ST_tiles.gather_values(dev_vals)
+
+    # ------------------------------------------------------------------ #
+    # Distributed ops — the public capability surface
+    # (reference `distributed_sparse.h:274-320`)
+    # ------------------------------------------------------------------ #
+
+    @abc.abstractmethod
+    def sddmm_a(self, A: jax.Array, B: jax.Array, s_vals: jax.Array) -> jax.Array:
+        """``vals = s_vals * (A @ B^T sampled at pattern(S))`` (tile layout)."""
+
+    @abc.abstractmethod
+    def sddmm_b(self, A: jax.Array, B: jax.Array, st_vals: jax.Array) -> jax.Array:
+        """SDDMM with values in S^T's tile layout."""
+
+    @abc.abstractmethod
+    def spmm_a(self, A: jax.Array, B: jax.Array, s_vals: jax.Array) -> jax.Array:
+        """Return ``S @ B`` in A's sharding (reference zeroes then accumulates,
+        `distributed_sparse.h:274-277`)."""
+
+    @abc.abstractmethod
+    def spmm_b(self, A: jax.Array, B: jax.Array, st_vals: jax.Array) -> jax.Array:
+        """Return ``S^T @ A`` in B's sharding."""
+
+    def fused_spmm(
+        self,
+        A: jax.Array,
+        B: jax.Array,
+        s_vals: jax.Array,
+        mode: MatMode = MatMode.A,
+    ) -> tuple[jax.Array, jax.Array]:
+        """SDDMM -> SpMM fusion. Returns ``(new_dense, sddmm_vals)``.
+
+        Base implementation chains the two ops ("replication reuse" shape,
+        `distributed_sparse.h:296-312`); subclasses override with fused
+        single-loop programs ("local kernel overlap").
+        """
+        if mode == MatMode.A:
+            mid = self.sddmm_a(A, B, s_vals)
+            return self.spmm_a(A, B, mid), mid
+        mid = self.sddmm_b(A, B, s_vals)
+        return self.spmm_b(A, B, mid), mid
+
+    def initial_shift(self, A, B, mode: KernelMode):
+        """Pre-skew dense operands if the strategy needs it (no-op default;
+        reference `distributed_sparse.h:266-268`)."""
+        return A, B
+
+    def de_shift(self, A, B, mode: KernelMode):
+        return A, B
+
+    # ------------------------------------------------------------------ #
+    # Verification fingerprints (reference `scratch.cpp:26-76`)
+    # ------------------------------------------------------------------ #
+
+    @staticmethod
+    def fingerprint(x: jax.Array) -> float:
+        x64 = np.asarray(x, dtype=np.float64)
+        return float(np.sum(x64 * x64))
+
+    # ------------------------------------------------------------------ #
+    # Performance counters (reference `distributed_sparse.h:205-261`)
+    # ------------------------------------------------------------------ #
+
+    def _timed(self, name: str, fn, *args):
+        t0 = time.perf_counter()
+        out = fn(*args)
+        jax.block_until_ready(out)
+        self.total_time[name] += time.perf_counter() - t0
+        self.call_count[name] += 1
+        return out
+
+    def reset_performance_timers(self) -> None:
+        self.call_count.clear()
+        self.total_time.clear()
+
+    def json_perf_statistics(self) -> dict:
+        return {k: self.total_time[k] for k in sorted(self.total_time)}
+
+    def json_algorithm_info(self) -> dict:
+        """Same record schema as the reference (`distributed_sparse.h:131-179`)."""
+        dims = [self.grid.nr, self.grid.nc, self.grid.nh]
+        return {
+            "alg_name": self.algorithm_name,
+            "m": self.M,
+            "n": self.N,
+            "nnz": self.S_tiles.nnz if self.S_tiles else 0,
+            "r": self.R,
+            "adjacency_mode": self.grid.adjacency,
+            "p": self.p,
+            "c": self.c,
+            "dim_interpretations": list(self.proc_grid_names),
+            "dim_values": dims[: len(self.proc_grid_names)],
+            "nnz_procs": self.S_tiles.nnz_per_device.reshape(-1).tolist()
+            if self.S_tiles
+            else [],
+            "nnz_tpose_procs": self.ST_tiles.nnz_per_device.reshape(-1).tolist()
+            if self.ST_tiles
+            else [],
+        }

@@ -1,0 +1,281 @@
+"""1.5D dense-shift algorithm with both SDDMM->SpMM fusion strategies.
+
+TPU-native redesign of the reference's ``Sparse15D_Dense_Shift``
+(`/root/reference/15D_dense_shift.hpp:48-385`):
+
+* Process grid ``(p/c) x c x 1`` -> mesh axes ``rows x cols`` (layers unused).
+* Sparse S stays put, block-row-replicated via the
+  :class:`~distributed_sddmm_tpu.parallel.layouts.ShardedBlockCyclicColumn`
+  layout; tiles are pre-skewed into step order at ingest so the shift loop
+  indexes them statically.
+* The stationary dense operand is replicated over the ``cols`` axis with
+  ``lax.all_gather`` (reference ``MPI_Allgather`` over ``row_world``,
+  `15D_dense_shift.hpp:306-314`), and SpMM partials are reduced with
+  ``lax.psum_scatter`` (reference ``MPI_Reduce_scatter``,
+  `15D_dense_shift.hpp:370-383`).
+* The moving dense operand rotates around the ``rows`` axis with
+  ``lax.ppermute`` (reference ``MPI_Sendrecv`` + ``BufferPair``,
+  `distributed_sparse.h:351-361`); XLA double-buffers and overlaps the
+  permute with the local kernels, which is what the reference's
+  ``BufferPair`` achieved by hand.
+* ``fusion_approach=2`` ("local kernel overlap", `15D_dense_shift.hpp:151-252`)
+  runs SDDMM and SpMM per tile inside ONE shift loop: one all_gather + one
+  psum_scatter total. ``fusion_approach=1`` ("replication reuse") shares one
+  replicated buffer across back-to-back SDDMM and SpMM ring passes inside a
+  single compiled program. Both produce identical results; unlike the
+  reference (comment at `15D_dense_shift.hpp:250-251`), the fused path here
+  does fill and return the SDDMM values.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+from jax import shard_map
+
+from distributed_sddmm_tpu.common import MatMode, divide_round_up
+from distributed_sddmm_tpu.parallel.base import DistributedSparse
+from distributed_sddmm_tpu.parallel.layouts import ShardedBlockCyclicColumn
+from distributed_sddmm_tpu.parallel.mesh import make_grid
+from distributed_sddmm_tpu.parallel.sharding import build_tiles
+from distributed_sddmm_tpu.utils.coo import HostCOO
+
+_DENSE_SPEC = P(("rows", "cols"), None)
+# The layers axis is unused (nh=1); leaving it out of the tile spec lets
+# shard_map statically prove dense outputs are replicated over it.
+_TILE_SPEC = P("rows", "cols", None, None, None)
+
+
+class DenseShift15D(DistributedSparse):
+    algorithm_name = "1.5D Block Row Replicated S Striped AB Cyclic Shift"
+    proc_grid_names = ("# Rows", "# Layers")
+
+    def __init__(
+        self,
+        S: HostCOO,
+        R: int,
+        c: int = 1,
+        fusion_approach: int = 2,
+        kernel=None,
+        adjacency: int = 1,
+        devices=None,
+        dtype=jnp.float32,
+        unroll: bool = True,
+    ):
+        if devices is None:
+            devices = jax.devices()
+        p = len(devices)
+        if p % c != 0:
+            raise ValueError(f"1.5D algorithm requires c | p (p={p}, c={c})")
+        if fusion_approach not in (1, 2):
+            raise ValueError("fusion_approach must be 1 or 2")
+        grid = make_grid(p // c, c, 1, adjacency=adjacency, devices=devices)
+        super().__init__(grid, S.M, S.N, R, c, kernel=kernel, dtype=dtype)
+        self.fusion_approach = fusion_approach
+        self.unroll = unroll
+        self.nr = p // c
+
+        # Padded uniform block geometry (reference divideAndRoundUp,
+        # `15D_dense_shift.hpp:91-92`).
+        self.localArows = divide_round_up(S.M, p)
+        self.localBrows = divide_round_up(S.N, p)
+        self.M_pad = self.localArows * p
+        self.N_pad = self.localBrows * p
+        self.a_spec = _DENSE_SPEC
+        self.b_spec = _DENSE_SPEC
+
+        layout_s = ShardedBlockCyclicColumn(self.M_pad, self.N_pad, p, c)
+        layout_st = ShardedBlockCyclicColumn(self.N_pad, self.M_pad, p, c)
+        self.S_tiles = build_tiles(
+            S, grid, layout_s,
+            tile_rows=self.localArows * c, tile_cols=self.localBrows, dtype=dtype,
+        )
+        self.ST_tiles = build_tiles(
+            S.transpose(), grid, layout_st,
+            tile_rows=self.localBrows * c, tile_cols=self.localArows, dtype=dtype,
+        )
+
+    def set_r_value(self, R: int) -> None:
+        """Change the inner dimension (reference ``setRValue``,
+        `15D_dense_shift.hpp:128-140`). Programs retrace per distinct shape."""
+        self.R = R
+
+    # ------------------------------------------------------------------ #
+    # shard_map programs
+    # ------------------------------------------------------------------ #
+
+    def _ring_perm(self):
+        nr = self.nr
+        return [(k, (k + 1) % nr) for k in range(nr)]
+
+    def _program(self, op: str, use_st: bool):
+        """Build (and cache) the jitted shard_map program for one op.
+
+        ``op`` in {"sddmm", "spmm", "fused", "fused_twopass"}; ``use_st``
+        selects the transposed tile set (B-output variants). The moving
+        operand always rotates along the ``rows`` axis; the stationary
+        operand is replicated over the ``cols`` axis.
+        """
+        key = (op, use_st)
+        if key in self._programs:
+            return self._programs[key]
+
+        tiles = self.ST_tiles if use_st else self.S_tiles
+        nr, c = self.nr, self.c
+        T, max_nnz = tiles.n_tiles, tiles.max_nnz
+        stat_rows = tiles.tile_rows  # stationary/output frame height
+        kern = self.kernel
+        perm = self._ring_perm()
+
+        def shift(x):
+            return lax.ppermute(x, "rows", perm)
+
+        def replicate(stat_blk):
+            if c == 1:
+                return stat_blk
+            return lax.all_gather(stat_blk, "cols", axis=0, tiled=True)
+
+        def reduce_out(acc):
+            if c == 1:
+                return acc
+            return lax.psum_scatter(acc, "cols", scatter_dimension=0, tiled=True)
+
+        def squeeze(t):
+            return t.reshape(T, max_nnz)
+
+        def sddmm_pass(stat_rep, mov, t_rows, t_cols, t_vals, out_vals):
+            for s in range(nr):
+                dots = kern.sddmm(t_rows[s], t_cols[s], t_vals[s], stat_rep, mov)
+                out_vals = out_vals.at[s].set(dots)
+                if s < nr - 1:
+                    mov = shift(mov)
+            return out_vals, mov
+
+        def spmm_pass(mov, t_rows, t_cols, vals_tiles, acc):
+            for s in range(nr):
+                acc = acc + kern.spmm(t_rows[s], t_cols[s], vals_tiles[s], mov, stat_rows)
+                if s < nr - 1:
+                    mov = shift(mov)
+            return acc, mov
+
+        dense_spec = _DENSE_SPEC
+        mesh = self.grid.mesh
+
+        if op == "sddmm":
+
+            def prog(stat, mov, t_rows, t_cols, t_vals):
+                t_rows, t_cols, t_vals = squeeze(t_rows), squeeze(t_cols), squeeze(t_vals)
+                stat_rep = replicate(stat)
+                out_vals = jnp.zeros((T, max_nnz), t_vals.dtype)
+                out_vals, _ = sddmm_pass(stat_rep, mov, t_rows, t_cols, t_vals, out_vals)
+                return out_vals.reshape(1, 1, 1, T, max_nnz)
+
+            in_specs = (dense_spec, dense_spec, _TILE_SPEC, _TILE_SPEC, _TILE_SPEC)
+            out_specs = _TILE_SPEC
+
+        elif op == "spmm":
+
+            def prog(mov, t_rows, t_cols, t_vals):
+                t_rows, t_cols, t_vals = squeeze(t_rows), squeeze(t_cols), squeeze(t_vals)
+                acc = jnp.zeros((stat_rows, mov.shape[1]), mov.dtype)
+                acc, _ = spmm_pass(mov, t_rows, t_cols, t_vals, acc)
+                return reduce_out(acc)
+
+            in_specs = (dense_spec, _TILE_SPEC, _TILE_SPEC, _TILE_SPEC)
+            out_specs = dense_spec
+
+        elif op == "fused":
+            # fusion 2, "local kernel overlap": SDDMM + SpMM per tile inside
+            # one ring traversal (`15D_dense_shift.hpp:199-227`).
+
+            def prog(stat, mov, t_rows, t_cols, t_vals):
+                t_rows, t_cols, t_vals = squeeze(t_rows), squeeze(t_cols), squeeze(t_vals)
+                stat_rep = replicate(stat)
+                acc = jnp.zeros((stat_rows, mov.shape[1]), mov.dtype)
+                out_vals = jnp.zeros((T, max_nnz), t_vals.dtype)
+                for s in range(nr):
+                    mid = kern.sddmm(t_rows[s], t_cols[s], t_vals[s], stat_rep, mov)
+                    out_vals = out_vals.at[s].set(mid)
+                    acc = acc + kern.spmm(t_rows[s], t_cols[s], mid, mov, stat_rows)
+                    if s < nr - 1:
+                        mov = shift(mov)
+                return reduce_out(acc), out_vals.reshape(1, 1, 1, T, max_nnz)
+
+            in_specs = (dense_spec, dense_spec, _TILE_SPEC, _TILE_SPEC, _TILE_SPEC)
+            out_specs = (dense_spec, _TILE_SPEC)
+
+        elif op == "fused_twopass":
+            # fusion 1, "replication reuse": one all_gather feeds two ring
+            # passes (SDDMM then SpMM) in one compiled program — the
+            # functional equivalent of `initial_replicate=false` on the
+            # second call (`distributed_sparse.h:296-312`).
+
+            def prog(stat, mov, t_rows, t_cols, t_vals):
+                t_rows, t_cols, t_vals = squeeze(t_rows), squeeze(t_cols), squeeze(t_vals)
+                stat_rep = replicate(stat)
+                out_vals = jnp.zeros((T, max_nnz), t_vals.dtype)
+                out_vals, mov = sddmm_pass(stat_rep, mov, t_rows, t_cols, t_vals, out_vals)
+                if nr > 1:
+                    mov = shift(mov)  # complete the first rotation
+                acc = jnp.zeros((stat_rows, mov.shape[1]), mov.dtype)
+                acc, _ = spmm_pass(mov, t_rows, t_cols, out_vals, acc)
+                return reduce_out(acc), out_vals.reshape(1, 1, 1, T, max_nnz)
+
+            in_specs = (dense_spec, dense_spec, _TILE_SPEC, _TILE_SPEC, _TILE_SPEC)
+            out_specs = (dense_spec, _TILE_SPEC)
+
+        else:
+            raise ValueError(op)
+
+        fn = jax.jit(
+            shard_map(prog, mesh=mesh, in_specs=in_specs, out_specs=out_specs)
+        )
+        self._programs[key] = fn
+        return fn
+
+    # ------------------------------------------------------------------ #
+    # Public ops
+    # ------------------------------------------------------------------ #
+
+    def sddmm_a(self, A, B, s_vals):
+        prog = self._program("sddmm", use_st=False)
+        return self._timed(
+            "sddmmA", prog, A, B, self.S_tiles.rows, self.S_tiles.cols, s_vals
+        )
+
+    def sddmm_b(self, A, B, st_vals):
+        prog = self._program("sddmm", use_st=True)
+        return self._timed(
+            "sddmmB", prog, B, A, self.ST_tiles.rows, self.ST_tiles.cols, st_vals
+        )
+
+    def spmm_a(self, A, B, s_vals):
+        prog = self._program("spmm", use_st=False)
+        out = self._timed(
+            "spmmA", prog, B, self.S_tiles.rows, self.S_tiles.cols, s_vals
+        )
+        return out
+
+    def spmm_b(self, A, B, st_vals):
+        prog = self._program("spmm", use_st=True)
+        return self._timed(
+            "spmmB", prog, A, self.ST_tiles.rows, self.ST_tiles.cols, st_vals
+        )
+
+    def fused_spmm(self, A, B, s_vals, mode: MatMode = MatMode.A):
+        op = "fused" if self.fusion_approach == 2 else "fused_twopass"
+        if mode == MatMode.A:
+            prog = self._program(op, use_st=False)
+            out, mid = self._timed(
+                "fusedSpMM", prog, A, B, self.S_tiles.rows, self.S_tiles.cols, s_vals
+            )
+            return out, mid
+        prog = self._program(op, use_st=True)
+        out, mid = self._timed(
+            "fusedSpMM", prog, B, A, self.ST_tiles.rows, self.ST_tiles.cols, s_vals
+        )
+        return out, mid

@@ -1,0 +1,72 @@
+"""Nonzero distributions: which device owns each nonzero, and in which tile.
+
+Counterparts of the reference's ``NonzeroDistribution`` subclasses
+(`/root/reference/SpmatLocal.hpp:34-53` and the per-algorithm layouts in
+`15D_dense_shift.hpp:22-42`, `15D_sparse_shift.hpp:23-45`,
+`25D_cannon_dense.hpp:26-46`, `25D_cannon_sparse.hpp:25-40`). Where the
+reference redistributes with ``MPI_Alltoallv`` at setup
+(`SpmatLocal.hpp:389-462`), we evaluate these pure vectorized maps on the host
+and build sharded device arrays directly — one-time numpy cost, no wire
+traffic to tune.
+
+A layout maps every nonzero ``(r, c)`` to:
+
+* a grid coordinate ``(i, j, k)`` on the 3-D mesh,
+* a tile id ``t`` (which block the nonzero lands in on that device), and
+* tile-local coordinates ``(lr, lc)``.
+
+All outputs are int64 numpy arrays, vectorized over the nnz dimension.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+from distributed_sddmm_tpu.common import divide_round_up
+
+
+@dataclasses.dataclass(frozen=True)
+class LayoutResult:
+    i: np.ndarray
+    j: np.ndarray
+    k: np.ndarray
+    tile: np.ndarray
+    local_r: np.ndarray
+    local_c: np.ndarray
+
+
+class ShardedBlockCyclicColumn:
+    """1.5D dense-shift layout (`15D_dense_shift.hpp:22-42`).
+
+    Grid is ``(p/c) x c x 1``. Device ``(i, j)`` owns the global row block
+    ``i`` of height ``rows_per_proc * c`` and every column block with
+    ``col_block % c == j``. Tiles are the p/c owned block-columns, stored in
+    **step order**: slot ``s`` holds the block-column the shift loop needs at
+    step ``s`` (``col_block = ((i - s) mod p/c) * c + j``), so the unrolled
+    shard_map loop indexes tiles statically.
+    """
+
+    def __init__(self, M: int, N: int, p: int, c: int):
+        self.p, self.c = p, c
+        self.rows_per_proc = divide_round_up(M, p)
+        self.cols_per_proc = divide_round_up(N, p)
+        self.n_tiles = p // c
+
+    def __call__(self, rows: np.ndarray, cols: np.ndarray) -> LayoutResult:
+        nr = self.p // self.c
+        row_block = rows // (self.rows_per_proc * self.c)
+        col_block = cols // self.cols_per_proc
+        i = row_block
+        j = col_block % self.c
+        t = col_block // self.c  # owned block-column index, 0..p/c
+        slot = np.mod(i - t, nr)  # step at which the shift loop visits tile t
+        return LayoutResult(
+            i=i,
+            j=j,
+            k=np.zeros_like(i),
+            tile=slot,
+            local_r=rows % (self.rows_per_proc * self.c),
+            local_c=cols % self.cols_per_proc,
+        )

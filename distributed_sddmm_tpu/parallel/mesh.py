@@ -46,6 +46,16 @@ _ADJACENCY_PERMUTATIONS = {
 }
 
 
+def _flat_rank(adjacency: int, dims: tuple, i: int, j: int, k: int) -> int:
+    """Grid coordinate -> flat device index (`FlexibleGrid.hpp:124-135`)."""
+    perm = _ADJACENCY_PERMUTATIONS[adjacency]
+    coord = (i, j, k)
+    rank = coord[perm[0]]
+    rank += coord[perm[1]] * dims[perm[0]]
+    rank += coord[perm[2]] * dims[perm[0]] * dims[perm[1]]
+    return rank
+
+
 @dataclasses.dataclass(frozen=True)
 class GridSpec:
     """A named 3-D mesh plus its construction metadata."""
@@ -64,14 +74,7 @@ class GridSpec:
         return NamedSharding(self.mesh, P(*spec))
 
     def flat_rank(self, i: int, j: int, k: int) -> int:
-        """Grid coordinate -> flat device index (`FlexibleGrid.hpp:124-135`)."""
-        perm = _ADJACENCY_PERMUTATIONS[self.adjacency]
-        dims = (self.nr, self.nc, self.nh)
-        coord = (i, j, k)
-        rank = coord[perm[0]]
-        rank += coord[perm[1]] * dims[perm[0]]
-        rank += coord[perm[2]] * dims[perm[0]] * dims[perm[1]]
-        return rank
+        return _flat_rank(self.adjacency, (self.nr, self.nc, self.nh), i, j, k)
 
     def grid_coords(self, rank: int) -> tuple[int, int, int]:
         """Flat device index -> grid coordinate (`FlexibleGrid.hpp:105-117`)."""
@@ -106,11 +109,10 @@ def make_grid(
             f"grid {nr}x{nc}x{nh} needs {nr * nc * nh} devices, have {len(devices)}"
         )
 
-    spec = GridSpec(mesh=None, nr=nr, nc=nc, nh=nh, adjacency=adjacency)  # temp
     dev_arr = np.empty((nr, nc, nh), dtype=object)
     for i in range(nr):
         for j in range(nc):
             for k in range(nh):
-                dev_arr[i, j, k] = devices[spec.flat_rank(i, j, k)]
+                dev_arr[i, j, k] = devices[_flat_rank(adjacency, (nr, nc, nh), i, j, k)]
     mesh = Mesh(dev_arr, (ROWS, COLS, LAYERS))
-    return dataclasses.replace(spec, mesh=mesh)
+    return GridSpec(mesh=mesh, nr=nr, nc=nc, nh=nh, adjacency=adjacency)

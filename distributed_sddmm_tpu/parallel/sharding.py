@@ -1,0 +1,150 @@
+"""Host-side nonzero redistribution: HostCOO + layout -> sharded device tiles.
+
+Replaces the reference's ``redistribute_nonzeros`` / ``divideIntoBlockCols`` /
+``initializeCSRBlocks`` pipeline (`/root/reference/SpmatLocal.hpp:314-462`):
+instead of an ``MPI_Alltoallv`` shuffle followed by per-rank MKL COO->CSR
+conversion, we bucket nonzeros on the host with one argsort and materialize a
+single global ``jax.Array`` per field, sharded over the mesh.
+
+Static-shape contract: every (device, tile) bucket is padded to the global
+``max_nnz`` with inert entries (row=col=0, mask=0). This is the XLA-friendly
+generalization of the reference's own max_nnz double buffers
+(`SpmatLocal.hpp:153-169`).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from distributed_sddmm_tpu.parallel.mesh import GridSpec
+from distributed_sddmm_tpu.utils.coo import HostCOO
+
+TILE_SPEC = P("rows", "cols", "layers", None, None)
+
+
+@dataclasses.dataclass
+class TileSet:
+    """Sharded, padded, struct-of-arrays sparse tiles.
+
+    ``rows/cols/mask`` have global shape ``(nr, nc, nh, T, max_nnz)`` sharded
+    over the first three (mesh) axes; each device sees its ``(T, max_nnz)``
+    tiles inside shard_map. Values travel separately in the same shape (the
+    reference's separation of structure from ``SValues`` vectors,
+    `distributed_sparse.h:189-195`).
+    """
+
+    rows: jax.Array
+    cols: jax.Array
+    mask: jax.Array
+    scatter_index: np.ndarray  # original nnz order -> flat padded position
+    tile_rows: int  # local tile frame height (rows the local indices address)
+    tile_cols: int
+    nnz: int
+    grid: GridSpec
+    nnz_per_device: np.ndarray  # (nr, nc, nh) — load-imbalance observability
+
+    @property
+    def shape(self) -> tuple:
+        return tuple(self.rows.shape)
+
+    @property
+    def max_nnz(self) -> int:
+        return self.rows.shape[-1]
+
+    @property
+    def n_tiles(self) -> int:
+        return self.rows.shape[-2]
+
+    def _sharding(self) -> NamedSharding:
+        return NamedSharding(self.grid.mesh, TILE_SPEC)
+
+    def like_values(self, value: float) -> jax.Array:
+        """Constant values at every real nonzero (reference ``like_S_values``,
+        `distributed_sparse.h:189-191`)."""
+        return self.mask * value
+
+    def scatter_values(self, host_vals: np.ndarray) -> jax.Array:
+        """Place a host vector (original nonzero order) into tile structure."""
+        host_vals = np.asarray(host_vals)
+        if host_vals.shape != (self.nnz,):
+            raise ValueError(f"expected ({self.nnz},) values, got {host_vals.shape}")
+        buf = np.zeros(int(np.prod(self.shape)), dtype=self.mask.dtype)
+        buf[self.scatter_index] = host_vals
+        return jax.device_put(buf.reshape(self.shape), self._sharding())
+
+    def gather_values(self, dev_vals: jax.Array) -> np.ndarray:
+        """Extract values back to the original host nonzero order."""
+        return np.asarray(dev_vals).reshape(-1)[self.scatter_index]
+
+
+def build_tiles(
+    S: HostCOO,
+    grid: GridSpec,
+    layout,
+    tile_rows: int,
+    tile_cols: int,
+    dtype=jnp.float32,
+    min_pad: int = 1,
+) -> TileSet:
+    """Bucket ``S``'s nonzeros by (device, tile) and pad to a static shape.
+
+    ``layout`` is called with ``(rows, cols)`` and must return a
+    :class:`~distributed_sddmm_tpu.parallel.layouts.LayoutResult`; its
+    ``n_tiles`` attribute fixes T. ``min_pad`` keeps max_nnz >= 1 so empty
+    matrices still produce valid static shapes.
+    """
+    nr, nc, nh = grid.nr, grid.nc, grid.nh
+    T = layout.n_tiles
+    res = layout(S.rows, S.cols)
+    if res.i.size:
+        assert res.i.max() < nr and res.j.max() < nc and res.k.max() < nh, (
+            "layout produced out-of-grid coordinates"
+        )
+        assert res.tile.max() < T, "layout produced out-of-range tile id"
+
+    dev = (res.i * nc + res.j) * nh + res.k
+    bucket = dev * T + res.tile
+    n_buckets = nr * nc * nh * T
+
+    order = np.argsort(bucket, kind="stable")
+    sorted_bucket = bucket[order]
+    counts = np.bincount(sorted_bucket, minlength=n_buckets)
+    max_nnz = max(int(counts.max(initial=0)), min_pad)
+    starts = np.zeros(n_buckets, dtype=np.int64)
+    np.cumsum(counts[:-1], out=starts[1:])
+
+    # Position of each (sorted) nonzero within its bucket.
+    within = np.arange(S.nnz, dtype=np.int64) - starts[sorted_bucket]
+    pos_sorted = sorted_bucket * max_nnz + within
+    scatter_index = np.empty(S.nnz, dtype=np.int64)
+    scatter_index[order] = pos_sorted
+
+    total = n_buckets * max_nnz
+    rows_flat = np.zeros(total, dtype=np.int32)
+    cols_flat = np.zeros(total, dtype=np.int32)
+    mask_flat = np.zeros(total, dtype=np.dtype(dtype))
+    rows_flat[scatter_index] = res.local_r
+    cols_flat[scatter_index] = res.local_c
+    mask_flat[scatter_index] = 1
+
+    shape = (nr, nc, nh, T, max_nnz)
+    sharding = NamedSharding(grid.mesh, TILE_SPEC)
+    nnz_per_device = np.bincount(dev, minlength=nr * nc * nh).reshape(nr, nc, nh)
+
+    return TileSet(
+        rows=jax.device_put(rows_flat.reshape(shape), sharding),
+        cols=jax.device_put(cols_flat.reshape(shape), sharding),
+        mask=jax.device_put(mask_flat.reshape(shape), sharding),
+        scatter_index=scatter_index,
+        tile_rows=tile_rows,
+        tile_cols=tile_cols,
+        nnz=S.nnz,
+        grid=grid,
+        nnz_per_device=nnz_per_device,
+    )

@@ -80,7 +80,9 @@ class HostCOO:
         )
 
     def with_values(self, vals: np.ndarray) -> "HostCOO":
-        return HostCOO(self.rows, self.cols, np.asarray(vals), self.M, self.N)
+        return HostCOO(
+            self.rows.copy(), self.cols.copy(), np.array(vals), self.M, self.N
+        )
 
     def sorted_by_row(self) -> "HostCOO":
         order = np.lexsort((self.cols, self.rows))

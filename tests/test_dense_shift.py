@@ -1,0 +1,158 @@
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from distributed_sddmm_tpu.common import MatMode
+from distributed_sddmm_tpu.parallel.dense_shift_15d import DenseShift15D
+from distributed_sddmm_tpu.utils import oracle
+from distributed_sddmm_tpu.utils.coo import HostCOO
+
+
+def _problem(M=64, N=48, R=8, seed=0):
+    S = HostCOO.erdos_renyi(M, N, 4, seed=seed, values="normal")
+    return S
+
+
+def _dense_inputs(alg):
+    A = alg.dummy_initialize(MatMode.A)
+    B = alg.dummy_initialize(MatMode.B)
+    A_host = oracle.dummy_dense(alg.M_pad, alg.R)
+    B_host = oracle.dummy_dense(alg.N_pad, alg.R)
+    return A, B, A_host, B_host
+
+
+CONFIGS = [(1, 1), (1, 2), (2, 1), (2, 2), (4, 1), (4, 2), (8, 1), (8, 2)]
+# (c, fusion_approach) on the 8-device CPU mesh
+
+
+@pytest.mark.parametrize("c,fusion", CONFIGS)
+def test_sddmm_a_matches_oracle(c, fusion):
+    S = _problem()
+    alg = DenseShift15D(S, R=8, c=c, fusion_approach=fusion)
+    A, B, A_host, B_host = _dense_inputs(alg)
+    s_vals = alg.scatter_s_values(S.vals)
+    out = alg.sddmm_a(A, B, s_vals)
+    expected = oracle.sddmm(S, A_host, B_host)
+    np.testing.assert_allclose(alg.gather_s_values(out), expected, rtol=1e-4)
+
+
+@pytest.mark.parametrize("c,fusion", [(1, 2), (2, 2), (4, 1), (8, 2)])
+def test_sddmm_b_matches_oracle(c, fusion):
+    S = _problem()
+    alg = DenseShift15D(S, R=8, c=c, fusion_approach=fusion)
+    A, B, A_host, B_host = _dense_inputs(alg)
+    st_vals = alg.scatter_st_values(S.transpose().vals)
+    out = alg.sddmm_b(A, B, st_vals)
+    expected = oracle.sddmm(S.transpose(), B_host, A_host)
+    np.testing.assert_allclose(alg.gather_st_values(out), expected, rtol=1e-4)
+
+
+@pytest.mark.parametrize("c,fusion", CONFIGS)
+def test_spmm_a_matches_oracle(c, fusion):
+    S = _problem()
+    alg = DenseShift15D(S, R=8, c=c, fusion_approach=fusion)
+    A, B, A_host, B_host = _dense_inputs(alg)
+    s_vals = alg.scatter_s_values(S.vals)
+    out = alg.spmm_a(A, B, s_vals)
+    expected = oracle.spmm_a(S, B_host)
+    np.testing.assert_allclose(alg.host_a(out)[: S.M], expected, rtol=1e-4, atol=1e-3)
+
+
+@pytest.mark.parametrize("c", [1, 2, 4])
+def test_spmm_b_matches_oracle(c):
+    S = _problem()
+    alg = DenseShift15D(S, R=8, c=c)
+    A, B, A_host, B_host = _dense_inputs(alg)
+    st_vals = alg.scatter_st_values(S.transpose().vals)
+    out = alg.spmm_b(A, B, st_vals)
+    expected = oracle.spmm_b(S, A_host)
+    np.testing.assert_allclose(alg.host_b(out)[: S.N], expected, rtol=1e-4, atol=1e-3)
+
+
+@pytest.mark.parametrize("c,fusion", CONFIGS)
+def test_fused_spmm_matches_oracle(c, fusion):
+    S = _problem()
+    alg = DenseShift15D(S, R=8, c=c, fusion_approach=fusion)
+    A, B, A_host, B_host = _dense_inputs(alg)
+    s_vals = alg.scatter_s_values(S.vals)
+    out, mid = alg.fused_spmm(A, B, s_vals, MatMode.A)
+    expected_mid = oracle.sddmm(S, A_host, B_host)
+    expected = oracle.fused_spmm_a(S, A_host, B_host)
+    np.testing.assert_allclose(alg.gather_s_values(mid), expected_mid, rtol=1e-4)
+    np.testing.assert_allclose(
+        alg.host_a(out)[: S.M], expected, rtol=1e-3, atol=1e-2
+    )
+
+
+def test_fused_spmm_bmat():
+    S = _problem()
+    alg = DenseShift15D(S, R=8, c=2, fusion_approach=2)
+    A, B, A_host, B_host = _dense_inputs(alg)
+    st_vals = alg.scatter_st_values(S.transpose().vals)
+    out, mid = alg.fused_spmm(A, B, st_vals, MatMode.B)
+    expected = oracle.fused_spmm_b(S, A_host, B_host)
+    np.testing.assert_allclose(
+        alg.host_b(out)[: S.N], expected, rtol=1e-3, atol=1e-2
+    )
+
+
+def test_non_divisible_dims_padded():
+    """M=30 pads to 32; padded rows are inert."""
+    S = HostCOO.erdos_renyi(30, 23, 3, seed=1, values="normal")
+    alg = DenseShift15D(S, R=4, c=2)
+    assert alg.M_pad == 32 and alg.N_pad == 24
+    A, B, A_host, B_host = _dense_inputs(alg)
+    s_vals = alg.scatter_s_values(S.vals)
+    out = alg.spmm_a(A, B, s_vals)
+    np.testing.assert_allclose(
+        alg.host_a(out)[: S.M], oracle.spmm_a(S, B_host), rtol=1e-4, atol=1e-3
+    )
+
+
+def test_fingerprints_match_across_configs():
+    """The reference's verification protocol (`scratch.cpp:26-76`): identical
+    fingerprints from dummy inputs across every (c, fusion) config."""
+    S = _problem()
+    fps = []
+    for c, fusion in [(1, 2), (2, 1), (4, 2), (8, 1)]:
+        alg = DenseShift15D(S, R=8, c=c, fusion_approach=fusion)
+        A, B, _, _ = _dense_inputs(alg)
+        s_vals = alg.scatter_s_values(S.vals)
+        out = alg.spmm_a(A, B, s_vals)
+        fps.append(alg.fingerprint(alg.host_a(out)[: S.M]))
+    np.testing.assert_allclose(fps, fps[0], rtol=1e-5)
+
+
+def test_like_matrices_and_values():
+    S = _problem()
+    alg = DenseShift15D(S, R=8, c=2)
+    A = alg.like_a_matrix(3.0)
+    assert A.shape == (alg.M_pad, 8)
+    assert float(A[0, 0]) == 3.0
+    v = alg.like_s_values(2.0)
+    np.testing.assert_allclose(alg.gather_s_values(v), np.full(S.nnz, 2.0))
+    # scatter/gather roundtrip
+    rt = alg.gather_s_values(alg.scatter_s_values(S.vals))
+    np.testing.assert_allclose(rt, S.vals, rtol=1e-6)
+
+
+def test_requires_c_divides_p():
+    S = _problem()
+    with pytest.raises(ValueError):
+        DenseShift15D(S, R=8, c=3)
+    with pytest.raises(ValueError):
+        DenseShift15D(S, R=8, c=1, fusion_approach=3)
+
+
+def test_perf_counters_populate():
+    S = _problem()
+    alg = DenseShift15D(S, R=8, c=2)
+    A, B, _, _ = _dense_inputs(alg)
+    s_vals = alg.scatter_s_values(S.vals)
+    alg.spmm_a(A, B, s_vals)
+    stats = alg.json_perf_statistics()
+    assert "spmmA" in stats and stats["spmmA"] > 0
+    info = alg.json_algorithm_info()
+    assert info["p"] == 8 and info["c"] == 2
+    assert sum(info["nnz_procs"]) == S.nnz
