@@ -1,0 +1,102 @@
+"""Headline benchmark: fused SDDMM+SpMM GFLOP/s per chip at R=128.
+
+Prints ONE JSON line: {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}.
+
+Mirrors the reference's primary entry point `bench_erdos_renyi`
+(`/root/reference/bench_erdos_renyi.cpp`) + `benchmark_algorithm`
+(`/root/reference/benchmark_dist.cpp:117-149`): Graph500-style R-mat input,
+fused SDDMM->SpMM pairs, throughput = 2*nnz*2*R*trials / elapsed.
+
+Baseline denominator: the only absolute figure recoverable from the reference
+repo is the weak-scaling point ~6.47 GFLOP/s (15d_sparse fused, 256 Cori-KNL
+ranks; ipdps_chart_generator.ipynb cell 10, see BASELINE.md). vs_baseline is
+value / 6.47 — i.e. this chip vs. a 256-rank Cori KNL job on the recoverable
+number.
+"""
+
+import json
+import os
+import sys
+import time
+
+
+def main() -> None:
+    import jax
+
+    from distributed_sddmm_tpu.common import MatMode
+    from distributed_sddmm_tpu.parallel.dense_shift_15d import DenseShift15D
+    from distributed_sddmm_tpu.utils.coo import HostCOO
+
+    log_m = int(os.environ.get("BENCH_LOG_M", "16"))
+    nnz_per_row = int(os.environ.get("BENCH_NNZ_PER_ROW", "32"))
+    R = int(os.environ.get("BENCH_R", "128"))
+    trials = int(os.environ.get("BENCH_TRIALS", "5"))
+    kernel_name = os.environ.get("BENCH_KERNEL", "auto")
+
+    from distributed_sddmm_tpu.ops import get_kernel
+
+    if kernel_name == "auto":
+        try:
+            kernel = get_kernel("pallas")
+        except (NotImplementedError, Exception):
+            kernel = get_kernel("xla")
+    else:
+        kernel = get_kernel(kernel_name)
+
+    S = HostCOO.rmat(log_m=log_m, edge_factor=nnz_per_row, seed=0)
+    n_dev = jax.device_count()
+    c = 1
+    alg = DenseShift15D(S, R=R, c=c, fusion_approach=2, kernel=kernel)
+
+    import jax.numpy as jnp
+
+    A = alg.dummy_initialize(MatMode.A)
+    B = alg.like_b_matrix(0.01)
+    s_vals = alg.like_s_values(1.0)
+
+    # Trials are CHAINED (each consumes the previous output, normalized to
+    # keep magnitudes finite) and the loop ends with a scalar host fetch.
+    # Rationale: on async/tunneled backends block_until_ready alone does not
+    # force execution, and independent same-input calls could be elided; a
+    # data-dependent chain plus one fetch guarantees every trial really ran.
+    norm = jax.jit(
+        lambda x: x * jax.lax.rsqrt(jnp.mean(x * x) + 1e-9),
+        out_shardings=alg.a_sharding(),
+    )
+
+    # Warmup (compile both programs)
+    out, _ = alg.fused_spmm(A, B, s_vals, MatMode.A)
+    A_t = norm(out)
+    float(A_t.sum())
+
+    t0 = time.perf_counter()
+    A_t = A
+    for _ in range(trials):
+        out, _ = alg.fused_spmm(A_t, B, s_vals, MatMode.A)
+        A_t = norm(out)
+    float(A_t.sum())  # forces the whole chain
+    elapsed = time.perf_counter() - t0
+
+    # Reference throughput formula (`benchmark_dist.cpp:147-149`).
+    flops = 2.0 * S.nnz * 2.0 * R * trials
+    gflops = flops / elapsed / 1e9
+    gflops_per_chip = gflops / n_dev
+
+    baseline = 6.47  # GFLOP/s, see module docstring
+    print(
+        json.dumps(
+            {
+                "metric": f"fused SDDMM+SpMM GFLOP/s/chip (R-mat 2^{log_m}, "
+                f"nnz/row={nnz_per_row}, R={R}, {kernel.name} kernel, "
+                f"{n_dev} chip(s))",
+                "value": round(gflops_per_chip, 3),
+                "unit": "GFLOP/s/chip",
+                "vs_baseline": round(gflops_per_chip / baseline, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    main()

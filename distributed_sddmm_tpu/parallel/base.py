@@ -82,17 +82,22 @@ class DistributedSparse(abc.ABC):
     def b_sharding(self) -> NamedSharding:
         return NamedSharding(self.grid.mesh, self.b_spec)
 
+    def _fill_program(self, n_rows: int, sharding):
+        """Cached constant-fill factory (value stays a traced argument so one
+        compile serves every fill value)."""
+        key = ("fill", n_rows, self.R, sharding)
+        if key not in self._programs:
+            self._programs[key] = jax.jit(
+                lambda v: jnp.full((n_rows, self.R), v, self.dtype),
+                out_shardings=sharding,
+            )
+        return self._programs[key]
+
     def like_a_matrix(self, value: float) -> jax.Array:
-        return jax.jit(
-            lambda: jnp.full((self.M_pad, self.R), value, self.dtype),
-            out_shardings=self.a_sharding(),
-        )()
+        return self._fill_program(self.M_pad, self.a_sharding())(value)
 
     def like_b_matrix(self, value: float) -> jax.Array:
-        return jax.jit(
-            lambda: jnp.full((self.N_pad, self.R), value, self.dtype),
-            out_shardings=self.b_sharding(),
-        )()
+        return self._fill_program(self.N_pad, self.b_sharding())(value)
 
     def dummy_initialize(self, mode: MatMode) -> jax.Array:
         """Deterministic ``value = globalRow * R + globalCol`` fill.
@@ -103,13 +108,16 @@ class DistributedSparse(abc.ABC):
         """
         n_rows = self.M_pad if mode == MatMode.A else self.N_pad
         sharding = self.a_sharding() if mode == MatMode.A else self.b_sharding()
+        key = ("dummy", n_rows, self.R, sharding)
+        if key not in self._programs:
 
-        def make():
-            r = jnp.arange(n_rows, dtype=self.dtype)[:, None]
-            col = jnp.arange(self.R, dtype=self.dtype)[None, :]
-            return r * self.R + col
+            def make():
+                r = jnp.arange(n_rows, dtype=self.dtype)[:, None]
+                col = jnp.arange(self.R, dtype=self.dtype)[None, :]
+                return r * self.R + col
 
-        return jax.jit(make, out_shardings=sharding)()
+            self._programs[key] = jax.jit(make, out_shardings=sharding)
+        return self._programs[key]()
 
     def put_a(self, host: np.ndarray) -> jax.Array:
         """Place a host (M, R) matrix (padded to M_pad) onto the mesh."""

@@ -130,9 +130,40 @@ class DenseShift15D(DistributedSparse):
         stat_rows = tiles.tile_rows  # stationary/output frame height
         kern = self.kernel
         perm = self._ring_perm()
+        unroll = self.unroll
 
         def shift(x):
             return lax.ppermute(x, "rows", perm)
+
+        def tile_at(arr, s):
+            # s is a Python int when unrolled, a traced index when rolled.
+            if unroll:
+                return arr[s]
+            return lax.dynamic_index_in_dim(arr, s, axis=0, keepdims=False)
+
+        def ring_loop(body, carry, mov, complete_rotation=False):
+            """Run ``carry = body(s, carry, mov)`` for s in 0..nr-1, rotating
+            ``mov`` between steps. Unrolled mode (default) lets XLA
+            software-pipeline the permutes; rolled mode (``unroll=False``)
+            bounds compile time on large meshes with a lax.fori_loop. With
+            ``complete_rotation`` the returned ``mov`` has made a full ring
+            trip (back at its starting block); otherwise it may be left
+            mid-rotation and should not be reused."""
+            if unroll:
+                for s in range(nr):
+                    carry = body(s, carry, mov)
+                    if s < nr - 1:
+                        mov = shift(mov)
+                if complete_rotation and nr > 1:
+                    mov = shift(mov)
+                return carry, mov
+
+            def f(s, state):
+                carry, mov = state
+                carry = body(s, carry, mov)
+                return (carry, shift(mov) if nr > 1 else mov)
+
+            return lax.fori_loop(0, nr, f, (carry, mov))
 
         def replicate(stat_blk):
             if c == 1:
@@ -147,20 +178,30 @@ class DenseShift15D(DistributedSparse):
         def squeeze(t):
             return t.reshape(T, max_nnz)
 
-        def sddmm_pass(stat_rep, mov, t_rows, t_cols, t_vals, out_vals):
-            for s in range(nr):
-                dots = kern.sddmm(t_rows[s], t_cols[s], t_vals[s], stat_rep, mov)
-                out_vals = out_vals.at[s].set(dots)
-                if s < nr - 1:
-                    mov = shift(mov)
-            return out_vals, mov
+        def vary(x):
+            # Mark loop-carry inits as device-varying so rolled fori_loop
+            # carries type-match after collectives touch them.
+            return lax.pvary(x, ("rows", "cols"))
+
+        def sddmm_pass(stat_rep, mov, t_rows, t_cols, t_vals, out_vals,
+                       complete_rotation=False):
+            def body(s, out_vals, mov):
+                dots = kern.sddmm(
+                    tile_at(t_rows, s), tile_at(t_cols, s), tile_at(t_vals, s),
+                    stat_rep, mov,
+                )
+                return out_vals.at[s].set(dots)
+
+            return ring_loop(body, out_vals, mov, complete_rotation)
 
         def spmm_pass(mov, t_rows, t_cols, vals_tiles, acc):
-            for s in range(nr):
-                acc = acc + kern.spmm(t_rows[s], t_cols[s], vals_tiles[s], mov, stat_rows)
-                if s < nr - 1:
-                    mov = shift(mov)
-            return acc, mov
+            def body(s, acc, mov):
+                return acc + kern.spmm(
+                    tile_at(t_rows, s), tile_at(t_cols, s), tile_at(vals_tiles, s),
+                    mov, stat_rows,
+                )
+
+            return ring_loop(body, acc, mov)
 
         dense_spec = _DENSE_SPEC
         mesh = self.grid.mesh
@@ -170,7 +211,7 @@ class DenseShift15D(DistributedSparse):
             def prog(stat, mov, t_rows, t_cols, t_vals):
                 t_rows, t_cols, t_vals = squeeze(t_rows), squeeze(t_cols), squeeze(t_vals)
                 stat_rep = replicate(stat)
-                out_vals = jnp.zeros((T, max_nnz), t_vals.dtype)
+                out_vals = vary(jnp.zeros((T, max_nnz), t_vals.dtype))
                 out_vals, _ = sddmm_pass(stat_rep, mov, t_rows, t_cols, t_vals, out_vals)
                 return out_vals.reshape(1, 1, 1, T, max_nnz)
 
@@ -181,7 +222,7 @@ class DenseShift15D(DistributedSparse):
 
             def prog(mov, t_rows, t_cols, t_vals):
                 t_rows, t_cols, t_vals = squeeze(t_rows), squeeze(t_cols), squeeze(t_vals)
-                acc = jnp.zeros((stat_rows, mov.shape[1]), mov.dtype)
+                acc = vary(jnp.zeros((stat_rows, mov.shape[1]), mov.dtype))
                 acc, _ = spmm_pass(mov, t_rows, t_cols, t_vals, acc)
                 return reduce_out(acc)
 
@@ -195,14 +236,19 @@ class DenseShift15D(DistributedSparse):
             def prog(stat, mov, t_rows, t_cols, t_vals):
                 t_rows, t_cols, t_vals = squeeze(t_rows), squeeze(t_cols), squeeze(t_vals)
                 stat_rep = replicate(stat)
-                acc = jnp.zeros((stat_rows, mov.shape[1]), mov.dtype)
-                out_vals = jnp.zeros((T, max_nnz), t_vals.dtype)
-                for s in range(nr):
-                    mid = kern.sddmm(t_rows[s], t_cols[s], t_vals[s], stat_rep, mov)
+
+                def body(s, carry, mov):
+                    acc, out_vals = carry
+                    rs, cs = tile_at(t_rows, s), tile_at(t_cols, s)
+                    mid = kern.sddmm(rs, cs, tile_at(t_vals, s), stat_rep, mov)
                     out_vals = out_vals.at[s].set(mid)
-                    acc = acc + kern.spmm(t_rows[s], t_cols[s], mid, mov, stat_rows)
-                    if s < nr - 1:
-                        mov = shift(mov)
+                    return (acc + kern.spmm(rs, cs, mid, mov, stat_rows), out_vals)
+
+                init = (
+                    vary(jnp.zeros((stat_rows, mov.shape[1]), mov.dtype)),
+                    vary(jnp.zeros((T, max_nnz), t_vals.dtype)),
+                )
+                (acc, out_vals), _ = ring_loop(body, init, mov)
                 return reduce_out(acc), out_vals.reshape(1, 1, 1, T, max_nnz)
 
             in_specs = (dense_spec, dense_spec, _TILE_SPEC, _TILE_SPEC, _TILE_SPEC)
@@ -217,11 +263,12 @@ class DenseShift15D(DistributedSparse):
             def prog(stat, mov, t_rows, t_cols, t_vals):
                 t_rows, t_cols, t_vals = squeeze(t_rows), squeeze(t_cols), squeeze(t_vals)
                 stat_rep = replicate(stat)
-                out_vals = jnp.zeros((T, max_nnz), t_vals.dtype)
-                out_vals, mov = sddmm_pass(stat_rep, mov, t_rows, t_cols, t_vals, out_vals)
-                if nr > 1:
-                    mov = shift(mov)  # complete the first rotation
-                acc = jnp.zeros((stat_rows, mov.shape[1]), mov.dtype)
+                out_vals = vary(jnp.zeros((T, max_nnz), t_vals.dtype))
+                out_vals, mov = sddmm_pass(
+                    stat_rep, mov, t_rows, t_cols, t_vals, out_vals,
+                    complete_rotation=True,
+                )
+                acc = vary(jnp.zeros((stat_rows, mov.shape[1]), mov.dtype))
                 acc, _ = spmm_pass(mov, t_rows, t_cols, out_vals, acc)
                 return reduce_out(acc), out_vals.reshape(1, 1, 1, T, max_nnz)
 

@@ -156,3 +156,30 @@ def test_perf_counters_populate():
     info = alg.json_algorithm_info()
     assert info["p"] == 8 and info["c"] == 2
     assert sum(info["nnz_procs"]) == S.nnz
+
+
+@pytest.mark.parametrize("c", [1, 2])
+def test_rolled_loop_matches_unrolled(c):
+    """unroll=False (lax.fori_loop + dynamic tile indexing) == unrolled."""
+    S = _problem()
+    alg_u = DenseShift15D(S, R=8, c=c, fusion_approach=2, unroll=True)
+    alg_r = DenseShift15D(S, R=8, c=c, fusion_approach=2, unroll=False)
+    for alg in (alg_u, alg_r):
+        A, B, _, _ = _dense_inputs(alg)
+        sv = alg.scatter_s_values(S.vals)
+        out, mid = alg.fused_spmm(A, B, sv)
+        alg._res = (alg.host_a(out), alg.gather_s_values(mid))
+    np.testing.assert_allclose(alg_u._res[0], alg_r._res[0], rtol=1e-5)
+    np.testing.assert_allclose(alg_u._res[1], alg_r._res[1], rtol=1e-5)
+
+
+def test_rolled_twopass():
+    S = _problem()
+    alg = DenseShift15D(S, R=8, c=2, fusion_approach=1, unroll=False)
+    A, B, A_host, B_host = _dense_inputs(alg)
+    sv = alg.scatter_s_values(S.vals)
+    out, _ = alg.fused_spmm(A, B, sv)
+    np.testing.assert_allclose(
+        alg.host_a(out)[: S.M], oracle.fused_spmm_a(S, A_host, B_host),
+        rtol=1e-3, atol=1e-2,
+    )
