@@ -38,7 +38,7 @@ def main() -> None:
     if kernel_name == "auto":
         try:
             kernel = get_kernel("pallas")
-        except (NotImplementedError, Exception):
+        except NotImplementedError:
             kernel = get_kernel("xla")
     else:
         kernel = get_kernel(kernel_name)

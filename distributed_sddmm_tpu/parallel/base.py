@@ -73,6 +73,28 @@ class DistributedSparse(abc.ABC):
         self.ST_tiles: TileSet = None
 
     # ------------------------------------------------------------------ #
+    # Canonical dense representation hooks.
+    #
+    # Most strategies store A as a plain (M_pad, R) array; R-splitting
+    # strategies (1.5D sparse-shift, 2.5D) use higher-rank canonical shapes
+    # whose leading dims encode a striped row order. A strategy defines the
+    # shape and the global-row index of each leading position; everything
+    # else (fills, dummy init, host converters) derives from those.
+    # ------------------------------------------------------------------ #
+
+    def dense_shape(self, mode: MatMode) -> tuple:
+        n_rows = self.M_pad if mode == MatMode.A else self.N_pad
+        return (n_rows, self.R)
+
+    def _dense_global_rows(self, mode: MatMode) -> jax.Array:
+        """Global row index for every leading position of the canonical
+        shape; shape == dense_shape(mode)[:-1]. Row-major reshape to
+        (n_rows_pad, R) must recover global row order (the default does
+        trivially)."""
+        n_rows = self.M_pad if mode == MatMode.A else self.N_pad
+        return jnp.arange(n_rows, dtype=self.dtype)
+
+    # ------------------------------------------------------------------ #
     # Dense buffer factories (reference `distributed_sparse.h:197-203`)
     # ------------------------------------------------------------------ #
 
@@ -82,22 +104,22 @@ class DistributedSparse(abc.ABC):
     def b_sharding(self) -> NamedSharding:
         return NamedSharding(self.grid.mesh, self.b_spec)
 
-    def _fill_program(self, n_rows: int, sharding):
+    def _fill_program(self, shape: tuple, sharding):
         """Cached constant-fill factory (value stays a traced argument so one
         compile serves every fill value)."""
-        key = ("fill", n_rows, self.R, sharding)
+        key = ("fill", shape, sharding)
         if key not in self._programs:
             self._programs[key] = jax.jit(
-                lambda v: jnp.full((n_rows, self.R), v, self.dtype),
+                lambda v: jnp.full(shape, v, self.dtype),
                 out_shardings=sharding,
             )
         return self._programs[key]
 
     def like_a_matrix(self, value: float) -> jax.Array:
-        return self._fill_program(self.M_pad, self.a_sharding())(value)
+        return self._fill_program(self.dense_shape(MatMode.A), self.a_sharding())(value)
 
     def like_b_matrix(self, value: float) -> jax.Array:
-        return self._fill_program(self.N_pad, self.b_sharding())(value)
+        return self._fill_program(self.dense_shape(MatMode.B), self.b_sharding())(value)
 
     def dummy_initialize(self, mode: MatMode) -> jax.Array:
         """Deterministic ``value = globalRow * R + globalCol`` fill.
@@ -106,15 +128,15 @@ class DistributedSparse(abc.ABC):
         requires every strategy to produce identical global results from it
         (`distributed_sparse.h:322-346`, `scratch.cpp:26-76`).
         """
-        n_rows = self.M_pad if mode == MatMode.A else self.N_pad
+        shape = self.dense_shape(mode)
         sharding = self.a_sharding() if mode == MatMode.A else self.b_sharding()
-        key = ("dummy", n_rows, self.R, sharding)
+        key = ("dummy", shape, sharding)
         if key not in self._programs:
 
             def make():
-                r = jnp.arange(n_rows, dtype=self.dtype)[:, None]
-                col = jnp.arange(self.R, dtype=self.dtype)[None, :]
-                return r * self.R + col
+                rows = self._dense_global_rows(mode)[..., None]
+                col = jnp.arange(self.R, dtype=self.dtype)
+                return rows * self.R + col
 
             self._programs[key] = jax.jit(make, out_shardings=sharding)
         return self._programs[key]()
@@ -123,19 +145,23 @@ class DistributedSparse(abc.ABC):
         """Place a host (M, R) matrix (padded to M_pad) onto the mesh."""
         buf = np.zeros((self.M_pad, self.R), dtype=self.dtype)
         buf[: host.shape[0]] = host
-        return jax.device_put(buf, self.a_sharding())
+        return jax.device_put(
+            buf.reshape(self.dense_shape(MatMode.A)), self.a_sharding()
+        )
 
     def put_b(self, host: np.ndarray) -> jax.Array:
         buf = np.zeros((self.N_pad, self.R), dtype=self.dtype)
         buf[: host.shape[0]] = host
-        return jax.device_put(buf, self.b_sharding())
+        return jax.device_put(
+            buf.reshape(self.dense_shape(MatMode.B)), self.b_sharding()
+        )
 
     def host_a(self, A: jax.Array) -> np.ndarray:
-        """Fetch A to host, stripping row padding."""
-        return np.asarray(A)[: self.M]
+        """Fetch A to host in global (M, R) row order, stripping padding."""
+        return np.asarray(A).reshape(self.M_pad, self.R)[: self.M]
 
     def host_b(self, B: jax.Array) -> np.ndarray:
-        return np.asarray(B)[: self.N]
+        return np.asarray(B).reshape(self.N_pad, self.R)[: self.N]
 
     # ------------------------------------------------------------------ #
     # Sparse value factories (reference `distributed_sparse.h:189-195`)

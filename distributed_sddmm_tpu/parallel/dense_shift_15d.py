@@ -39,6 +39,7 @@ from jax import shard_map
 
 from distributed_sddmm_tpu.common import MatMode, divide_round_up
 from distributed_sddmm_tpu.parallel.base import DistributedSparse
+from distributed_sddmm_tpu.parallel.loops import ring_loop, ring_perm, vary
 from distributed_sddmm_tpu.parallel.layouts import ShardedBlockCyclicColumn
 from distributed_sddmm_tpu.parallel.mesh import make_grid
 from distributed_sddmm_tpu.parallel.sharding import build_tiles
@@ -108,10 +109,6 @@ class DenseShift15D(DistributedSparse):
     # shard_map programs
     # ------------------------------------------------------------------ #
 
-    def _ring_perm(self):
-        nr = self.nr
-        return [(k, (k + 1) % nr) for k in range(nr)]
-
     def _program(self, op: str, use_st: bool):
         """Build (and cache) the jitted shard_map program for one op.
 
@@ -129,41 +126,18 @@ class DenseShift15D(DistributedSparse):
         T, max_nnz = tiles.n_tiles, tiles.max_nnz
         stat_rows = tiles.tile_rows  # stationary/output frame height
         kern = self.kernel
-        perm = self._ring_perm()
+        perm = ring_perm(nr)
         unroll = self.unroll
 
-        def shift(x):
-            return lax.ppermute(x, "rows", perm)
+        def shift_mov(state):
+            carry, mov = state
+            return carry, lax.ppermute(mov, "rows", perm)
 
         def tile_at(arr, s):
             # s is a Python int when unrolled, a traced index when rolled.
             if unroll:
                 return arr[s]
             return lax.dynamic_index_in_dim(arr, s, axis=0, keepdims=False)
-
-        def ring_loop(body, carry, mov, complete_rotation=False):
-            """Run ``carry = body(s, carry, mov)`` for s in 0..nr-1, rotating
-            ``mov`` between steps. Unrolled mode (default) lets XLA
-            software-pipeline the permutes; rolled mode (``unroll=False``)
-            bounds compile time on large meshes with a lax.fori_loop. With
-            ``complete_rotation`` the returned ``mov`` has made a full ring
-            trip (back at its starting block); otherwise it may be left
-            mid-rotation and should not be reused."""
-            if unroll:
-                for s in range(nr):
-                    carry = body(s, carry, mov)
-                    if s < nr - 1:
-                        mov = shift(mov)
-                if complete_rotation and nr > 1:
-                    mov = shift(mov)
-                return carry, mov
-
-            def f(s, state):
-                carry, mov = state
-                carry = body(s, carry, mov)
-                return (carry, shift(mov) if nr > 1 else mov)
-
-            return lax.fori_loop(0, nr, f, (carry, mov))
 
         def replicate(stat_blk):
             if c == 1:
@@ -178,30 +152,35 @@ class DenseShift15D(DistributedSparse):
         def squeeze(t):
             return t.reshape(T, max_nnz)
 
-        def vary(x):
-            # Mark loop-carry inits as device-varying so rolled fori_loop
-            # carries type-match after collectives touch them.
-            return lax.pvary(x, ("rows", "cols"))
+        def dvary(x):
+            return vary(x, ("rows", "cols"))
 
         def sddmm_pass(stat_rep, mov, t_rows, t_cols, t_vals, out_vals,
                        complete_rotation=False):
-            def body(s, out_vals, mov):
+            def body(s, state):
+                out_vals, mov = state
                 dots = kern.sddmm(
                     tile_at(t_rows, s), tile_at(t_cols, s), tile_at(t_vals, s),
                     stat_rep, mov,
                 )
-                return out_vals.at[s].set(dots)
+                return out_vals.at[s].set(dots), mov
 
-            return ring_loop(body, out_vals, mov, complete_rotation)
+            return ring_loop(
+                nr, body, (out_vals, mov), shift_mov,
+                shift_final=shift_mov if complete_rotation else None,
+                unroll=unroll,
+            )
 
         def spmm_pass(mov, t_rows, t_cols, vals_tiles, acc):
-            def body(s, acc, mov):
-                return acc + kern.spmm(
+            def body(s, state):
+                acc, mov = state
+                acc = acc + kern.spmm(
                     tile_at(t_rows, s), tile_at(t_cols, s), tile_at(vals_tiles, s),
                     mov, stat_rows,
                 )
+                return acc, mov
 
-            return ring_loop(body, acc, mov)
+            return ring_loop(nr, body, (acc, mov), shift_mov, unroll=unroll)
 
         dense_spec = _DENSE_SPEC
         mesh = self.grid.mesh
@@ -211,7 +190,7 @@ class DenseShift15D(DistributedSparse):
             def prog(stat, mov, t_rows, t_cols, t_vals):
                 t_rows, t_cols, t_vals = squeeze(t_rows), squeeze(t_cols), squeeze(t_vals)
                 stat_rep = replicate(stat)
-                out_vals = vary(jnp.zeros((T, max_nnz), t_vals.dtype))
+                out_vals = dvary(jnp.zeros((T, max_nnz), t_vals.dtype))
                 out_vals, _ = sddmm_pass(stat_rep, mov, t_rows, t_cols, t_vals, out_vals)
                 return out_vals.reshape(1, 1, 1, T, max_nnz)
 
@@ -222,7 +201,7 @@ class DenseShift15D(DistributedSparse):
 
             def prog(mov, t_rows, t_cols, t_vals):
                 t_rows, t_cols, t_vals = squeeze(t_rows), squeeze(t_cols), squeeze(t_vals)
-                acc = vary(jnp.zeros((stat_rows, mov.shape[1]), mov.dtype))
+                acc = dvary(jnp.zeros((stat_rows, mov.shape[1]), mov.dtype))
                 acc, _ = spmm_pass(mov, t_rows, t_cols, t_vals, acc)
                 return reduce_out(acc)
 
@@ -237,18 +216,20 @@ class DenseShift15D(DistributedSparse):
                 t_rows, t_cols, t_vals = squeeze(t_rows), squeeze(t_cols), squeeze(t_vals)
                 stat_rep = replicate(stat)
 
-                def body(s, carry, mov):
-                    acc, out_vals = carry
+                def body(s, state):
+                    (acc, out_vals), mov = state
                     rs, cs = tile_at(t_rows, s), tile_at(t_cols, s)
                     mid = kern.sddmm(rs, cs, tile_at(t_vals, s), stat_rep, mov)
                     out_vals = out_vals.at[s].set(mid)
-                    return (acc + kern.spmm(rs, cs, mid, mov, stat_rows), out_vals)
+                    return (acc + kern.spmm(rs, cs, mid, mov, stat_rows), out_vals), mov
 
                 init = (
-                    vary(jnp.zeros((stat_rows, mov.shape[1]), mov.dtype)),
-                    vary(jnp.zeros((T, max_nnz), t_vals.dtype)),
+                    dvary(jnp.zeros((stat_rows, mov.shape[1]), mov.dtype)),
+                    dvary(jnp.zeros((T, max_nnz), t_vals.dtype)),
                 )
-                (acc, out_vals), _ = ring_loop(body, init, mov)
+                (acc, out_vals), _ = ring_loop(
+                    nr, body, (init, mov), shift_mov, unroll=unroll
+                )
                 return reduce_out(acc), out_vals.reshape(1, 1, 1, T, max_nnz)
 
             in_specs = (dense_spec, dense_spec, _TILE_SPEC, _TILE_SPEC, _TILE_SPEC)
@@ -263,12 +244,12 @@ class DenseShift15D(DistributedSparse):
             def prog(stat, mov, t_rows, t_cols, t_vals):
                 t_rows, t_cols, t_vals = squeeze(t_rows), squeeze(t_cols), squeeze(t_vals)
                 stat_rep = replicate(stat)
-                out_vals = vary(jnp.zeros((T, max_nnz), t_vals.dtype))
+                out_vals = dvary(jnp.zeros((T, max_nnz), t_vals.dtype))
                 out_vals, mov = sddmm_pass(
                     stat_rep, mov, t_rows, t_cols, t_vals, out_vals,
                     complete_rotation=True,
                 )
-                acc = vary(jnp.zeros((stat_rows, mov.shape[1]), mov.dtype))
+                acc = dvary(jnp.zeros((stat_rows, mov.shape[1]), mov.dtype))
                 acc, _ = spmm_pass(mov, t_rows, t_cols, out_vals, acc)
                 return reduce_out(acc), out_vals.reshape(1, 1, 1, T, max_nnz)
 

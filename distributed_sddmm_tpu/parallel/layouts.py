@@ -37,6 +37,92 @@ class LayoutResult:
     local_c: np.ndarray
 
 
+class ShardedBlockRow:
+    """1.5D sparse-shift layout (`15D_sparse_shift.hpp:23-45`).
+
+    Block-row ``b`` (height ``rows_per_proc``, full matrix width) lives on
+    grid coordinate ``(b // c, b % c)``. One monolithic tile per device
+    (reference ``monolithBlockColumn``, `SpmatLocal.hpp:565-569`): local row
+    indices are within the block-row, column indices stay GLOBAL — the
+    stationary dense operand is fully replicated along the shift axis, so
+    tiles address it directly as they rotate.
+    """
+
+    def __init__(self, M: int, N: int, p: int, c: int):
+        self.p, self.c = p, c
+        self.rows_per_proc = divide_round_up(M, p)
+        self.n_tiles = 1
+
+    def __call__(self, rows: np.ndarray, cols: np.ndarray) -> LayoutResult:
+        row_block = rows // self.rows_per_proc
+        return LayoutResult(
+            i=row_block // self.c,
+            j=row_block % self.c,
+            k=np.zeros_like(rows),
+            tile=np.zeros_like(rows),
+            local_r=rows % self.rows_per_proc,
+            local_c=cols.copy(),
+        )
+
+
+class BlockCyclic25D:
+    """2.5D Cannon layout with the Cannon skew baked in
+    (`25D_cannon_dense.hpp:26-46` + the setup-time skew at
+    `25D_cannon_dense.hpp:137-145`).
+
+    The matrix is cut into ``sqrtpc`` row-blocks (height
+    ``rows_per_block * c``) and ``sqrtpc * c`` column-blocks. Unskewed, the
+    tile (row-block ``i``, col-block ``q*c + k``) belongs to grid coordinate
+    ``(i, q, k)``; Cannon's initial skew moves it to column ``q - i``. The
+    reference performs that skew with an extra setup communication round
+    (``shiftCSR`` over ``row_world``); here ingest places tiles directly at
+    their skewed home, eliminating the communication entirely.
+    """
+
+    def __init__(self, M: int, N: int, sqrtpc: int, c: int, skew: bool = True):
+        self.sqrtpc, self.c, self.skew = sqrtpc, c, skew
+        self.rows_in_block = divide_round_up(M, sqrtpc * c) * c
+        self.cols_in_block = divide_round_up(N, sqrtpc * c)
+        self.n_tiles = 1
+
+    def __call__(self, rows: np.ndarray, cols: np.ndarray) -> LayoutResult:
+        rb = rows // self.rows_in_block  # grid row i
+        cb = cols // self.cols_in_block  # 0 .. sqrtpc*c
+        q = cb // self.c
+        j = np.mod(q - rb, self.sqrtpc) if self.skew else q
+        return LayoutResult(
+            i=rb,
+            j=j,
+            k=cb % self.c,
+            tile=np.zeros_like(rows),
+            local_r=rows % self.rows_in_block,
+            local_c=cols % self.cols_in_block,
+        )
+
+
+class Floor2D:
+    """2.5D sparse-replicating floor layout (`25D_cannon_sparse.hpp:25-40`).
+
+    Plain sqrtpc x sqrtpc 2-D blocking; the fiber replication happens at
+    placement (spec without the ``layers`` axis), not here.
+    """
+
+    def __init__(self, M: int, N: int, sqrtpc: int):
+        self.rows_in_block = divide_round_up(M, sqrtpc)
+        self.cols_in_block = divide_round_up(N, sqrtpc)
+        self.n_tiles = 1
+
+    def __call__(self, rows: np.ndarray, cols: np.ndarray) -> LayoutResult:
+        return LayoutResult(
+            i=rows // self.rows_in_block,
+            j=cols // self.cols_in_block,
+            k=np.zeros_like(rows),
+            tile=np.zeros_like(rows),
+            local_r=rows % self.rows_in_block,
+            local_c=cols % self.cols_in_block,
+        )
+
+
 class ShardedBlockCyclicColumn:
     """1.5D dense-shift layout (`15D_dense_shift.hpp:22-42`).
 

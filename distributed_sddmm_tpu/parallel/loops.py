@@ -1,0 +1,76 @@
+"""Shared ring-loop machinery for the shift algorithms.
+
+Every strategy's inner loop is `n` steps of compute + rotate. Two build
+modes:
+
+* ``unroll=True`` (default): Python-unrolled — XLA sees each step statically
+  and can software-pipeline the collective permutes behind the local kernels
+  (the role of the reference's ``BufferPair`` double buffering,
+  `common.h:49-93`).
+* ``unroll=False``: a ``lax.fori_loop`` bounding compile time on large
+  meshes; step indices become traced values (use
+  ``lax.dynamic_index_in_dim`` in bodies — they accept Python ints too, so
+  one body serves both modes).
+
+The shift after the final step is often pure waste (the rotated operand is
+discarded), but sometimes required (an accumulator or output traveling the
+ring must complete its round trip home). Callers express this precisely with
+``shift_final``: ``None`` skips the trailing shift entirely; otherwise it is
+applied once after the last step (it may shift fewer arrays than
+``shift_between`` — e.g. return the traveling output home but drop the spent
+input).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+from jax import lax
+
+
+def ring_perm(n: int) -> list:
+    """The +1 ring permutation for an axis of size n."""
+    return [(k, (k + 1) % n) for k in range(n)]
+
+
+def vary(x, axes):
+    """Mark loop-carry inits as device-varying over ``axes`` so rolled
+    fori_loop carries type-match after collectives touch them."""
+    return lax.pcast(x, axes, to="varying")
+
+
+def ring_loop(
+    n: int,
+    body: Callable,
+    state,
+    shift_between: Callable,
+    shift_final: Optional[Callable] = None,
+    unroll: bool = True,
+):
+    """Run ``state = body(s, state)`` for s in 0..n-1 with
+    ``shift_between`` applied between steps and ``shift_final`` (if any)
+    after the last."""
+    if unroll:
+        for s in range(n):
+            state = body(s, state)
+            if s < n - 1:
+                state = shift_between(state)
+        if shift_final is not None and n > 1:
+            state = shift_final(state)
+        return state
+
+    if shift_final is not None:
+        # Uniform step (shift every iteration) only if the final shift is the
+        # full between-step shift; otherwise peel the last step.
+        if shift_final is shift_between:
+            return lax.fori_loop(
+                0, n, lambda s, st: shift_between(body(s, st)), state
+            )
+    if n > 1:
+        state = lax.fori_loop(
+            0, n - 1, lambda s, st: shift_between(body(s, st)), state
+        )
+    state = body(n - 1, state)
+    if shift_final is not None and n > 1:
+        state = shift_final(state)
+    return state

@@ -22,6 +22,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from distributed_sddmm_tpu.common import divide_round_up
 from distributed_sddmm_tpu.parallel.mesh import GridSpec
 from distributed_sddmm_tpu.utils.coo import HostCOO
 
@@ -81,6 +82,118 @@ class TileSet:
     def gather_values(self, dev_vals: jax.Array) -> np.ndarray:
         """Extract values back to the original host nonzero order."""
         return np.asarray(dev_vals).reshape(-1)[self.scatter_index]
+
+
+@dataclasses.dataclass
+class ReplicatedTiles:
+    """Tiles replicated across the ``layers`` fiber with values sharded 1/c
+    per layer — the 2.5D sparse-replicating data layout
+    (`25D_cannon_sparse.hpp:47-54` broadcast + ``shard_across_layers``,
+    `SpmatLocal.hpp:338-356`).
+
+    Structure (rows/cols/mask) has global shape ``(nr, nc, max_nnz)`` with
+    spec ``P("rows", "cols", None)`` — omitting ``layers`` IS the broadcast
+    under SPMD. Values have shape ``(nr, nc, c, owned_len)`` with spec
+    ``P("rows", "cols", "layers", None)``; ``max_nnz = c * owned_len`` so a
+    fiber all_gather of the owned slices reconstitutes full tile values and
+    a fiber psum_scatter splits summed dots back into owned slices.
+    """
+
+    rows: jax.Array
+    cols: jax.Array
+    mask: jax.Array
+    mask_owned: jax.Array
+    scatter_index: np.ndarray  # host nnz order -> flat index into values shape
+    owned_len: int
+    tile_rows: int
+    tile_cols: int
+    nnz: int
+    grid: GridSpec
+    nnz_per_device: np.ndarray
+
+    STRUCT_SPEC = P("rows", "cols", None)
+    VALUES_SPEC = P("rows", "cols", "layers", None)
+
+    @property
+    def max_nnz(self) -> int:
+        return self.rows.shape[-1]
+
+    def like_values(self, value: float) -> jax.Array:
+        return self.mask_owned * value
+
+    def scatter_values(self, host_vals: np.ndarray) -> jax.Array:
+        host_vals = np.asarray(host_vals)
+        if host_vals.shape != (self.nnz,):
+            raise ValueError(f"expected ({self.nnz},) values, got {host_vals.shape}")
+        shape = self.mask_owned.shape
+        buf = np.zeros(int(np.prod(shape)), dtype=self.mask.dtype)
+        buf[self.scatter_index] = host_vals
+        return jax.device_put(
+            buf.reshape(shape), NamedSharding(self.grid.mesh, self.VALUES_SPEC)
+        )
+
+    def gather_values(self, dev_vals: jax.Array) -> np.ndarray:
+        return np.asarray(dev_vals).reshape(-1)[self.scatter_index]
+
+
+def build_replicated_tiles(
+    S: HostCOO,
+    grid: GridSpec,
+    layout,
+    tile_rows: int,
+    tile_cols: int,
+    dtype=jnp.float32,
+) -> ReplicatedTiles:
+    """Bucket nonzeros onto the 2-D grid floor, replicate structure across
+    layers, shard values 1/c per layer (contiguous equal slices)."""
+    nr, nc, nh = grid.nr, grid.nc, grid.nh
+    res = layout(S.rows, S.cols)
+    if res.i.size:
+        assert res.i.max() < nr and res.j.max() < nc
+
+    dev = res.i * nc + res.j
+    n_buckets = nr * nc
+    order = np.argsort(dev, kind="stable")
+    counts = np.bincount(dev[order], minlength=n_buckets)
+    # Pad to a multiple of the fiber depth so value slices are equal-sized.
+    raw_max = max(int(counts.max(initial=0)), 1)
+    max_nnz = divide_round_up(raw_max, nh) * nh
+    owned_len = max_nnz // nh
+    starts = np.zeros(n_buckets, dtype=np.int64)
+    np.cumsum(counts[:-1], out=starts[1:])
+    within = np.arange(S.nnz, dtype=np.int64) - starts[dev[order]]
+    pos_sorted = dev[order] * max_nnz + within
+    scatter_index = np.empty(S.nnz, dtype=np.int64)
+    scatter_index[order] = pos_sorted
+
+    total = n_buckets * max_nnz
+    rows_flat = np.zeros(total, dtype=np.int32)
+    cols_flat = np.zeros(total, dtype=np.int32)
+    mask_flat = np.zeros(total, dtype=np.dtype(dtype))
+    rows_flat[scatter_index] = res.local_r
+    cols_flat[scatter_index] = res.local_c
+    mask_flat[scatter_index] = 1
+
+    struct_shape = (nr, nc, max_nnz)
+    values_shape = (nr, nc, nh, owned_len)
+    struct_sharding = NamedSharding(grid.mesh, ReplicatedTiles.STRUCT_SPEC)
+    values_sharding = NamedSharding(grid.mesh, ReplicatedTiles.VALUES_SPEC)
+
+    return ReplicatedTiles(
+        rows=jax.device_put(rows_flat.reshape(struct_shape), struct_sharding),
+        cols=jax.device_put(cols_flat.reshape(struct_shape), struct_sharding),
+        mask=jax.device_put(mask_flat.reshape(struct_shape), struct_sharding),
+        mask_owned=jax.device_put(
+            mask_flat.reshape(values_shape), values_sharding
+        ),
+        scatter_index=scatter_index,
+        owned_len=owned_len,
+        tile_rows=tile_rows,
+        tile_cols=tile_cols,
+        nnz=S.nnz,
+        grid=grid,
+        nnz_per_device=counts.reshape(nr, nc, 1),
+    )
 
 
 def build_tiles(

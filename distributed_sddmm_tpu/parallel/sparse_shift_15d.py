@@ -1,0 +1,257 @@
+"""1.5D sparse-shift algorithm: rotating sparse, stationary R-split dense.
+
+TPU-native redesign of the reference's ``Sparse15D_Sparse_Shift``
+(`/root/reference/15D_sparse_shift.hpp:48-277`):
+
+* Grid ``(p/c) x c``; sparse matrix block-row distributed
+  (:class:`~distributed_sddmm_tpu.parallel.layouts.ShardedBlockRow`), one
+  monolithic tile per device with GLOBAL column indices.
+* Dense matrices are **stationary and R-split**: each device holds
+  ``R * c / p`` feature columns of every row it sees — the reference's
+  ``r_split=true`` feature-dimension sharding (`15D_sparse_shift.hpp:139-157`),
+  the framework's analog of Ulysses-style head/feature parallelism. The
+  canonical dense representation is 4-D ``(p/c stripes, c, block_rows, R)``
+  sharded ``P(None, "cols", None, "rows")`` — a pure reshape of the global
+  ``(M_pad, R)`` row-major matrix (stripe/layer leading dims encode the
+  block-cyclic row order that a flat PartitionSpec cannot express).
+* The stationary operand is replicated over the ``cols`` axis per stripe
+  (reference per-stripe ``MPI_Allgather``, `15D_sparse_shift.hpp:203-215`),
+  yielding all N_pad rows of this device's R-slice.
+* The SPARSE tile ring-shifts around the ``rows`` axis: ``lax.ppermute`` of
+  the padded ``(rows, cols, mask, vals)`` struct-of-arrays — the XLA-native
+  form of the reference's 4-array ``shiftCSR`` with max_nnz-sized buffers
+  (`SpmatLocal.hpp:200-259`, `15D_sparse_shift.hpp:252-268`). For SDDMM the
+  partial R-slice dot products travel WITH the tile, accumulating the full
+  dot over one ring trip; for SpMM each device writes the output stripe
+  matching the tile it currently holds (`15D_sparse_shift.hpp:228-249`).
+* CG-style consumers must ``psum`` dot products over the ``rows`` axis
+  (``r_split`` reduction world, `15D_sparse_shift.hpp:80-81`).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+from jax import shard_map
+
+from distributed_sddmm_tpu.common import MatMode, divide_round_up
+from distributed_sddmm_tpu.parallel.base import DistributedSparse
+from distributed_sddmm_tpu.parallel.loops import ring_loop, ring_perm, vary
+from distributed_sddmm_tpu.parallel.layouts import ShardedBlockRow
+from distributed_sddmm_tpu.parallel.mesh import make_grid
+from distributed_sddmm_tpu.parallel.sharding import build_tiles
+from distributed_sddmm_tpu.utils.coo import HostCOO
+
+_DENSE_SPEC = P(None, "cols", None, "rows")
+_TILE_SPEC = P("rows", "cols", None, None, None)
+
+
+class SparseShift15D(DistributedSparse):
+    algorithm_name = "1.5D Sparse Shifting Dense Replicating Algorithm"
+    proc_grid_names = ("# Rows", "# Layers")
+
+    def __init__(
+        self,
+        S: HostCOO,
+        R: int,
+        c: int = 1,
+        kernel=None,
+        adjacency: int = 1,
+        devices=None,
+        dtype=jnp.float32,
+        unroll: bool = True,
+    ):
+        if devices is None:
+            devices = jax.devices()
+        p = len(devices)
+        if p % c != 0:
+            raise ValueError(f"1.5D algorithm requires c | p (p={p}, c={c})")
+        nr = p // c
+        if R % nr != 0:
+            raise ValueError(
+                f"sparse-shift requires (p/c) | R (R={R}, p/c={nr}): the R "
+                "dimension is split across the shift axis "
+                "(reference check at 15D_sparse_shift.hpp:145-147)"
+            )
+        grid = make_grid(nr, c, 1, adjacency=adjacency, devices=devices)
+        super().__init__(grid, S.M, S.N, R, c, kernel=kernel, dtype=dtype)
+        self.r_split = True
+        self.r_split_axis = "rows"  # psum axis for CG dot products
+        self.unroll = unroll
+        self.nr = nr
+
+        self.blockAwidth = divide_round_up(S.M, p)
+        self.blockBwidth = divide_round_up(S.N, p)
+        self.M_pad = self.blockAwidth * p
+        self.N_pad = self.blockBwidth * p
+        self.a_spec = _DENSE_SPEC
+        self.b_spec = _DENSE_SPEC
+
+        self.S_tiles = build_tiles(
+            S, grid, ShardedBlockRow(self.M_pad, self.N_pad, p, c),
+            tile_rows=self.blockAwidth, tile_cols=self.N_pad, dtype=dtype,
+        )
+        self.ST_tiles = build_tiles(
+            S.transpose(), grid, ShardedBlockRow(self.N_pad, self.M_pad, p, c),
+            tile_rows=self.blockBwidth, tile_cols=self.M_pad, dtype=dtype,
+        )
+
+    # Canonical dense representation: (stripes, c, block, R), see module doc.
+    def dense_shape(self, mode: MatMode) -> tuple:
+        bw = self.blockAwidth if mode == MatMode.A else self.blockBwidth
+        return (self.nr, self.c, bw, self.R)
+
+    def _dense_global_rows(self, mode: MatMode) -> jax.Array:
+        bw = self.blockAwidth if mode == MatMode.A else self.blockBwidth
+        s = jnp.arange(self.nr, dtype=self.dtype)[:, None, None]
+        j = jnp.arange(self.c, dtype=self.dtype)[None, :, None]
+        r = jnp.arange(bw, dtype=self.dtype)[None, None, :]
+        return (s * self.c + j) * bw + r
+
+    def set_r_value(self, R: int) -> None:
+        if R % self.nr != 0:
+            raise ValueError(f"(p/c) | R required (R={R}, p/c={self.nr})")
+        self.R = R
+
+    # ------------------------------------------------------------------ #
+    # shard_map programs
+    # ------------------------------------------------------------------ #
+
+    def _program(self, op: str, use_st: bool):
+        key = (op, use_st)
+        if key in self._programs:
+            return self._programs[key]
+
+        tiles = self.ST_tiles if use_st else self.S_tiles
+        nr, c = self.nr, self.c
+        max_nnz = tiles.max_nnz
+        out_bw = tiles.tile_rows  # output stripe height (A-role block width)
+        kern = self.kernel
+        perm = ring_perm(nr)
+        unroll = self.unroll
+
+        def shift(tree):
+            if nr == 1:
+                return tree
+            return jax.tree.map(lambda x: lax.ppermute(x, "rows", perm), tree)
+
+        def replicate_stationary(blk):
+            # blk: (nr, 1, bw, r_loc) -> all-gather layers -> (N_pad, r_loc)
+            if c > 1:
+                blk = lax.all_gather(blk, "cols", axis=1, tiled=True)
+            return blk.reshape(blk.shape[0] * blk.shape[1] * blk.shape[2], blk.shape[3])
+
+        def dvary(x):
+            return vary(x, ("rows", "cols"))
+
+        def my_stripe(step):
+            i_idx = lax.axis_index("rows")
+            return jax.numpy.mod(i_idx - step, nr)
+
+        def squeeze_tile(t):
+            return t.reshape(max_nnz)
+
+        mesh = self.grid.mesh
+
+        if op == "sddmm":
+            # Partial dots accumulate onto the traveling tile; one full ring
+            # trip returns them to the owner with the complete R sum.
+
+            def prog(a_role, b_role, t_rows, t_cols, t_mask, t_vals):
+                # a_role supplies the per-step output-side stripe; b_role is
+                # replicated across layers (reference Arole/Brole split,
+                # `15D_sparse_shift.hpp:176-199`).
+                b_rep = replicate_stationary(b_role)  # (rows_pad, r_loc)
+                init = (
+                    squeeze_tile(t_rows),
+                    squeeze_tile(t_cols),
+                    squeeze_tile(t_mask),
+                    dvary(jnp.zeros((max_nnz,), t_mask.dtype)),
+                )
+
+                def body(s, state):
+                    rows, cols, mask, acc = state
+                    stripe = lax.dynamic_index_in_dim(
+                        a_role, my_stripe(s), axis=0, keepdims=False
+                    ).reshape(out_bw, a_role.shape[-1])
+                    acc = acc + kern.sddmm(rows, cols, mask, stripe, b_rep)
+                    return (rows, cols, mask, acc)
+
+                # The accumulating dots travel WITH the tile; the final shift
+                # completes their round trip home.
+                state = ring_loop(
+                    nr, body, init, shift, shift_final=shift, unroll=unroll
+                )
+                acc = state[3]
+                return (squeeze_tile(t_vals) * acc).reshape(1, 1, 1, 1, max_nnz)
+
+            in_specs = (
+                _DENSE_SPEC, _DENSE_SPEC,
+                _TILE_SPEC, _TILE_SPEC, _TILE_SPEC, _TILE_SPEC,
+            )
+            out_specs = _TILE_SPEC
+
+        elif op == "spmm":
+            # The tile (with its values) rotates; each step computes the
+            # output stripe matching the tile currently held.
+
+            def prog(stat, t_rows, t_cols, t_vals):
+                stat_rep = replicate_stationary(stat)
+                init = (
+                    squeeze_tile(t_rows),
+                    squeeze_tile(t_cols),
+                    squeeze_tile(t_vals),
+                    dvary(jnp.zeros((nr, 1, out_bw, stat.shape[-1]), stat.dtype)),
+                )
+
+                def body(s, state):
+                    rows, cols, vals, out = state
+                    stripe = kern.spmm(rows, cols, vals, stat_rep, out_bw)
+                    out = lax.dynamic_update_index_in_dim(
+                        out, stripe[None, :, :].astype(out.dtype), my_stripe(s), axis=0
+                    )
+                    return (rows, cols, vals, out)
+
+                def shift_tile_only(state):
+                    rows, cols, vals, out = state
+                    rows, cols, vals = shift((rows, cols, vals))
+                    return (rows, cols, vals, out)
+
+                state = ring_loop(nr, body, init, shift_tile_only, unroll=unroll)
+                return state[3]
+
+            in_specs = (_DENSE_SPEC, _TILE_SPEC, _TILE_SPEC, _TILE_SPEC)
+            out_specs = _DENSE_SPEC
+
+        else:
+            raise ValueError(op)
+
+        fn = jax.jit(shard_map(prog, mesh=mesh, in_specs=in_specs, out_specs=out_specs))
+        self._programs[key] = fn
+        return fn
+
+    # ------------------------------------------------------------------ #
+    # Public ops
+    # ------------------------------------------------------------------ #
+
+    def sddmm_a(self, A, B, s_vals):
+        t = self.S_tiles
+        prog = self._program("sddmm", use_st=False)
+        return self._timed("sddmmA", prog, A, B, t.rows, t.cols, t.mask, s_vals)
+
+    def sddmm_b(self, A, B, st_vals):
+        t = self.ST_tiles
+        prog = self._program("sddmm", use_st=True)
+        return self._timed("sddmmB", prog, B, A, t.rows, t.cols, t.mask, st_vals)
+
+    def spmm_a(self, A, B, s_vals):
+        t = self.S_tiles
+        prog = self._program("spmm", use_st=False)
+        return self._timed("spmmA", prog, B, t.rows, t.cols, s_vals)
+
+    def spmm_b(self, A, B, st_vals):
+        t = self.ST_tiles
+        prog = self._program("spmm", use_st=True)
+        return self._timed("spmmB", prog, A, t.rows, t.cols, st_vals)

@@ -1,0 +1,143 @@
+import numpy as np
+import pytest
+
+from distributed_sddmm_tpu.common import KernelMode, MatMode
+from distributed_sddmm_tpu.parallel.cannon_dense_25d import CannonDense25D
+from distributed_sddmm_tpu.utils import oracle
+from distributed_sddmm_tpu.utils.coo import HostCOO
+
+
+def _problem(M=64, N=48, seed=0):
+    return HostCOO.erdos_renyi(M, N, 4, seed=seed, values="normal")
+
+
+def _dense_inputs(alg):
+    A = alg.dummy_initialize(MatMode.A)
+    B = alg.dummy_initialize(MatMode.B)
+    A_host = oracle.dummy_dense(alg.M_pad, alg.R)
+    B_host = oracle.dummy_dense(alg.N_pad, alg.R)
+    return A, B, A_host, B_host
+
+
+# (c,) configs on 8 devices: c=2 -> 2x2x2; c=8 -> 1x1x8.
+CONFIGS = [2, 8]
+
+
+def test_grid_requirements():
+    S = _problem()
+    with pytest.raises(ValueError):
+        CannonDense25D(S, R=8, c=1)  # p/c=8 not a perfect square
+    with pytest.raises(ValueError):
+        CannonDense25D(S, R=7, c=2)  # sqrt(p/c)=2 does not divide 7
+
+
+def test_skew_roundtrip():
+    S = _problem()
+    alg = CannonDense25D(S, R=8, c=2)
+    A, B, A_host, _ = _dense_inputs(alg)
+    A_sk, _ = alg.initial_shift(A, None, KernelMode.SDDMM_A)
+    A_rt, _ = alg.de_shift(A_sk, None, KernelMode.SDDMM_A)
+    np.testing.assert_allclose(alg.host_a(A_rt), A_host[: alg.M], rtol=1e-6)
+    # B-mode skews B, leaves A untouched
+    _, B_sk = alg.initial_shift(None, B, KernelMode.SPMM_B)
+    _, B_rt = alg.de_shift(None, B_sk, KernelMode.SPMM_B)
+    np.testing.assert_allclose(alg.host_b(B_rt), oracle.dummy_dense(alg.N_pad, 8)[: alg.N], rtol=1e-6)
+
+
+@pytest.mark.parametrize("c", CONFIGS)
+def test_sddmm_a(c):
+    S = _problem()
+    alg = CannonDense25D(S, R=8, c=c)
+    A, B, A_host, B_host = _dense_inputs(alg)
+    A_sk, _ = alg.initial_shift(A, None, KernelMode.SDDMM_A)
+    sv = alg.scatter_s_values(S.transpose().vals)  # A-ops: S^T value order
+    out = alg.sddmm_a(A_sk, B, sv)
+    expected = oracle.sddmm(S.transpose(), B_host, A_host)
+    np.testing.assert_allclose(alg.gather_s_values(out), expected, rtol=1e-4)
+
+
+@pytest.mark.parametrize("c", CONFIGS)
+def test_sddmm_b(c):
+    S = _problem()
+    alg = CannonDense25D(S, R=8, c=c)
+    A, B, A_host, B_host = _dense_inputs(alg)
+    _, B_sk = alg.initial_shift(None, B, KernelMode.SDDMM_B)
+    sv = alg.scatter_st_values(S.vals)  # B-ops: S value order
+    out = alg.sddmm_b(A, B_sk, sv)
+    expected = oracle.sddmm(S, A_host, B_host)
+    np.testing.assert_allclose(alg.gather_st_values(out), expected, rtol=1e-4)
+
+
+@pytest.mark.parametrize("c", CONFIGS)
+def test_spmm_a(c):
+    S = _problem()
+    alg = CannonDense25D(S, R=8, c=c)
+    A, B, A_host, B_host = _dense_inputs(alg)
+    sv = alg.scatter_s_values(S.transpose().vals)
+    out = alg.spmm_a(alg.like_a_matrix(0.0), B, sv)
+    out, _ = alg.de_shift(out, None, KernelMode.SPMM_A)
+    np.testing.assert_allclose(
+        alg.host_a(out)[: S.M], oracle.spmm_a(S, B_host), rtol=1e-4, atol=1e-3
+    )
+
+
+@pytest.mark.parametrize("c", CONFIGS)
+def test_spmm_b(c):
+    S = _problem()
+    alg = CannonDense25D(S, R=8, c=c)
+    A, B, A_host, B_host = _dense_inputs(alg)
+    sv = alg.scatter_st_values(S.vals)
+    out = alg.spmm_b(A, alg.like_b_matrix(0.0), sv)
+    _, out = alg.de_shift(None, out, KernelMode.SPMM_B)
+    np.testing.assert_allclose(
+        alg.host_b(out)[: S.N], oracle.spmm_b(S, A_host), rtol=1e-4, atol=1e-3
+    )
+
+
+def test_spmm_accumulates_into_moving_buffer():
+    """The rotating output accumulates on top of its initial content
+    (reference `beta=1` semantics through the rotating bBuf)."""
+    S = _problem()
+    alg = CannonDense25D(S, R=8, c=2)
+    A, B, A_host, B_host = _dense_inputs(alg)
+    base, _ = alg.initial_shift(A, None, KernelMode.SPMM_A)
+    out = alg.spmm_a(base, B, alg.scatter_s_values(S.transpose().vals))
+    out, _ = alg.de_shift(out, None, KernelMode.SPMM_A)
+    np.testing.assert_allclose(
+        alg.host_a(out)[: S.M],
+        A_host[: S.M] + oracle.spmm_a(S, B_host),
+        rtol=1e-4, atol=1e-3,
+    )
+
+
+def test_fused_and_fingerprint_parity_with_15d():
+    from distributed_sddmm_tpu.parallel.dense_shift_15d import DenseShift15D
+
+    S = _problem()
+    alg = CannonDense25D(S, R=8, c=2)
+    A, B, A_host, B_host = _dense_inputs(alg)
+    A_sk, _ = alg.initial_shift(A, None, KernelMode.SDDMM_A)
+    out, mid = alg.fused_spmm(A_sk, B, alg.scatter_s_values(S.transpose().vals))
+    out, _ = alg.de_shift(out, None, KernelMode.SPMM_A)
+    expected = oracle.fused_spmm_a(S, A_host, B_host)
+    np.testing.assert_allclose(alg.host_a(out)[: S.M], expected, rtol=1e-3, atol=1e-2)
+
+    ref = DenseShift15D(S, R=8, c=2)
+    A2 = ref.dummy_initialize(MatMode.A)
+    B2 = ref.dummy_initialize(MatMode.B)
+    out2, _ = ref.fused_spmm(A2, B2, ref.scatter_s_values(S.vals))
+    fp1 = alg.fingerprint(alg.host_a(out)[: S.M])
+    fp2 = ref.fingerprint(ref.host_a(out2)[: S.M])
+    np.testing.assert_allclose(fp1, fp2, rtol=1e-5)
+
+
+def test_rolled_matches_unrolled():
+    S = _problem()
+    res = []
+    for unroll in (True, False):
+        alg = CannonDense25D(S, R=8, c=2, unroll=unroll)
+        A, B, _, _ = _dense_inputs(alg)
+        _, B_sk = alg.initial_shift(None, B, KernelMode.SDDMM_B)
+        out = alg.sddmm_b(A, B_sk, alg.scatter_st_values(S.vals))
+        res.append(alg.gather_st_values(out))
+    np.testing.assert_allclose(res[0], res[1], rtol=1e-5)

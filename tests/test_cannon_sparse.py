@@ -1,0 +1,145 @@
+import numpy as np
+import pytest
+
+from distributed_sddmm_tpu.common import KernelMode, MatMode
+from distributed_sddmm_tpu.parallel.cannon_sparse_25d import CannonSparse25D
+from distributed_sddmm_tpu.utils import oracle
+from distributed_sddmm_tpu.utils.coo import HostCOO
+
+
+def _problem(M=64, N=48, seed=0):
+    return HostCOO.erdos_renyi(M, N, 4, seed=seed, values="normal")
+
+
+def _dense_inputs(alg):
+    A = alg.dummy_initialize(MatMode.A)
+    B = alg.dummy_initialize(MatMode.B)
+    A_host = oracle.dummy_dense(alg.M_pad, alg.R)
+    B_host = oracle.dummy_dense(alg.N_pad, alg.R)
+    return A, B, A_host, B_host
+
+
+CONFIGS = [2, 8]  # c on 8 devices: 2x2x2 and 1x1x8
+
+
+def test_requirements():
+    S = _problem()
+    with pytest.raises(ValueError):
+        CannonSparse25D(S, R=8, c=1)  # p/c = 8 not square
+    with pytest.raises(ValueError):
+        CannonSparse25D(S, R=6, c=2)  # sqrt(p/c)*c = 4 does not divide 6
+
+
+def test_skewed_layout_roundtrip():
+    """put/host converters and dummy init agree on the skewed R layout."""
+    S = _problem()
+    alg = CannonSparse25D(S, R=8, c=2)
+    A = alg.dummy_initialize(MatMode.A)
+    np.testing.assert_allclose(
+        alg.host_a(A), oracle.dummy_dense(alg.M_pad, 8)[: alg.M], rtol=1e-6
+    )
+    rng = np.random.default_rng(1)
+    X = rng.standard_normal((S.M, 8))
+    np.testing.assert_allclose(alg.host_a(alg.put_a(X)), X, rtol=1e-6)
+
+
+def test_transpose_shift_self_inverse():
+    S = _problem()
+    alg = CannonSparse25D(S, R=8, c=2)
+    _, B, _, B_host = _dense_inputs(alg)
+    _, B1 = alg.initial_shift(None, B, KernelMode.SDDMM_A)
+    _, B2 = alg.de_shift(None, B1, KernelMode.SDDMM_A)
+    np.testing.assert_allclose(np.asarray(B2), np.asarray(B), rtol=1e-6)
+
+
+@pytest.mark.parametrize("c", CONFIGS)
+def test_sddmm_a(c):
+    S = _problem()
+    alg = CannonSparse25D(S, R=8, c=c)
+    A, B, A_host, B_host = _dense_inputs(alg)
+    _, B_sh = alg.initial_shift(None, B, KernelMode.SDDMM_A)
+    out = alg.sddmm_a(A, B_sh, alg.scatter_s_values(S.vals))
+    np.testing.assert_allclose(
+        alg.gather_s_values(out), oracle.sddmm(S, A_host, B_host), rtol=1e-4
+    )
+
+
+@pytest.mark.parametrize("c", CONFIGS)
+def test_sddmm_b(c):
+    S = _problem()
+    alg = CannonSparse25D(S, R=8, c=c)
+    A, B, A_host, B_host = _dense_inputs(alg)
+    A_sh, _ = alg.initial_shift(A, None, KernelMode.SDDMM_B)
+    out = alg.sddmm_b(A_sh, B, alg.scatter_st_values(S.transpose().vals))
+    np.testing.assert_allclose(
+        alg.gather_st_values(out),
+        oracle.sddmm(S.transpose(), B_host, A_host),
+        rtol=1e-4,
+    )
+
+
+@pytest.mark.parametrize("c", CONFIGS)
+def test_spmm_a(c):
+    S = _problem()
+    alg = CannonSparse25D(S, R=8, c=c)
+    A, B, A_host, B_host = _dense_inputs(alg)
+    _, B_sh = alg.initial_shift(None, B, KernelMode.SPMM_A)
+    out = alg.spmm_a(alg.like_a_matrix(0.0), B_sh, alg.scatter_s_values(S.vals))
+    np.testing.assert_allclose(
+        alg.host_a(out)[: S.M], oracle.spmm_a(S, B_host), rtol=1e-4, atol=1e-3
+    )
+
+
+@pytest.mark.parametrize("c", CONFIGS)
+def test_spmm_b(c):
+    S = _problem()
+    alg = CannonSparse25D(S, R=8, c=c)
+    A, B, A_host, B_host = _dense_inputs(alg)
+    A_sh, _ = alg.initial_shift(A, None, KernelMode.SPMM_B)
+    out = alg.spmm_b(A_sh, alg.like_b_matrix(0.0), alg.scatter_st_values(S.transpose().vals))
+    np.testing.assert_allclose(
+        alg.host_b(out)[: S.N], oracle.spmm_b(S, A_host), rtol=1e-4, atol=1e-3
+    )
+
+
+def test_fused_and_four_algorithm_fingerprints():
+    """The full scratch.cpp protocol: all four algorithms produce the same
+    spmmA fingerprint from dummy inputs."""
+    from distributed_sddmm_tpu.parallel.cannon_dense_25d import CannonDense25D
+    from distributed_sddmm_tpu.parallel.dense_shift_15d import DenseShift15D
+    from distributed_sddmm_tpu.parallel.sparse_shift_15d import SparseShift15D
+
+    S = _problem()
+    fps = []
+
+    alg = CannonSparse25D(S, R=8, c=2)
+    A, B, _, _ = _dense_inputs(alg)
+    _, B_sh = alg.initial_shift(None, B, KernelMode.SPMM_A)
+    out = alg.spmm_a(alg.like_a_matrix(0.0), B_sh, alg.scatter_s_values(S.vals))
+    fps.append(alg.fingerprint(alg.host_a(out)[: S.M]))
+
+    alg = CannonDense25D(S, R=8, c=2)
+    A, B, _, _ = _dense_inputs(alg)
+    out = alg.spmm_a(alg.like_a_matrix(0.0), B, alg.scatter_s_values(S.transpose().vals))
+    out, _ = alg.de_shift(out, None, KernelMode.SPMM_A)
+    fps.append(alg.fingerprint(alg.host_a(out)[: S.M]))
+
+    for alg in (DenseShift15D(S, R=8, c=2), SparseShift15D(S, R=8, c=4)):
+        A = alg.dummy_initialize(MatMode.A)
+        B = alg.dummy_initialize(MatMode.B)
+        out = alg.spmm_a(A, B, alg.scatter_s_values(S.vals))
+        fps.append(alg.fingerprint(alg.host_a(out)[: S.M]))
+
+    np.testing.assert_allclose(fps, fps[0], rtol=1e-5)
+
+
+def test_rolled_matches_unrolled():
+    S = _problem()
+    res = []
+    for unroll in (True, False):
+        alg = CannonSparse25D(S, R=8, c=2, unroll=unroll)
+        A, B, _, _ = _dense_inputs(alg)
+        _, B_sh = alg.initial_shift(None, B, KernelMode.SDDMM_A)
+        out = alg.sddmm_a(A, B_sh, alg.scatter_s_values(S.vals))
+        res.append(alg.gather_s_values(out))
+    np.testing.assert_allclose(res[0], res[1], rtol=1e-5)

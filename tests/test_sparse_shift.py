@@ -1,0 +1,134 @@
+import numpy as np
+import pytest
+
+from distributed_sddmm_tpu.common import MatMode
+from distributed_sddmm_tpu.parallel.sparse_shift_15d import SparseShift15D
+from distributed_sddmm_tpu.utils import oracle
+from distributed_sddmm_tpu.utils.coo import HostCOO
+
+
+def _problem(M=64, N=48, seed=0):
+    return HostCOO.erdos_renyi(M, N, 4, seed=seed, values="normal")
+
+
+def _dense_inputs(alg):
+    A = alg.dummy_initialize(MatMode.A)
+    B = alg.dummy_initialize(MatMode.B)
+    A_host = oracle.dummy_dense(alg.M_pad, alg.R)
+    B_host = oracle.dummy_dense(alg.N_pad, alg.R)
+    return A, B, A_host, B_host
+
+
+CONFIGS = [1, 2, 4, 8]  # c values on the 8-device CPU mesh
+
+
+def test_dense_representation_roundtrip():
+    S = _problem()
+    alg = SparseShift15D(S, R=8, c=2)
+    A = alg.dummy_initialize(MatMode.A)
+    assert A.shape == alg.dense_shape(MatMode.A)
+    np.testing.assert_allclose(
+        alg.host_a(A), oracle.dummy_dense(alg.M_pad, 8)[: alg.M], rtol=1e-6
+    )
+    rng = np.random.default_rng(0)
+    X = rng.standard_normal((S.M, 8))
+    np.testing.assert_allclose(alg.host_a(alg.put_a(X)), X, rtol=1e-6)
+
+
+@pytest.mark.parametrize("c", CONFIGS)
+def test_sddmm_a(c):
+    S = _problem()
+    alg = SparseShift15D(S, R=8, c=c)
+    A, B, A_host, B_host = _dense_inputs(alg)
+    out = alg.sddmm_a(A, B, alg.scatter_s_values(S.vals))
+    np.testing.assert_allclose(
+        alg.gather_s_values(out), oracle.sddmm(S, A_host, B_host), rtol=1e-4
+    )
+
+
+@pytest.mark.parametrize("c", [1, 2, 8])
+def test_sddmm_b(c):
+    S = _problem()
+    alg = SparseShift15D(S, R=8, c=c)
+    A, B, A_host, B_host = _dense_inputs(alg)
+    out = alg.sddmm_b(A, B, alg.scatter_st_values(S.transpose().vals))
+    np.testing.assert_allclose(
+        alg.gather_st_values(out),
+        oracle.sddmm(S.transpose(), B_host, A_host),
+        rtol=1e-4,
+    )
+
+
+@pytest.mark.parametrize("c", CONFIGS)
+def test_spmm_a(c):
+    S = _problem()
+    alg = SparseShift15D(S, R=8, c=c)
+    A, B, A_host, B_host = _dense_inputs(alg)
+    out = alg.spmm_a(A, B, alg.scatter_s_values(S.vals))
+    np.testing.assert_allclose(
+        alg.host_a(out)[: S.M], oracle.spmm_a(S, B_host), rtol=1e-4, atol=1e-3
+    )
+
+
+@pytest.mark.parametrize("c", [1, 4])
+def test_spmm_b(c):
+    S = _problem()
+    alg = SparseShift15D(S, R=8, c=c)
+    A, B, A_host, B_host = _dense_inputs(alg)
+    out = alg.spmm_b(A, B, alg.scatter_st_values(S.transpose().vals))
+    np.testing.assert_allclose(
+        alg.host_b(out)[: S.N], oracle.spmm_b(S, A_host), rtol=1e-4, atol=1e-3
+    )
+
+
+def test_fused_spmm_chained():
+    """Base-class fused (sddmm then spmm with the mid values)."""
+    S = _problem()
+    alg = SparseShift15D(S, R=8, c=2)
+    A, B, A_host, B_host = _dense_inputs(alg)
+    out, mid = alg.fused_spmm(A, B, alg.scatter_s_values(S.vals), MatMode.A)
+    np.testing.assert_allclose(
+        alg.host_a(out)[: S.M],
+        oracle.fused_spmm_a(S, A_host, B_host),
+        rtol=1e-3,
+        atol=1e-2,
+    )
+
+
+def test_rolled_matches_unrolled():
+    S = _problem()
+    res = []
+    for unroll in (True, False):
+        alg = SparseShift15D(S, R=8, c=2, unroll=unroll)
+        A, B, _, _ = _dense_inputs(alg)
+        out = alg.spmm_a(A, B, alg.scatter_s_values(S.vals))
+        res.append(alg.host_a(out))
+    np.testing.assert_allclose(res[0], res[1], rtol=1e-5)
+
+
+def test_cross_algorithm_fingerprints():
+    """Fingerprint protocol across DIFFERENT algorithms (scratch.cpp:26-76)."""
+    from distributed_sddmm_tpu.parallel.dense_shift_15d import DenseShift15D
+
+    S = _problem()
+    fps = []
+    for alg in (
+        SparseShift15D(S, R=8, c=2),
+        DenseShift15D(S, R=8, c=4, fusion_approach=1),
+        DenseShift15D(S, R=8, c=1, fusion_approach=2),
+    ):
+        A = alg.dummy_initialize(MatMode.A)
+        B = alg.dummy_initialize(MatMode.B)
+        out = alg.spmm_a(A, B, alg.scatter_s_values(S.vals))
+        fps.append(alg.fingerprint(alg.host_a(out)[: S.M]))
+    np.testing.assert_allclose(fps, fps[0], rtol=1e-5)
+
+
+def test_r_divisibility_check():
+    S = _problem()
+    with pytest.raises(ValueError):
+        SparseShift15D(S, R=7, c=1)  # p/c = 8 does not divide 7
+    alg = SparseShift15D(S, R=8, c=2)
+    with pytest.raises(ValueError):
+        alg.set_r_value(6)  # p/c = 4 does not divide 6
+    assert alg.r_split and alg.r_split_axis == "rows"
