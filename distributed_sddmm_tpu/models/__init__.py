@@ -1,0 +1,4 @@
+from distributed_sddmm_tpu.models.als import DistributedALS
+from distributed_sddmm_tpu.models.gat import GAT, GATLayer
+
+__all__ = ["DistributedALS", "GAT", "GATLayer"]

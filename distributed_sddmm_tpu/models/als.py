@@ -1,0 +1,203 @@
+"""ALS collaborative filtering via batched conjugate gradients.
+
+TPU-native redesign of the reference's ``ALS_CG`` / ``Distributed_ALS``
+(`/root/reference/als_conjugate_gradients.{h,cpp}`): alternating
+optimization of embeddings A (M x R) and B (N x R) against observed sparse
+entries, each half-step solving the ridge normal equations with a batched
+(per-row) CG whose matrix-vector product is the fused SDDMM->SpMM pair
+(`als_conjugate_gradients.cpp:265-301`).
+
+Key deviation by design: the reference manually allreduces CG dot products
+over the R-split communicators when ``r_split`` is set
+(`als_conjugate_gradients.cpp:74-76,95-97`). Here the embeddings are global
+``jax.Array``s in each strategy's canonical sharding, and the batched dots
+are plain ``jnp.sum(x * y, axis=-1)`` under jit — XLA inserts the psum over
+the sharded R dimension automatically. The r_split bookkeeping disappears
+from application code entirely; that is the point of the global-array
+programming model.
+
+The ridge term uses ``lambda=1e-6`` by default rather than the reference's
+1e-13 (`als_conjugate_gradients.cpp:271`), which is below float32 epsilon
+relative to typical Gram-matrix scales; pass ``ridge_lambda`` to override.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from distributed_sddmm_tpu.common import KernelMode, MatMode
+from distributed_sddmm_tpu.parallel.base import DistributedSparse
+
+
+def _batch_dot(x: jax.Array, y: jax.Array) -> jax.Array:
+    """Per-row dot products (reference ``batch_dot_product``,
+    `als_conjugate_gradients.cpp:9-11`); any canonical dense shape with R
+    last."""
+    return jnp.sum(x * y, axis=-1)
+
+
+def _scale_rows(scale: jax.Array, mat: jax.Array) -> jax.Array:
+    return mat * scale[..., None]
+
+
+class DistributedALS:
+    """Alternating least squares over any distributed strategy."""
+
+    def __init__(
+        self,
+        d_ops: DistributedSparse,
+        seed: int = 0,
+        ridge_lambda: float = 1e-6,
+        artificial_groundtruth: bool = True,
+        ground_truth_vals: np.ndarray | None = None,
+        ground_truth_vals_transpose: np.ndarray | None = None,
+    ):
+        self.d_ops = d_ops
+        self.ridge_lambda = ridge_lambda
+        key = jax.random.key(seed)
+        k1, k2, k3, k4 = jax.random.split(key, 4)
+
+        if artificial_groundtruth:
+            # Synthesize observations by an SDDMM of small random factors
+            # (`als_conjugate_gradients.cpp:157-184`): a correct solver must
+            # then drive the residual toward zero.
+            Agt = self._random_like(k1, MatMode.A) / d_ops.R
+            Bgt = self._random_like(k2, MatMode.B) / d_ops.R
+            ones = d_ops.like_s_values(1.0)
+            Agt_s, Bgt_s = d_ops.initial_shift(Agt, Bgt, KernelMode.SDDMM_A)
+            self.ground_truth = d_ops.sddmm_a(Agt_s, Bgt_s, ones)
+            ones_t = d_ops.like_st_values(1.0)
+            Agt_s, Bgt_s = d_ops.initial_shift(Agt, Bgt, KernelMode.SDDMM_B)
+            self.ground_truth_transpose = d_ops.sddmm_b(Agt_s, Bgt_s, ones_t)
+        else:
+            if ground_truth_vals is None:
+                raise ValueError(
+                    "ground_truth_vals required when artificial_groundtruth=False"
+                )
+            self.ground_truth = d_ops.scatter_s_values(ground_truth_vals)
+            # B half-steps need the observations in S^T's canonical nonzero
+            # order (S.with_values(obs).transpose().vals); without them only
+            # A-mode optimization is possible.
+            self.ground_truth_transpose = (
+                d_ops.scatter_st_values(ground_truth_vals_transpose)
+                if ground_truth_vals_transpose is not None
+                else None
+            )
+
+        self.A = None
+        self.B = None
+        self._init_keys = (k3, k4)
+
+    def _random_like(self, key, mode: MatMode) -> jax.Array:
+        shape = self.d_ops.dense_shape(mode)
+        sharding = (
+            self.d_ops.a_sharding() if mode == MatMode.A else self.d_ops.b_sharding()
+        )
+        fn = jax.jit(
+            lambda k: jax.random.uniform(
+                k, shape, self.d_ops.dtype, minval=-1.0, maxval=1.0
+            ),
+            out_shardings=sharding,
+        )
+        return fn(key)
+
+    def initialize_embeddings(self) -> None:
+        """Reference ``initializeEmbeddings``
+        (`als_conjugate_gradients.cpp:221-233`)."""
+        R = self.d_ops.R
+        self.A = self._random_like(self._init_keys[0], MatMode.A) / R * 1.4
+        self.B = self._random_like(self._init_keys[1], MatMode.B) / R / 1.3
+
+    # ------------------------------------------------------------------ #
+    # Normal-equation pieces
+    # ------------------------------------------------------------------ #
+
+    def compute_rhs(self, mode: MatMode) -> jax.Array:
+        """``rhs = S_gt @ B`` (or transpose), `als_conjugate_gradients.cpp:192-205`."""
+        d = self.d_ops
+        if mode == MatMode.A:
+            zero, B_s = d.initial_shift(d.like_a_matrix(0.0), self.B, KernelMode.SPMM_A)
+            out = d.spmm_a(zero, B_s, self.ground_truth)
+            out, _ = d.de_shift(out, None, KernelMode.SPMM_A)
+            return out
+        if self.ground_truth_transpose is None:
+            raise ValueError(
+                "B-mode optimization requires transposed ground-truth values: "
+                "pass ground_truth_vals_transpose (observations in "
+                "S.transpose() nonzero order) to DistributedALS"
+            )
+        A_s, zero = d.initial_shift(self.A, d.like_b_matrix(0.0), KernelMode.SPMM_B)
+        out = d.spmm_b(A_s, zero, self.ground_truth_transpose)
+        _, out = d.de_shift(None, out, KernelMode.SPMM_B)
+        return out
+
+    def compute_queries(self, A, B, mode: MatMode) -> jax.Array:
+        """Apply the Gram operator: ``fusedSpMM + lambda*X``
+        (`als_conjugate_gradients.cpp:265-301`)."""
+        d = self.d_ops
+        if mode == MatMode.A:
+            ones = d.like_s_values(1.0)
+            A_s, B_s = d.initial_shift(A, B, KernelMode.SDDMM_A)
+            out, _ = d.fused_spmm(A_s, B_s, ones, MatMode.A)
+            out, _ = d.de_shift(out, None, KernelMode.SPMM_A)
+            return out + self.ridge_lambda * A
+        ones = d.like_st_values(1.0)
+        A_s, B_s = d.initial_shift(A, B, KernelMode.SDDMM_B)
+        out, _ = d.fused_spmm(A_s, B_s, ones, MatMode.B)
+        _, out = d.de_shift(None, out, KernelMode.SPMM_B)
+        return out + self.ridge_lambda * B
+
+    # ------------------------------------------------------------------ #
+    # Batched CG (`als_conjugate_gradients.cpp:38-141`)
+    # ------------------------------------------------------------------ #
+
+    def cg_optimizer(self, mode: MatMode, cg_max_iter: int = 10) -> None:
+        eps = 1e-8  # nan_avoidance_constant, cpp:40
+        X = self.A if mode == MatMode.A else self.B
+        rhs = self.compute_rhs(mode)
+        Mx = self.compute_queries(self.A, self.B, mode)
+
+        r = rhs - Mx
+        p = r
+        rsold = _batch_dot(r, r)
+
+        for _ in range(cg_max_iter):
+            if mode == MatMode.A:
+                Mp = self.compute_queries(p, self.B, mode)
+            else:
+                Mp = self.compute_queries(self.A, p, mode)
+            bdot = _batch_dot(p, Mp) + eps
+            alpha = (rsold + eps) / bdot
+            X = X + _scale_rows(alpha, p)
+            r = r - _scale_rows(alpha, Mp)
+            rsnew = _batch_dot(r, r)
+            beta = rsnew / (rsold + eps)
+            p = r + _scale_rows(beta, p)
+            rsold = rsnew
+
+        if mode == MatMode.A:
+            self.A = X
+        else:
+            self.B = X
+
+    def run_cg(self, n_alternating_steps: int, cg_iters: int = 10) -> None:
+        """`als_conjugate_gradients.cpp:235-263`."""
+        if self.A is None:
+            self.initialize_embeddings()
+        for _ in range(n_alternating_steps):
+            self.cg_optimizer(MatMode.A, cg_iters)
+            self.cg_optimizer(MatMode.B, cg_iters)
+
+    def compute_residual(self) -> float:
+        """||sddmm(A, B) - ground_truth||_2 (`als_conjugate_gradients.cpp:207-219`)."""
+        d = self.d_ops
+        ones = d.like_s_values(1.0)
+        A_s, B_s = d.initial_shift(self.A, self.B, KernelMode.SDDMM_A)
+        pred = d.sddmm_a(A_s, B_s, ones)
+        diff = np.asarray(pred, dtype=np.float64) - np.asarray(
+            self.ground_truth, dtype=np.float64
+        )
+        return float(np.sqrt(np.sum(diff * diff)))

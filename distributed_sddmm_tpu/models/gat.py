@@ -1,0 +1,132 @@
+"""Multi-head graph attention network forward pass.
+
+TPU-native redesign of the reference's ``GAT`` / ``GATLayer``
+(`/root/reference/gat.hpp:25-113`): per layer and head,
+
+1. local projection ``A_h = X @ W``  (`gat.hpp:88`)
+2. distributed SDDMM at the adjacency pattern -> attention logits
+   (`gat.hpp:93`)
+3. LeakyReLU on the edge values (`gat.hpp:97`)
+4. distributed SpMM aggregation (`gat.hpp:100`)
+5. ReLU into the head's output column block (`gat.hpp:103`)
+
+Deviations, by design:
+
+* Weights are randomly initialized (scaled-uniform) instead of the
+  reference's all-zeros constants (`gat.hpp:76`), which make a forward pass
+  vacuous.
+* The aggregation is a fresh ``h = S_att @ A_h``; the reference accumulated
+  into the buffer still holding the projected features at c=1 (an
+  accidental residual connection, an inconsistency for c>1 —
+  `gat.hpp:94,100` with `15D_dense_shift.hpp:346`), which we do not
+  reproduce.
+* Per-layer R changes (the reference's ``setRValue`` mid-flight,
+  `gat.hpp:84`) simply retrace the strategy's cached jitted programs per
+  distinct shape.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from distributed_sddmm_tpu.common import KernelMode, MatMode
+from distributed_sddmm_tpu.parallel.base import DistributedSparse
+
+
+@dataclasses.dataclass
+class GATLayer:
+    """Layer spec (reference `gat.hpp:25-40`); weights filled by GAT."""
+
+    input_features: int
+    features_per_head: int
+    num_heads: int
+    weights: list = dataclasses.field(default_factory=list)
+
+    @property
+    def output_features(self) -> int:
+        return self.features_per_head * self.num_heads
+
+
+class GAT:
+    def __init__(
+        self,
+        layers: list[GATLayer],
+        d_ops: DistributedSparse,
+        leaky_relu_alpha: float = 0.2,
+        seed: int = 0,
+    ):
+        if d_ops.M != d_ops.N:
+            raise ValueError("GAT requires a square adjacency matrix")
+        if not layers:
+            raise ValueError("need at least one layer")
+        for i in range(1, len(layers)):
+            if layers[i].input_features != layers[i - 1].output_features:
+                raise ValueError(
+                    f"layer {i} input_features {layers[i].input_features} != "
+                    f"layer {i - 1} output {layers[i - 1].output_features}"
+                )
+        self.d_ops = d_ops
+        self.layers = layers
+        self.leaky_relu_alpha = leaky_relu_alpha
+
+        key = jax.random.key(seed)
+        for layer in layers:
+            for _ in range(layer.num_heads):
+                key, sub = jax.random.split(key)
+                bound = 1.0 / math.sqrt(layer.input_features)
+                layer.weights.append(
+                    jax.random.uniform(
+                        sub,
+                        (layer.input_features, layer.features_per_head),
+                        d_ops.dtype,
+                        minval=-bound,
+                        maxval=bound,
+                    )
+                )
+
+    def compute_self_attention_head(self, X: jax.Array, i: int, j: int) -> jax.Array:
+        """One head: projection -> SDDMM -> LeakyReLU -> SpMM -> ReLU
+        (reference ``computeSelfAttentionHead``, `gat.hpp:83-104`)."""
+        d = self.d_ops
+        layer = self.layers[i]
+        alpha = self.leaky_relu_alpha
+
+        d.set_r_value(layer.input_features)
+        A = d.dense_project(X, layer.weights[j], MatMode.A)
+        # GAT mandates M == N, where every strategy's A and B canonical
+        # layouts coincide — the B-role projection is the same array.
+        B = A
+
+        ones = d.like_s_values(1.0)
+        A_s, B_s = d.initial_shift(A, B, KernelMode.SDDMM_A)
+        logits = d.sddmm_a(A_s, B_s, ones)
+        att = jnp.maximum(logits, 0) + jnp.minimum(logits, 0) * alpha  # gat.hpp:97
+
+        _, B_s2 = d.initial_shift(None, B, KernelMode.SPMM_A)
+        h = d.spmm_a(d.like_a_matrix(0.0), B_s2, att)
+        h, _ = d.de_shift(h, None, KernelMode.SPMM_A)
+        return jnp.maximum(h, 0)  # gat.hpp:103
+
+    def forward(self, X: jax.Array | None = None) -> jax.Array:
+        """Full forward pass (`gat.hpp:106-112`).
+
+        ``X`` is node features in A-layout with R = layers[0].input_features;
+        defaults to a deterministic dummy fill.
+        """
+        d = self.d_ops
+        if X is None:
+            d.set_r_value(self.layers[0].input_features)
+            X = d.dummy_initialize(MatMode.A) * (1.0 / (d.M * self.layers[0].input_features))
+        for i, layer in enumerate(self.layers):
+            heads = [
+                self.compute_self_attention_head(X, i, j)
+                for j in range(layer.num_heads)
+            ]
+            X = d.concat_heads(heads, MatMode.A)
+        return X

@@ -226,6 +226,53 @@ class DistributedSparse(abc.ABC):
         mid = self.sddmm_b(A, B, s_vals)
         return self.spmm_b(A, B, mid), mid
 
+    def _unskew_cols(self, X: jax.Array, mode: MatMode):
+        """Resident layout -> global column order (identity unless the
+        strategy skews its resident R layout)."""
+        return X
+
+    def _skew_cols(self, X: jax.Array, mode: MatMode):
+        """Global column order -> resident layout (identity default)."""
+        return X
+
+    def dense_project(self, X: jax.Array, W: jax.Array, mode: MatMode) -> jax.Array:
+        """Local dense projection ``X @ W`` in the canonical layout (the
+        GAT per-head GEMM, reference `gat.hpp:88`). W is (R_in, R_out) in
+        global column order."""
+        self.set_r_value(W.shape[1])
+        sharding = self.a_sharding() if mode == MatMode.A else self.b_sharding()
+        key = ("project", X.shape, W.shape, sharding)
+        if key not in self._programs:
+            self._programs[key] = jax.jit(
+                lambda x, w: self._skew_cols(
+                    jnp.einsum("...r,rk->...k", self._unskew_cols(x, mode), w), mode
+                ),
+                out_shardings=sharding,
+            )
+        return self._programs[key](X, W)
+
+    def concat_heads(self, heads: list, mode: MatMode) -> jax.Array:
+        """Concatenate per-head outputs along the feature dim in the
+        canonical layout (reference per-head column-block writes,
+        `gat.hpp:103`)."""
+        self.set_r_value(sum(h.shape[-1] for h in heads))
+        sharding = self.a_sharding() if mode == MatMode.A else self.b_sharding()
+        key = ("concat", tuple(h.shape for h in heads), sharding)
+        if key not in self._programs:
+            self._programs[key] = jax.jit(
+                lambda *hs: self._skew_cols(
+                    jnp.concatenate(
+                        [self._unskew_cols(h, mode) for h in hs], axis=-1
+                    ),
+                    mode,
+                ),
+                out_shardings=sharding,
+            )
+        return self._programs[key](*heads)
+
+    def set_r_value(self, R: int) -> None:
+        self.R = R
+
     def initial_shift(self, A, B, mode: KernelMode):
         """Pre-skew dense operands if the strategy needs it (no-op default;
         reference `distributed_sparse.h:266-268`)."""

@@ -177,23 +177,55 @@ class CannonSparse25D(DistributedSparse):
     def dummy_initialize(self, mode: MatMode) -> jax.Array:
         shape = self.dense_shape(mode)
         sharding = self.a_sharding() if mode == MatMode.A else self.b_sharding()
-        block = self.localArows if mode == MatMode.A else self.localBrows
-        n, c, la, R = self.sqrtpc, self.c, self._la(), self.R
         key = ("dummy", shape, sharding)
         if key not in self._programs:
 
             def make():
-                r_idx = jnp.arange(shape[0], dtype=jnp.int32)[:, None]
-                i_blk = r_idx // block
-                scp = jnp.arange(R, dtype=jnp.int32)[None, :]
-                q_st = scp // la
-                j, k = q_st // c, q_st % c
-                q_gl = jnp.mod(i_blk + j, n) * c + k
-                g_col = q_gl * la + scp % la
-                return (r_idx * R + g_col).astype(self.dtype)
+                # Global-order fill, then the one device-side skew impl.
+                rows = jnp.arange(shape[0], dtype=self.dtype)[:, None]
+                col = jnp.arange(self.R, dtype=self.dtype)
+                return self._skew_cols(rows * self.R + col, mode)
 
             self._programs[key] = jax.jit(make, out_shardings=sharding)
         return self._programs[key]()
+
+    def _row_blocks(self, X, mode: MatMode):
+        block = self.localArows if mode == MatMode.A else self.localBrows
+        return jnp.arange(X.shape[0], dtype=jnp.int32)[:, None] // block
+
+    def _skew_cols(self, X, mode: MatMode):
+        """global col order -> resident skewed layout: stored[scp] =
+        global[g_col(i_blk, scp)] — device-side iota gather, any width
+        divisible by sqrtpc*c."""
+        n, c = self.sqrtpc, self.c
+        w = X.shape[-1]
+        assert w % (n * c) == 0, (
+            f"feature width {w} must be divisible by sqrt(p/c)*c = {n * c}"
+        )
+        la = w // (n * c)
+        i_blk = self._row_blocks(X, mode)
+        scp = jnp.arange(w, dtype=jnp.int32)[None, :]
+        q_st = scp // la
+        j, k = q_st // c, q_st % c
+        g = (jnp.mod(i_blk + j, n) * c + k) * la + scp % la
+        return jnp.take_along_axis(X, jnp.broadcast_to(g, X.shape), axis=-1)
+
+    def _unskew_cols(self, X, mode: MatMode):
+        """resident skewed layout -> global col order: global[t] =
+        stored[scp(i_blk, t)]."""
+        n, c = self.sqrtpc, self.c
+        w = X.shape[-1]
+        assert w % (n * c) == 0, (
+            f"feature width {w} must be divisible by sqrt(p/c)*c = {n * c}"
+        )
+        la = w // (n * c)
+        i_blk = self._row_blocks(X, mode)
+        t = jnp.arange(w, dtype=jnp.int32)[None, :]
+        q_gl = t // la
+        k, q = q_gl % c, q_gl // c
+        j = jnp.mod(q - i_blk, n)
+        scp = (j * c + k) * la + t % la
+        return jnp.take_along_axis(X, jnp.broadcast_to(scp, X.shape), axis=-1)
 
     # ------------------------------------------------------------------ #
     # Transpose shift (initial_shift == de_shift, self-inverse)

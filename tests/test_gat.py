@@ -1,0 +1,93 @@
+import numpy as np
+import pytest
+
+from distributed_sddmm_tpu.common import MatMode
+from distributed_sddmm_tpu.models.gat import GAT, GATLayer
+from distributed_sddmm_tpu.parallel.cannon_dense_25d import CannonDense25D
+from distributed_sddmm_tpu.parallel.cannon_sparse_25d import CannonSparse25D
+from distributed_sddmm_tpu.parallel.dense_shift_15d import DenseShift15D
+from distributed_sddmm_tpu.parallel.sparse_shift_15d import SparseShift15D
+from distributed_sddmm_tpu.utils import oracle
+from distributed_sddmm_tpu.utils.coo import HostCOO
+
+
+def _graph(M=32, seed=0):
+    return HostCOO.erdos_renyi(M, M, 4, seed=seed)
+
+
+def _gat_oracle(S, X, gat):
+    """Dense numpy forward pass."""
+    alpha = gat.leaky_relu_alpha
+    pat = S.to_scipy().toarray() != 0
+    for layer in gat.layers:
+        heads = []
+        for W in layer.weights:
+            A = X @ np.asarray(W, dtype=np.float64)
+            logits = (A @ A.T) * pat
+            att = np.maximum(logits, 0) + np.minimum(logits, 0) * alpha
+            h = att @ A
+            heads.append(np.maximum(h, 0))
+        X = np.concatenate(heads, axis=-1)
+    return X
+
+
+SPECS = [GATLayer(8, 4, 2), GATLayer(8, 4, 2)]
+
+
+def _fresh_specs():
+    return [GATLayer(s.input_features, s.features_per_head, s.num_heads) for s in SPECS]
+
+
+STRATEGIES = [
+    ("15d_dense_c2", lambda S: DenseShift15D(S, R=8, c=2)),
+    ("15d_sparse_c2", lambda S: SparseShift15D(S, R=8, c=2)),
+    ("25d_dense_c2", lambda S: CannonDense25D(S, R=8, c=2)),
+    ("25d_sparse_c2", lambda S: CannonSparse25D(S, R=8, c=2)),
+]
+
+
+@pytest.mark.parametrize("name,mk", STRATEGIES)
+def test_gat_forward_matches_oracle(name, mk):
+    S = _graph()
+    d_ops = mk(S)
+    gat = GAT(_fresh_specs(), d_ops, seed=3)
+    out = gat.forward()
+    # Oracle on the same default input
+    scale = 1.0 / (d_ops.M * gat.layers[0].input_features)
+    X_host = oracle.dummy_dense(d_ops.M_pad, 8) * scale
+    # pad oracle pattern to M_pad
+    S_pad = HostCOO(S.rows, S.cols, S.vals, d_ops.M_pad, d_ops.M_pad)
+    expected = _gat_oracle(S_pad, X_host, gat)
+    got = d_ops.host_a(out)
+    np.testing.assert_allclose(got, expected[: d_ops.M], rtol=2e-3, atol=1e-5)
+
+
+def test_gat_validates_specs():
+    S = _graph()
+    d_ops = DenseShift15D(S, R=8, c=1)
+    with pytest.raises(ValueError):
+        GAT([GATLayer(8, 4, 2), GATLayer(9, 4, 2)], d_ops)
+    with pytest.raises(ValueError):
+        GAT([], d_ops)
+    rect = HostCOO.erdos_renyi(32, 16, 2, seed=1)
+    with pytest.raises(ValueError):
+        GAT(_fresh_specs(), DenseShift15D(rect, R=8, c=1))
+
+
+def test_gat_benchmark_layer_spec():
+    """The reference benchmark's GAT shape on a small graph: layer widths
+    change per layer, exercising setRValue retraces
+    (`benchmark_dist.cpp:90-92` uses 256->(256x4)->...; scaled down here)."""
+    S = _graph(M=24)
+    d_ops = DenseShift15D(S, R=16, c=1)
+    layers = [GATLayer(16, 8, 2), GATLayer(16, 4, 3)]
+    gat = GAT(layers, d_ops, seed=5)
+    out = gat.forward()
+    assert out.shape[-1] == 12
+    scale = 1.0 / (d_ops.M * 16)
+    X_host = oracle.dummy_dense(d_ops.M_pad, 16) * scale
+    S_pad = HostCOO(S.rows, S.cols, S.vals, d_ops.M_pad, d_ops.M_pad)
+    expected = _gat_oracle(S_pad, X_host, gat)
+    np.testing.assert_allclose(
+        d_ops.host_a(out), expected[: d_ops.M], rtol=2e-3, atol=1e-5
+    )
