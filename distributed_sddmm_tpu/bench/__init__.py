@@ -1,0 +1,16 @@
+"""Benchmark harness: algorithm factory, trial loop, JSON result emission.
+
+TPU-native counterpart of the reference's ``benchmark_dist.{hpp,cpp}`` and
+its CLI drivers (``bench_erdos_renyi.cpp``, ``bench_file.cpp``,
+``bench_heatmap.cpp``): one module + one argparse CLI
+(``python -m distributed_sddmm_tpu.bench``) replace the four positional-argv
+executables.
+"""
+
+from distributed_sddmm_tpu.bench.harness import (
+    ALGORITHM_FACTORIES,
+    benchmark_algorithm,
+    make_algorithm,
+)
+
+__all__ = ["ALGORITHM_FACTORIES", "benchmark_algorithm", "make_algorithm"]

@@ -1,0 +1,3 @@
+from distributed_sddmm_tpu.bench.cli import main
+
+raise SystemExit(main())

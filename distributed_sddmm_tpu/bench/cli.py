@@ -1,0 +1,199 @@
+"""Unified benchmark CLI: ``python -m distributed_sddmm_tpu.bench <cmd>``.
+
+Replaces the reference's positional-argv executables with argparse
+subcommands:
+
+* ``er``      — R-mat / Erdos-Renyi synthetic benchmark
+  (`/root/reference/bench_erdos_renyi.cpp:19-118`)
+* ``file``    — matrix-market file benchmark
+  (`/root/reference/bench_file.cpp:19-101`)
+* ``heatmap`` — R-sweep for the winner heatmap
+  (`/root/reference/bench_heatmap.cpp:19-107`)
+* ``permute`` — random row/col permutation of a .mtx file
+  (`/root/reference/random_permute.cpp:19-59`)
+* ``verify``  — fingerprint cross-check of all algorithms
+  (`/root/reference/scratch.cpp:26-76`)
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from distributed_sddmm_tpu.bench.harness import (
+    ALGORITHM_FACTORIES,
+    benchmark_algorithm,
+)
+from distributed_sddmm_tpu.utils.coo import HostCOO
+
+# `bench_erdos_renyi.cpp:50-115`: "15d" runs both fusion strategies, "25d"
+# runs both replication strategies.
+ALG_GROUPS = {
+    "15d": ["15d_fusion1", "15d_fusion2", "15d_sparse"],
+    "25d": ["25d_dense_replicate", "25d_sparse_replicate"],
+    "all": list(ALGORITHM_FACTORIES),
+}
+
+# `bench_heatmap.cpp:33-35`.
+HEATMAP_R_VALUES = [64, 128, 192, 256, 320, 384, 448]
+
+
+def _resolve_algs(name: str) -> list[str]:
+    if name in ALG_GROUPS:
+        return ALG_GROUPS[name]
+    if name in ALGORITHM_FACTORIES:
+        return [name]
+    raise SystemExit(
+        f"unknown algorithm {name!r}; expected one of "
+        f"{sorted(ALGORITHM_FACTORIES) + sorted(ALG_GROUPS)}"
+    )
+
+
+def _get_kernel(name: str):
+    from distributed_sddmm_tpu.ops import get_kernel
+
+    if name == "auto":
+        try:
+            return get_kernel("pallas")
+        except NotImplementedError:
+            return get_kernel("xla")
+    return get_kernel(name)
+
+
+def _run_configs(S, alg_names, args, r_values=None):
+    kernel = _get_kernel(args.kernel)
+    records = []
+    for alg in alg_names:
+        for R in r_values or [args.R]:
+            for fused in ([True, False] if args.fused == "both" else [args.fused == "yes"]):
+                try:
+                    rec = benchmark_algorithm(
+                        S,
+                        alg,
+                        args.output_file,
+                        fused=fused,
+                        R=R,
+                        c=args.c,
+                        app=args.app,
+                        trials=args.trials,
+                        warmup=args.warmup,
+                        kernel=kernel,
+                    )
+                except ValueError as e:
+                    # Divisibility constraints differ per algorithm
+                    # (reference exits; the sweep driver skips instead).
+                    print(f"skip {alg} R={R} c={args.c}: {e}", file=sys.stderr)
+                    continue
+                records.append(rec)
+                print(
+                    json.dumps(
+                        {
+                            "algorithm": alg,
+                            "R": R,
+                            "c": args.c,
+                            "fused": fused,
+                            "elapsed": round(rec["elapsed"], 4),
+                            "GFLOPs": round(rec["overall_throughput"], 3),
+                        }
+                    )
+                )
+    return records
+
+
+def _add_common(p: argparse.ArgumentParser) -> None:
+    p.add_argument("--app", default="vanilla", choices=["vanilla", "gat", "als"])
+    p.add_argument("--trials", type=int, default=5)
+    p.add_argument("--warmup", type=int, default=1)
+    p.add_argument("--kernel", default="auto", help="xla | pallas | auto")
+    p.add_argument("--fused", default="yes", choices=["yes", "no", "both"])
+    p.add_argument("-o", "--output-file", default=None, help="append JSON records here")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="distributed_sddmm_tpu.bench", description=__doc__)
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    er = sub.add_parser("er", help="synthetic R-mat benchmark")
+    er.add_argument("log_m", type=int, help="log2 of matrix side")
+    er.add_argument("edge_factor", type=int, help="average nnz per row")
+    er.add_argument("alg", help="algorithm name or group (15d | 25d | all)")
+    er.add_argument("R", type=int)
+    er.add_argument("c", type=int)
+    _add_common(er)
+
+    fi = sub.add_parser("file", help="matrix-market file benchmark")
+    fi.add_argument("path", help=".mtx file")
+    fi.add_argument("alg")
+    fi.add_argument("R", type=int)
+    fi.add_argument("c", type=int)
+    fi.add_argument("--permute", action="store_true", help="random row/col permutation first")
+    _add_common(fi)
+
+    hm = sub.add_parser("heatmap", help="R-value sweep on one synthetic matrix")
+    hm.add_argument("log_m", type=int)
+    hm.add_argument("edge_factor", type=int)
+    hm.add_argument("c", type=int)
+    hm.add_argument("--alg", default="all")
+    hm.add_argument("--r-values", type=int, nargs="+", default=HEATMAP_R_VALUES)
+    _add_common(hm)
+    hm.set_defaults(R=None)
+
+    pm = sub.add_parser("permute", help="randomly permute a .mtx file")
+    pm.add_argument("path")
+    pm.add_argument("--seed", type=int, default=0)
+    pm.add_argument("-o", "--output-file", default=None, help="default <in>-permuted.mtx")
+
+    vf = sub.add_parser("verify", help="fingerprint cross-check of algorithms")
+    vf.add_argument("--log-m", type=int, default=8)
+    vf.add_argument("--edge-factor", type=int, default=8)
+    vf.add_argument("--R", type=int, default=16)
+    vf.add_argument("--c", type=int, default=1)
+    vf.add_argument("--alg", default="all")
+    vf.add_argument("--kernel", default="xla")
+
+    args = ap.parse_args(argv)
+
+    if args.cmd == "er":
+        S = HostCOO.rmat(log_m=args.log_m, edge_factor=args.edge_factor, seed=0)
+        _run_configs(S, _resolve_algs(args.alg), args)
+        return 0
+
+    if args.cmd == "file":
+        S = HostCOO.load_mtx(args.path)
+        if args.permute:
+            S = S.random_permuted(seed=0)
+        _run_configs(S, _resolve_algs(args.alg), args)
+        return 0
+
+    if args.cmd == "heatmap":
+        S = HostCOO.rmat(log_m=args.log_m, edge_factor=args.edge_factor, seed=0)
+        _run_configs(S, _resolve_algs(args.alg), args, r_values=args.r_values)
+        return 0
+
+    if args.cmd == "permute":
+        out = args.output_file or args.path.replace(".mtx", "-permuted.mtx")
+        S = HostCOO.load_mtx(args.path).random_permuted(seed=args.seed)
+        S.save_mtx(out)
+        print(f"wrote {out} ({S.M}x{S.N}, nnz={S.nnz})")
+        return 0
+
+    if args.cmd == "verify":
+        from distributed_sddmm_tpu.utils.verify import verify_algorithms
+
+        ok = verify_algorithms(
+            log_m=args.log_m,
+            edge_factor=args.edge_factor,
+            R=args.R,
+            c=args.c,
+            alg_names=_resolve_algs(args.alg),
+            kernel=_get_kernel(args.kernel),
+            verbose=True,
+        )
+        return 0 if ok else 1
+
+    raise AssertionError(args.cmd)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,194 @@
+"""String-keyed algorithm factory + timed trial loop + JSON records.
+
+Mirrors the reference's ``benchmark_algorithm``
+(`/root/reference/benchmark_dist.cpp:26-163`):
+
+* the same five algorithm configurations behind the same magic strings
+  (`benchmark_dist.cpp:45-82`),
+* app selection ``{vanilla, gat, als}`` (`benchmark_dist.cpp:88-100`),
+* a fixed-trial loop (default 5, `benchmark_dist.cpp:117-141`),
+* throughput ``2*nnz*2*R*trials / elapsed`` GFLOP/s
+  (`benchmark_dist.cpp:147-149`),
+* one JSON record appended per run to the output file
+  (`benchmark_dist.cpp:151-163`).
+
+Deviation by design: one **untimed warmup iteration** precedes the timed
+loop so that XLA compilation (which the reference's ahead-of-time C++ build
+has no analog of) is excluded from steady-state throughput. Pass
+``warmup=0`` to time cold-start instead.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from typing import Callable, Optional
+
+import jax
+
+from distributed_sddmm_tpu.common import MatMode
+from distributed_sddmm_tpu.models.als import DistributedALS
+from distributed_sddmm_tpu.models.gat import GAT, GATLayer
+from distributed_sddmm_tpu.parallel.base import DistributedSparse
+from distributed_sddmm_tpu.parallel.cannon_dense_25d import CannonDense25D
+from distributed_sddmm_tpu.parallel.cannon_sparse_25d import CannonSparse25D
+from distributed_sddmm_tpu.parallel.dense_shift_15d import DenseShift15D
+from distributed_sddmm_tpu.parallel.sparse_shift_15d import SparseShift15D
+from distributed_sddmm_tpu.utils.coo import HostCOO
+
+# The five named configurations of `benchmark_dist.cpp:45-82`.
+ALGORITHM_FACTORIES: dict[str, Callable[..., DistributedSparse]] = {
+    "15d_fusion1": lambda S, R, c, **kw: DenseShift15D(
+        S, R=R, c=c, fusion_approach=1, **kw
+    ),
+    "15d_fusion2": lambda S, R, c, **kw: DenseShift15D(
+        S, R=R, c=c, fusion_approach=2, **kw
+    ),
+    "15d_sparse": lambda S, R, c, **kw: SparseShift15D(S, R=R, c=c, **kw),
+    "25d_dense_replicate": lambda S, R, c, **kw: CannonDense25D(S, R=R, c=c, **kw),
+    "25d_sparse_replicate": lambda S, R, c, **kw: CannonSparse25D(S, R=R, c=c, **kw),
+}
+
+# Reference GAT benchmark spec: 256 -> (256 x 4) -> (256 x 4) -> (256 x 6)
+# (`benchmark_dist.cpp:90-92`).
+GAT_REFERENCE_LAYERS = [(256, 256, 4), (1024, 256, 4), (1536, 256, 6)]
+
+
+def make_algorithm(
+    name: str,
+    S: HostCOO,
+    R: int,
+    c: int,
+    kernel=None,
+    devices=None,
+    **kw,
+) -> DistributedSparse:
+    """Instantiate one of the five named algorithm configurations."""
+    if name not in ALGORITHM_FACTORIES:
+        raise ValueError(
+            f"unknown algorithm {name!r}; available: {sorted(ALGORITHM_FACTORIES)}"
+        )
+    return ALGORITHM_FACTORIES[name](S, R, c, kernel=kernel, devices=devices, **kw)
+
+
+def _gat_layers(R: int, num_layers: int = 3) -> list[GATLayer]:
+    """GAT spec shaped like the reference's benchmark network but
+    parameterized on R (features_per_head) so small test runs work: heads
+    (4, 4, 6) as in `benchmark_dist.cpp:90-92`."""
+    heads = [4, 4, 6][:num_layers]
+    layers = []
+    in_feat = R
+    for h in heads:
+        layers.append(GATLayer(input_features=in_feat, features_per_head=R, num_heads=h))
+        in_feat = R * h
+    return layers
+
+
+def _run_vanilla(alg: DistributedSparse, fused: bool, trials: int, warmup: int):
+    """The primary measured loop: ``fusedSpMM`` pairs or unfused
+    sddmmA-then-spmmA (`benchmark_dist.cpp:117-141`)."""
+    A = alg.dummy_initialize(MatMode.A)
+    B = alg.dummy_initialize(MatMode.B)
+    s_vals = alg.like_s_values(1.0)
+
+    def one_trial():
+        if fused:
+            out, mid = alg.fused_spmm(A, B, s_vals, MatMode.A)
+            return out, mid
+        mid = alg.sddmm_a(A, B, s_vals)
+        out = alg.spmm_a(A, B, mid)
+        return out, mid
+
+    for _ in range(warmup):
+        jax.block_until_ready(one_trial())
+    alg.reset_performance_timers()
+    t0 = time.perf_counter()
+    out = None
+    for _ in range(trials):
+        out = one_trial()
+    jax.block_until_ready(out)
+    elapsed = time.perf_counter() - t0
+    return elapsed, {}
+
+
+def _run_gat(alg: DistributedSparse, trials: int, warmup: int, num_layers: int):
+    gat = GAT(_gat_layers(alg.R, num_layers), alg)
+    for _ in range(warmup):
+        jax.block_until_ready(gat.forward())
+    alg.reset_performance_timers()
+    t0 = time.perf_counter()
+    out = None
+    for _ in range(trials):
+        out = gat.forward()
+    jax.block_until_ready(out)
+    return time.perf_counter() - t0, {"gat_heads": [l.num_heads for l in gat.layers]}
+
+
+def _run_als(alg: DistributedSparse, trials: int, warmup: int, cg_iters: int = 10):
+    als = DistributedALS(alg)
+    als.initialize_embeddings()
+    if warmup:
+        als.run_cg(1, cg_iters=cg_iters)  # compiles every program in the loop
+        als.initialize_embeddings()
+    alg.reset_performance_timers()
+    t0 = time.perf_counter()
+    als.run_cg(trials, cg_iters=cg_iters)
+    jax.block_until_ready((als.A, als.B))
+    elapsed = time.perf_counter() - t0
+    return elapsed, {"als_residual": als.compute_residual(), "cg_iters": cg_iters}
+
+
+def benchmark_algorithm(
+    S: HostCOO,
+    algorithm_name: str,
+    output_file: Optional[str],
+    fused: bool,
+    R: int,
+    c: int,
+    app: str = "vanilla",
+    trials: int = 5,
+    warmup: int = 1,
+    kernel=None,
+    devices=None,
+    extra_info: Optional[dict] = None,
+) -> dict:
+    """Run one benchmark configuration; append a JSON record to
+    ``output_file`` (if given) and return it.
+
+    Record schema follows `benchmark_dist.cpp:151-163`: ``alg_info`` (the
+    reference's ``json_algorithm_info``), ``fused``, ``app``,
+    ``overall_throughput`` in GFLOP/s, and per-op ``perf_stats``.
+    """
+    if app not in ("vanilla", "gat", "als"):
+        raise ValueError(f"unknown app {app!r}; expected vanilla | gat | als")
+
+    alg = make_algorithm(algorithm_name, S, R, c, kernel=kernel, devices=devices)
+
+    if app == "vanilla":
+        elapsed, app_stats = _run_vanilla(alg, fused, trials, warmup)
+    elif app == "gat":
+        elapsed, app_stats = _run_gat(alg, trials, warmup, num_layers=3)
+    else:
+        elapsed, app_stats = _run_als(alg, trials, warmup)
+
+    # SDDMM+SpMM pair = 2 ops x 2*nnz*R flops each (`benchmark_dist.cpp:147-149`).
+    nnz = S.nnz
+    throughput = 2.0 * nnz * 2.0 * alg.R * trials / max(elapsed, 1e-12) / 1e9
+
+    record = {
+        "algorithm": algorithm_name,
+        "app": app,
+        "fused": bool(fused),
+        "num_trials": trials,
+        "elapsed": elapsed,
+        "overall_throughput": throughput,
+        "kernel": getattr(alg.kernel, "name", type(alg.kernel).__name__),
+        "alg_info": alg.json_algorithm_info(),
+        "perf_stats": alg.json_perf_statistics(),
+        **app_stats,
+        **(extra_info or {}),
+    }
+    if output_file:
+        with open(output_file, "a") as f:
+            f.write(json.dumps(record) + "\n")
+    return record

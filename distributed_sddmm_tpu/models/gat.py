@@ -77,6 +77,7 @@ class GAT:
 
         key = jax.random.key(seed)
         for layer in layers:
+            layer.weights = []  # never reuse weights from a prior GAT instance
             for _ in range(layer.num_heads):
                 key, sub = jax.random.split(key)
                 bound = 1.0 / math.sqrt(layer.input_features)
@@ -108,8 +109,10 @@ class GAT:
         logits = d.sddmm_a(A_s, B_s, ones)
         att = jnp.maximum(logits, 0) + jnp.minimum(logits, 0) * alpha  # gat.hpp:97
 
-        _, B_s2 = d.initial_shift(None, B, KernelMode.SPMM_A)
-        h = d.spmm_a(d.like_a_matrix(0.0), B_s2, att)
+        # SDDMM_A and SPMM_A share a shift-mode group in every strategy, so
+        # the already-shifted B_s serves the aggregation too — no second
+        # collective.
+        h = d.spmm_a(d.like_a_matrix(0.0), B_s, att)
         h, _ = d.de_shift(h, None, KernelMode.SPMM_A)
         return jnp.maximum(h, 0)  # gat.hpp:103
 

@@ -241,7 +241,7 @@ class DistributedSparse(abc.ABC):
         global column order."""
         self.set_r_value(W.shape[1])
         sharding = self.a_sharding() if mode == MatMode.A else self.b_sharding()
-        key = ("project", X.shape, W.shape, sharding)
+        key = ("project", mode, X.shape, W.shape, sharding)
         if key not in self._programs:
             self._programs[key] = jax.jit(
                 lambda x, w: self._skew_cols(
@@ -257,7 +257,7 @@ class DistributedSparse(abc.ABC):
         `gat.hpp:103`)."""
         self.set_r_value(sum(h.shape[-1] for h in heads))
         sharding = self.a_sharding() if mode == MatMode.A else self.b_sharding()
-        key = ("concat", tuple(h.shape for h in heads), sharding)
+        key = ("concat", mode, tuple(h.shape for h in heads), sharding)
         if key not in self._programs:
             self._programs[key] = jax.jit(
                 lambda *hs: self._skew_cols(

@@ -199,9 +199,10 @@ class CannonSparse25D(DistributedSparse):
         divisible by sqrtpc*c."""
         n, c = self.sqrtpc, self.c
         w = X.shape[-1]
-        assert w % (n * c) == 0, (
-            f"feature width {w} must be divisible by sqrt(p/c)*c = {n * c}"
-        )
+        if w % (n * c) != 0:
+            raise ValueError(
+                f"feature width {w} must be divisible by sqrt(p/c)*c = {n * c}"
+            )
         la = w // (n * c)
         i_blk = self._row_blocks(X, mode)
         scp = jnp.arange(w, dtype=jnp.int32)[None, :]
@@ -215,9 +216,10 @@ class CannonSparse25D(DistributedSparse):
         stored[scp(i_blk, t)]."""
         n, c = self.sqrtpc, self.c
         w = X.shape[-1]
-        assert w % (n * c) == 0, (
-            f"feature width {w} must be divisible by sqrt(p/c)*c = {n * c}"
-        )
+        if w % (n * c) != 0:
+            raise ValueError(
+                f"feature width {w} must be divisible by sqrt(p/c)*c = {n * c}"
+            )
         la = w // (n * c)
         i_blk = self._row_blocks(X, mode)
         t = jnp.arange(w, dtype=jnp.int32)[None, :]

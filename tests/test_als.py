@@ -46,7 +46,7 @@ def test_als_converges_close_to_zero():
     als.initialize_embeddings()
     als.run_cg(4, cg_iters=10)
     r = als.compute_residual()
-    assert r < 1e-3 * als.compute_residual.__self__.d_ops.S_tiles.nnz ** 0.5 or r < 1e-2
+    assert r < 1e-3 * als.d_ops.S_tiles.nnz ** 0.5 or r < 1e-2
 
 
 def test_als_real_ground_truth_values():

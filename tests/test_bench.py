@@ -1,0 +1,125 @@
+"""Benchmark harness + CLI tests (reference `benchmark_dist.cpp`,
+`bench_erdos_renyi.cpp`, `bench_heatmap.cpp`, `scratch.cpp`)."""
+
+import json
+
+import pytest
+
+from distributed_sddmm_tpu.bench.harness import (
+    ALGORITHM_FACTORIES,
+    benchmark_algorithm,
+    make_algorithm,
+)
+from distributed_sddmm_tpu.utils.coo import HostCOO
+from distributed_sddmm_tpu.utils.verify import verify_algorithms
+
+
+@pytest.fixture(scope="module")
+def small_s():
+    return HostCOO.rmat(log_m=7, edge_factor=4, seed=3)
+
+
+def test_factory_has_all_five_reference_configs():
+    assert set(ALGORITHM_FACTORIES) == {
+        "15d_fusion1",
+        "15d_fusion2",
+        "15d_sparse",
+        "25d_dense_replicate",
+        "25d_sparse_replicate",
+    }
+
+
+def test_factory_unknown_name(small_s):
+    with pytest.raises(ValueError, match="unknown algorithm"):
+        make_algorithm("nope", small_s, R=16, c=1)
+
+
+@pytest.mark.parametrize("alg,c", [("15d_fusion2", 2), ("15d_sparse", 2),
+                                   ("25d_dense_replicate", 2)])
+def test_vanilla_record_schema(small_s, tmp_path, alg, c):
+    out = tmp_path / "results.json"
+    rec = benchmark_algorithm(
+        small_s, alg, str(out), fused=True, R=16, c=c, trials=2, warmup=1
+    )
+    assert rec["overall_throughput"] > 0
+    assert rec["elapsed"] > 0
+    assert rec["alg_info"]["nnz"] == small_s.nnz
+    assert rec["alg_info"]["p"] == 8
+    assert rec["alg_info"]["c"] == c
+    assert sum(rec["alg_info"]["nnz_procs"]) == small_s.nnz
+    # strategies with a native fused program log "fusedSpMM"; those using
+    # the base chained implementation log the two constituent ops.
+    assert set(rec["perf_stats"]) & {"fusedSpMM", "sddmmA"}
+    # one JSON line appended
+    lines = out.read_text().strip().splitlines()
+    assert len(lines) == 1 and json.loads(lines[0])["algorithm"] == alg
+
+
+def test_vanilla_unfused(small_s):
+    rec = benchmark_algorithm(
+        small_s, "15d_fusion1", None, fused=False, R=16, c=1, trials=1
+    )
+    assert "sddmmA" in rec["perf_stats"] and "spmmA" in rec["perf_stats"]
+
+
+def test_als_app(small_s):
+    rec = benchmark_algorithm(
+        small_s, "15d_fusion2", None, fused=True, R=16, c=1,
+        app="als", trials=1, warmup=0,
+    )
+    assert rec["als_residual"] >= 0
+
+
+def test_gat_app(small_s):
+    rec = benchmark_algorithm(
+        small_s, "15d_fusion2", None, fused=True, R=8, c=1,
+        app="gat", trials=1, warmup=0,
+    )
+    assert rec["gat_heads"] == [4, 4, 6]
+
+
+def test_bad_app(small_s):
+    with pytest.raises(ValueError, match="unknown app"):
+        benchmark_algorithm(small_s, "15d_fusion2", None, True, 16, 1, app="wat")
+
+
+def test_verify_driver_all_algorithms():
+    # c=2, R=16: every algorithm is constructible on p=8 (p/c=4 | R etc.)
+    assert verify_algorithms(log_m=6, edge_factor=4, R=16, c=2, verbose=False)
+
+
+def test_cli_er_and_heatmap(tmp_path, capsys):
+    from distributed_sddmm_tpu.bench.cli import main
+
+    out = tmp_path / "er.json"
+    assert main(["er", "6", "4", "15d_fusion2", "16", "1",
+                 "--trials", "1", "--kernel", "xla", "-o", str(out)]) == 0
+    assert json.loads(out.read_text().splitlines()[0])["overall_throughput"] > 0
+
+    assert main(["heatmap", "6", "4", "1", "--alg", "15d_fusion2",
+                 "--r-values", "8", "16", "--trials", "1", "--kernel", "xla"]) == 0
+    printed = [json.loads(l) for l in capsys.readouterr().out.strip().splitlines()]
+    assert {p["R"] for p in printed if "R" in p} == {8, 16}
+
+
+def test_cli_permute_roundtrip(tmp_path):
+    from distributed_sddmm_tpu.bench.cli import main
+
+    S = HostCOO.rmat(log_m=5, edge_factor=4, seed=1)
+    src = tmp_path / "m.mtx"
+    S.save_mtx(str(src))
+    assert main(["permute", str(src), "--seed", "7"]) == 0
+    P = HostCOO.load_mtx(str(tmp_path / "m-permuted.mtx"))
+    assert P.nnz == S.nnz and P.M == S.M
+    # permutation preserves the value multiset
+    import numpy as np
+
+    assert np.allclose(sorted(P.vals), sorted(S.vals))
+
+
+def test_cli_verify(capsys):
+    from distributed_sddmm_tpu.bench.cli import main
+
+    assert main(["verify", "--log-m", "6", "--edge-factor", "4",
+                 "--R", "16", "--c", "2"]) == 0
+    assert "OK" in capsys.readouterr().out
