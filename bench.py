@@ -54,28 +54,33 @@ def main() -> None:
     B = alg.like_b_matrix(0.01)
     s_vals = alg.like_s_values(1.0)
 
-    # Trials are CHAINED (each consumes the previous output, normalized to
-    # keep magnitudes finite) and the loop ends with a scalar host fetch.
-    # Rationale: on async/tunneled backends block_until_ready alone does not
-    # force execution, and independent same-input calls could be elided; a
-    # data-dependent chain plus one fetch guarantees every trial really ran.
-    norm = jax.jit(
-        lambda x: x * jax.lax.rsqrt(jnp.mean(x * x) + 1e-9),
-        out_shardings=alg.a_sharding(),
-    )
+    # Trials are CHAINED (each consumes the previous output, scaled to keep
+    # magnitudes finite) inside ONE jitted fori_loop ending in a scalar host
+    # fetch. Rationale: on async/tunneled backends block_until_ready alone
+    # does not force execution, independent same-input calls could be elided,
+    # and per-call dispatch latency through a remote tunnel would otherwise
+    # dominate the measurement; a single compiled data-dependent chain plus
+    # one fetch times exactly the device work.
+    pair = alg.fused_program(s_vals, MatMode.A)
 
-    # Warmup (compile both programs)
-    out, _ = alg.fused_spmm(A, B, s_vals, MatMode.A)
-    A_t = norm(out)
-    float(A_t.sum())
+    from functools import partial
 
+    @partial(jax.jit, static_argnums=2)
+    def chain(A_t, B, n):
+        def body(_, A_t):
+            out, _ = pair(A_t, B)
+            return A_t + out * 1e-12
+        return jax.lax.fori_loop(0, n, body, A_t)
+
+    # Warmup / compile both trip counts.
+    float(chain(A, B, 1).sum())
+    float(chain(A, B, 1 + trials).sum())
     t0 = time.perf_counter()
-    A_t = A
-    for _ in range(trials):
-        out, _ = alg.fused_spmm(A_t, B, s_vals, MatMode.A)
-        A_t = norm(out)
-    float(A_t.sum())  # forces the whole chain
-    elapsed = time.perf_counter() - t0
+    float(chain(A, B, 1).sum())
+    t_one = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    float(chain(A, B, 1 + trials).sum())
+    elapsed = (time.perf_counter() - t0) - t_one
 
     # Reference throughput formula (`benchmark_dist.cpp:147-149`).
     flops = 2.0 * S.nnz * 2.0 * R * trials

@@ -1,0 +1,256 @@
+"""Host-side 2-D block bucketing: flat COO tiles -> MXU chunk lists.
+
+The TPU has no vectorized random gather, so the Pallas kernels
+(``ops/pallas_kernels.py``) express SDDMM's A[row]/B[col] row gathers and
+SpMM's row scatter as small dense matmuls with on-the-fly one-hot selector
+matrices — MXU work instead of memory-system work. For that to pay off, each
+matmul must touch only a small dense block, so every tile's nonzeros are
+bucketed by ``(row_block, col_block)`` of size ``BM x BN`` and packed into
+**chunks of 128** (one VPU lane row per nonzero).
+
+The kernel then runs a 1-D grid over the chunk list; per-chunk scalar
+metadata (which blocks to DMA, when to zero / flush the output accumulator)
+is scalar-prefetched from SMEM. This mirrors how the reference tiles S into
+block columns sized for cache (`/root/reference/SpmatLocal.hpp:541-563`) and
+keeps max-size padded buffers for static shapes (`SpmatLocal.hpp:153-169`) —
+here the padding target is the chunk grid instead of max_nnz.
+
+Everything in this module is one-time numpy setup on the host, keyed off the
+same ``scatter_index`` flat layout that ``parallel/sharding.build_tiles``
+produces, so device-side relayout between the flat value layout and the
+chunk layout is a cheap gather in both directions.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+CHUNK = 128  # nonzeros per chunk = VPU lane count
+
+# meta word packing: | gr (15 bits) | gc (15 bits) | last | first |
+_GR_SHIFT = 17
+_GC_SHIFT = 2
+MAX_BLOCKS = 1 << 15
+
+
+def pick_block(frame: int, preferred: int = 512) -> int:
+    """Largest power-of-two block size <= preferred that the padded frame
+    supports. Frames are padded to a multiple of the result, so any
+    power-of-two works; smaller frames use one block."""
+    b = preferred
+    while b > CHUNK and b >= 2 * frame:
+        b //= 2
+    return b
+
+
+def pad_frame(frame: int, block: int) -> int:
+    return -(-frame // block) * block
+
+
+@dataclasses.dataclass(frozen=True)
+class BlockedMeta:
+    """Host-side chunk-list encoding for every (device, tile) bucket.
+
+    Arrays are indexed by flat bucket id ``b`` (device-major, tile-minor) —
+    the same ordering as ``build_tiles``'s flat layout. The chunk layout IS
+    the tile's flat nonzero layout: position ``b * C * CHUNK + chunk * CHUNK
+    + lane`` holds one nonzero (or an inert pad), so value vectors need no
+    relayout between the XLA and Pallas kernel paths.
+    """
+
+    lr: np.ndarray        # [NB, C, CHUNK] int32 — row within its row block
+    lc: np.ndarray        # [NB, C, CHUNK] int32 — col within its col block
+    meta: np.ndarray      # [NB, C] int32 — packed (gr, gc, first, last)
+    host_to_chunk: np.ndarray  # [nnz] int64 — host nonzero -> absolute position
+    pad_lane: np.ndarray  # [NB, C, CHUNK] bool — True at inert pad lanes
+    bm: int               # row block size
+    bn: int               # col block size
+    gr_blocks: int        # row blocks per (padded) tile frame
+    gc_blocks: int
+    n_chunks: int         # C, padded axis-max chunks per bucket
+
+    @property
+    def rows_pad(self) -> int:
+        return self.gr_blocks * self.bm
+
+    @property
+    def cols_pad(self) -> int:
+        return self.gc_blocks * self.bn
+
+    def global_rows(self) -> np.ndarray:
+        """Tile-frame row index of every chunk lane, [NB, C, CHUNK] int32
+        (pad lanes -> 0). Makes the chunk layout consumable by the flat
+        gather/segment-sum kernels."""
+        gr, _, _, _ = unpack_meta(self.meta)
+        rows = gr[:, :, None] * self.bm + self.lr
+        return np.where(self.pad_lane, 0, rows).astype(np.int32)
+
+    def global_cols(self) -> np.ndarray:
+        _, gc, _, _ = unpack_meta(self.meta)
+        cols = gc[:, :, None] * self.bn + self.lc
+        return np.where(self.pad_lane, 0, cols).astype(np.int32)
+
+
+def pack_meta(gr, gc, first, last):
+    return (
+        (gr.astype(np.int64) << _GR_SHIFT)
+        | (gc.astype(np.int64) << _GC_SHIFT)
+        | (last.astype(np.int64) << 1)
+        | first.astype(np.int64)
+    ).astype(np.int32)
+
+
+def build_blocked(
+    n_buckets: int,
+    bucket: np.ndarray,   # host nnz order -> flat (device, tile) bucket id
+    local_r: np.ndarray,  # host nnz order, tile-local rows
+    local_c: np.ndarray,
+    tile_rows: int,
+    tile_cols: int,
+    block_rows: int = 512,
+    block_cols: int = 512,
+) -> BlockedMeta:
+    """Build the chunk-list encoding.
+
+    Guarantees the kernels rely on:
+
+    * chunks of one bucket are sorted by ``(gr, gc)``;
+    * every row block ``gr`` of every bucket has >= 1 chunk (so the output
+      accumulator is always zeroed and flushed, even for empty row blocks);
+    * the ``first`` / ``last`` flags mark the boundary chunks of each
+      bucket's ``gr`` group;
+    * pad lanes carry ``lr = lc = 0`` and are flagged in ``pad_lane`` (value
+      vectors must be zero there — ``build_tiles`` enforces this via the
+      mask);
+    * trailing bucket-pad chunks (to reach the shared C) have no flags set
+      and gr = gr_blocks-1, gc = gc_blocks-1: they keep the kernel's output
+      window pinned on the bucket's LAST (already flushed) row block. Pad
+      chunks must never remap the output window — Pallas output buffers are
+      write-only, so a remapped-but-unwritten window would flush stale VMEM
+      over a correct block at grid end.
+    """
+    bm = pick_block(tile_rows, block_rows)
+    bn = pick_block(tile_cols, block_cols)
+    gr_blocks = max(-(-tile_rows // bm), 1)
+    gc_blocks = max(-(-tile_cols // bn), 1)
+    if gr_blocks > MAX_BLOCKS or gc_blocks > MAX_BLOCKS:
+        raise ValueError(
+            f"tile frame {tile_rows}x{tile_cols} exceeds the packed-meta "
+            f"limit of {MAX_BLOCKS} blocks per axis"
+        )
+
+    nnz = local_r.size
+    bucket = bucket.astype(np.int64)
+    gr = (local_r // bm).astype(np.int64)
+    gc = (local_c // bn).astype(np.int64)
+
+    # Sort nonzeros by (bucket, gr, gc); stable keeps flat-slot order within.
+    key = (bucket * gr_blocks + gr) * gc_blocks + gc
+    order = np.argsort(key, kind="stable")
+    key_sorted = key[order]
+
+    # nnz per (bucket, gr, gc) pair and chunks per pair.
+    n_pairs = n_buckets * gr_blocks * gc_blocks
+    pair_counts = np.bincount(key_sorted, minlength=n_pairs)
+    pair_chunks = -(-pair_counts // CHUNK)
+
+    # Ensure >= 1 chunk for every (bucket, gr): give empty gr GROUPS one pad
+    # chunk at gc = 0.
+    group_chunks = pair_chunks.reshape(n_buckets, gr_blocks, gc_blocks)
+    group_tot = group_chunks.sum(axis=2)
+    need_pad_group = group_tot == 0
+    pair_chunks = group_chunks.copy()
+    pair_chunks[:, :, 0][need_pad_group] = 1
+    pair_chunks = pair_chunks.reshape(-1)
+
+    chunks_per_bucket = pair_chunks.reshape(n_buckets, -1).sum(axis=1)
+    C = max(int(chunks_per_bucket.max(initial=0)), 1)
+
+    # Chunk start offset (within its bucket) for every pair.
+    pair_chunk_start = np.zeros(n_pairs, dtype=np.int64)
+    np.cumsum(pair_chunks[:-1], out=pair_chunk_start[1:])
+    # pair_chunk_start counts from the global running total; rebase per bucket
+    bucket_first_pair = (
+        np.arange(n_buckets) * gr_blocks * gc_blocks
+    )
+    pair_chunk_start -= np.repeat(
+        pair_chunk_start[bucket_first_pair], gr_blocks * gc_blocks
+    )
+
+    # Place each nonzero: chunk = pair's start + within//CHUNK, lane = within%CHUNK.
+    pair_nnz_start = np.zeros(n_pairs, dtype=np.int64)
+    np.cumsum(pair_counts[:-1], out=pair_nnz_start[1:])
+    within = np.arange(nnz, dtype=np.int64) - pair_nnz_start[key_sorted]
+    chunk_in_bucket = pair_chunk_start[key_sorted] + within // CHUNK
+    lane = within % CHUNK
+    pos_sorted = (bucket[order] * C + chunk_in_bucket) * CHUNK + lane
+
+    total = n_buckets * C * CHUNK
+    lr_flat = np.zeros(total, dtype=np.int32)
+    lc_flat = np.zeros(total, dtype=np.int32)
+    pad_lane = np.ones(total, dtype=bool)
+    lr_flat[pos_sorted] = (local_r[order] % bm).astype(np.int32)
+    lc_flat[pos_sorted] = (local_c[order] % bn).astype(np.int32)
+    pad_lane[pos_sorted] = False
+
+    host_to_chunk = np.empty(nnz, dtype=np.int64)
+    host_to_chunk[order] = pos_sorted
+
+    # Packed per-chunk metadata. Trailing bucket-pad chunks default to the
+    # last (gr, gc) block with no flags, pinning the output window (see
+    # docstring).
+    meta = np.full(
+        (n_buckets, C),
+        int(pack_meta(
+            np.int64(gr_blocks - 1), np.int64(gc_blocks - 1),
+            np.int64(0), np.int64(0),
+        )),
+        dtype=np.int32,
+    )
+    pair_gr = (np.arange(n_pairs) // gc_blocks) % gr_blocks
+    pair_gc = np.arange(n_pairs) % gc_blocks
+    pair_bucket = np.arange(n_pairs) // (gr_blocks * gc_blocks)
+    # Expand pairs to chunks; a bucket's chunks are consecutive and ordered
+    # by (gr, gc), so positions within the bucket are just a running index.
+    ch_bucket = np.repeat(pair_bucket, pair_chunks)
+    ch_gr = np.repeat(pair_gr, pair_chunks)
+    ch_gc = np.repeat(pair_gc, pair_chunks)
+    bucket_chunk_offset = np.zeros(n_buckets, dtype=np.int64)
+    np.cumsum(chunks_per_bucket[:-1], out=bucket_chunk_offset[1:])
+    ch_pos = np.arange(ch_bucket.size, dtype=np.int64) - np.repeat(
+        bucket_chunk_offset, chunks_per_bucket
+    )
+    # first/last chunk of each bucket's gr group (groups are contiguous).
+    grp_key = ch_bucket * gr_blocks + ch_gr
+    first = np.ones(ch_bucket.size, dtype=np.int64)
+    first[1:] = grp_key[1:] != grp_key[:-1]
+    last = np.ones(ch_bucket.size, dtype=np.int64)
+    last[:-1] = grp_key[1:] != grp_key[:-1]
+    meta[ch_bucket, ch_pos] = pack_meta(ch_gr, ch_gc, first, last)
+
+    return BlockedMeta(
+        lr=lr_flat.reshape(n_buckets, C, CHUNK),
+        lc=lc_flat.reshape(n_buckets, C, CHUNK),
+        meta=meta,
+        host_to_chunk=host_to_chunk,
+        pad_lane=pad_lane.reshape(n_buckets, C, CHUNK),
+        bm=bm,
+        bn=bn,
+        gr_blocks=gr_blocks,
+        gc_blocks=gc_blocks,
+        n_chunks=C,
+    )
+
+
+def unpack_meta(word):
+    """Inverse of :func:`pack_meta` (numpy or jax arrays).
+
+    gr is masked like gc: the word is int32, so an unmasked arithmetic shift
+    would sign-extend gr >= 16384 into negative block indices."""
+    gr = (word >> _GR_SHIFT) & (MAX_BLOCKS - 1)
+    gc = (word >> _GC_SHIFT) & (MAX_BLOCKS - 1)
+    last = (word >> 1) & 1
+    first = word & 1
+    return gr, gc, first, last

@@ -91,13 +91,16 @@ class DenseShift15D(DistributedSparse):
 
         layout_s = ShardedBlockCyclicColumn(self.M_pad, self.N_pad, p, c)
         layout_st = ShardedBlockCyclicColumn(self.N_pad, self.M_pad, p, c)
+        block = getattr(self.kernel, "is_blocked", False)
         self.S_tiles = build_tiles(
             S, grid, layout_s,
             tile_rows=self.localArows * c, tile_cols=self.localBrows, dtype=dtype,
+            block=block,
         )
         self.ST_tiles = build_tiles(
             S.transpose(), grid, layout_st,
             tile_rows=self.localBrows * c, tile_cols=self.localArows, dtype=dtype,
+            block=block,
         )
 
     def set_r_value(self, R: int) -> None:
@@ -109,6 +112,9 @@ class DenseShift15D(DistributedSparse):
     # shard_map programs
     # ------------------------------------------------------------------ #
 
+    def _use_blocked(self, tiles) -> bool:
+        return getattr(self.kernel, "is_blocked", False) and tiles.has_blocked
+
     def _program(self, op: str, use_st: bool):
         """Build (and cache) the jitted shard_map program for one op.
 
@@ -116,10 +122,19 @@ class DenseShift15D(DistributedSparse):
         selects the transposed tile set (B-output variants). The moving
         operand always rotates along the ``rows`` axis; the stationary
         operand is replicated over the ``cols`` axis.
+
+        When the kernel is blocked-capable (Pallas) and the tiles carry
+        chunk-list metadata, the blocked program variants are built instead:
+        same ring/collective structure, but local compute runs feature-major
+        through the tile-level Pallas kernels.
         """
         key = (op, use_st)
         if key in self._programs:
             return self._programs[key]
+        if self._use_blocked(self.ST_tiles if use_st else self.S_tiles):
+            fn = self._build_blocked_program(op, use_st)
+            self._programs[key] = fn
+            return fn
 
         tiles = self.ST_tiles if use_st else self.S_tiles
         nr, c = self.nr, self.c
@@ -266,44 +281,234 @@ class DenseShift15D(DistributedSparse):
         return fn
 
     # ------------------------------------------------------------------ #
+    # Blocked (Pallas) shard_map programs — same ring/collective skeleton,
+    # local compute through the feature-major tile kernels.
+    # ------------------------------------------------------------------ #
+
+    def _build_blocked_program(self, op: str, use_st: bool):
+        from distributed_sddmm_tpu.ops.pallas_kernels import BlockedTile
+
+        tiles = self.ST_tiles if use_st else self.S_tiles
+        nr, c = self.nr, self.c
+        T, max_nnz = tiles.n_tiles, tiles.max_nnz
+        stat_rows = tiles.tile_rows
+        kern = self.kernel
+        perm = ring_perm(nr)
+        unroll = self.unroll
+        bm, bn, grb, gcb = tiles.blk_geom
+        rows_pad, cols_pad = grb * bm, gcb * bn
+        chunk_len = 128
+
+        def shift_mov(state):
+            carry, mov = state
+            return carry, lax.ppermute(mov, "rows", perm)
+
+        def tile_at(arr, s):
+            if unroll:
+                return arr[s]
+            return lax.dynamic_index_in_dim(arr, s, axis=0, keepdims=False)
+
+        def replicate(stat_blk):
+            if c == 1:
+                return stat_blk
+            return lax.all_gather(stat_blk, "cols", axis=0, tiled=True)
+
+        def reduce_out(acc):
+            if c == 1:
+                return acc
+            return lax.psum_scatter(acc, "cols", scatter_dimension=0, tiled=True)
+
+        def dvary(x):
+            return vary(x, ("rows", "cols"))
+
+        def squeeze_blk(blr, blc, bmeta):
+            C = blr.shape[-2]
+            return (
+                blr.reshape(T, C, chunk_len),
+                blc.reshape(T, C, chunk_len),
+                bmeta.reshape(T, C),
+            )
+
+        def blk_at(fields, s):
+            blr, blc, bmeta = fields
+            return BlockedTile(
+                tile_at(blr, s), tile_at(blc, s), tile_at(bmeta, s),
+                bm=bm, bn=bn, gr_blocks=grb, gc_blocks=gcb,
+            )
+
+        def sddmm_pass(at, mov, fields, t_vals, out_vals, complete_rotation=False):
+            def body(s, state):
+                out_vals, mov = state
+                mid = kern.sddmm_tile_t(
+                    blk_at(fields, s), tile_at(t_vals, s),
+                    at, kern.prep(mov, cols_pad), t_vals.dtype,
+                )
+                return out_vals.at[s].set(mid), mov
+
+            return ring_loop(
+                nr, body, (out_vals, mov), shift_mov,
+                shift_final=shift_mov if complete_rotation else None,
+                unroll=unroll,
+            )
+
+        def spmm_pass(mov, fields, vals_tiles, accT):
+            def body(s, state):
+                accT, mov = state
+                accT = accT + kern.spmm_tile_t(
+                    blk_at(fields, s), tile_at(vals_tiles, s),
+                    kern.prep(mov, cols_pad),
+                )
+                return accT, mov
+
+            return ring_loop(nr, body, (accT, mov), shift_mov, unroll=unroll)
+
+        def finish(accT, like):
+            return reduce_out(accT.T[:stat_rows].astype(like.dtype))
+
+        dense_spec = _DENSE_SPEC
+        BLK6 = P("rows", "cols", None, None, None, None)
+        blk_specs = (BLK6, BLK6, _TILE_SPEC)
+        mesh = self.grid.mesh
+
+        if op == "sddmm":
+
+            def prog(stat, mov, blr, blc, bmeta, t_vals):
+                fields = squeeze_blk(blr, blc, bmeta)
+                t_vals = t_vals.reshape(T, max_nnz)
+                at = kern.prep(replicate(stat), rows_pad)
+                out_vals = dvary(jnp.zeros((T, max_nnz), t_vals.dtype))
+                out_vals, _ = sddmm_pass(at, mov, fields, t_vals, out_vals)
+                return out_vals.reshape(1, 1, 1, T, max_nnz)
+
+            in_specs = (dense_spec, dense_spec) + blk_specs + (_TILE_SPEC,)
+            out_specs = _TILE_SPEC
+
+        elif op == "spmm":
+
+            def prog(mov, blr, blc, bmeta, t_vals):
+                fields = squeeze_blk(blr, blc, bmeta)
+                t_vals = t_vals.reshape(T, max_nnz)
+                accT = dvary(jnp.zeros((mov.shape[-1], rows_pad), jnp.float32))
+                accT, _ = spmm_pass(mov, fields, t_vals, accT)
+                return finish(accT, mov)
+
+            in_specs = (dense_spec,) + blk_specs + (_TILE_SPEC,)
+            out_specs = dense_spec
+
+        elif op == "fused":
+
+            def prog(stat, mov, blr, blc, bmeta, t_vals):
+                fields = squeeze_blk(blr, blc, bmeta)
+                t_vals = t_vals.reshape(T, max_nnz)
+                at = kern.prep(replicate(stat), rows_pad)
+
+                def body(s, state):
+                    (accT, out_vals), mov = state
+                    pT, mid = kern.fused_tile_t(
+                        blk_at(fields, s), tile_at(t_vals, s),
+                        at, kern.prep(mov, cols_pad), t_vals.dtype,
+                    )
+                    return (accT + pT, out_vals.at[s].set(mid)), mov
+
+                init = (
+                    dvary(jnp.zeros((mov.shape[-1], rows_pad), jnp.float32)),
+                    dvary(jnp.zeros((T, max_nnz), t_vals.dtype)),
+                )
+                (accT, out_vals), _ = ring_loop(
+                    nr, body, (init, mov), shift_mov, unroll=unroll
+                )
+                return finish(accT, mov), out_vals.reshape(1, 1, 1, T, max_nnz)
+
+            in_specs = (dense_spec, dense_spec) + blk_specs + (_TILE_SPEC,)
+            out_specs = (dense_spec, _TILE_SPEC)
+
+        elif op == "fused_twopass":
+
+            def prog(stat, mov, blr, blc, bmeta, t_vals):
+                fields = squeeze_blk(blr, blc, bmeta)
+                t_vals = t_vals.reshape(T, max_nnz)
+                at = kern.prep(replicate(stat), rows_pad)
+                out_vals = dvary(jnp.zeros((T, max_nnz), t_vals.dtype))
+                out_vals, mov = sddmm_pass(
+                    at, mov, fields, t_vals, out_vals, complete_rotation=True
+                )
+                accT = dvary(jnp.zeros((mov.shape[-1], rows_pad), jnp.float32))
+                accT, _ = spmm_pass(mov, fields, out_vals, accT)
+                return finish(accT, mov), out_vals.reshape(1, 1, 1, T, max_nnz)
+
+            in_specs = (dense_spec, dense_spec) + blk_specs + (_TILE_SPEC,)
+            out_specs = (dense_spec, _TILE_SPEC)
+
+        else:
+            raise ValueError(op)
+
+        # check_vma=False: pallas_call out_shapes carry no varying-mesh-axes
+        # annotation, which the strict checker rejects inside shard_map.
+        return jax.jit(
+            shard_map(
+                prog, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                check_vma=False,
+            )
+        )
+
+    def _tile_args(self, tiles, vals) -> tuple:
+        """The per-path tile operands following the dense args."""
+        if self._use_blocked(tiles):
+            return (tiles.blk_lr, tiles.blk_lc, tiles.blk_meta, vals)
+        return (tiles.rows, tiles.cols, vals)
+
+    # ------------------------------------------------------------------ #
     # Public ops
     # ------------------------------------------------------------------ #
 
     def sddmm_a(self, A, B, s_vals):
         prog = self._program("sddmm", use_st=False)
         return self._timed(
-            "sddmmA", prog, A, B, self.S_tiles.rows, self.S_tiles.cols, s_vals
+            "sddmmA", prog, A, B, *self._tile_args(self.S_tiles, s_vals)
         )
 
     def sddmm_b(self, A, B, st_vals):
         prog = self._program("sddmm", use_st=True)
         return self._timed(
-            "sddmmB", prog, B, A, self.ST_tiles.rows, self.ST_tiles.cols, st_vals
+            "sddmmB", prog, B, A, *self._tile_args(self.ST_tiles, st_vals)
         )
 
     def spmm_a(self, A, B, s_vals):
         prog = self._program("spmm", use_st=False)
-        out = self._timed(
-            "spmmA", prog, B, self.S_tiles.rows, self.S_tiles.cols, s_vals
+        return self._timed(
+            "spmmA", prog, B, *self._tile_args(self.S_tiles, s_vals)
         )
-        return out
 
     def spmm_b(self, A, B, st_vals):
         prog = self._program("spmm", use_st=True)
         return self._timed(
-            "spmmB", prog, A, self.ST_tiles.rows, self.ST_tiles.cols, st_vals
+            "spmmB", prog, A, *self._tile_args(self.ST_tiles, st_vals)
         )
+
+    def fused_program(self, s_vals, mode: MatMode = MatMode.A):
+        """Public raw-program accessor: returns ``f(A, B) -> (out, mid)``
+        running one compiled fused SDDMM->SpMM pair (no host-side timing
+        wrappers). Benchmarks chain this inside a jitted loop — per-call
+        dispatch latency on tunneled backends would otherwise dominate."""
+        op = "fused" if self.fusion_approach == 2 else "fused_twopass"
+        use_st = mode == MatMode.B
+        tiles = self.ST_tiles if use_st else self.S_tiles
+        prog = self._program(op, use_st)
+        args = self._tile_args(tiles, s_vals)
+        if use_st:
+            return lambda A, B: prog(B, A, *args)
+        return lambda A, B: prog(A, B, *args)
 
     def fused_spmm(self, A, B, s_vals, mode: MatMode = MatMode.A):
         op = "fused" if self.fusion_approach == 2 else "fused_twopass"
         if mode == MatMode.A:
             prog = self._program(op, use_st=False)
             out, mid = self._timed(
-                "fusedSpMM", prog, A, B, self.S_tiles.rows, self.S_tiles.cols, s_vals
+                "fusedSpMM", prog, A, B, *self._tile_args(self.S_tiles, s_vals)
             )
             return out, mid
         prog = self._program(op, use_st=True)
         out, mid = self._timed(
-            "fusedSpMM", prog, B, A, self.ST_tiles.rows, self.ST_tiles.cols, s_vals
+            "fusedSpMM", prog, B, A, *self._tile_args(self.ST_tiles, s_vals)
         )
         return out, mid

@@ -49,6 +49,19 @@ class TileSet:
     nnz: int
     grid: GridSpec
     nnz_per_device: np.ndarray  # (nr, nc, nh) — load-imbalance observability
+    # MXU chunk-list encoding (ops/blocked.py) for the Pallas kernels;
+    # None when blocking was skipped. When present, the flat nonzero layout
+    # (rows/cols/mask and every value vector) IS the chunk layout, so both
+    # kernel families consume the same value arrays. blk_* arrays share the
+    # mesh sharding of rows/cols with trailing per-bucket dims.
+    blk_lr: jax.Array = None    # (nr, nc, nh, T, C, 128) int32
+    blk_lc: jax.Array = None
+    blk_meta: jax.Array = None  # (nr, nc, nh, T, C) int32 packed
+    blk_geom: tuple = None      # (bm, bn, gr_blocks, gc_blocks)
+
+    @property
+    def has_blocked(self) -> bool:
+        return self.blk_lr is not None
 
     @property
     def shape(self) -> tuple:
@@ -204,13 +217,19 @@ def build_tiles(
     tile_cols: int,
     dtype=jnp.float32,
     min_pad: int = 1,
+    block: bool = False,
 ) -> TileSet:
     """Bucket ``S``'s nonzeros by (device, tile) and pad to a static shape.
 
     ``layout`` is called with ``(rows, cols)`` and must return a
     :class:`~distributed_sddmm_tpu.parallel.layouts.LayoutResult`; its
     ``n_tiles`` attribute fixes T. ``min_pad`` keeps max_nnz >= 1 so empty
-    matrices still produce valid static shapes.
+    matrices still produce valid static shapes. ``block=True`` additionally
+    builds the MXU chunk-list encoding (``ops/blocked.py``) consumed by the
+    Pallas kernels (and makes the chunk layout the flat value layout, which
+    inflates max_nnz by the chunk padding — only ask for it when the kernel
+    consumes it); it is skipped automatically when the block-pair grid would
+    be degenerate (see ``_BLOCK_PAIR_LIMIT``).
     """
     nr, nc, nh = grid.nr, grid.nc, grid.nh
     T = layout.n_tiles
@@ -225,30 +244,66 @@ def build_tiles(
     bucket = dev * T + res.tile
     n_buckets = nr * nc * nh * T
 
-    order = np.argsort(bucket, kind="stable")
-    sorted_bucket = bucket[order]
-    counts = np.bincount(sorted_bucket, minlength=n_buckets)
-    max_nnz = max(int(counts.max(initial=0)), min_pad)
-    starts = np.zeros(n_buckets, dtype=np.int64)
-    np.cumsum(counts[:-1], out=starts[1:])
+    blocked = None
+    if block:
+        blocked = _try_build_blocked(
+            n_buckets, bucket, res, tile_rows, tile_cols
+        )
 
-    # Position of each (sorted) nonzero within its bucket.
-    within = np.arange(S.nnz, dtype=np.int64) - starts[sorted_bucket]
-    pos_sorted = sorted_bucket * max_nnz + within
-    scatter_index = np.empty(S.nnz, dtype=np.int64)
-    scatter_index[order] = pos_sorted
+    if blocked is not None:
+        # The chunk layout IS the flat layout: value vectors serve both the
+        # flat (XLA) and blocked (Pallas) kernels with zero relayout cost.
+        from distributed_sddmm_tpu.ops.blocked import CHUNK
 
-    total = n_buckets * max_nnz
-    rows_flat = np.zeros(total, dtype=np.int32)
-    cols_flat = np.zeros(total, dtype=np.int32)
-    mask_flat = np.zeros(total, dtype=np.dtype(dtype))
-    rows_flat[scatter_index] = res.local_r
-    cols_flat[scatter_index] = res.local_c
-    mask_flat[scatter_index] = 1
+        max_nnz = blocked.n_chunks * CHUNK
+        scatter_index = blocked.host_to_chunk
+        rows_flat = blocked.global_rows().reshape(-1)
+        cols_flat = blocked.global_cols().reshape(-1)
+        mask_flat = (~blocked.pad_lane).reshape(-1).astype(np.dtype(dtype))
+    else:
+        order = np.argsort(bucket, kind="stable")
+        sorted_bucket = bucket[order]
+        counts = np.bincount(sorted_bucket, minlength=n_buckets)
+        max_nnz = max(int(counts.max(initial=0)), min_pad)
+        starts = np.zeros(n_buckets, dtype=np.int64)
+        np.cumsum(counts[:-1], out=starts[1:])
+
+        # Position of each (sorted) nonzero within its bucket.
+        within = np.arange(S.nnz, dtype=np.int64) - starts[sorted_bucket]
+        pos_sorted = sorted_bucket * max_nnz + within
+        scatter_index = np.empty(S.nnz, dtype=np.int64)
+        scatter_index[order] = pos_sorted
+
+        total = n_buckets * max_nnz
+        rows_flat = np.zeros(total, dtype=np.int32)
+        cols_flat = np.zeros(total, dtype=np.int32)
+        mask_flat = np.zeros(total, dtype=np.dtype(dtype))
+        rows_flat[scatter_index] = res.local_r
+        cols_flat[scatter_index] = res.local_c
+        mask_flat[scatter_index] = 1
 
     shape = (nr, nc, nh, T, max_nnz)
     sharding = NamedSharding(grid.mesh, TILE_SPEC)
     nnz_per_device = np.bincount(dev, minlength=nr * nc * nh).reshape(nr, nc, nh)
+
+    blocked_fields = {}
+    if blocked is not None:
+        C = blocked.n_chunks
+        chunk_spec = NamedSharding(
+            grid.mesh, P("rows", "cols", "layers", None, None, None)
+        )
+        meta_spec = NamedSharding(grid.mesh, P("rows", "cols", "layers", None, None))
+        shape6 = (nr, nc, nh, T, C, blocked.lr.shape[-1])
+        blocked_fields = dict(
+            blk_lr=jax.device_put(blocked.lr.reshape(shape6), chunk_spec),
+            blk_lc=jax.device_put(blocked.lc.reshape(shape6), chunk_spec),
+            blk_meta=jax.device_put(
+                blocked.meta.reshape(nr, nc, nh, T, C), meta_spec
+            ),
+            blk_geom=(
+                blocked.bm, blocked.bn, blocked.gr_blocks, blocked.gc_blocks
+            ),
+        )
 
     return TileSet(
         rows=jax.device_put(rows_flat.reshape(shape), sharding),
@@ -260,4 +315,27 @@ def build_tiles(
         nnz=S.nnz,
         grid=grid,
         nnz_per_device=nnz_per_device,
+        **blocked_fields,
+    )
+
+
+# Skip chunk-list blocking when the (bucket, row_block, col_block) pair grid
+# would not fit comfortably in host memory — e.g. absurd T x frame combos.
+_BLOCK_PAIR_LIMIT = 200_000_000
+
+
+def _try_build_blocked(n_buckets, bucket, res, tile_rows, tile_cols):
+    from distributed_sddmm_tpu.ops.blocked import build_blocked, pick_block
+
+    bm = pick_block(max(tile_rows, 1))
+    bn = pick_block(max(tile_cols, 1))
+    n_pairs = (
+        n_buckets
+        * max(-(-tile_rows // bm), 1)
+        * max(-(-tile_cols // bn), 1)
+    )
+    if n_pairs > _BLOCK_PAIR_LIMIT:
+        return None
+    return build_blocked(
+        n_buckets, bucket, res.local_r, res.local_c, tile_rows, tile_cols
     )
