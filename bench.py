@@ -36,10 +36,9 @@ def main() -> None:
     from distributed_sddmm_tpu.ops import get_kernel
 
     if kernel_name == "auto":
-        try:
-            kernel = get_kernel("pallas")
-        except NotImplementedError:
-            kernel = get_kernel("xla")
+        # Pallas compiles to Mosaic only on TPU; elsewhere it would run the
+        # interpreter, so the honest fallback is the XLA kernel.
+        kernel = get_kernel("pallas" if jax.default_backend() == "tpu" else "xla")
     else:
         kernel = get_kernel(kernel_name)
 

@@ -51,13 +51,14 @@ def _resolve_algs(name: str) -> list[str]:
 
 
 def _get_kernel(name: str):
+    import jax
+
     from distributed_sddmm_tpu.ops import get_kernel
 
     if name == "auto":
-        try:
-            return get_kernel("pallas")
-        except NotImplementedError:
-            return get_kernel("xla")
+        # Pallas compiles to Mosaic only on TPU; elsewhere it would run the
+        # interpreter, so the honest fallback is the XLA kernel.
+        return get_kernel("pallas" if jax.default_backend() == "tpu" else "xla")
     return get_kernel(name)
 
 

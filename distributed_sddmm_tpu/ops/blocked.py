@@ -146,14 +146,15 @@ def build_blocked(
     gr = (local_r // bm).astype(np.int64)
     gc = (local_c // bn).astype(np.int64)
 
-    # Sort nonzeros by (bucket, gr, gc); stable keeps flat-slot order within.
+    # Sort nonzeros by (bucket, gr, gc); stable keeps host order within.
+    from distributed_sddmm_tpu import native
+
     key = (bucket * gr_blocks + gr) * gc_blocks + gc
-    order = np.argsort(key, kind="stable")
+    n_pairs = n_buckets * gr_blocks * gc_blocks
+    pair_counts, order = native.bucket_sort(key, n_pairs)
     key_sorted = key[order]
 
-    # nnz per (bucket, gr, gc) pair and chunks per pair.
-    n_pairs = n_buckets * gr_blocks * gc_blocks
-    pair_counts = np.bincount(key_sorted, minlength=n_pairs)
+    # Chunks per (bucket, gr, gc) pair.
     pair_chunks = -(-pair_counts // CHUNK)
 
     # Ensure >= 1 chunk for every (bucket, gr): give empty gr GROUPS one pad

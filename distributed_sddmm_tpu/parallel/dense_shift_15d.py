@@ -286,6 +286,7 @@ class DenseShift15D(DistributedSparse):
     # ------------------------------------------------------------------ #
 
     def _build_blocked_program(self, op: str, use_st: bool):
+        from distributed_sddmm_tpu.ops.blocked import CHUNK
         from distributed_sddmm_tpu.ops.pallas_kernels import BlockedTile
 
         tiles = self.ST_tiles if use_st else self.S_tiles
@@ -297,7 +298,7 @@ class DenseShift15D(DistributedSparse):
         unroll = self.unroll
         bm, bn, grb, gcb = tiles.blk_geom
         rows_pad, cols_pad = grb * bm, gcb * bn
-        chunk_len = 128
+        chunk_len = CHUNK
 
         def shift_mov(state):
             carry, mov = state

@@ -261,9 +261,10 @@ def build_tiles(
         cols_flat = blocked.global_cols().reshape(-1)
         mask_flat = (~blocked.pad_lane).reshape(-1).astype(np.dtype(dtype))
     else:
-        order = np.argsort(bucket, kind="stable")
+        from distributed_sddmm_tpu import native
+
+        counts, order = native.bucket_sort(bucket, n_buckets)
         sorted_bucket = bucket[order]
-        counts = np.bincount(sorted_bucket, minlength=n_buckets)
         max_nnz = max(int(counts.max(initial=0)), min_pad)
         starts = np.zeros(n_buckets, dtype=np.int64)
         np.cumsum(counts[:-1], out=starts[1:])

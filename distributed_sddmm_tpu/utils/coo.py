@@ -158,23 +158,11 @@ class HostCOO:
         """
         if not np.isclose(a + b + c + d, 1.0):
             raise ValueError("initiator probabilities must sum to 1")
+        from distributed_sddmm_tpu import native
+
         M = 1 << log_m
         n_edges = M * edge_factor
-        rng = np.random.default_rng(seed)
-        rows = np.zeros(n_edges, dtype=np.int64)
-        cols = np.zeros(n_edges, dtype=np.int64)
-        for _ in range(log_m):
-            u = rng.random(n_edges)
-            rbit = (u >= a + b).astype(np.int64)
-            # Conditional column bit: P(cbit=1 | rbit) per initiator quadrant.
-            # Guard zero-mass halves (e.g. c+d == 0): that branch is never
-            # selected when its mass is zero, but the division still runs.
-            top = b / max(a + b, 1e-300)
-            bot = d / max(c + d, 1e-300)
-            cprob = np.where(rbit == 0, top, bot)
-            cbit = (rng.random(n_edges) < cprob).astype(np.int64)
-            rows = (rows << 1) | rbit
-            cols = (cols << 1) | cbit
+        rows, cols = native.rmat_edges(log_m, n_edges, a, b, c, d, seed)
         mat = cls(rows, cols, np.ones(n_edges), M, M).deduplicated()
         # Graph500 permutes vertex names to de-skew locality
         # (PermEdges + RenameVertices, SpmatLocal.hpp:505-506).
@@ -186,14 +174,12 @@ class HostCOO:
 
     @classmethod
     def load_mtx(cls, path: str) -> "HostCOO":
-        import scipy.io
+        from distributed_sddmm_tpu import native
 
-        return cls.from_scipy(scipy.io.mmread(path))
+        rows, cols, vals, M, N = native.mtx_read(path)
+        return cls(rows, cols, vals, M, N)
 
     def save_mtx(self, path: str) -> None:
-        import scipy.io
-        import scipy.sparse as sp
+        from distributed_sddmm_tpu import native
 
-        scipy.io.mmwrite(
-            path, sp.coo_matrix((self.vals, (self.rows, self.cols)), shape=(self.M, self.N))
-        )
+        native.mtx_write(path, self.rows, self.cols, self.vals, self.M, self.N)

@@ -1,0 +1,216 @@
+// Native host-side data layer for distributed_sddmm_tpu.
+//
+// The TPU compute path is JAX/XLA/Pallas; everything that the reference
+// implemented as C++ host machinery around its kernels gets a native
+// equivalent here, exposed through a C ABI consumed via ctypes
+// (distributed_sddmm_tpu/native.py):
+//
+//  * Graph500-style R-mat generation — reference used CombBLAS
+//    GenGraph500Data (/root/reference/SpmatLocal.hpp:499-516).
+//  * Matrix-market coordinate IO — reference used CombBLAS
+//    ParallelReadMM / ParallelWriteMM (/root/reference/SpmatLocal.hpp:486-497,
+//    ParIOTest.cpp:66-73).
+//  * Stable bucket (counting) sort — the hot host-side op behind nonzero
+//    redistribution and chunk-list construction; the reference's analog is
+//    the MPI_Alltoallv shuffle + GNU parallel sort
+//    (/root/reference/SpmatLocal.hpp:389-462).
+//
+// Build: see native/Makefile (g++ -O3 -fopenmp -shared -fPIC). The Python
+// wrapper falls back to numpy implementations when the library is absent.
+
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <cctype>
+
+#ifdef _OPENMP
+#include <omp.h>
+#endif
+
+extern "C" {
+
+// ----------------------------------------------------------------------
+// splitmix64: counter-based, so edge generation is deterministic AND
+// embarrassingly parallel (each edge derives its stream from seed+index).
+// ----------------------------------------------------------------------
+static inline uint64_t splitmix64(uint64_t x) {
+  x += 0x9e3779b97f4a7c15ULL;
+  x = (x ^ (x >> 30)) * 0xbf58476d1ce4e5b9ULL;
+  x = (x ^ (x >> 27)) * 0x94d049bb133111ebULL;
+  return x ^ (x >> 31);
+}
+
+static inline double u01(uint64_t bits) {
+  return (double)(bits >> 11) * (1.0 / 9007199254740992.0);  // 2^-53
+}
+
+// R-mat: recursive-quadrant edge sampling with initiator (a,b,c,d).
+// rows/cols must hold n_edges int64 each.
+void hnh_rmat(int64_t log_m, int64_t n_edges, double a, double b, double c,
+              double d, uint64_t seed, int64_t* rows, int64_t* cols) {
+  const double ab = a + b;
+  const double cd = c + d;
+  // P(col bit = 1 | row bit): top half -> b/(a+b), bottom half -> d/(c+d).
+  const double top = ab > 0 ? b / ab : 0.0;
+  const double bot = cd > 0 ? d / cd : 0.0;
+#pragma omp parallel for schedule(static)
+  for (int64_t e = 0; e < n_edges; ++e) {
+    uint64_t st = splitmix64(seed ^ (uint64_t)e * 0x9e3779b97f4a7c15ULL);
+    int64_t r = 0, cc = 0;
+    for (int64_t lvl = 0; lvl < log_m; ++lvl) {
+      st = splitmix64(st);
+      const double u = u01(st);
+      st = splitmix64(st);
+      const double v = u01(st);
+      const int rbit = u >= ab;
+      const int cbit = v < (rbit ? bot : top);
+      r = (r << 1) | rbit;
+      cc = (cc << 1) | cbit;
+    }
+    rows[e] = r;
+    cols[e] = cc;
+  }
+}
+
+// ----------------------------------------------------------------------
+// Stable parallel counting sort by bucket key.
+// counts: [n_buckets] out. order: [n] out — argsort(keys, stable).
+// ----------------------------------------------------------------------
+int hnh_bucket_sort(const int64_t* keys, int64_t n, int64_t n_buckets,
+                    int64_t* counts, int64_t* order) {
+  int nt = 1;
+#ifdef _OPENMP
+  nt = omp_get_max_threads();
+#endif
+  // Per-thread histograms over contiguous slices keep the scatter stable.
+  // Clamp threads so the histogram block stays bounded for huge key spaces.
+  const int64_t kHistCap = 1LL << 31;  // 2 GiB of int64 histogram at most
+  while (nt > 1 && (int64_t)nt * n_buckets * 8 > kHistCap) nt /= 2;
+  int64_t* hist = (int64_t*)calloc((size_t)nt * (size_t)n_buckets, sizeof(int64_t));
+  if (!hist) return -1;
+#pragma omp parallel num_threads(nt)
+  {
+#ifdef _OPENMP
+    const int t = omp_get_thread_num();
+#else
+    const int t = 0;
+#endif
+    const int64_t lo = n * t / nt, hi = n * (t + 1) / nt;
+    int64_t* h = hist + (int64_t)t * n_buckets;
+    for (int64_t i = lo; i < hi; ++i) ++h[keys[i]];
+  }
+  // Column-major exclusive prefix over (bucket, thread) gives each thread
+  // its stable write base per bucket.
+  int64_t run = 0;
+  for (int64_t b = 0; b < n_buckets; ++b) {
+    counts[b] = 0;
+    for (int t = 0; t < nt; ++t) {
+      const int64_t v = hist[(int64_t)t * n_buckets + b];
+      hist[(int64_t)t * n_buckets + b] = run;
+      run += v;
+      counts[b] += v;
+    }
+  }
+#pragma omp parallel num_threads(nt)
+  {
+#ifdef _OPENMP
+    const int t = omp_get_thread_num();
+#else
+    const int t = 0;
+#endif
+    const int64_t lo = n * t / nt, hi = n * (t + 1) / nt;
+    int64_t* h = hist + (int64_t)t * n_buckets;
+    for (int64_t i = lo; i < hi; ++i) order[h[keys[i]]++] = i;
+  }
+  free(hist);
+  return 0;
+}
+
+// ----------------------------------------------------------------------
+// Matrix-market coordinate IO.
+// ----------------------------------------------------------------------
+// Pass 1: header + counts. Returns 0 on success, negative on error.
+// symmetric: 0 = general, 1 = symmetric/hermitian (real), 2 = skew-symmetric
+// (mirror entries negate). pattern: 1 if entries carry no value field.
+// Complex fields and dense 'array' format return an error so the caller can
+// fall back to a full-featured reader.
+int hnh_mtx_header(const char* path, int64_t* M, int64_t* N, int64_t* nnz,
+                   int* symmetric, int* pattern) {
+  FILE* f = fopen(path, "r");
+  if (!f) return -1;
+  char line[1024];
+  if (!fgets(line, sizeof line, f)) { fclose(f); return -2; }
+  if (strncmp(line, "%%MatrixMarket", 14) != 0) { fclose(f); return -3; }
+  if (strstr(line, "skew-symmetric")) {
+    *symmetric = 2;
+  } else if (strstr(line, "symmetric") || strstr(line, "hermitian")) {
+    *symmetric = 1;
+  } else {
+    *symmetric = 0;
+  }
+  *pattern = strstr(line, "pattern") ? 1 : 0;
+  if (strstr(line, "array")) { fclose(f); return -4; }  // dense not supported
+  if (strstr(line, "complex")) { fclose(f); return -6; }
+  while (fgets(line, sizeof line, f)) {
+    if (line[0] != '%') break;
+  }
+  if (sscanf(line, "%ld %ld %ld", (long*)M, (long*)N, (long*)nnz) != 3) {
+    fclose(f);
+    return -5;
+  }
+  fclose(f);
+  return 0;
+}
+
+// Pass 2: parse entries (1-based in file -> 0-based out). rows/cols/vals
+// sized nnz (vals ignored when pattern). Returns entries read or negative.
+int64_t hnh_mtx_read(const char* path, int64_t nnz, int pattern, int64_t* rows,
+                     int64_t* cols, double* vals) {
+  FILE* f = fopen(path, "r");
+  if (!f) return -1;
+  char line[1024];
+  // Skip header + comments + size line.
+  if (!fgets(line, sizeof line, f)) { fclose(f); return -2; }
+  while (fgets(line, sizeof line, f)) {
+    if (line[0] != '%') break;  // size line consumed
+  }
+  int64_t k = 0;
+  while (k < nnz && fgets(line, sizeof line, f)) {
+    char* p = line;
+    const long r = strtol(p, &p, 10);
+    const long c = strtol(p, &p, 10);
+    if (p == line) continue;  // blank line
+    rows[k] = r - 1;
+    cols[k] = c - 1;
+    vals[k] = pattern ? 1.0 : strtod(p, &p);
+    ++k;
+  }
+  fclose(f);
+  return k;
+}
+
+int64_t hnh_mtx_write(const char* path, int64_t M, int64_t N, int64_t nnz,
+                      const int64_t* rows, const int64_t* cols,
+                      const double* vals) {
+  FILE* f = fopen(path, "w");
+  if (!f) return -1;
+  fprintf(f, "%%%%MatrixMarket matrix coordinate real general\n");
+  fprintf(f, "%ld %ld %ld\n", (long)M, (long)N, (long)nnz);
+  for (int64_t k = 0; k < nnz; ++k) {
+    fprintf(f, "%ld %ld %.17g\n", (long)(rows[k] + 1), (long)(cols[k] + 1),
+            vals[k]);
+  }
+  fclose(f);
+  return nnz;
+}
+
+int hnh_num_threads(void) {
+#ifdef _OPENMP
+  return omp_get_max_threads();
+#else
+  return 1;
+#endif
+}
+
+}  // extern "C"

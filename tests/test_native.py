@@ -1,0 +1,103 @@
+"""Native C++ data layer (native/hnh_native.cpp via ctypes).
+
+Every binding is checked against its numpy fallback so the two paths stay
+interchangeable; tests skip the native-only assertions when no toolchain
+built the library.
+"""
+
+import numpy as np
+import pytest
+
+from distributed_sddmm_tpu import native
+
+
+class TestBucketSort:
+    def test_matches_numpy_stable_argsort(self):
+        rng = np.random.default_rng(0)
+        keys = rng.integers(0, 97, 10_000)
+        counts, order = native.bucket_sort(keys, 97)
+        np.testing.assert_array_equal(order, np.argsort(keys, kind="stable"))
+        np.testing.assert_array_equal(counts, np.bincount(keys, minlength=97))
+
+    def test_empty_and_single(self):
+        counts, order = native.bucket_sort(np.array([], dtype=np.int64), 5)
+        assert counts.tolist() == [0] * 5 and order.size == 0
+        counts, order = native.bucket_sort(np.array([3], dtype=np.int64), 5)
+        assert counts.tolist() == [0, 0, 0, 1, 0] and order.tolist() == [0]
+
+
+class TestRmat:
+    def test_deterministic_and_in_range(self):
+        r1, c1 = native.rmat_edges(10, 5000, 0.57, 0.19, 0.19, 0.05, seed=7)
+        r2, c2 = native.rmat_edges(10, 5000, 0.57, 0.19, 0.19, 0.05, seed=7)
+        np.testing.assert_array_equal(r1, r2)
+        np.testing.assert_array_equal(c1, c2)
+        assert 0 <= r1.min() and r1.max() < 1024
+        assert 0 <= c1.min() and c1.max() < 1024
+
+    def test_initiator_skew(self):
+        # a+b mass lands rows in the top half.
+        r, _ = native.rmat_edges(12, 20000, 0.57, 0.19, 0.19, 0.05, seed=1)
+        top_frac = (r < 2048).mean()
+        assert abs(top_frac - 0.76) < 0.05
+
+    def test_uniform_initiator_is_uniform(self):
+        r, c = native.rmat_edges(10, 20000, 0.25, 0.25, 0.25, 0.25, seed=2)
+        assert abs((r < 512).mean() - 0.5) < 0.05
+        assert abs((c < 512).mean() - 0.5) < 0.05
+
+
+class TestMtxIO:
+    def test_roundtrip(self, tmp_path):
+        p = str(tmp_path / "m.mtx")
+        rows = np.array([0, 1, 4], dtype=np.int64)
+        cols = np.array([2, 0, 4], dtype=np.int64)
+        vals = np.array([1.25, -3.5, 1e-17])
+        native.mtx_write(p, rows, cols, vals, 5, 5)
+        rr, cc, vv, M, N = native.mtx_read(p)
+        assert (M, N) == (5, 5)
+        np.testing.assert_array_equal(rr, rows)
+        np.testing.assert_array_equal(cc, cols)
+        np.testing.assert_allclose(vv, vals)
+
+    def test_symmetric_and_pattern(self, tmp_path):
+        scipy_io = pytest.importorskip("scipy.io")
+        import scipy.sparse as sp
+
+        p = str(tmp_path / "sym.mtx")
+        dense = np.array([[1, 2, 0], [2, 3, 0], [0, 0, 4.0]])
+        scipy_io.mmwrite(p, sp.coo_matrix(dense), symmetry="symmetric")
+        rr, cc, vv, M, N = native.mtx_read(p)
+        got = sp.coo_matrix((vv, (rr, cc)), shape=(M, N)).toarray()
+        np.testing.assert_allclose(got, dense)
+
+    def test_hostcoo_integration(self, tmp_path):
+        from distributed_sddmm_tpu.utils.coo import HostCOO
+
+        S = HostCOO.erdos_renyi(50, 40, 3, seed=0, values="normal")
+        p = str(tmp_path / "er.mtx")
+        S.save_mtx(p)
+        S2 = HostCOO.load_mtx(p)
+        assert (S2.M, S2.N, S2.nnz) == (S.M, S.N, S.nnz)
+        np.testing.assert_allclose(
+            S2.to_scipy().toarray(), S.to_scipy().toarray()
+        )
+
+
+def test_reported_availability_is_consistent():
+    # available() decides which path runs; both must work through the
+    # public wrappers regardless.
+    assert native.available() in (True, False)
+
+
+class TestMtxSymmetryVariants:
+    def test_skew_symmetric_negates_mirror(self, tmp_path):
+        scipy_io = pytest.importorskip("scipy.io")
+        import scipy.sparse as sp
+
+        p = str(tmp_path / "skew.mtx")
+        dense = np.array([[0, 2, 0], [-2, 0, 5], [0, -5, 0.0]])
+        scipy_io.mmwrite(p, sp.coo_matrix(dense), symmetry="skew-symmetric")
+        rr, cc, vv, M, N = native.mtx_read(p)
+        got = sp.coo_matrix((vv, (rr, cc)), shape=(M, N)).toarray()
+        np.testing.assert_allclose(got, dense)
