@@ -35,12 +35,7 @@ def main() -> None:
 
     from distributed_sddmm_tpu.ops import get_kernel
 
-    if kernel_name == "auto":
-        # Pallas compiles to Mosaic only on TPU; elsewhere it would run the
-        # interpreter, so the honest fallback is the XLA kernel.
-        kernel = get_kernel("pallas" if jax.default_backend() == "tpu" else "xla")
-    else:
-        kernel = get_kernel(kernel_name)
+    kernel = get_kernel(kernel_name)
 
     S = HostCOO.rmat(log_m=log_m, edge_factor=nnz_per_row, seed=0)
     n_dev = jax.device_count()

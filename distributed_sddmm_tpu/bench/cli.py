@@ -13,6 +13,12 @@ subcommands:
   (`/root/reference/random_permute.cpp:19-59`)
 * ``verify``  — fingerprint cross-check of all algorithms
   (`/root/reference/scratch.cpp:26-76`)
+* ``kernels`` — single-device local-kernel sweep
+  (`/root/reference/local_kernel_benchmark.cpp:109-305`)
+* ``overlap`` — comm/compute overlap experiment
+  (`/root/reference/test_async_strategies.cpp:14-103`)
+* ``baseline`` — external-competitor host SpMM baseline
+  (`/root/reference/petsc_baseline/spmm_test.cpp:111-157`)
 """
 
 from __future__ import annotations
@@ -51,14 +57,8 @@ def _resolve_algs(name: str) -> list[str]:
 
 
 def _get_kernel(name: str):
-    import jax
-
     from distributed_sddmm_tpu.ops import get_kernel
 
-    if name == "auto":
-        # Pallas compiles to Mosaic only on TPU; elsewhere it would run the
-        # interpreter, so the honest fallback is the XLA kernel.
-        return get_kernel("pallas" if jax.default_backend() == "tpu" else "xla")
     return get_kernel(name)
 
 
@@ -145,6 +145,27 @@ def main(argv=None) -> int:
     pm.add_argument("--seed", type=int, default=0)
     pm.add_argument("-o", "--output-file", default=None, help="default <in>-permuted.mtx")
 
+    kn = sub.add_parser("kernels", help="single-device local-kernel sweep")
+    kn.add_argument("--log-m", type=int, nargs="+", default=None)
+    kn.add_argument("--nnz-per-row", type=int, nargs="+", default=None)
+    kn.add_argument("--r-values", type=int, nargs="+", default=None)
+    kn.add_argument("--kernels", nargs="+", default=["xla", "pallas"])
+    kn.add_argument("--trials", type=int, default=5)
+    kn.add_argument("-o", "--output-file", default=None)
+
+    ov = sub.add_parser("overlap", help="comm/compute overlap experiment")
+    ov.add_argument("--block", type=int, default=1024)
+    ov.add_argument("--steps-work", type=int, default=4)
+    ov.add_argument("--trials", type=int, default=10)
+    ov.add_argument("-o", "--output-file", default=None)
+
+    bl = sub.add_parser("baseline", help="external host-CPU SpMM baseline")
+    bl.add_argument("log_m", type=int)
+    bl.add_argument("edge_factor", type=int)
+    bl.add_argument("R", type=int)
+    bl.add_argument("--iters", type=int, default=10)
+    bl.add_argument("-o", "--output-file", default=None)
+
     vf = sub.add_parser("verify", help="fingerprint cross-check of algorithms")
     vf.add_argument("--log-m", type=int, default=8)
     vf.add_argument("--edge-factor", type=int, default=8)
@@ -177,6 +198,39 @@ def main(argv=None) -> int:
         S = HostCOO.load_mtx(args.path).random_permuted(seed=args.seed)
         S.save_mtx(out)
         print(f"wrote {out} ({S.M}x{S.N}, nnz={S.nnz})")
+        return 0
+
+    if args.cmd == "kernels":
+        from distributed_sddmm_tpu.bench.kernels import run_kernel_benchmark
+
+        run_kernel_benchmark(
+            log_m_values=args.log_m,
+            nnz_per_row_values=args.nnz_per_row,
+            r_values=args.r_values,
+            kernels=args.kernels,
+            trials=args.trials,
+            output_file=args.output_file,
+        )
+        return 0
+
+    if args.cmd == "overlap":
+        from distributed_sddmm_tpu.bench.overlap import run_overlap_experiment
+
+        rec = run_overlap_experiment(
+            block=args.block, steps_work=args.steps_work, trials=args.trials,
+            output_file=args.output_file,
+        )
+        print(json.dumps(rec))
+        return 0
+
+    if args.cmd == "baseline":
+        from distributed_sddmm_tpu.bench.baseline import run_baseline
+
+        S = HostCOO.rmat(log_m=args.log_m, edge_factor=args.edge_factor, seed=0)
+        rec = run_baseline(
+            S, R=args.R, iters=args.iters, output_file=args.output_file
+        )
+        print(json.dumps(rec))
         return 0
 
     if args.cmd == "verify":

@@ -35,17 +35,39 @@ _i64p = np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS")
 _f64p = np.ctypeslib.ndpointer(np.float64, flags="C_CONTIGUOUS")
 
 
+def _is_fresh() -> bool:
+    src = _SRC_DIR / "hnh_native.cpp"
+    try:
+        return (
+            _LIB_PATH.exists()
+            and (not src.exists() or src.stat().st_mtime <= _LIB_PATH.stat().st_mtime)
+        )
+    except OSError:
+        return False
+
+
 def _try_build() -> bool:
+    """Build (or rebuild a stale) library; concurrency-safe.
+
+    Compiles to a per-process temp name and atomically renames into place,
+    so parallel imports (pytest-xdist, multi-process launches) never dlopen
+    a half-written .so or clobber each other's compile.
+    """
+    if _is_fresh():
+        return True
     if not (_SRC_DIR / "Makefile").exists():
         return False
+    tmp = _LIB_DIR / f"libhnh_native.build{os.getpid()}.so"
     try:
         subprocess.run(
-            ["make", "-C", str(_SRC_DIR)],
+            ["make", "-C", str(_SRC_DIR), f"OUT={tmp}"],
             check=True,
             capture_output=True,
             timeout=120,
         )
+        os.replace(tmp, _LIB_PATH)
     except (subprocess.SubprocessError, OSError):
+        tmp.unlink(missing_ok=True)
         return False
     return _LIB_PATH.exists()
 
@@ -144,6 +166,13 @@ def bucket_sort(keys: np.ndarray, n_buckets: int):
     native path.
     """
     keys = np.ascontiguousarray(keys, dtype=np.int64)
+    if keys.size and (keys.min() < 0 or keys.max() >= n_buckets):
+        # Match the numpy path's behavior (np.bincount raises); the native
+        # histogram would write out of bounds on bad keys.
+        raise ValueError(
+            f"bucket keys out of range [0, {n_buckets}): "
+            f"min={keys.min()}, max={keys.max()}"
+        )
     lib = _load()
     if lib is not None and keys.size:
         counts = np.empty(n_buckets, np.int64)

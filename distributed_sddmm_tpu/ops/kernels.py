@@ -79,7 +79,15 @@ _REGISTRY = {"xla": XlaKernel}
 
 
 def get_kernel(name: str) -> LocalKernel:
-    """Kernel factory; Pallas registers lazily to keep CPU imports light."""
+    """Kernel factory; Pallas registers lazily to keep CPU imports light.
+
+    ``"auto"`` picks Pallas on real TPU backends and XLA elsewhere (the
+    Pallas interpreter is not an honest non-TPU fallback).
+    """
+    if name == "auto":
+        import jax
+
+        name = "pallas" if jax.default_backend() == "tpu" else "xla"
     if name == "pallas" and "pallas" not in _REGISTRY:
         try:
             from distributed_sddmm_tpu.ops.pallas_kernels import PallasKernel

@@ -1,0 +1,1 @@
+"""Offline analysis tools (chart generation from benchmark JSON records)."""

@@ -1,0 +1,136 @@
+"""Chart generation from benchmark JSON records.
+
+Offline analysis pipeline mirroring the reference's
+``ipdps_chart_generator.ipynb`` (SURVEY.md component #29): consume the
+JSON-lines files the benchmark harness appends
+(`benchmark_dist.cpp:151-163` schema parity) and emit
+
+* per-algorithm throughput bars,
+* a communication/computation time breakdown per algorithm (the notebook's
+  {Replication, Propagation, Computation} mapping of perf counters, cell 2),
+* the R-sweep "winner heatmap" (cell 21) when heatmap-style records exist.
+
+Usage: ``python -m distributed_sddmm_tpu.tools.charts results.jsonl -o out/``
+"""
+
+from __future__ import annotations
+
+import argparse
+import collections
+import json
+import pathlib
+import sys
+
+# Perf-counter name -> breakdown category (notebook cell 2 mapping).
+_CATEGORY = {
+    "sddmmA": "Computation",
+    "sddmmB": "Computation",
+    "spmmA": "Computation",
+    "spmmB": "Computation",
+    "fusedSpMM": "Computation",
+    "replication": "Replication",
+    "allgather": "Replication",
+    "shift": "Propagation",
+    "ppermute": "Propagation",
+}
+
+
+def load_records(path: str) -> list:
+    records = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                records.append(json.loads(line))
+    return records
+
+
+def _alg_label(rec: dict) -> str:
+    alg = rec.get("algorithm", rec.get("baseline", "?"))
+    fused = rec.get("fused")
+    return f"{alg}{'/fused' if fused else ''}"
+
+
+def throughput_chart(records, ax) -> None:
+    labels, values = [], []
+    for rec in records:
+        if "overall_throughput" in rec:
+            labels.append(f"{_alg_label(rec)}\nR={rec.get('R', rec.get('r', '?'))}")
+            values.append(rec["overall_throughput"])
+    ax.bar(range(len(values)), values)
+    ax.set_xticks(range(len(labels)), labels, rotation=45, ha="right", fontsize=7)
+    ax.set_ylabel("GFLOP/s")
+    ax.set_title("Throughput by configuration")
+
+
+def breakdown_chart(records, ax) -> None:
+    per_alg: dict = collections.defaultdict(lambda: collections.defaultdict(float))
+    for rec in records:
+        stats = rec.get("perf_stats") or {}
+        for name, secs in stats.items():
+            cat = _CATEGORY.get(name, "Computation")
+            per_alg[_alg_label(rec)][cat] += secs
+    if not per_alg:
+        ax.set_axis_off()
+        return
+    algs = sorted(per_alg)
+    cats = ["Computation", "Replication", "Propagation"]
+    bottoms = [0.0] * len(algs)
+    for cat in cats:
+        vals = [per_alg[a].get(cat, 0.0) for a in algs]
+        ax.bar(range(len(algs)), vals, bottom=bottoms, label=cat)
+        bottoms = [b + v for b, v in zip(bottoms, vals)]
+    ax.set_xticks(range(len(algs)), algs, rotation=45, ha="right", fontsize=7)
+    ax.set_ylabel("seconds")
+    ax.set_title("Time breakdown")
+    ax.legend(fontsize=7)
+
+
+def heatmap_winner(records) -> dict:
+    """(R, c) -> winning algorithm by throughput (notebook cell 21)."""
+    best: dict = {}
+    for rec in records:
+        if "overall_throughput" not in rec or "algorithm" not in rec:
+            continue
+        key = (rec.get("R"), rec.get("alg_info", {}).get("c", rec.get("c")))
+        if key not in best or rec["overall_throughput"] > best[key][1]:
+            best[key] = (_alg_label(rec), rec["overall_throughput"])
+    return {f"R={k[0]},c={k[1]}": v[0] for k, v in sorted(best.items(), key=str)}
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("results", help="JSON-lines results file from the harness")
+    ap.add_argument("-o", "--out-dir", default="charts")
+    args = ap.parse_args(argv)
+
+    records = load_records(args.results)
+    if not records:
+        print("no records found", file=sys.stderr)
+        return 1
+
+    out = pathlib.Path(args.out_dir)
+    out.mkdir(parents=True, exist_ok=True)
+
+    import matplotlib
+
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+
+    fig, axes = plt.subplots(1, 2, figsize=(12, 5))
+    throughput_chart(records, axes[0])
+    breakdown_chart(records, axes[1])
+    fig.tight_layout()
+    fig.savefig(out / "benchmark.png", dpi=150)
+    print(f"wrote {out / 'benchmark.png'}")
+
+    winners = heatmap_winner(records)
+    if winners:
+        with open(out / "winners.json", "w") as f:
+            json.dump(winners, f, indent=2)
+        print(f"wrote {out / 'winners.json'}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -195,14 +195,16 @@ int64_t hnh_mtx_write(const char* path, int64_t M, int64_t N, int64_t nnz,
                       const double* vals) {
   FILE* f = fopen(path, "w");
   if (!f) return -1;
-  fprintf(f, "%%%%MatrixMarket matrix coordinate real general\n");
-  fprintf(f, "%ld %ld %ld\n", (long)M, (long)N, (long)nnz);
-  for (int64_t k = 0; k < nnz; ++k) {
-    fprintf(f, "%ld %ld %.17g\n", (long)(rows[k] + 1), (long)(cols[k] + 1),
-            vals[k]);
+  int ok = fprintf(f, "%%%%MatrixMarket matrix coordinate real general\n") >= 0;
+  ok = ok && fprintf(f, "%ld %ld %ld\n", (long)M, (long)N, (long)nnz) >= 0;
+  for (int64_t k = 0; ok && k < nnz; ++k) {
+    ok = fprintf(f, "%ld %ld %.17g\n", (long)(rows[k] + 1), (long)(cols[k] + 1),
+                 vals[k]) >= 0;
   }
-  fclose(f);
-  return nnz;
+  // fclose flushes buffered data; a failure there (ENOSPC, I/O error) means
+  // the file on disk is truncated even if every fprintf "succeeded".
+  if (fclose(f) != 0) ok = 0;
+  return ok ? nnz : -2;
 }
 
 int hnh_num_threads(void) {

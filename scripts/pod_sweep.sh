@@ -1,0 +1,25 @@
+#!/usr/bin/env bash
+# Replication-factor / scaling sweep on a TPU pod — the analog of the
+# reference's Cori SLURM sweeps (`/root/reference/jobscript.sh:21-63`,
+# c in {1,4,16,64} at fixed problem size).
+#
+# Usage: TPU_NAME=my-pod ./scripts/pod_sweep.sh [logM] [nnz_per_row] [R]
+set -euo pipefail
+
+TPU_NAME=${TPU_NAME:?set TPU_NAME to the tpu-vm name}
+LOG_M=${1:-20}
+NNZ_PER_ROW=${2:-32}
+R=${3:-128}
+OUT=${OUT:-sweep_$(date +%Y%m%d_%H%M%S).jsonl}
+
+for C in 1 4 16 64; do
+  for ALG in 15d_fusion1 15d_fusion2 15d_sparse 25d_dense_replicate 25d_sparse_replicate; do
+    echo "=== c=$C alg=$ALG ==="
+    gcloud compute tpus tpu-vm ssh "$TPU_NAME" --worker=all --command \
+      "cd ~/distributed_sddmm_tpu && python scripts/run_pod.py \
+         er $LOG_M $NNZ_PER_ROW $ALG $R $C --fused both -o $OUT" \
+      || echo "skipped (divisibility or OOM)"
+  done
+done
+echo "results in $OUT on each worker; fetch worker 0's copy for charts:"
+echo "  python -m distributed_sddmm_tpu.tools.charts $OUT -o charts/"

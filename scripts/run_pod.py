@@ -1,0 +1,58 @@
+"""Multi-host TPU pod runner.
+
+The reference scaled with SLURM jobscripts over MPI ranks
+(`/root/reference/jobscript.sh`); on TPU pods the analog is one process per
+host, connected by ``jax.distributed.initialize()``, with every algorithm in
+this framework unchanged — the 3-D ``Mesh`` simply spans all pod chips and
+the shift/replication axes ride ICI (and DCN across slices).
+
+Run THIS SAME script on every host of the pod, e.g. with
+
+    gcloud compute tpus tpu-vm ssh $TPU_NAME --worker=all \
+      --command="cd ~/distributed_sddmm_tpu && python scripts/run_pod.py \
+                 er 20 32 15d_fusion2 128 4 -o results.jsonl"
+
+JAX's TPU backend discovers coordinator/topology automatically on Cloud TPU;
+pass --coordinator for other clusters.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--coordinator", default=None,
+                    help="host:port (omit on Cloud TPU: auto-discovered)")
+    ap.add_argument("--num-processes", type=int, default=None)
+    ap.add_argument("--process-id", type=int, default=None)
+    ap.add_argument("bench_args", nargs=argparse.REMAINDER,
+                    help="arguments forwarded to distributed_sddmm_tpu.bench")
+    args = ap.parse_args(argv)
+
+    import jax
+
+    if args.coordinator:
+        jax.distributed.initialize(
+            coordinator_address=args.coordinator,
+            num_processes=args.num_processes,
+            process_id=args.process_id,
+        )
+    else:
+        jax.distributed.initialize()  # Cloud TPU auto-discovery
+
+    if jax.process_index() == 0:
+        print(
+            f"pod up: {jax.process_count()} hosts, "
+            f"{jax.device_count()} chips ({jax.local_device_count()}/host)"
+        )
+
+    from distributed_sddmm_tpu.bench.cli import main as bench_main
+
+    return bench_main(args.bench_args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
