@@ -91,7 +91,8 @@ def run_overlap_experiment(
         t0 = time.perf_counter(); float(chain(X, W, 1).sum())
         t_one = time.perf_counter() - t0
         t0 = time.perf_counter(); float(chain(X, W, 1 + trials).sum())
-        results[name] = (time.perf_counter() - t0 - t_one) / trials
+        # Clamp: dispatch noise can make the difference negative at tiny sizes.
+        results[name] = max((time.perf_counter() - t0 - t_one) / trials, 1e-9)
 
     record = {
         "experiment": "comm-compute-overlap",

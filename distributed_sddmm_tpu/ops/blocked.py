@@ -245,6 +245,45 @@ def build_blocked(
     )
 
 
+def pad_chunk_count(meta: BlockedMeta, c_new: int) -> BlockedMeta:
+    """Append trailing pad chunks to every bucket to reach ``c_new`` chunks.
+
+    Used when the chunk-flat length must divide evenly (e.g. into fiber
+    value slices). Pad chunks follow the window-pinning convention (last
+    (gr, gc) block, no flags) and are all-pad lanes."""
+    C = meta.n_chunks
+    if c_new < C:
+        raise ValueError(f"cannot shrink chunk count {C} -> {c_new}")
+    if c_new == C:
+        return meta
+    nb = meta.lr.shape[0]
+    extra = c_new - C
+    pad_word = int(pack_meta(
+        np.int64(meta.gr_blocks - 1), np.int64(meta.gc_blocks - 1),
+        np.int64(0), np.int64(0),
+    ))
+    return dataclasses.replace(
+        meta,
+        lr=np.concatenate(
+            [meta.lr, np.zeros((nb, extra, CHUNK), np.int32)], axis=1
+        ),
+        lc=np.concatenate(
+            [meta.lc, np.zeros((nb, extra, CHUNK), np.int32)], axis=1
+        ),
+        meta=np.concatenate(
+            [meta.meta, np.full((nb, extra), pad_word, np.int32)], axis=1
+        ),
+        pad_lane=np.concatenate(
+            [meta.pad_lane, np.ones((nb, extra, CHUNK), bool)], axis=1
+        ),
+        host_to_chunk=(
+            meta.host_to_chunk
+            + (meta.host_to_chunk // (C * CHUNK)) * (extra * CHUNK)
+        ),
+        n_chunks=c_new,
+    )
+
+
 def unpack_meta(word):
     """Inverse of :func:`pack_meta` (numpy or jax arrays).
 

@@ -226,20 +226,23 @@ class PallasKernel:
     ``fused_tile`` that the distributed algorithms call when blocked
     metadata is available.
 
-    ``precision``: "bf16" (default — exact one-hot selection, dense values
-    rounded to bf16) or "f32" (full f32 MXU, ~4x slower).
+    ``precision``: "bf16" (exact one-hot selection, dense values rounded to
+    bf16) or "f32" (full f32 MXU, ~4x slower). Default: bf16 on TPU, f32 in
+    interpreter mode (CPU executors lack bf16 matmuls).
     ``interpret``: run in the Pallas interpreter (CPU test meshes).
     """
 
     is_blocked = True
 
-    def __init__(self, precision: str = "bf16", interpret: bool | None = None):
-        if precision not in ("bf16", "f32"):
-            raise ValueError(f"precision must be 'bf16' or 'f32', got {precision!r}")
-        self.precision = precision
+    def __init__(self, precision: str | None = None, interpret: bool | None = None):
         if interpret is None:
             interpret = jax.default_backend() != "tpu"
         self.interpret = interpret
+        if precision is None:
+            precision = "f32" if interpret else "bf16"
+        if precision not in ("bf16", "f32"):
+            raise ValueError(f"precision must be 'bf16' or 'f32', got {precision!r}")
+        self.precision = precision
         self._xla = XlaKernel()
         self.name = f"pallas-{precision}"
 

@@ -273,6 +273,26 @@ class DistributedSparse(abc.ABC):
     def set_r_value(self, R: int) -> None:
         self.R = R
 
+    # ------------------------------------------------------------------ #
+    # Blocked (Pallas) kernel dispatch, shared by every strategy
+    # ------------------------------------------------------------------ #
+
+    def _use_blocked(self, tiles) -> bool:
+        """True when the kernel consumes chunk-list metadata and the tile
+        set carries it (``ops/blocked.py``)."""
+        return getattr(self.kernel, "is_blocked", False) and tiles.has_blocked
+
+    def _sddmm_args(self, tiles, vals) -> tuple:
+        """Tile operands following the dense args for sddmm programs."""
+        if self._use_blocked(tiles):
+            return (tiles.blk_lr, tiles.blk_lc, tiles.blk_meta, tiles.mask, vals)
+        return (tiles.rows, tiles.cols, tiles.mask, vals)
+
+    def _spmm_args(self, tiles, vals) -> tuple:
+        if self._use_blocked(tiles):
+            return (tiles.blk_lr, tiles.blk_lc, tiles.blk_meta, vals)
+        return (tiles.rows, tiles.cols, vals)
+
     def initial_shift(self, A, B, mode: KernelMode):
         """Pre-skew dense operands if the strategy needs it (no-op default;
         reference `distributed_sparse.h:266-268`)."""

@@ -101,13 +101,19 @@ class CannonDense25D(DistributedSparse):
         self.a_spec = _DENSE_SPEC
         self.b_spec = _DENSE_SPEC
 
+        # Blocked (Pallas) encoding in SWAPPED orientation: Cannon-dense SpMM
+        # scatters into the tile's COLUMN dimension (the rotating output,
+        # `25D_cannon_dense.hpp:271-305`), so chunks must group by col block.
+        block = getattr(self.kernel, "is_blocked", False)
         self.S_tiles = build_tiles(
             S, grid, BlockCyclic25D(self.M_pad, self.N_pad, sqrtpc, c),
             tile_rows=self.localArows * c, tile_cols=self.localBrows, dtype=dtype,
+            block=block, block_swap=True,
         )
         self.ST_tiles = build_tiles(
             S.transpose(), grid, BlockCyclic25D(self.N_pad, self.M_pad, sqrtpc, c),
             tile_rows=self.localBrows * c, tile_cols=self.localArows, dtype=dtype,
+            block=block, block_swap=True,
         )
 
     def set_r_value(self, R: int) -> None:
@@ -187,10 +193,152 @@ class CannonDense25D(DistributedSparse):
     # Cannon main loop
     # ------------------------------------------------------------------ #
 
+    def _build_blocked_program(self, op: str, use_st: bool):
+        """Blocked (Pallas) variants over the SWAPPED chunk encoding: the
+        accumulator dimension is the tile's column frame (the rotating
+        output), and SDDMM flips its dense operands (it is role-symmetric).
+        Tile chunk metadata and traveling values rotate around the ``cols``
+        ring exactly like the flat struct-of-arrays."""
+        from distributed_sddmm_tpu.ops.blocked import CHUNK
+        from distributed_sddmm_tpu.ops.pallas_kernels import BlockedTile
+
+        tiles = self.ST_tiles if use_st else self.S_tiles
+        n, c = self.sqrtpc, self.c
+        max_nnz = tiles.max_nnz
+        out_rows = tiles.tile_cols  # moving-output block height (cols side)
+        kern = self.kernel
+        unroll = self.unroll
+        perm = ring_perm(n)
+        # Swapped geometry: gr blocks tile the COLS frame, gc the ROWS frame.
+        bm, bn, grb, gcb = tiles.blk_geom
+        mov_pad, stat_pad = grb * bm, gcb * bn
+        C = max_nnz // CHUNK
+
+        def shift_dense(x):
+            return x if n == 1 else lax.ppermute(x, "rows", perm)
+
+        def shift_sparse(tree):
+            if n == 1:
+                return tree
+            return jax.tree.map(lambda t: lax.ppermute(t, "cols", perm), tree)
+
+        def replicate(stat):
+            if c == 1:
+                return stat
+            return lax.all_gather(stat, "layers", axis=0, tiled=True)
+
+        def dvary(x):
+            return vary(x, ("rows", "cols", "layers"))
+
+        def squeeze_blk(blr, blc, bmeta):
+            return (
+                blr.reshape(C, CHUNK),
+                blc.reshape(C, CHUNK),
+                bmeta.reshape(C),
+            )
+
+        def blk_of(fields):
+            blr, blc, bmeta = fields
+            return BlockedTile(
+                blr, blc, bmeta, bm=bm, bn=bn, gr_blocks=grb, gc_blocks=gcb
+            )
+
+        BLK6 = P("rows", "cols", "layers", None, None, None)
+        mesh = self.grid.mesh
+
+        if op == "sddmm":
+
+            def prog(stat, mov, blr, blc, bmeta, t_mask, t_vals):
+                bt = kern.prep(replicate(stat), stat_pad)  # gathered via lc=rows
+                init = (
+                    squeeze_blk(blr, blc, bmeta),
+                    t_mask.reshape(max_nnz),
+                    dvary(jnp.zeros((max_nnz,), t_mask.dtype)),
+                    mov,
+                )
+
+                def body(s, state):
+                    fields, mask, acc, mov = state
+                    at = kern.prep(mov, mov_pad)  # gathered via lr=cols
+                    acc = acc + kern.sddmm_tile_t(
+                        blk_of(fields), mask, at, bt, mask.dtype
+                    )
+                    return (fields, mask, acc, mov)
+
+                def shift_all(state):
+                    fields, mask, acc, mov = state
+                    fields, mask, acc = shift_sparse((fields, mask, acc))
+                    return (fields, mask, acc, shift_dense(mov))
+
+                def shift_acc_home(state):
+                    fields, mask, acc, mov = state
+                    return fields, mask, shift_sparse(acc), mov
+
+                state = ring_loop(
+                    n, body, init, shift_all, shift_final=shift_acc_home,
+                    unroll=unroll,
+                )
+                acc = state[2]
+                return (t_vals.reshape(max_nnz) * acc).reshape(1, 1, 1, 1, max_nnz)
+
+            in_specs = (
+                _DENSE_SPEC, _DENSE_SPEC, BLK6, BLK6,
+                _TILE_SPEC, _TILE_SPEC, _TILE_SPEC,
+            )
+            out_specs = _TILE_SPEC
+
+        elif op == "spmm":
+
+            def prog(stat, mov, blr, blc, bmeta, t_vals):
+                bt = kern.prep(replicate(stat), stat_pad)
+                init = (
+                    squeeze_blk(blr, blc, bmeta),
+                    t_vals.reshape(max_nnz),
+                    mov,
+                )
+
+                def body(s, state):
+                    fields, vals, mov = state
+                    partial = kern.spmm_tile_t(blk_of(fields), vals, bt)
+                    mov = mov + partial.T[:out_rows].astype(mov.dtype)
+                    return (fields, vals, mov)
+
+                def shift_all(state):
+                    fields, vals, mov = state
+                    fields, vals = shift_sparse((fields, vals))
+                    return (fields, vals, shift_dense(mov))
+
+                def shift_out_home(state):
+                    fields, vals, mov = state
+                    return fields, vals, shift_dense(mov)
+
+                state = ring_loop(
+                    n, body, init, shift_all, shift_final=shift_out_home,
+                    unroll=unroll,
+                )
+                return state[2]
+
+            in_specs = (_DENSE_SPEC, _DENSE_SPEC, BLK6, BLK6, _TILE_SPEC, _TILE_SPEC)
+            out_specs = _DENSE_SPEC
+
+        else:
+            raise ValueError(op)
+
+        return jax.jit(
+            shard_map(
+                prog, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                check_vma=False,
+            )
+        )
+
     def _program(self, op: str, use_st: bool):
         key = (op, use_st)
         if key in self._programs:
             return self._programs[key]
+        if self._use_blocked(self.ST_tiles if use_st else self.S_tiles):
+            fn = self._build_blocked_program(op, use_st)
+            self._programs[key] = fn
+            return fn
 
         tiles = self.ST_tiles if use_st else self.S_tiles
         n, c = self.sqrtpc, self.c
@@ -312,23 +460,23 @@ class CannonDense25D(DistributedSparse):
     def sddmm_a(self, A, B, s_vals):
         t = self.ST_tiles
         prog = self._program("sddmm", use_st=True)
-        return self._timed("sddmmA", prog, B, A, t.rows, t.cols, t.mask, s_vals)
+        return self._timed("sddmmA", prog, B, A, *self._sddmm_args(t, s_vals))
 
     def sddmm_b(self, A, B, st_vals):
         t = self.S_tiles
         prog = self._program("sddmm", use_st=False)
-        return self._timed("sddmmB", prog, A, B, t.rows, t.cols, t.mask, st_vals)
+        return self._timed("sddmmB", prog, A, B, *self._sddmm_args(t, st_vals))
 
     def spmm_a(self, A, B, s_vals):
         """A = S @ B; A must be pre-skewed zeros (or accumulate base)."""
         t = self.ST_tiles
         prog = self._program("spmm", use_st=True)
-        return self._timed("spmmA", prog, B, A, t.rows, t.cols, s_vals)
+        return self._timed("spmmA", prog, B, A, *self._spmm_args(t, s_vals))
 
     def spmm_b(self, A, B, st_vals):
         t = self.S_tiles
         prog = self._program("spmm", use_st=False)
-        return self._timed("spmmB", prog, A, B, t.rows, t.cols, st_vals)
+        return self._timed("spmmB", prog, A, B, *self._spmm_args(t, st_vals))
 
     def fused_spmm(self, A, B, s_vals, mode: MatMode = MatMode.A):
         """SDDMM -> SpMM with the moving operand pre-skewed once for both."""

@@ -102,13 +102,16 @@ class CannonSparse25D(DistributedSparse):
         self.a_spec = _DENSE_SPEC
         self.b_spec = _DENSE_SPEC
 
+        block = getattr(self.kernel, "is_blocked", False)
         self.S_tiles = build_replicated_tiles(
             S, grid, Floor2D(self.M_pad, self.N_pad, sqrtpc),
             tile_rows=self.localArows, tile_cols=self.localBrows, dtype=dtype,
+            block=block,
         )
         self.ST_tiles = build_replicated_tiles(
             S.transpose(), grid, Floor2D(self.N_pad, self.M_pad, sqrtpc),
             tile_rows=self.localBrows, tile_cols=self.localArows, dtype=dtype,
+            block=block,
         )
 
     def set_r_value(self, R: int) -> None:
@@ -267,10 +270,139 @@ class CannonSparse25D(DistributedSparse):
     # Cannon main loop (sparse stationary, both dense operands rotate)
     # ------------------------------------------------------------------ #
 
+    def _build_blocked_program(self, op: str, use_st: bool):
+        """Blocked (Pallas) variants: the sparse chunk lists stay put (they
+        are replicated up the fiber like the rest of the structure); both
+        dense operands rotate and are re-prepped feature-major per step.
+        The fiber value collectives (`25D_cannon_sparse.hpp:221-242,287-306`)
+        operate on the chunk-flat layout, whose length is padded to split
+        evenly into owned slices."""
+        from distributed_sddmm_tpu.ops.blocked import CHUNK
+        from distributed_sddmm_tpu.ops.pallas_kernels import BlockedTile
+
+        tiles = self.ST_tiles if use_st else self.S_tiles
+        n, c = self.sqrtpc, self.c
+        max_nnz, owned_len = tiles.max_nnz, tiles.owned_len
+        out_rows = tiles.tile_rows
+        kern = self.kernel
+        unroll = self.unroll
+        perm = ring_perm(n)
+        bm, bn, grb, gcb = tiles.blk_geom
+        rows_pad, cols_pad = grb * bm, gcb * bn
+        C = max_nnz // CHUNK
+
+        def shift_a(x):
+            return x if n == 1 else lax.ppermute(x, "cols", perm)
+
+        def shift_b(x):
+            return x if n == 1 else lax.ppermute(x, "rows", perm)
+
+        def dvary(x):
+            return vary(x, ("rows", "cols", "layers"))
+
+        def blk_of(blr, blc, bmeta):
+            return BlockedTile(
+                blr.reshape(C, CHUNK), blc.reshape(C, CHUNK), bmeta.reshape(C),
+                bm=bm, bn=bn, gr_blocks=grb, gc_blocks=gcb,
+            )
+
+        BLK_SPEC = P("rows", "cols", None, None)
+        META_SPEC = P("rows", "cols", None)
+        mesh = self.grid.mesh
+
+        if op == "sddmm":
+
+            def prog(a_role, b_role, blr, blc, bmeta, t_mask, vals_owned):
+                blk = blk_of(blr, blc, bmeta)
+                mask = t_mask.reshape(max_nnz)
+                init = (
+                    dvary(jnp.zeros((max_nnz,), mask.dtype)),
+                    a_role, b_role,
+                )
+
+                def body(s, state):
+                    acc, a, b = state
+                    at = kern.prep(a, rows_pad)
+                    bt = kern.prep(b, cols_pad)
+                    acc = acc + kern.sddmm_tile_t(blk, mask, at, bt, mask.dtype)
+                    return (acc, a, b)
+
+                def shift_ab(state):
+                    acc, a, b = state
+                    return (acc, shift_a(a), shift_b(b))
+
+                state = ring_loop(n, body, init, shift_ab, unroll=unroll)
+                acc = state[0]
+                if c > 1:
+                    owned = lax.psum_scatter(
+                        acc, "layers", scatter_dimension=0, tiled=True
+                    )
+                else:
+                    owned = acc
+                return (vals_owned.reshape(owned_len) * owned).reshape(
+                    1, 1, 1, owned_len
+                )
+
+            in_specs = (
+                _DENSE_SPEC, _DENSE_SPEC, BLK_SPEC, BLK_SPEC, META_SPEC,
+                _STRUCT_SPEC, _VALUES_SPEC,
+            )
+            out_specs = _VALUES_SPEC
+
+        elif op == "spmm":
+
+            def prog(a_role, b_role, blr, blc, bmeta, vals_owned):
+                blk = blk_of(blr, blc, bmeta)
+                v = vals_owned.reshape(owned_len)
+                if c > 1:
+                    vals = lax.all_gather(v, "layers", axis=0, tiled=True)
+                else:
+                    vals = v
+                init = (a_role, b_role)
+
+                def body(s, state):
+                    a, b = state
+                    partial = kern.spmm_tile_t(blk, vals, kern.prep(b, cols_pad))
+                    return (a + partial.T[:out_rows].astype(a.dtype), b)
+
+                def shift_ab(state):
+                    a, b = state
+                    return (shift_a(a), shift_b(b))
+
+                def shift_out_home(state):
+                    a, b = state
+                    return (shift_a(a), b)
+
+                state = ring_loop(
+                    n, body, init, shift_ab, shift_final=shift_out_home,
+                    unroll=unroll,
+                )
+                return state[0]
+
+            in_specs = (
+                _DENSE_SPEC, _DENSE_SPEC, BLK_SPEC, BLK_SPEC, META_SPEC,
+                _VALUES_SPEC,
+            )
+            out_specs = _DENSE_SPEC
+
+        else:
+            raise ValueError(op)
+
+        return jax.jit(
+            shard_map(
+                prog, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                check_vma=False,
+            )
+        )
+
     def _program(self, op: str, use_st: bool):
         key = (op, use_st)
         if key in self._programs:
             return self._programs[key]
+        if self._use_blocked(self.ST_tiles if use_st else self.S_tiles):
+            fn = self._build_blocked_program(op, use_st)
+            self._programs[key] = fn
+            return fn
 
         tiles = self.ST_tiles if use_st else self.S_tiles
         n, c = self.sqrtpc, self.c
@@ -384,22 +516,22 @@ class CannonSparse25D(DistributedSparse):
     def sddmm_a(self, A, B, s_vals):
         t = self.S_tiles
         prog = self._program("sddmm", use_st=False)
-        return self._timed("sddmmA", prog, A, B, t.rows, t.cols, t.mask, s_vals)
+        return self._timed("sddmmA", prog, A, B, *self._sddmm_args(t, s_vals))
 
     def sddmm_b(self, A, B, st_vals):
         t = self.ST_tiles
         prog = self._program("sddmm", use_st=True)
-        return self._timed("sddmmB", prog, B, A, t.rows, t.cols, t.mask, st_vals)
+        return self._timed("sddmmB", prog, B, A, *self._sddmm_args(t, st_vals))
 
     def spmm_a(self, A, B, s_vals):
         t = self.S_tiles
         prog = self._program("spmm", use_st=False)
-        return self._timed("spmmA", prog, A, B, t.rows, t.cols, s_vals)
+        return self._timed("spmmA", prog, A, B, *self._spmm_args(t, s_vals))
 
     def spmm_b(self, A, B, st_vals):
         t = self.ST_tiles
         prog = self._program("spmm", use_st=True)
-        return self._timed("spmmB", prog, B, A, t.rows, t.cols, st_vals)
+        return self._timed("spmmB", prog, B, A, *self._spmm_args(t, st_vals))
 
     def fused_spmm(self, A, B, s_vals, mode: MatMode = MatMode.A):
         if mode == MatMode.A:

@@ -112,9 +112,6 @@ class DenseShift15D(DistributedSparse):
     # shard_map programs
     # ------------------------------------------------------------------ #
 
-    def _use_blocked(self, tiles) -> bool:
-        return getattr(self.kernel, "is_blocked", False) and tiles.has_blocked
-
     def _program(self, op: str, use_st: bool):
         """Build (and cache) the jitted shard_map program for one op.
 

@@ -15,6 +15,7 @@ generalization of the reference's own max_nnz double buffers
 from __future__ import annotations
 
 import dataclasses
+import math
 
 import numpy as np
 
@@ -123,9 +124,19 @@ class ReplicatedTiles:
     nnz: int
     grid: GridSpec
     nnz_per_device: np.ndarray
+    # Blocked (Pallas) chunk-list encoding; structure replicated over the
+    # fiber like rows/cols. None when not built.
+    blk_lr: jax.Array = None    # (nr, nc, C, 128) int32
+    blk_lc: jax.Array = None
+    blk_meta: jax.Array = None  # (nr, nc, C) int32 packed
+    blk_geom: tuple = None
 
     STRUCT_SPEC = P("rows", "cols", None)
     VALUES_SPEC = P("rows", "cols", "layers", None)
+
+    @property
+    def has_blocked(self) -> bool:
+        return self.blk_lr is not None
 
     @property
     def max_nnz(self) -> int:
@@ -156,9 +167,13 @@ def build_replicated_tiles(
     tile_rows: int,
     tile_cols: int,
     dtype=jnp.float32,
+    block: bool = False,
 ) -> ReplicatedTiles:
     """Bucket nonzeros onto the 2-D grid floor, replicate structure across
-    layers, shard values 1/c per layer (contiguous equal slices)."""
+    layers, shard values 1/c per layer (contiguous equal slices).
+    ``block=True`` additionally builds the chunk-list (Pallas) encoding and
+    makes it the flat layout, with the chunk count padded so the chunk-flat
+    length splits evenly into fiber slices."""
     nr, nc, nh = grid.nr, grid.nc, grid.nh
     res = layout(S.rows, S.cols)
     if res.i.size:
@@ -166,31 +181,71 @@ def build_replicated_tiles(
 
     dev = res.i * nc + res.j
     n_buckets = nr * nc
-    order = np.argsort(dev, kind="stable")
-    counts = np.bincount(dev[order], minlength=n_buckets)
-    # Pad to a multiple of the fiber depth so value slices are equal-sized.
-    raw_max = max(int(counts.max(initial=0)), 1)
-    max_nnz = divide_round_up(raw_max, nh) * nh
+
+    blocked = None
+    if block:
+        blocked = _try_build_blocked(n_buckets, dev, res, tile_rows, tile_cols)
+        if blocked is not None:
+            from distributed_sddmm_tpu.ops.blocked import CHUNK, pad_chunk_count
+
+            # Chunk-flat length must divide into nh equal value slices.
+            lcm_chunks = nh // math.gcd(CHUNK, nh)
+            C = divide_round_up(blocked.n_chunks, lcm_chunks) * lcm_chunks
+            blocked = pad_chunk_count(blocked, C)
+
+    if blocked is not None:
+        from distributed_sddmm_tpu.ops.blocked import CHUNK
+
+        max_nnz = blocked.n_chunks * CHUNK
+        scatter_index = blocked.host_to_chunk
+        rows_flat = blocked.global_rows().reshape(-1)
+        cols_flat = blocked.global_cols().reshape(-1)
+        mask_flat = (~blocked.pad_lane).reshape(-1).astype(np.dtype(dtype))
+        counts = np.bincount(dev, minlength=n_buckets)
+    else:
+        order = np.argsort(dev, kind="stable")
+        counts = np.bincount(dev[order], minlength=n_buckets)
+        # Pad to a multiple of the fiber depth so value slices are equal-sized.
+        raw_max = max(int(counts.max(initial=0)), 1)
+        max_nnz = divide_round_up(raw_max, nh) * nh
+        starts = np.zeros(n_buckets, dtype=np.int64)
+        np.cumsum(counts[:-1], out=starts[1:])
+        within = np.arange(S.nnz, dtype=np.int64) - starts[dev[order]]
+        pos_sorted = dev[order] * max_nnz + within
+        scatter_index = np.empty(S.nnz, dtype=np.int64)
+        scatter_index[order] = pos_sorted
+
+        total = n_buckets * max_nnz
+        rows_flat = np.zeros(total, dtype=np.int32)
+        cols_flat = np.zeros(total, dtype=np.int32)
+        mask_flat = np.zeros(total, dtype=np.dtype(dtype))
+        rows_flat[scatter_index] = res.local_r
+        cols_flat[scatter_index] = res.local_c
+        mask_flat[scatter_index] = 1
+
     owned_len = max_nnz // nh
-    starts = np.zeros(n_buckets, dtype=np.int64)
-    np.cumsum(counts[:-1], out=starts[1:])
-    within = np.arange(S.nnz, dtype=np.int64) - starts[dev[order]]
-    pos_sorted = dev[order] * max_nnz + within
-    scatter_index = np.empty(S.nnz, dtype=np.int64)
-    scatter_index[order] = pos_sorted
-
-    total = n_buckets * max_nnz
-    rows_flat = np.zeros(total, dtype=np.int32)
-    cols_flat = np.zeros(total, dtype=np.int32)
-    mask_flat = np.zeros(total, dtype=np.dtype(dtype))
-    rows_flat[scatter_index] = res.local_r
-    cols_flat[scatter_index] = res.local_c
-    mask_flat[scatter_index] = 1
-
     struct_shape = (nr, nc, max_nnz)
     values_shape = (nr, nc, nh, owned_len)
     struct_sharding = NamedSharding(grid.mesh, ReplicatedTiles.STRUCT_SPEC)
     values_sharding = NamedSharding(grid.mesh, ReplicatedTiles.VALUES_SPEC)
+
+    blocked_fields = {}
+    if blocked is not None:
+        C = blocked.n_chunks
+        chunk_spec = NamedSharding(grid.mesh, P("rows", "cols", None, None))
+        meta_spec = NamedSharding(grid.mesh, P("rows", "cols", None))
+        blocked_fields = dict(
+            blk_lr=jax.device_put(
+                blocked.lr.reshape(nr, nc, C, blocked.lr.shape[-1]), chunk_spec
+            ),
+            blk_lc=jax.device_put(
+                blocked.lc.reshape(nr, nc, C, blocked.lc.shape[-1]), chunk_spec
+            ),
+            blk_meta=jax.device_put(blocked.meta.reshape(nr, nc, C), meta_spec),
+            blk_geom=(
+                blocked.bm, blocked.bn, blocked.gr_blocks, blocked.gc_blocks
+            ),
+        )
 
     return ReplicatedTiles(
         rows=jax.device_put(rows_flat.reshape(struct_shape), struct_sharding),
@@ -206,6 +261,7 @@ def build_replicated_tiles(
         nnz=S.nnz,
         grid=grid,
         nnz_per_device=counts.reshape(nr, nc, 1),
+        **blocked_fields,
     )
 
 
@@ -218,6 +274,7 @@ def build_tiles(
     dtype=jnp.float32,
     min_pad: int = 1,
     block: bool = False,
+    block_swap: bool = False,
 ) -> TileSet:
     """Bucket ``S``'s nonzeros by (device, tile) and pad to a static shape.
 
@@ -230,6 +287,15 @@ def build_tiles(
     inflates max_nnz by the chunk padding — only ask for it when the kernel
     consumes it); it is skipped automatically when the block-pair grid would
     be degenerate (see ``_BLOCK_PAIR_LIMIT``).
+
+    ``block_swap=True`` builds the encoding in SWAPPED orientation: chunks
+    are grouped by column block (``blk_lr`` holds column-locals, ``blk_lc``
+    row-locals, ``blk_geom`` describes the (cols, rows) frames). Algorithms
+    whose SpMM scatters into the tile's COLUMN dimension (Cannon dense,
+    `25D_cannon_dense.hpp:271-305`) need this: the Pallas output-accumulator
+    contract requires chunks grouped by the scatter dimension, and SDDMM is
+    role-symmetric so it simply flips its dense operands. The flat
+    rows/cols arrays remain in true (row, col) convention either way.
     """
     nr, nc, nh = grid.nr, grid.nc, grid.nh
     T = layout.n_tiles
@@ -247,7 +313,7 @@ def build_tiles(
     blocked = None
     if block:
         blocked = _try_build_blocked(
-            n_buckets, bucket, res, tile_rows, tile_cols
+            n_buckets, bucket, res, tile_rows, tile_cols, swap=block_swap
         )
 
     if blocked is not None:
@@ -257,8 +323,12 @@ def build_tiles(
 
         max_nnz = blocked.n_chunks * CHUNK
         scatter_index = blocked.host_to_chunk
-        rows_flat = blocked.global_rows().reshape(-1)
-        cols_flat = blocked.global_cols().reshape(-1)
+        if block_swap:
+            rows_flat = blocked.global_cols().reshape(-1)
+            cols_flat = blocked.global_rows().reshape(-1)
+        else:
+            rows_flat = blocked.global_rows().reshape(-1)
+            cols_flat = blocked.global_cols().reshape(-1)
         mask_flat = (~blocked.pad_lane).reshape(-1).astype(np.dtype(dtype))
     else:
         from distributed_sddmm_tpu import native
@@ -325,7 +395,7 @@ def build_tiles(
 _BLOCK_PAIR_LIMIT = 200_000_000
 
 
-def _try_build_blocked(n_buckets, bucket, res, tile_rows, tile_cols):
+def _try_build_blocked(n_buckets, bucket, res, tile_rows, tile_cols, swap=False):
     from distributed_sddmm_tpu.ops.blocked import build_blocked, pick_block
 
     bm = pick_block(max(tile_rows, 1))
@@ -337,6 +407,10 @@ def _try_build_blocked(n_buckets, bucket, res, tile_rows, tile_cols):
     )
     if n_pairs > _BLOCK_PAIR_LIMIT:
         return None
+    local_r, local_c = res.local_r, res.local_c
+    if swap:
+        local_r, local_c = local_c, local_r
+        tile_rows, tile_cols = tile_cols, tile_rows
     return build_blocked(
-        n_buckets, bucket, res.local_r, res.local_c, tile_rows, tile_cols
+        n_buckets, bucket, local_r, local_c, tile_rows, tile_cols
     )

@@ -89,13 +89,16 @@ class SparseShift15D(DistributedSparse):
         self.a_spec = _DENSE_SPEC
         self.b_spec = _DENSE_SPEC
 
+        block = getattr(self.kernel, "is_blocked", False)
         self.S_tiles = build_tiles(
             S, grid, ShardedBlockRow(self.M_pad, self.N_pad, p, c),
             tile_rows=self.blockAwidth, tile_cols=self.N_pad, dtype=dtype,
+            block=block,
         )
         self.ST_tiles = build_tiles(
             S.transpose(), grid, ShardedBlockRow(self.N_pad, self.M_pad, p, c),
             tile_rows=self.blockBwidth, tile_cols=self.M_pad, dtype=dtype,
+            block=block,
         )
 
     # Canonical dense representation: (stripes, c, block, R), see module doc.
@@ -119,10 +122,141 @@ class SparseShift15D(DistributedSparse):
     # shard_map programs
     # ------------------------------------------------------------------ #
 
+    def _build_blocked_program(self, op: str, use_st: bool):
+        """Blocked (Pallas) variants: the chunk-list tile metadata ring-shifts
+        WITH the tile (`shiftCSR` analog — the blocked encoding is just more
+        arrays in the traveling struct-of-arrays), local compute runs through
+        the feature-major tile kernels."""
+        from distributed_sddmm_tpu.ops.blocked import CHUNK
+        from distributed_sddmm_tpu.ops.pallas_kernels import BlockedTile
+
+        tiles = self.ST_tiles if use_st else self.S_tiles
+        nr, c = self.nr, self.c
+        max_nnz = tiles.max_nnz
+        out_bw = tiles.tile_rows
+        kern = self.kernel
+        perm = ring_perm(nr)
+        unroll = self.unroll
+        bm, bn, grb, gcb = tiles.blk_geom
+        rows_pad, cols_pad = grb * bm, gcb * bn
+        C = max_nnz // CHUNK
+
+        def shift(tree):
+            if nr == 1:
+                return tree
+            return jax.tree.map(lambda x: lax.ppermute(x, "rows", perm), tree)
+
+        def replicate_stationary(blk):
+            if c > 1:
+                blk = lax.all_gather(blk, "cols", axis=1, tiled=True)
+            return blk.reshape(blk.shape[0] * blk.shape[1] * blk.shape[2], blk.shape[3])
+
+        def dvary(x):
+            return vary(x, ("rows", "cols"))
+
+        def my_stripe(step):
+            i_idx = lax.axis_index("rows")
+            return jax.numpy.mod(i_idx - step, nr)
+
+        def squeeze_blk(blr, blc, bmeta):
+            return (
+                blr.reshape(C, CHUNK),
+                blc.reshape(C, CHUNK),
+                bmeta.reshape(C),
+            )
+
+        def blk_of(fields):
+            blr, blc, bmeta = fields
+            return BlockedTile(
+                blr, blc, bmeta, bm=bm, bn=bn, gr_blocks=grb, gc_blocks=gcb
+            )
+
+        BLK6 = P("rows", "cols", None, None, None, None)
+        mesh = self.grid.mesh
+
+        if op == "sddmm":
+
+            def prog(a_role, b_role, blr, blc, bmeta, t_mask, t_vals):
+                bt = kern.prep(replicate_stationary(b_role), cols_pad)
+                fields = squeeze_blk(blr, blc, bmeta)
+                init = (
+                    fields,
+                    t_mask.reshape(max_nnz),
+                    dvary(jnp.zeros((max_nnz,), t_mask.dtype)),
+                )
+
+                def body(s, state):
+                    fields, mask, acc = state
+                    stripe = lax.dynamic_index_in_dim(
+                        a_role, my_stripe(s), axis=0, keepdims=False
+                    ).reshape(out_bw, a_role.shape[-1])
+                    at = kern.prep(stripe, rows_pad)
+                    acc = acc + kern.sddmm_tile_t(
+                        blk_of(fields), mask, at, bt, mask.dtype
+                    )
+                    return (fields, mask, acc)
+
+                state = ring_loop(
+                    nr, body, init, shift, shift_final=shift, unroll=unroll
+                )
+                acc = state[2]
+                return (t_vals.reshape(max_nnz) * acc).reshape(1, 1, 1, 1, max_nnz)
+
+            in_specs = (
+                _DENSE_SPEC, _DENSE_SPEC, BLK6, BLK6,
+                _TILE_SPEC, _TILE_SPEC, _TILE_SPEC,
+            )
+            out_specs = _TILE_SPEC
+
+        elif op == "spmm":
+
+            def prog(stat, blr, blc, bmeta, t_vals):
+                bt = kern.prep(replicate_stationary(stat), cols_pad)
+                fields = squeeze_blk(blr, blc, bmeta)
+                init = (
+                    fields,
+                    t_vals.reshape(max_nnz),
+                    dvary(jnp.zeros((nr, 1, out_bw, stat.shape[-1]), stat.dtype)),
+                )
+
+                def body(s, state):
+                    fields, vals, out = state
+                    partial = kern.spmm_tile_t(blk_of(fields), vals, bt)
+                    stripe = partial.T[:out_bw].astype(out.dtype)
+                    out = lax.dynamic_update_index_in_dim(
+                        out, stripe[None, :, :], my_stripe(s), axis=0
+                    )
+                    return (fields, vals, out)
+
+                def shift_tile_only(state):
+                    fields, vals, out = state
+                    fields, vals = shift((fields, vals))
+                    return (fields, vals, out)
+
+                state = ring_loop(nr, body, init, shift_tile_only, unroll=unroll)
+                return state[2]
+
+            in_specs = (_DENSE_SPEC, BLK6, BLK6, _TILE_SPEC, _TILE_SPEC)
+            out_specs = _DENSE_SPEC
+
+        else:
+            raise ValueError(op)
+
+        return jax.jit(
+            shard_map(
+                prog, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                check_vma=False,
+            )
+        )
+
     def _program(self, op: str, use_st: bool):
         key = (op, use_st)
         if key in self._programs:
             return self._programs[key]
+        if self._use_blocked(self.ST_tiles if use_st else self.S_tiles):
+            fn = self._build_blocked_program(op, use_st)
+            self._programs[key] = fn
+            return fn
 
         tiles = self.ST_tiles if use_st else self.S_tiles
         nr, c = self.nr, self.c
@@ -239,19 +373,19 @@ class SparseShift15D(DistributedSparse):
     def sddmm_a(self, A, B, s_vals):
         t = self.S_tiles
         prog = self._program("sddmm", use_st=False)
-        return self._timed("sddmmA", prog, A, B, t.rows, t.cols, t.mask, s_vals)
+        return self._timed("sddmmA", prog, A, B, *self._sddmm_args(t, s_vals))
 
     def sddmm_b(self, A, B, st_vals):
         t = self.ST_tiles
         prog = self._program("sddmm", use_st=True)
-        return self._timed("sddmmB", prog, B, A, t.rows, t.cols, t.mask, st_vals)
+        return self._timed("sddmmB", prog, B, A, *self._sddmm_args(t, st_vals))
 
     def spmm_a(self, A, B, s_vals):
         t = self.S_tiles
         prog = self._program("spmm", use_st=False)
-        return self._timed("spmmA", prog, B, t.rows, t.cols, s_vals)
+        return self._timed("spmmA", prog, B, *self._spmm_args(t, s_vals))
 
     def spmm_b(self, A, B, st_vals):
         t = self.ST_tiles
         prog = self._program("spmm", use_st=True)
-        return self._timed("spmmB", prog, A, t.rows, t.cols, st_vals)
+        return self._timed("spmmB", prog, A, *self._spmm_args(t, st_vals))

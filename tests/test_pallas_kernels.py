@@ -202,3 +202,61 @@ class TestPallasDistributed:
                 )
             )
         np.testing.assert_allclose(prints[0], prints[1], rtol=1e-5)
+
+
+class TestPallasAllAlgorithms:
+    """Every strategy (including the tile-rotating and fiber-replicated
+    ones) runs its ops through the blocked Pallas kernels with fingerprints
+    identical to the XLA path — the scratch.cpp protocol across kernels."""
+
+    @pytest.mark.parametrize(
+        "alg_name,c,p",
+        [
+            ("15d_sparse", 1, 4),
+            ("15d_sparse", 2, 8),
+            ("25d_dense_replicate", 1, 4),
+            ("25d_dense_replicate", 2, 8),
+            ("25d_sparse_replicate", 1, 4),
+            ("25d_sparse_replicate", 2, 8),
+        ],
+    )
+    def test_fingerprints_match_xla(self, alg_name, c, p):
+        import jax
+
+        from distributed_sddmm_tpu.common import KernelMode
+        from distributed_sddmm_tpu.bench.harness import make_algorithm
+
+        S = HostCOO.erdos_renyi(280, 260, 5, seed=4, values="normal")
+        devices = jax.devices()[:p]
+        prints = []
+        for kern in (
+            XlaKernel(),
+            PallasKernel(precision="f32", interpret=True),
+        ):
+            alg = make_algorithm(alg_name, S, R=16, c=c, kernel=kern,
+                                 devices=devices)
+            A = alg.dummy_initialize(MatMode.A)
+            B = alg.dummy_initialize(MatMode.B)
+            A_s, B_s = alg.initial_shift(A, B, KernelMode.SDDMM_A)
+            mid = alg.sddmm_a(A_s, B_s, alg.like_s_values(1.0))
+            zero, B_s2 = alg.initial_shift(
+                alg.like_a_matrix(0.0), B, KernelMode.SPMM_A
+            )
+            out = alg.spmm_a(zero, B_s2, alg.like_s_values(1.0))
+            out, _ = alg.de_shift(out, None, KernelMode.SPMM_A)
+            A_s3, zb = alg.initial_shift(
+                A, alg.like_b_matrix(0.0), KernelMode.SPMM_B
+            )
+            outb = alg.spmm_b(A_s3, zb, alg.like_st_values(1.0))
+            _, outb = alg.de_shift(None, outb, KernelMode.SPMM_B)
+            A_s4, B_s4 = alg.initial_shift(A, B, KernelMode.SDDMM_B)
+            mid_b = alg.sddmm_b(A_s4, B_s4, alg.like_st_values(1.0))
+            prints.append(
+                (
+                    alg.fingerprint(alg.gather_s_values(mid)),
+                    alg.fingerprint(alg.host_a(out)),
+                    alg.fingerprint(alg.host_b(outb)),
+                    alg.fingerprint(alg.gather_st_values(mid_b)),
+                )
+            )
+        np.testing.assert_allclose(prints[0], prints[1], rtol=1e-5)
