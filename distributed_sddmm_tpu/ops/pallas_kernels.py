@@ -42,7 +42,9 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from distributed_sddmm_tpu.ops.blocked import CHUNK, _GC_SHIFT, _GR_SHIFT, MAX_BLOCKS
+from distributed_sddmm_tpu.ops.blocked import (
+    CHUNK, _GC_SHIFT, _GR_SHIFT, MAX_BLOCKS, unpack_meta,
+)
 from distributed_sddmm_tpu.ops.kernels import XlaKernel
 
 
@@ -217,6 +219,133 @@ def _tile_call(
     )(meta, *operands)
 
 
+def _flat_indices(geom, meta, lr, lc):
+    """Device-side reconstruction of the chunk lanes' block-frame indices:
+    ``rows`` address the ``at``/output frame, ``cols`` the ``bt`` frame
+    (whatever the encoding's orientation)."""
+    bm, bn = geom[0], geom[1]
+    gr, gc, _, _ = unpack_meta(meta)
+    rows = (gr[:, None] * bm + lr).reshape(-1)
+    cols = (gc[:, None] * bn + lc).reshape(-1)
+    return rows, cols
+
+
+# Differentiable tile ops: forward runs the Mosaic kernel, backward runs XLA
+# gather/segment-sum formulas over indices reconstructed from the chunk
+# metadata. Pad lanes contribute nothing to dense cotangents because value
+# vectors are zero there (the TileSet mask contract); their d_sv entries are
+# don't-cares that the pad positions of value vectors absorb. The integer
+# metadata arrays are explicit arguments with float0 cotangents (custom_vjp
+# must not close over tracers); ``geom`` = (bm, bn, gr_blocks, gc_blocks,
+# interpret) rides in nondiff_argnums.
+
+
+def _geom_call(geom, op, meta, lr, lc, sv, at, bt):
+    bm, bn, grb, gcb, interpret = geom
+    return tuple(
+        _tile_call(
+            meta, lr, lc, sv, at, bt, op=op, bm=bm, bn=bn,
+            gr_blocks=grb, gc_blocks=gcb, interpret=interpret,
+        )
+    )
+
+
+def _int_zeros(*arrays):
+    import numpy as onp
+
+    return tuple(onp.zeros(a.shape, dtype=jax.dtypes.float0) for a in arrays)
+
+
+def _seg_t(contrib, idx, n, dtype):
+    """Scatter-add [nnz_flat, R] rows -> feature-major [R, n]."""
+    return jax.ops.segment_sum(contrib, idx, num_segments=n).T.astype(dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0,))
+def _sddmm_op(geom, meta, lr, lc, sv, at, bt):
+    return _geom_call(geom, "sddmm", meta, lr, lc, sv, at, bt)[0]
+
+
+def _sddmm_fwd(geom, meta, lr, lc, sv, at, bt):
+    return _sddmm_op(geom, meta, lr, lc, sv, at, bt), (meta, lr, lc, sv, at, bt)
+
+
+def _sddmm_bwd(geom, res, g):
+    meta, lr, lc, sv, at, bt = res
+    rows, cols = _flat_indices(geom, meta, lr, lc)
+    a_g = at.T.astype(jnp.float32)[rows]
+    b_g = bt.T.astype(jnp.float32)[cols]
+    dots = jnp.sum(a_g * b_g, axis=-1)
+    gf = g.reshape(-1).astype(jnp.float32)
+    gs = (gf * sv.reshape(-1).astype(jnp.float32))[:, None]
+    return _int_zeros(meta, lr, lc) + (
+        (gf * dots).reshape(sv.shape).astype(sv.dtype),
+        _seg_t(gs * b_g, rows, at.shape[1], at.dtype),
+        _seg_t(gs * a_g, cols, bt.shape[1], bt.dtype),
+    )
+
+
+_sddmm_op.defvjp(_sddmm_fwd, _sddmm_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0,))
+def _spmm_op(geom, meta, lr, lc, sv, bt):
+    return _geom_call(geom, "spmm", meta, lr, lc, sv, None, bt)[0]
+
+
+def _spmm_fwd(geom, meta, lr, lc, sv, bt):
+    return _spmm_op(geom, meta, lr, lc, sv, bt), (meta, lr, lc, sv, bt)
+
+
+def _spmm_bwd(geom, res, g):
+    meta, lr, lc, sv, bt = res
+    rows, cols = _flat_indices(geom, meta, lr, lc)
+    g_rows = g.T.astype(jnp.float32)[rows]
+    b_g = bt.T.astype(jnp.float32)[cols]
+    svf = sv.reshape(-1).astype(jnp.float32)[:, None]
+    return _int_zeros(meta, lr, lc) + (
+        jnp.sum(g_rows * b_g, axis=-1).reshape(sv.shape).astype(sv.dtype),
+        _seg_t(svf * g_rows, cols, bt.shape[1], bt.dtype),
+    )
+
+
+_spmm_op.defvjp(_spmm_fwd, _spmm_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0,))
+def _fused_op(geom, meta, lr, lc, sv, at, bt):
+    return _geom_call(geom, "fused", meta, lr, lc, sv, at, bt)
+
+
+def _fused_fwd(geom, meta, lr, lc, sv, at, bt):
+    outT, mid = _fused_op(geom, meta, lr, lc, sv, at, bt)
+    return (outT, mid), (meta, lr, lc, sv, at, bt, mid)
+
+
+def _fused_bwd(geom, res, cts):
+    meta, lr, lc, sv, at, bt, mid = res
+    g_out, g_mid = cts
+    rows, cols = _flat_indices(geom, meta, lr, lc)
+    a_g = at.T.astype(jnp.float32)[rows]
+    b_g = bt.T.astype(jnp.float32)[cols]
+    dots = jnp.sum(a_g * b_g, axis=-1)
+    g_out_rows = g_out.T.astype(jnp.float32)[rows]
+    # out = spmm(mid, bt) with mid = sv * dots: fold out's cotangent into mid's.
+    g_mid_eff = g_mid.reshape(-1).astype(jnp.float32) + jnp.sum(
+        g_out_rows * b_g, axis=-1
+    )
+    gs = (g_mid_eff * sv.reshape(-1).astype(jnp.float32))[:, None]
+    midf = mid.reshape(-1).astype(jnp.float32)[:, None]
+    return _int_zeros(meta, lr, lc) + (
+        (g_mid_eff * dots).reshape(sv.shape).astype(sv.dtype),
+        _seg_t(gs * b_g, rows, at.shape[1], at.dtype),
+        _seg_t(gs * a_g + midf * g_out_rows, cols, bt.shape[1], bt.dtype),
+    )
+
+
+_fused_op.defvjp(_fused_fwd, _fused_bwd)
+
+
 class PallasKernel:
     """TPU-native local kernel (one-hot MXU formulation).
 
@@ -284,14 +413,14 @@ class PallasKernel:
         bt = self.prep(B, blk.cols_pad)
         return self.sddmm_tile_t(blk, vals, at, bt, vals.dtype)
 
+    def _geom(self, blk: BlockedTile) -> tuple:
+        return (blk.bm, blk.bn, blk.gr_blocks, blk.gc_blocks, self.interpret)
+
     def sddmm_tile_t(self, blk: BlockedTile, vals, at, bt, out_dtype):
-        """Feature-major variant (operands already via prep_*)."""
-        sv = self._chunk_vals(blk, vals)
-        (mid,) = _tile_call(
-            blk.meta, blk.lr, blk.lc, sv, at, bt,
-            op="sddmm", bm=blk.bm, bn=blk.bn,
-            gr_blocks=blk.gr_blocks, gc_blocks=blk.gc_blocks,
-            interpret=self.interpret,
+        """Feature-major variant (operands already via ``prep``)."""
+        mid = _sddmm_op(
+            self._geom(blk), blk.meta, blk.lr, blk.lc,
+            self._chunk_vals(blk, vals), at, bt,
         )
         return self._unchunk(blk, mid, out_dtype)
 
@@ -303,14 +432,10 @@ class PallasKernel:
 
     def spmm_tile_t(self, blk: BlockedTile, vals, bt):
         """Feature-major variant: returns padded [R, rows_pad] f32 partial."""
-        sv = self._chunk_vals(blk, vals)
-        (outT,) = _tile_call(
-            blk.meta, blk.lr, blk.lc, sv, None, bt,
-            op="spmm", bm=blk.bm, bn=blk.bn,
-            gr_blocks=blk.gr_blocks, gc_blocks=blk.gc_blocks,
-            interpret=self.interpret,
+        return _spmm_op(
+            self._geom(blk), blk.meta, blk.lr, blk.lc,
+            self._chunk_vals(blk, vals), bt,
         )
-        return outT
 
     def fused_tile(self, blk: BlockedTile, vals, A, B):
         """SDDMM -> SpMM with shared gathers ("local kernel overlap").
@@ -322,11 +447,8 @@ class PallasKernel:
         return outT.T[: A.shape[0]].astype(A.dtype), mid
 
     def fused_tile_t(self, blk: BlockedTile, vals, at, bt, out_dtype):
-        sv = self._chunk_vals(blk, vals)
-        outT, mid = _tile_call(
-            blk.meta, blk.lr, blk.lc, sv, at, bt,
-            op="fused", bm=blk.bm, bn=blk.bn,
-            gr_blocks=blk.gr_blocks, gc_blocks=blk.gc_blocks,
-            interpret=self.interpret,
+        outT, mid = _fused_op(
+            self._geom(blk), blk.meta, blk.lr, blk.lc,
+            self._chunk_vals(blk, vals), at, bt,
         )
         return outT, self._unchunk(blk, mid, out_dtype)
