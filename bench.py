@@ -7,6 +7,21 @@ Mirrors the reference's primary entry point `bench_erdos_renyi`
 (`/root/reference/benchmark_dist.cpp:117-149`): Graph500-style R-mat input,
 fused SDDMM->SpMM pairs, throughput = 2*nnz*2*R*trials / elapsed.
 
+Resilience: the TPU in this environment is reached through an experimental
+tunnel whose backend init is flaky (it can raise UNAVAILABLE or hang
+outright, including mid-run). A crash or hang in-process would leave the
+driver with no number at all, so this script is split in two:
+
+* orchestrator (default): launches the measurement as a ``--worker``
+  subprocess with a hard timeout, retries the TPU attempt with backoff, and
+  if the TPU never produces a result falls back to a CPU-backend run so a
+  real (if slower) number always exists. Terminal failure still exits 0 with
+  a JSON error record rather than a stack trace.
+* worker (``--worker``): the actual chained-trial measurement. Trials are
+  data-dependently chained inside one jitted fori_loop ending in a scalar
+  host fetch, because on the tunneled backend ``block_until_ready`` alone
+  does not force execution and per-dispatch latency would otherwise dominate.
+
 Baseline denominator: the only absolute figure recoverable from the reference
 repo is the weak-scaling point ~6.47 GFLOP/s (15d_sparse fused, 256 Cori-KNL
 ranks; ipdps_chart_generator.ipynb cell 10, see BASELINE.md). vs_baseline is
@@ -16,14 +31,25 @@ number.
 
 import json
 import os
+import signal
+import subprocess
 import sys
 import time
 
+BASELINE_GFLOPS = 6.47  # see module docstring
 
-def main() -> None:
+
+def worker() -> None:
+    """The measurement itself; runs in a subprocess under the orchestrator."""
+    if os.environ.get("BENCH_PLATFORM", "") == "cpu":
+        from distributed_sddmm_tpu.utils.platform import force_cpu_platform
+
+        force_cpu_platform()
+
     import jax
 
     from distributed_sddmm_tpu.common import MatMode
+    from distributed_sddmm_tpu.ops import get_kernel
     from distributed_sddmm_tpu.parallel.dense_shift_15d import DenseShift15D
     from distributed_sddmm_tpu.utils.coo import HostCOO
 
@@ -33,28 +59,16 @@ def main() -> None:
     trials = int(os.environ.get("BENCH_TRIALS", "5"))
     kernel_name = os.environ.get("BENCH_KERNEL", "auto")
 
-    from distributed_sddmm_tpu.ops import get_kernel
-
     kernel = get_kernel(kernel_name)
 
     S = HostCOO.rmat(log_m=log_m, edge_factor=nnz_per_row, seed=0)
     n_dev = jax.device_count()
-    c = 1
-    alg = DenseShift15D(S, R=R, c=c, fusion_approach=2, kernel=kernel)
-
-    import jax.numpy as jnp
+    alg = DenseShift15D(S, R=R, c=1, fusion_approach=2, kernel=kernel)
 
     A = alg.dummy_initialize(MatMode.A)
     B = alg.like_b_matrix(0.01)
     s_vals = alg.like_s_values(1.0)
 
-    # Trials are CHAINED (each consumes the previous output, scaled to keep
-    # magnitudes finite) inside ONE jitted fori_loop ending in a scalar host
-    # fetch. Rationale: on async/tunneled backends block_until_ready alone
-    # does not force execution, independent same-input calls could be elided,
-    # and per-call dispatch latency through a remote tunnel would otherwise
-    # dominate the measurement; a single compiled data-dependent chain plus
-    # one fetch times exactly the device work.
     pair = alg.fused_program(s_vals, MatMode.A)
 
     from functools import partial
@@ -64,9 +78,11 @@ def main() -> None:
         def body(_, A_t):
             out, _ = pair(A_t, B)
             return A_t + out * 1e-12
+
         return jax.lax.fori_loop(0, n, body, A_t)
 
-    # Warmup / compile both trip counts.
+    # Warmup / compile both trip counts, then time by difference so the
+    # constant per-fetch overhead cancels.
     float(chain(A, B, 1).sum())
     float(chain(A, B, 1 + trials).sum())
     t0 = time.perf_counter()
@@ -81,16 +97,118 @@ def main() -> None:
     gflops = flops / elapsed / 1e9
     gflops_per_chip = gflops / n_dev
 
-    baseline = 6.47  # GFLOP/s, see module docstring
     print(
         json.dumps(
             {
                 "metric": f"fused SDDMM+SpMM GFLOP/s/chip (R-mat 2^{log_m}, "
                 f"nnz/row={nnz_per_row}, R={R}, {kernel.name} kernel, "
-                f"{n_dev} chip(s))",
+                f"{n_dev} {jax.default_backend()} chip(s))",
                 "value": round(gflops_per_chip, 3),
                 "unit": "GFLOP/s/chip",
-                "vs_baseline": round(gflops_per_chip / baseline, 3),
+                "vs_baseline": round(gflops_per_chip / BASELINE_GFLOPS, 3),
+            }
+        )
+    )
+
+
+def _run_attempt(env_extra: dict, timeout_s: float) -> dict | None:
+    """Run one worker subprocess; return its JSON record or None.
+
+    The worker runs in its own session so a timeout kills the whole process
+    GROUP — the tunneled backend spawns helper processes that would otherwise
+    inherit our pipes and keep ``communicate()`` blocked past the kill.
+    """
+    env = dict(os.environ)
+    env.update(env_extra)
+    proc = subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__), "--worker"],
+        env=env,
+        stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE,
+        text=True,
+        start_new_session=True,
+    )
+    try:
+        stdout, stderr = proc.communicate(timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        try:
+            os.killpg(proc.pid, signal.SIGKILL)
+        except (ProcessLookupError, PermissionError):
+            proc.kill()
+        stderr = ""
+        try:
+            _, stderr = proc.communicate(timeout=10)
+        except subprocess.TimeoutExpired:
+            pass
+        print(f"[bench] attempt timed out after {timeout_s:.0f}s", file=sys.stderr)
+        for ln in (stderr or "").strip().splitlines()[-15:]:
+            print(f"[bench]   {ln}", file=sys.stderr)
+        return None
+    for line in reversed((stdout or "").strip().splitlines()):
+        try:
+            rec = json.loads(line)
+            if isinstance(rec, dict) and "value" in rec:
+                return rec
+        except json.JSONDecodeError:
+            continue
+    tail = (stderr or "").strip().splitlines()[-15:]
+    print(
+        f"[bench] attempt rc={proc.returncode}, no JSON record; stderr tail:",
+        file=sys.stderr,
+    )
+    for ln in tail:
+        print(f"[bench]   {ln}", file=sys.stderr)
+    return None
+
+
+def main() -> None:
+    # Attempt schedule: TPU (auto kernel -> Pallas) with retries, then a CPU
+    # fallback so the driver always records a real measurement. Everything
+    # fits inside ONE total wall-clock budget with the tail reserved for the
+    # CPU fallback — an external harness timeout must never land before the
+    # fallback has had its chance.
+    total = float(os.environ.get("BENCH_TOTAL_TIMEOUT", "2100"))
+    backoff = float(os.environ.get("BENCH_BACKOFF", "20"))
+    start = time.monotonic()
+    cpu_reserve = min(600.0, total / 3)
+    tpu_budget = total - cpu_reserve
+
+    cpu_env = {"BENCH_PLATFORM": "cpu", "BENCH_KERNEL": "xla"}
+    attempts = [
+        ({}, tpu_budget * 0.6, 0.0),
+        ({}, tpu_budget * 0.4 - backoff, backoff),
+        (cpu_env, cpu_reserve, 0.0),
+    ]
+    errors = 0
+    for env_extra, timeout_s, backoff_s in attempts:
+        if backoff_s and errors:
+            time.sleep(backoff_s)
+        remaining = total - (time.monotonic() - start)
+        is_cpu = env_extra.get("BENCH_PLATFORM") == "cpu"
+        if not is_cpu:
+            # Never let a TPU attempt eat into the fallback reserve.
+            timeout_s = min(timeout_s, remaining - cpu_reserve)
+            if timeout_s < 30:
+                continue
+        else:
+            timeout_s = min(timeout_s, max(remaining, 60.0))
+        rec = _run_attempt(env_extra, timeout_s)
+        if rec is not None:
+            if is_cpu:
+                rec["note"] = (
+                    "TPU backend unavailable after retries; CPU fallback run"
+                )
+            print(json.dumps(rec))
+            return
+        errors += 1
+    print(
+        json.dumps(
+            {
+                "metric": "fused SDDMM+SpMM GFLOP/s/chip (all backends failed)",
+                "value": 0.0,
+                "unit": "GFLOP/s/chip",
+                "vs_baseline": 0.0,
+                "note": "TPU and CPU bench attempts all failed or timed out",
             }
         )
     )
@@ -98,4 +216,7 @@ def main() -> None:
 
 if __name__ == "__main__":
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
-    main()
+    if "--worker" in sys.argv:
+        worker()
+    else:
+        main()

@@ -1,0 +1,57 @@
+"""Force-CPU platform selection that actually works in this environment.
+
+One shared implementation of the "pin JAX to the host CPU platform before
+any backend init" dance needed by the test suite, the bench CPU fallback,
+and the multi-chip dryrun. The subtlety: a sitecustomize may pre-import jax
+with an experimental hardware platform registered, in which case the
+``JAX_PLATFORMS`` env var alone is IGNORED — ``jax.config.update`` must win
+before the first backend initialization, and nothing can rescue a process
+whose backend is already up (config updates become silent no-ops).
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def force_cpu_platform(n_devices: int | None = None, replace: bool = False) -> None:
+    """Pin this process's JAX to CPU; optionally force a virtual device count.
+
+    Must run before any JAX backend touch (``jax.devices()``, jit execution,
+    ``jax.default_backend()``...). Raises if a non-CPU backend already got
+    initialized, because then the pin silently cannot take effect.
+
+    ``n_devices``: if given, ensure ``--xla_force_host_platform_device_count``
+    is set (kept as-is when already present unless ``replace=True``).
+    """
+    flags = os.environ.get("XLA_FLAGS", "").split()
+    have = any(
+        f.startswith("--xla_force_host_platform_device_count") for f in flags
+    )
+    if n_devices is not None and (replace or not have):
+        flags = [
+            f
+            for f in flags
+            if not f.startswith("--xla_force_host_platform_device_count")
+        ]
+        flags.append(f"--xla_force_host_platform_device_count={n_devices}")
+    os.environ["XLA_FLAGS"] = " ".join(flags)
+    os.environ["JAX_PLATFORMS"] = "cpu"
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    backend = jax.default_backend()
+    if backend != "cpu":
+        raise RuntimeError(
+            f"force_cpu_platform ran too late: a {backend!r} backend is "
+            "already initialized in this process; call it before any JAX "
+            "backend touch (or use a fresh process)"
+        )
+    if n_devices is not None and jax.device_count() < n_devices:
+        raise RuntimeError(
+            f"force_cpu_platform ran too late: the CPU backend initialized "
+            f"with {jax.device_count()} device(s) before the "
+            f"device-count flag could take effect (wanted {n_devices}); "
+            "use a fresh process"
+        )

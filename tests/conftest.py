@@ -6,17 +6,14 @@ device count. Env vars must be set before jax is first imported.
 """
 
 import os
+import sys
 
-os.environ["JAX_PLATFORMS"] = "cpu"
-_flags = os.environ.get("XLA_FLAGS", "")
-if "--xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from distributed_sddmm_tpu.utils.platform import force_cpu_platform  # noqa: E402
+
+force_cpu_platform(n_devices=8, replace=True)
 
 import jax  # noqa: E402
-
-# Some environments pre-import jax from sitecustomize with a hardware
-# platform pinned; the config update wins over the stale env var as long as
-# no backend has been initialized yet.
-jax.config.update("jax_platforms", "cpu")
 
 assert jax.device_count() == 8, jax.devices()
