@@ -80,6 +80,7 @@ def _run_configs(S, alg_names, args, r_values=None):
                         trials=args.trials,
                         warmup=args.warmup,
                         kernel=kernel,
+                        breakdown=getattr(args, "breakdown", False),
                     )
                 except ValueError as e:
                     # Divisibility constraints differ per algorithm
@@ -108,6 +109,12 @@ def _add_common(p: argparse.ArgumentParser) -> None:
     p.add_argument("--warmup", type=int, default=1)
     p.add_argument("--kernel", default="auto", help="xla | pallas | auto")
     p.add_argument("--fused", default="yes", choices=["yes", "no", "both"])
+    p.add_argument(
+        "--breakdown", action="store_true",
+        help="add {Replication, Propagation, Computation} region attribution "
+        "to perf_stats (collective-ablation timing; run on a standard "
+        "backend, e.g. the CPU test mesh)",
+    )
     p.add_argument("-o", "--output-file", default=None, help="append JSON records here")
 
 

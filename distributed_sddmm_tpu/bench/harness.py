@@ -26,7 +26,7 @@ from typing import Callable, Optional
 
 import jax
 
-from distributed_sddmm_tpu.common import MatMode
+from distributed_sddmm_tpu.common import KernelMode, MatMode
 from distributed_sddmm_tpu.models.als import DistributedALS
 from distributed_sddmm_tpu.models.gat import GAT, GATLayer
 from distributed_sddmm_tpu.parallel.base import DistributedSparse
@@ -151,6 +151,7 @@ def benchmark_algorithm(
     kernel=None,
     devices=None,
     extra_info: Optional[dict] = None,
+    breakdown: bool = False,
 ) -> dict:
     """Run one benchmark configuration; append a JSON record to
     ``output_file`` (if given) and return it.
@@ -175,6 +176,24 @@ def benchmark_algorithm(
     nnz = S.nnz
     throughput = 2.0 * nnz * 2.0 * alg.R * trials / max(elapsed, 1e-12) / 1e9
 
+    perf_stats = alg.json_perf_statistics()
+    if breakdown:
+        if app != "vanilla":
+            raise ValueError(
+                "--breakdown attributes the fusedSpMM op and would mix "
+                "units with the gat/als whole-app perf counters; use "
+                "app='vanilla'"
+            )
+        # Region attribution via collective-ablated program variants
+        # (reference region timers, `distributed_sparse.h:205-261`).
+        A = alg.dummy_initialize(MatMode.A)
+        B = alg.dummy_initialize(MatMode.B)
+        s_vals = alg.like_s_values(1.0)
+        A, B = alg.initial_shift(A, B, KernelMode.SDDMM_A)
+        perf_stats.update(
+            alg.measure_breakdown(A, B, s_vals, op="fusedSpMM", trials=trials)
+        )
+
     record = {
         "algorithm": algorithm_name,
         "app": app,
@@ -184,7 +203,7 @@ def benchmark_algorithm(
         "overall_throughput": throughput,
         "kernel": getattr(alg.kernel, "name", type(alg.kernel).__name__),
         "alg_info": alg.json_algorithm_info(),
-        "perf_stats": alg.json_perf_statistics(),
+        "perf_stats": perf_stats,
         **app_stats,
         **(extra_info or {}),
     }

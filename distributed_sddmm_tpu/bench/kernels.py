@@ -23,7 +23,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from distributed_sddmm_tpu.ops.blocked import CHUNK, build_blocked
+from distributed_sddmm_tpu.ops.blocked import CHUNK, DEFAULT_GROUP, build_blocked
 from distributed_sddmm_tpu.ops.kernels import XlaKernel
 from distributed_sddmm_tpu.utils.coo import HostCOO
 
@@ -89,12 +89,14 @@ def _bench_one(S: HostCOO, R: int, kernel_name: str, trials: int) -> dict:
         precision = "bf16" if kernel_name == "pallas" else "f32"
         kern = PallasKernel(precision=precision)
         meta = build_blocked(
-            1, np.zeros(S.nnz, np.int64), S.rows, S.cols, S.M, S.N
+            1, np.zeros(S.nnz, np.int64), S.rows, S.cols, S.M, S.N,
+            group=DEFAULT_GROUP,
         )
         blk = BlockedTile(
             lr=jnp.array(meta.lr[0]), lc=jnp.array(meta.lc[0]),
             meta=jnp.array(meta.meta[0]), bm=meta.bm, bn=meta.bn,
             gr_blocks=meta.gr_blocks, gc_blocks=meta.gc_blocks,
+            group=meta.group,
         )
         vals_np = np.zeros(meta.n_chunks * CHUNK, np.float32)
         vals_np[meta.host_to_chunk] = S.vals

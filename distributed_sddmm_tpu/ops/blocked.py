@@ -29,6 +29,12 @@ import numpy as np
 
 CHUNK = 128  # nonzeros per chunk = VPU lane count
 
+# Chunks processed per Pallas grid step (see pallas_kernels._tile_call):
+# amortizes the per-step semaphore/DMA fixed cost, tuned on TPU v5e
+# (scripts/tune_blocks.py). Groups are gr-aligned, so larger values cost
+# pad chunks in small row blocks.
+DEFAULT_GROUP = 4
+
 # meta word packing: | gr (15 bits) | gc (15 bits) | last | first |
 _GR_SHIFT = 17
 _GC_SHIFT = 2
@@ -70,6 +76,7 @@ class BlockedMeta:
     gr_blocks: int        # row blocks per (padded) tile frame
     gc_blocks: int
     n_chunks: int         # C, padded axis-max chunks per bucket
+    group: int = 1        # chunks per kernel grid step (gr-aligned groups)
 
     @property
     def rows_pad(self) -> int:
@@ -111,6 +118,7 @@ def build_blocked(
     tile_cols: int,
     block_rows: int = 512,
     block_cols: int = 512,
+    group: int = 1,
 ) -> BlockedMeta:
     """Build the chunk-list encoding.
 
@@ -129,7 +137,19 @@ def build_blocked(
       window pinned on the bucket's LAST (already flushed) row block. Pad
       chunks must never remap the output window — Pallas output buffers are
       write-only, so a remapped-but-unwritten window would flush stale VMEM
-      over a correct block at grid end.
+      over a correct block at grid end;
+    * with ``group`` > 1, every bucket's ``gr`` group spans a multiple of
+      ``group`` chunks and C is a multiple of ``group``, so a kernel grid
+      step processing ``group`` consecutive chunks always stays inside one
+      row-block window (the per-step output/stationary index maps read the
+      step's first chunk). Group-pad chunks sit at the END of their gr
+      group (appended to its last (gr, gc) pair) with all-pad lanes; since
+      the first/last flags are derived from gr-group adjacency over the
+      pad-EXPANDED chunk sequence, the ``last`` flag lands on the group's
+      final chunk — a pad chunk when deficit padding was added. That is by
+      design: the flush then happens at the group's true end (pads add
+      nothing to the accumulator first), and a flag therefore does NOT
+      imply the chunk carries real nonzeros.
     """
     bm = pick_block(tile_rows, block_rows)
     bn = pick_block(tile_cols, block_cols)
@@ -164,10 +184,19 @@ def build_blocked(
     need_pad_group = group_tot == 0
     pair_chunks = group_chunks.copy()
     pair_chunks[:, :, 0][need_pad_group] = 1
+    if group > 1:
+        # Pad every (bucket, gr) group to a multiple of `group` chunks so a
+        # G-chunk grid step never straddles a row-block boundary; the pad
+        # chunks ride on the group's last (gr, gc) pair, after its real
+        # chunks.
+        tot = pair_chunks.sum(axis=2)
+        deficit = (-tot) % group
+        pair_chunks[:, :, -1] += deficit
     pair_chunks = pair_chunks.reshape(-1)
 
     chunks_per_bucket = pair_chunks.reshape(n_buckets, -1).sum(axis=1)
     C = max(int(chunks_per_bucket.max(initial=0)), 1)
+    C = -(-C // group) * group
 
     # Chunk start offset (within its bucket) for every pair.
     pair_chunk_start = np.zeros(n_pairs, dtype=np.int64)
@@ -242,6 +271,7 @@ def build_blocked(
         gr_blocks=gr_blocks,
         gc_blocks=gc_blocks,
         n_chunks=C,
+        group=group,
     )
 
 
@@ -250,8 +280,10 @@ def pad_chunk_count(meta: BlockedMeta, c_new: int) -> BlockedMeta:
 
     Used when the chunk-flat length must divide evenly (e.g. into fiber
     value slices). Pad chunks follow the window-pinning convention (last
-    (gr, gc) block, no flags) and are all-pad lanes."""
+    (gr, gc) block, no flags) and are all-pad lanes. ``c_new`` is rounded up
+    to the encoding's group multiple."""
     C = meta.n_chunks
+    c_new = -(-c_new // meta.group) * meta.group
     if c_new < C:
         raise ValueError(f"cannot shrink chunk count {C} -> {c_new}")
     if c_new == C:

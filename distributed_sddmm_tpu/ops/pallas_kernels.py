@@ -64,6 +64,7 @@ class BlockedTile:
     bn: int = dataclasses.field(metadata=dict(static=True), default=512)
     gr_blocks: int = dataclasses.field(metadata=dict(static=True), default=1)
     gc_blocks: int = dataclasses.field(metadata=dict(static=True), default=1)
+    group: int = dataclasses.field(metadata=dict(static=True), default=1)
 
     @property
     def n_chunks(self) -> int:
@@ -109,93 +110,129 @@ def _gathered(dense_ref, loc_row):
     return ohT, _dotg(dense_ref[:], ohT, 1, 0)
 
 
-def _acc_boundaries(meta_ref, acc_ref, out_ref):
-    """Zero the accumulator at the first chunk of a row-block group and
-    return the flush predicate for the last."""
-    t = pl.program_id(0)
+def _sub_boundaries(meta_ref, acc_ref, t, G, j):
+    """Zero the accumulator at a first-of-row-block sub-chunk and return the
+    flush predicate for a last-of-row-block one. With group > 1 the grid
+    step never straddles a row-block boundary (``build_blocked``'s gr
+    alignment), so the step's output window is valid for every sub-chunk."""
+    w = meta_ref[t * G + j]
 
-    @pl.when((meta_ref[t] & 1) == 1)
+    @pl.when((w & 1) == 1)
     def _():
         acc_ref[:] = jnp.zeros_like(acc_ref)
 
-    return ((meta_ref[t] >> 1) & 1) == 1
+    return ((w >> 1) & 1) == 1
 
 
-def _fused_body(meta_ref, lr_ref, lc_ref, sv_ref, at_ref, bt_ref,
-                out_ref, mid_ref, acc_ref):
-    last = _acc_boundaries(meta_ref, acc_ref, out_ref)
-    ohT_r, a_rT = _gathered(at_ref, lr_ref[0])
-    _, b_rT = _gathered(bt_ref, lc_ref[0])
-    dots = jnp.sum(a_rT * b_rT, axis=0, keepdims=True) * sv_ref[0]
-    mid_ref[0] = dots
-    scT = (b_rT * dots).astype(bt_ref.dtype)
-    acc_ref[:] += _dotg(scT, ohT_r, 1, 1)  # [R, BM]
+def _make_fused_body(G):
+    def body(meta_ref, lr_ref, lc_ref, sv_ref, at_ref, *rest):
+        bt_refs = rest[:G]
+        out_ref, mid_ref, acc_ref = rest[G], rest[G + 1], rest[G + 2]
+        t = pl.program_id(0)
+        for j in range(G):
+            last = _sub_boundaries(meta_ref, acc_ref, t, G, j)
+            ohT_r, a_rT = _gathered(at_ref, lr_ref[0, j : j + 1])
+            _, b_rT = _gathered(bt_refs[j], lc_ref[0, j : j + 1])
+            dots = jnp.sum(a_rT * b_rT, axis=0, keepdims=True) * sv_ref[0, j : j + 1]
+            mid_ref[0, j : j + 1] = dots
+            scT = (b_rT * dots).astype(bt_refs[j].dtype)
+            acc_ref[:] += _dotg(scT, ohT_r, 1, 1)  # [R, BM]
 
-    @pl.when(last)
-    def _():
-        out_ref[:] = acc_ref[:]
+            @pl.when(last)
+            def _():
+                out_ref[:] = acc_ref[:]
+
+    return body
 
 
-def _sddmm_body(meta_ref, lr_ref, lc_ref, sv_ref, at_ref, bt_ref, mid_ref):
-    _, a_rT = _gathered(at_ref, lr_ref[0])
-    _, b_rT = _gathered(bt_ref, lc_ref[0])
-    mid_ref[0] = jnp.sum(a_rT * b_rT, axis=0, keepdims=True) * sv_ref[0]
+def _make_sddmm_body(G):
+    def body(meta_ref, lr_ref, lc_ref, sv_ref, at_ref, *rest):
+        bt_refs = rest[:G]
+        mid_ref = rest[G]
+        for j in range(G):
+            _, a_rT = _gathered(at_ref, lr_ref[0, j : j + 1])
+            _, b_rT = _gathered(bt_refs[j], lc_ref[0, j : j + 1])
+            mid_ref[0, j : j + 1] = (
+                jnp.sum(a_rT * b_rT, axis=0, keepdims=True) * sv_ref[0, j : j + 1]
+            )
+
+    return body
 
 
-def _spmm_body(meta_ref, lr_ref, lc_ref, sv_ref, bt_ref,
-               out_ref, acc_ref):
-    last = _acc_boundaries(meta_ref, acc_ref, out_ref)
-    _, b_rT = _gathered(bt_ref, lc_ref[0])
-    ohT_r = (
-        jax.lax.broadcasted_iota(jnp.int32, (out_ref.shape[1], CHUNK), 0)
-        == lr_ref[0]
-    ).astype(bt_ref.dtype)
-    scT = (b_rT * sv_ref[0]).astype(bt_ref.dtype)
-    acc_ref[:] += _dotg(scT, ohT_r, 1, 1)
+def _make_spmm_body(G):
+    def body(meta_ref, lr_ref, lc_ref, sv_ref, *rest):
+        bt_refs = rest[:G]
+        out_ref, acc_ref = rest[G], rest[G + 1]
+        t = pl.program_id(0)
+        for j in range(G):
+            last = _sub_boundaries(meta_ref, acc_ref, t, G, j)
+            _, b_rT = _gathered(bt_refs[j], lc_ref[0, j : j + 1])
+            ohT_r = (
+                jax.lax.broadcasted_iota(jnp.int32, (out_ref.shape[1], CHUNK), 0)
+                == lr_ref[0, j : j + 1]
+            ).astype(bt_refs[j].dtype)
+            scT = (b_rT * sv_ref[0, j : j + 1]).astype(bt_refs[j].dtype)
+            acc_ref[:] += _dotg(scT, ohT_r, 1, 1)
 
-    @pl.when(last)
-    def _():
-        out_ref[:] = acc_ref[:]
+            @pl.when(last)
+            def _():
+                out_ref[:] = acc_ref[:]
+
+    return body
 
 
 @functools.partial(
     jax.jit,
-    static_argnames=("op", "bm", "bn", "gr_blocks", "gc_blocks", "interpret"),
+    static_argnames=(
+        "op", "bm", "bn", "gr_blocks", "gc_blocks", "group", "interpret",
+    ),
 )
 def _tile_call(
-    meta, lr, lc, sv, at, bt, op, bm, bn, gr_blocks, gc_blocks, interpret
+    meta, lr, lc, sv, at, bt, op, bm, bn, gr_blocks, gc_blocks, group,
+    interpret,
 ):
     """Launch one chunk-list kernel. ``at``/``bt`` are feature-major padded
     dense operands [R, gr_blocks*bm] / [R, gc_blocks*bn]; ``sv`` is the
-    chunk-layout values [C, 1, CHUNK]. Returns op-dependent outputs."""
+    chunk-layout values [C, CHUNK]. The grid walks ``group`` chunks per step
+    (one semaphore round-trip and one chunk-data DMA amortized over G
+    chunks); each sub-chunk gets its own bt window via a per-sub-chunk
+    BlockSpec, while the at/out windows are shared (gr-aligned groups).
+    Returns op-dependent outputs."""
     C = lr.shape[0]
+    G = group
+    if C % G:
+        raise ValueError(f"chunk count {C} not a multiple of group {G}")
+    steps = C // G
     R = bt.shape[0]
-    lr3 = lr.reshape(C, 1, CHUNK)
-    lc3 = lc.reshape(C, 1, CHUNK)
-    sv3 = sv.reshape(C, 1, CHUNK)
+    lr3 = lr.reshape(steps, G, CHUNK)
+    lc3 = lc.reshape(steps, G, CHUNK)
+    sv3 = sv.reshape(steps, G, CHUNK)
 
-    chunk_spec = pl.BlockSpec((1, 1, CHUNK), lambda t, m: (t, 0, 0))
-    at_spec = pl.BlockSpec((R, bm), lambda t, m: (0, _meta_gr(m, t)))
-    bt_spec = pl.BlockSpec((R, bn), lambda t, m: (0, _meta_gc(m, t)))
-    out_spec = pl.BlockSpec((R, bm), lambda t, m: (0, _meta_gr(m, t)))
+    chunk_spec = pl.BlockSpec((1, G, CHUNK), lambda t, m: (t, 0, 0))
+    at_spec = pl.BlockSpec((R, bm), lambda t, m: (0, _meta_gr(m, t * G)))
+    bt_specs = [
+        pl.BlockSpec((R, bn), (lambda j: lambda t, m: (0, _meta_gc(m, t * G + j)))(j))
+        for j in range(G)
+    ]
+    out_spec = pl.BlockSpec((R, bm), lambda t, m: (0, _meta_gr(m, t * G)))
     out_shape = jax.ShapeDtypeStruct((R, gr_blocks * bm), jnp.float32)
-    mid_shape = jax.ShapeDtypeStruct((C, 1, CHUNK), jnp.float32)
+    mid_shape = jax.ShapeDtypeStruct((steps, G, CHUNK), jnp.float32)
 
     if op == "fused":
-        body = _fused_body
-        in_specs = [chunk_spec, chunk_spec, chunk_spec, at_spec, bt_spec]
-        operands = (lr3, lc3, sv3, at, bt)
+        body = _make_fused_body(G)
+        in_specs = [chunk_spec, chunk_spec, chunk_spec, at_spec, *bt_specs]
+        operands = (lr3, lc3, sv3, at, *([bt] * G))
         out_specs, out_shapes = [out_spec, chunk_spec], [out_shape, mid_shape]
         scratch = [pltpu.VMEM((R, bm), jnp.float32)]
     elif op == "sddmm":
-        body = _sddmm_body
-        in_specs = [chunk_spec, chunk_spec, chunk_spec, at_spec, bt_spec]
-        operands = (lr3, lc3, sv3, at, bt)
+        body = _make_sddmm_body(G)
+        in_specs = [chunk_spec, chunk_spec, chunk_spec, at_spec, *bt_specs]
+        operands = (lr3, lc3, sv3, at, *([bt] * G))
         out_specs, out_shapes, scratch = [chunk_spec], [mid_shape], []
     elif op == "spmm":
-        body = _spmm_body
-        in_specs = [chunk_spec, chunk_spec, chunk_spec, bt_spec]
-        operands = (lr3, lc3, sv3, bt)
+        body = _make_spmm_body(G)
+        in_specs = [chunk_spec, chunk_spec, chunk_spec, *bt_specs]
+        operands = (lr3, lc3, sv3, *([bt] * G))
         out_specs, out_shapes = [out_spec], [out_shape]
         scratch = [pltpu.VMEM((R, bm), jnp.float32)]
     else:
@@ -203,12 +240,12 @@ def _tile_call(
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
-        grid=(C,),
+        grid=(steps,),
         in_specs=in_specs,
         out_specs=out_specs,
         scratch_shapes=scratch,
     )
-    return pl.pallas_call(
+    outs = pl.pallas_call(
         body,
         grid_spec=grid_spec,
         out_shape=out_shapes,
@@ -217,6 +254,7 @@ def _tile_call(
         ),
         interpret=interpret,
     )(meta, *operands)
+    return outs
 
 
 def _flat_indices(geom, meta, lr, lc):
@@ -237,15 +275,15 @@ def _flat_indices(geom, meta, lr, lc):
 # don't-cares that the pad positions of value vectors absorb. The integer
 # metadata arrays are explicit arguments with float0 cotangents (custom_vjp
 # must not close over tracers); ``geom`` = (bm, bn, gr_blocks, gc_blocks,
-# interpret) rides in nondiff_argnums.
+# group, interpret) rides in nondiff_argnums.
 
 
 def _geom_call(geom, op, meta, lr, lc, sv, at, bt):
-    bm, bn, grb, gcb, interpret = geom
+    bm, bn, grb, gcb, group, interpret = geom
     return tuple(
         _tile_call(
             meta, lr, lc, sv, at, bt, op=op, bm=bm, bn=bn,
-            gr_blocks=grb, gc_blocks=gcb, interpret=interpret,
+            gr_blocks=grb, gc_blocks=gcb, group=group, interpret=interpret,
         )
     )
 
@@ -414,7 +452,10 @@ class PallasKernel:
         return self.sddmm_tile_t(blk, vals, at, bt, vals.dtype)
 
     def _geom(self, blk: BlockedTile) -> tuple:
-        return (blk.bm, blk.bn, blk.gr_blocks, blk.gc_blocks, self.interpret)
+        return (
+            blk.bm, blk.bn, blk.gr_blocks, blk.gc_blocks, blk.group,
+            self.interpret,
+        )
 
     def sddmm_tile_t(self, blk: BlockedTile, vals, at, bt, out_dtype):
         """Feature-major variant (operands already via ``prep``)."""

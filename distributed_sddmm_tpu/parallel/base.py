@@ -326,6 +326,66 @@ class DistributedSparse(abc.ABC):
         self.call_count.clear()
         self.total_time.clear()
 
+    def measure_breakdown(
+        self,
+        A: jax.Array,
+        B: jax.Array,
+        s_vals: jax.Array,
+        op: str = "fusedSpMM",
+        trials: int = 3,
+    ) -> dict:
+        """Region-level {Replication, Propagation, Computation} attribution.
+
+        The reference brackets every replication/shift/compute region with
+        named timers between barriers (`distributed_sparse.h:205-261`,
+        counter keys per algorithm at `15D_dense_shift.hpp:70-74`). Inside
+        one fused XLA program regions cannot be bracketed, so this times
+        three separately compiled variants of the op program with
+        collectives selectively replaced by local shape-preserving ops
+        (``parallel/loops.ablation_mode``):
+
+        * Computation  = t(local)            — all collectives ablated
+        * Replication  = t(no_ring) - t(local) — gathers/reduce-scatters real
+        * Propagation  = t(full) - t(no_ring)  — ring permutes real
+
+        Returns counters under the names the chart pipeline maps
+        (``tools/charts.py``): the op name (Computation), ``replication``,
+        ``ppermute``, plus ``<op>_total``. Overlap between comm and compute
+        makes the split approximate — exactly as the reference's
+        barrier-separated timing was.
+
+        Timing relies on ``block_until_ready``; on tunneled experimental
+        backends run this on the CPU test mesh (where the distributed
+        structure is identical) for trustworthy numbers.
+        """
+        from distributed_sddmm_tpu.parallel.loops import ablation_mode
+
+        runners = {
+            "fusedSpMM": lambda: self.fused_spmm(A, B, s_vals),
+            "sddmmA": lambda: self.sddmm_a(A, B, s_vals),
+            "spmmA": lambda: self.spmm_a(A, B, s_vals),
+        }
+        if op not in runners:
+            raise ValueError(f"op must be one of {sorted(runners)}")
+        times = {}
+        for mode in ("full", "no_ring", "local"):
+            with ablation_mode(mode):
+                jax.block_until_ready(runners[op]())  # compile + warm
+                t0 = time.perf_counter()
+                for _ in range(trials):
+                    out = runners[op]()
+                jax.block_until_ready(out)
+                times[mode] = (time.perf_counter() - t0) / trials
+        comp = times["local"]
+        repl = max(times["no_ring"] - comp, 0.0)
+        prop = max(times["full"] - times["no_ring"], 0.0)
+        return {
+            op: comp,
+            "replication": repl,
+            "ppermute": prop,
+            f"{op}_total": times["full"],
+        }
+
     def json_perf_statistics(self) -> dict:
         return {k: self.total_time[k] for k in sorted(self.total_time)}
 

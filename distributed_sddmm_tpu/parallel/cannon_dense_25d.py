@@ -46,7 +46,9 @@ from jax import shard_map
 
 from distributed_sddmm_tpu.common import KernelMode, MatMode, divide_round_up
 from distributed_sddmm_tpu.parallel.base import DistributedSparse
-from distributed_sddmm_tpu.parallel.loops import ring_loop, ring_perm, vary
+from distributed_sddmm_tpu.parallel.loops import (
+    abl_all_gather, abl_ppermute, ablation, ring_loop, ring_perm, vary,
+)
 from distributed_sddmm_tpu.parallel.layouts import BlockCyclic25D
 from distributed_sddmm_tpu.parallel.mesh import make_grid
 from distributed_sddmm_tpu.parallel.sharding import build_tiles
@@ -210,22 +212,22 @@ class CannonDense25D(DistributedSparse):
         unroll = self.unroll
         perm = ring_perm(n)
         # Swapped geometry: gr blocks tile the COLS frame, gc the ROWS frame.
-        bm, bn, grb, gcb = tiles.blk_geom
+        bm, bn, grb, gcb, grp = tiles.blk_geom
         mov_pad, stat_pad = grb * bm, gcb * bn
         C = max_nnz // CHUNK
 
         def shift_dense(x):
-            return x if n == 1 else lax.ppermute(x, "rows", perm)
+            return x if n == 1 else abl_ppermute(x, "rows", perm)
 
         def shift_sparse(tree):
             if n == 1:
                 return tree
-            return jax.tree.map(lambda t: lax.ppermute(t, "cols", perm), tree)
+            return jax.tree.map(lambda t: abl_ppermute(t, "cols", perm), tree)
 
         def replicate(stat):
             if c == 1:
                 return stat
-            return lax.all_gather(stat, "layers", axis=0, tiled=True)
+            return abl_all_gather(stat, "layers", axis=0, tiled=True, size=c)
 
         def dvary(x):
             return vary(x, ("rows", "cols", "layers"))
@@ -240,7 +242,8 @@ class CannonDense25D(DistributedSparse):
         def blk_of(fields):
             blr, blc, bmeta = fields
             return BlockedTile(
-                blr, blc, bmeta, bm=bm, bn=bn, gr_blocks=grb, gc_blocks=gcb
+                blr, blc, bmeta, bm=bm, bn=bn, gr_blocks=grb,
+                gc_blocks=gcb, group=grp,
             )
 
         BLK6 = P("rows", "cols", "layers", None, None, None)
@@ -332,7 +335,7 @@ class CannonDense25D(DistributedSparse):
         )
 
     def _program(self, op: str, use_st: bool):
-        key = (op, use_st)
+        key = (op, use_st, ablation())
         if key in self._programs:
             return self._programs[key]
         if self._use_blocked(self.ST_tiles if use_st else self.S_tiles):
@@ -352,12 +355,12 @@ class CannonDense25D(DistributedSparse):
         def shift_dense(x):
             if n == 1:
                 return x
-            return lax.ppermute(x, "rows", perm)
+            return abl_ppermute(x, "rows", perm)
 
         def shift_sparse(tree):
             if n == 1:
                 return tree
-            return jax.tree.map(lambda t: lax.ppermute(t, "cols", perm), tree)
+            return jax.tree.map(lambda t: abl_ppermute(t, "cols", perm), tree)
 
         def replicate(stat):
             # (localXrows, r_loc) -> (localXrows * c, r_loc), k-major order
@@ -365,7 +368,7 @@ class CannonDense25D(DistributedSparse):
             # 25D_cannon_dense.hpp:261-269).
             if c == 1:
                 return stat
-            return lax.all_gather(stat, "layers", axis=0, tiled=True)
+            return abl_all_gather(stat, "layers", axis=0, tiled=True, size=c)
 
         def dvary(x):
             return vary(x, ("rows", "cols", "layers"))

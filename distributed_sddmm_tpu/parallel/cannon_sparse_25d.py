@@ -46,7 +46,10 @@ from jax import shard_map
 
 from distributed_sddmm_tpu.common import KernelMode, MatMode, divide_round_up
 from distributed_sddmm_tpu.parallel.base import DistributedSparse
-from distributed_sddmm_tpu.parallel.loops import ring_loop, ring_perm, vary
+from distributed_sddmm_tpu.parallel.loops import (
+    abl_all_gather, abl_ppermute, abl_psum_scatter, ablation, ring_loop,
+    ring_perm, vary,
+)
 from distributed_sddmm_tpu.parallel.layouts import Floor2D
 from distributed_sddmm_tpu.parallel.mesh import make_grid
 from distributed_sddmm_tpu.parallel.sharding import build_replicated_tiles
@@ -287,15 +290,15 @@ class CannonSparse25D(DistributedSparse):
         kern = self.kernel
         unroll = self.unroll
         perm = ring_perm(n)
-        bm, bn, grb, gcb = tiles.blk_geom
+        bm, bn, grb, gcb, grp = tiles.blk_geom
         rows_pad, cols_pad = grb * bm, gcb * bn
         C = max_nnz // CHUNK
 
         def shift_a(x):
-            return x if n == 1 else lax.ppermute(x, "cols", perm)
+            return x if n == 1 else abl_ppermute(x, "cols", perm)
 
         def shift_b(x):
-            return x if n == 1 else lax.ppermute(x, "rows", perm)
+            return x if n == 1 else abl_ppermute(x, "rows", perm)
 
         def dvary(x):
             return vary(x, ("rows", "cols", "layers"))
@@ -303,7 +306,7 @@ class CannonSparse25D(DistributedSparse):
         def blk_of(blr, blc, bmeta):
             return BlockedTile(
                 blr.reshape(C, CHUNK), blc.reshape(C, CHUNK), bmeta.reshape(C),
-                bm=bm, bn=bn, gr_blocks=grb, gc_blocks=gcb,
+                bm=bm, bn=bn, gr_blocks=grb, gc_blocks=gcb, group=grp,
             )
 
         BLK_SPEC = P("rows", "cols", None, None)
@@ -334,8 +337,8 @@ class CannonSparse25D(DistributedSparse):
                 state = ring_loop(n, body, init, shift_ab, unroll=unroll)
                 acc = state[0]
                 if c > 1:
-                    owned = lax.psum_scatter(
-                        acc, "layers", scatter_dimension=0, tiled=True
+                    owned = abl_psum_scatter(
+                        acc, "layers", scatter_dimension=0, tiled=True, size=c
                     )
                 else:
                     owned = acc
@@ -355,7 +358,7 @@ class CannonSparse25D(DistributedSparse):
                 blk = blk_of(blr, blc, bmeta)
                 v = vals_owned.reshape(owned_len)
                 if c > 1:
-                    vals = lax.all_gather(v, "layers", axis=0, tiled=True)
+                    vals = abl_all_gather(v, "layers", axis=0, tiled=True, size=c)
                 else:
                     vals = v
                 init = (a_role, b_role)
@@ -396,7 +399,7 @@ class CannonSparse25D(DistributedSparse):
         )
 
     def _program(self, op: str, use_st: bool):
-        key = (op, use_st)
+        key = (op, use_st, ablation())
         if key in self._programs:
             return self._programs[key]
         if self._use_blocked(self.ST_tiles if use_st else self.S_tiles):
@@ -413,10 +416,10 @@ class CannonSparse25D(DistributedSparse):
         perm = ring_perm(n)
 
         def shift_a(x):  # A-role rotates along the cols axis (row_world)
-            return x if n == 1 else lax.ppermute(x, "cols", perm)
+            return x if n == 1 else abl_ppermute(x, "cols", perm)
 
         def shift_b(x):  # B-role rotates along the rows axis (col_world)
-            return x if n == 1 else lax.ppermute(x, "rows", perm)
+            return x if n == 1 else abl_ppermute(x, "rows", perm)
 
         def dvary(x):
             return vary(x, ("rows", "cols", "layers"))
@@ -447,8 +450,8 @@ class CannonSparse25D(DistributedSparse):
                 state = ring_loop(n, body, init, shift_ab, unroll=unroll)
                 acc = state[0]
                 if c > 1:
-                    owned = lax.psum_scatter(
-                        acc, "layers", scatter_dimension=0, tiled=True
+                    owned = abl_psum_scatter(
+                        acc, "layers", scatter_dimension=0, tiled=True, size=c
                     )
                 else:
                     owned = acc
@@ -471,7 +474,7 @@ class CannonSparse25D(DistributedSparse):
                 cols = t_cols.reshape(max_nnz)
                 v = vals_owned.reshape(owned_len)
                 if c > 1:
-                    vals = lax.all_gather(v, "layers", axis=0, tiled=True)
+                    vals = abl_all_gather(v, "layers", axis=0, tiled=True, size=c)
                 else:
                     vals = v
                 init = (a_role, b_role)

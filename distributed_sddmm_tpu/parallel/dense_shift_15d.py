@@ -39,7 +39,10 @@ from jax import shard_map
 
 from distributed_sddmm_tpu.common import MatMode, divide_round_up
 from distributed_sddmm_tpu.parallel.base import DistributedSparse
-from distributed_sddmm_tpu.parallel.loops import ring_loop, ring_perm, vary
+from distributed_sddmm_tpu.parallel.loops import (
+    abl_all_gather, abl_ppermute, abl_psum_scatter, ablation, ring_loop,
+    ring_perm, vary,
+)
 from distributed_sddmm_tpu.parallel.layouts import ShardedBlockCyclicColumn
 from distributed_sddmm_tpu.parallel.mesh import make_grid
 from distributed_sddmm_tpu.parallel.sharding import build_tiles
@@ -125,7 +128,7 @@ class DenseShift15D(DistributedSparse):
         same ring/collective structure, but local compute runs feature-major
         through the tile-level Pallas kernels.
         """
-        key = (op, use_st)
+        key = (op, use_st, ablation())
         if key in self._programs:
             return self._programs[key]
         if self._use_blocked(self.ST_tiles if use_st else self.S_tiles):
@@ -143,7 +146,7 @@ class DenseShift15D(DistributedSparse):
 
         def shift_mov(state):
             carry, mov = state
-            return carry, lax.ppermute(mov, "rows", perm)
+            return carry, abl_ppermute(mov, "rows", perm)
 
         def tile_at(arr, s):
             # s is a Python int when unrolled, a traced index when rolled.
@@ -154,12 +157,14 @@ class DenseShift15D(DistributedSparse):
         def replicate(stat_blk):
             if c == 1:
                 return stat_blk
-            return lax.all_gather(stat_blk, "cols", axis=0, tiled=True)
+            return abl_all_gather(stat_blk, "cols", axis=0, tiled=True, size=c)
 
         def reduce_out(acc):
             if c == 1:
                 return acc
-            return lax.psum_scatter(acc, "cols", scatter_dimension=0, tiled=True)
+            return abl_psum_scatter(
+                acc, "cols", scatter_dimension=0, tiled=True, size=c
+            )
 
         def squeeze(t):
             return t.reshape(T, max_nnz)
@@ -293,13 +298,13 @@ class DenseShift15D(DistributedSparse):
         kern = self.kernel
         perm = ring_perm(nr)
         unroll = self.unroll
-        bm, bn, grb, gcb = tiles.blk_geom
+        bm, bn, grb, gcb, grp = tiles.blk_geom
         rows_pad, cols_pad = grb * bm, gcb * bn
         chunk_len = CHUNK
 
         def shift_mov(state):
             carry, mov = state
-            return carry, lax.ppermute(mov, "rows", perm)
+            return carry, abl_ppermute(mov, "rows", perm)
 
         def tile_at(arr, s):
             if unroll:
@@ -309,12 +314,14 @@ class DenseShift15D(DistributedSparse):
         def replicate(stat_blk):
             if c == 1:
                 return stat_blk
-            return lax.all_gather(stat_blk, "cols", axis=0, tiled=True)
+            return abl_all_gather(stat_blk, "cols", axis=0, tiled=True, size=c)
 
         def reduce_out(acc):
             if c == 1:
                 return acc
-            return lax.psum_scatter(acc, "cols", scatter_dimension=0, tiled=True)
+            return abl_psum_scatter(
+                acc, "cols", scatter_dimension=0, tiled=True, size=c
+            )
 
         def dvary(x):
             return vary(x, ("rows", "cols"))
@@ -331,7 +338,7 @@ class DenseShift15D(DistributedSparse):
             blr, blc, bmeta = fields
             return BlockedTile(
                 tile_at(blr, s), tile_at(blc, s), tile_at(bmeta, s),
-                bm=bm, bn=bn, gr_blocks=grb, gc_blocks=gcb,
+                bm=bm, bn=bn, gr_blocks=grb, gc_blocks=gcb, group=grp,
             )
 
         def sddmm_pass(at, mov, fields, t_vals, out_vals, complete_rotation=False):

@@ -23,14 +23,81 @@ input).
 
 from __future__ import annotations
 
+import contextlib
 from typing import Callable, Optional
 
+import jax.numpy as jnp
 from jax import lax
 
 
 def ring_perm(n: int) -> list:
     """The +1 ring permutation for an axis of size n."""
     return [(k, (k + 1) % n) for k in range(n)]
+
+
+# --------------------------------------------------------------------- #
+# Trace-time collective ablation for region-level performance attribution
+# (the TPU answer to the reference's barrier-bracketed region timers,
+# `/root/reference/distributed_sparse.h:205-261`). Timers cannot bracket
+# regions inside one fused XLA program, so attribution instead times three
+# separately compiled variants of the SAME op program:
+#
+#   "full"    — the real program;
+#   "no_ring" — ring ppermutes replaced by identity (compute + replication
+#               collectives remain);
+#   "local"   — ALL collectives replaced by shape-preserving local ops
+#               (compute only).
+#
+# Computation ~= t(local); Replication ~= t(no_ring) - t(local);
+# Propagation ~= t(full) - t(no_ring). Every strategy reads the active mode
+# at trace time through the abl_* wrappers below and includes it in its
+# program-cache key. Ablated programs produce WRONG numerics by design —
+# they exist only to be timed.
+# --------------------------------------------------------------------- #
+
+_ABLATION = "full"
+ABLATION_MODES = ("full", "no_ring", "local")
+
+
+def ablation() -> str:
+    return _ABLATION
+
+
+@contextlib.contextmanager
+def ablation_mode(mode: str):
+    if mode not in ABLATION_MODES:
+        raise ValueError(f"unknown ablation mode {mode!r}; expected {ABLATION_MODES}")
+    global _ABLATION
+    prev = _ABLATION
+    _ABLATION = mode
+    try:
+        yield
+    finally:
+        _ABLATION = prev
+
+
+def abl_ppermute(x, axis_name, perm):
+    """Ring hop; identity under "no_ring"/"local" (Propagation)."""
+    if _ABLATION != "full":
+        return x
+    return lax.ppermute(x, axis_name, perm)
+
+
+def abl_all_gather(x, axis_name, *, axis, tiled=True, size):
+    """Replication gather; local concat of ``size`` copies under "local"."""
+    if _ABLATION == "local":
+        return jnp.concatenate([x] * size, axis=axis)
+    return lax.all_gather(x, axis_name, axis=axis, tiled=tiled)
+
+
+def abl_psum_scatter(x, axis_name, *, scatter_dimension, tiled=True, size):
+    """Replication reduce-scatter; local 1/``size`` slice under "local"."""
+    if _ABLATION == "local":
+        n = x.shape[scatter_dimension] // size
+        return lax.slice_in_dim(x, 0, n, axis=scatter_dimension)
+    return lax.psum_scatter(
+        x, axis_name, scatter_dimension=scatter_dimension, tiled=tiled
+    )
 
 
 def vary(x, axes):

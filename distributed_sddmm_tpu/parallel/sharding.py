@@ -188,8 +188,10 @@ def build_replicated_tiles(
         if blocked is not None:
             from distributed_sddmm_tpu.ops.blocked import CHUNK, pad_chunk_count
 
-            # Chunk-flat length must divide into nh equal value slices.
+            # Chunk-flat length must divide into nh equal value slices AND
+            # stay a multiple of the kernel grid group.
             lcm_chunks = nh // math.gcd(CHUNK, nh)
+            lcm_chunks *= blocked.group // math.gcd(lcm_chunks, blocked.group)
             C = divide_round_up(blocked.n_chunks, lcm_chunks) * lcm_chunks
             blocked = pad_chunk_count(blocked, C)
 
@@ -243,7 +245,8 @@ def build_replicated_tiles(
             ),
             blk_meta=jax.device_put(blocked.meta.reshape(nr, nc, C), meta_spec),
             blk_geom=(
-                blocked.bm, blocked.bn, blocked.gr_blocks, blocked.gc_blocks
+                blocked.bm, blocked.bn, blocked.gr_blocks, blocked.gc_blocks,
+                blocked.group,
             ),
         )
 
@@ -372,7 +375,8 @@ def build_tiles(
                 blocked.meta.reshape(nr, nc, nh, T, C), meta_spec
             ),
             blk_geom=(
-                blocked.bm, blocked.bn, blocked.gr_blocks, blocked.gc_blocks
+                blocked.bm, blocked.bn, blocked.gr_blocks, blocked.gc_blocks,
+                blocked.group,
             ),
         )
 
@@ -396,7 +400,9 @@ _BLOCK_PAIR_LIMIT = 200_000_000
 
 
 def _try_build_blocked(n_buckets, bucket, res, tile_rows, tile_cols, swap=False):
-    from distributed_sddmm_tpu.ops.blocked import build_blocked, pick_block
+    from distributed_sddmm_tpu.ops.blocked import (
+        DEFAULT_GROUP, build_blocked, pick_block,
+    )
 
     bm = pick_block(max(tile_rows, 1))
     bn = pick_block(max(tile_cols, 1))
@@ -412,5 +418,6 @@ def _try_build_blocked(n_buckets, bucket, res, tile_rows, tile_cols, swap=False)
         local_r, local_c = local_c, local_r
         tile_rows, tile_cols = tile_cols, tile_rows
     return build_blocked(
-        n_buckets, bucket, local_r, local_c, tile_rows, tile_cols
+        n_buckets, bucket, local_r, local_c, tile_rows, tile_cols,
+        group=DEFAULT_GROUP,
     )

@@ -38,7 +38,9 @@ from jax import shard_map
 
 from distributed_sddmm_tpu.common import MatMode, divide_round_up
 from distributed_sddmm_tpu.parallel.base import DistributedSparse
-from distributed_sddmm_tpu.parallel.loops import ring_loop, ring_perm, vary
+from distributed_sddmm_tpu.parallel.loops import (
+    abl_all_gather, abl_ppermute, ablation, ring_loop, ring_perm, vary,
+)
 from distributed_sddmm_tpu.parallel.layouts import ShardedBlockRow
 from distributed_sddmm_tpu.parallel.mesh import make_grid
 from distributed_sddmm_tpu.parallel.sharding import build_tiles
@@ -137,18 +139,18 @@ class SparseShift15D(DistributedSparse):
         kern = self.kernel
         perm = ring_perm(nr)
         unroll = self.unroll
-        bm, bn, grb, gcb = tiles.blk_geom
+        bm, bn, grb, gcb, grp = tiles.blk_geom
         rows_pad, cols_pad = grb * bm, gcb * bn
         C = max_nnz // CHUNK
 
         def shift(tree):
             if nr == 1:
                 return tree
-            return jax.tree.map(lambda x: lax.ppermute(x, "rows", perm), tree)
+            return jax.tree.map(lambda x: abl_ppermute(x, "rows", perm), tree)
 
         def replicate_stationary(blk):
             if c > 1:
-                blk = lax.all_gather(blk, "cols", axis=1, tiled=True)
+                blk = abl_all_gather(blk, "cols", axis=1, tiled=True, size=c)
             return blk.reshape(blk.shape[0] * blk.shape[1] * blk.shape[2], blk.shape[3])
 
         def dvary(x):
@@ -168,7 +170,8 @@ class SparseShift15D(DistributedSparse):
         def blk_of(fields):
             blr, blc, bmeta = fields
             return BlockedTile(
-                blr, blc, bmeta, bm=bm, bn=bn, gr_blocks=grb, gc_blocks=gcb
+                blr, blc, bmeta, bm=bm, bn=bn, gr_blocks=grb,
+                gc_blocks=gcb, group=grp,
             )
 
         BLK6 = P("rows", "cols", None, None, None, None)
@@ -250,7 +253,7 @@ class SparseShift15D(DistributedSparse):
         )
 
     def _program(self, op: str, use_st: bool):
-        key = (op, use_st)
+        key = (op, use_st, ablation())
         if key in self._programs:
             return self._programs[key]
         if self._use_blocked(self.ST_tiles if use_st else self.S_tiles):
@@ -269,12 +272,12 @@ class SparseShift15D(DistributedSparse):
         def shift(tree):
             if nr == 1:
                 return tree
-            return jax.tree.map(lambda x: lax.ppermute(x, "rows", perm), tree)
+            return jax.tree.map(lambda x: abl_ppermute(x, "rows", perm), tree)
 
         def replicate_stationary(blk):
             # blk: (nr, 1, bw, r_loc) -> all-gather layers -> (N_pad, r_loc)
             if c > 1:
-                blk = lax.all_gather(blk, "cols", axis=1, tiled=True)
+                blk = abl_all_gather(blk, "cols", axis=1, tiled=True, size=c)
             return blk.reshape(blk.shape[0] * blk.shape[1] * blk.shape[2], blk.shape[3])
 
         def dvary(x):

@@ -68,6 +68,8 @@ def breakdown_chart(records, ax) -> None:
     for rec in records:
         stats = rec.get("perf_stats") or {}
         for name, secs in stats.items():
+            if name.endswith("_total"):
+                continue  # whole-call duplicates of the region counters
             cat = _CATEGORY.get(name, "Computation")
             per_alg[_alg_label(rec)][cat] += secs
     if not per_alg:

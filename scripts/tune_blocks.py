@@ -1,0 +1,128 @@
+"""Block-size tuning probe for the Pallas chunk kernels (runs on real TPU).
+
+For one (logM, nnz/row, R) config, times the fused/sddmm/spmm tile kernels
+across (block_rows, block_cols) candidates plus the XLA gather kernel, and
+prints one JSON line per measurement. Model for interpreting results:
+
+    t_chunk ~ max(mxu: 2*R*CHUNK*(2*bm+bn)/PEAK, dma: bt block, fixed overhead)
+    total   ~ n_chunks * t_chunk
+
+Usage: python scripts/tune_blocks.py [logM npr R trials]
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from distributed_sddmm_tpu.ops.blocked import CHUNK, build_blocked
+from distributed_sddmm_tpu.ops.kernels import XlaKernel
+from distributed_sddmm_tpu.ops.pallas_kernels import BlockedTile, PallasKernel
+from distributed_sddmm_tpu.utils.coo import HostCOO
+from distributed_sddmm_tpu.bench.kernels import _chain_time
+
+import os
+
+BLOCKS = [(512, 512), (256, 512), (512, 1024), (256, 1024), (1024, 512),
+          (1024, 1024), (256, 256), (128, 512)]
+if os.environ.get("TUNE_BLOCKS"):
+    BLOCKS = [tuple(int(x) for x in pair.split("x"))
+              for pair in os.environ["TUNE_BLOCKS"].split(",")]
+FUSED_ONLY = bool(os.environ.get("TUNE_FUSED_ONLY"))
+SKIP_XLA = bool(os.environ.get("TUNE_SKIP_XLA"))
+
+
+def main():
+    log_m = int(sys.argv[1]) if len(sys.argv) > 1 else 16
+    npr = int(sys.argv[2]) if len(sys.argv) > 2 else 32
+    R = int(sys.argv[3]) if len(sys.argv) > 3 else 128
+    trials = int(sys.argv[4]) if len(sys.argv) > 4 else 5
+
+    S = HostCOO.rmat(log_m=log_m, edge_factor=npr, seed=0)
+    S = S.with_values(np.random.default_rng(1).standard_normal(S.nnz))
+    rng = np.random.default_rng(0)
+    A = jnp.array(rng.standard_normal((S.M, R)), jnp.float32)
+    B = jnp.array(rng.standard_normal((S.N, R)), jnp.float32)
+    flops = 2.0 * S.nnz * R
+
+    if not SKIP_XLA:
+        kern = XlaKernel()
+        rows = jnp.array(S.rows.astype(np.int32))
+        cols = jnp.array(S.cols.astype(np.int32))
+        vals = jnp.array(S.vals.astype(np.float32))
+
+        def sddmm_step(state):
+            Bs, v = state
+            out = kern.sddmm(rows, cols, v, A, Bs)
+            return (Bs + out.sum() * 1e-30, v)
+
+        def spmm_step(state):
+            Bs, _ = state
+            return (Bs + kern.spmm(rows, cols, vals, Bs, S.M)[: S.N] * 1e-12, _)
+
+        t_sddmm = _chain_time(sddmm_step, (B, vals), trials)
+        t_spmm = _chain_time(spmm_step, (B, vals), trials)
+        rec = {"kernel": "xla", "logM": log_m, "npr": npr, "R": R,
+               "sddmm_ms": t_sddmm * 1e3, "spmm_ms": t_spmm * 1e3,
+               "sddmm_gflops": flops / t_sddmm / 1e9,
+               "spmm_gflops": flops / t_spmm / 1e9,
+               "fused_pair_gflops": 2 * flops / (t_sddmm + t_spmm) / 1e9}
+        print(json.dumps(rec), flush=True)
+
+    kernp = PallasKernel()
+    for bm_pref, bn_pref in BLOCKS:
+        group = int(os.environ.get("TUNE_GROUP", "1"))
+        meta = build_blocked(1, np.zeros(S.nnz, np.int64), S.rows, S.cols,
+                             S.M, S.N, block_rows=bm_pref, block_cols=bn_pref,
+                             group=group)
+        if (meta.bm, meta.bn) != (bm_pref, bn_pref):
+            continue
+        blk = BlockedTile(
+            lr=jnp.array(meta.lr[0]), lc=jnp.array(meta.lc[0]),
+            meta=jnp.array(meta.meta[0]), bm=meta.bm, bn=meta.bn,
+            gr_blocks=meta.gr_blocks, gc_blocks=meta.gc_blocks,
+            group=meta.group,
+        )
+        vals_np = np.zeros(meta.n_chunks * CHUNK, np.float32)
+        vals_np[meta.host_to_chunk] = S.vals
+        cvals = jnp.array(vals_np)
+
+        def fused_step(state):
+            Bs, _ = state
+            o, _mid = kernp.fused_tile(blk, cvals, A, Bs)
+            return (Bs + o[: S.N] * 1e-12, _)
+
+        def psddmm_step(state):
+            Bs, v = state
+            out = kernp.sddmm_tile(blk, v, A, Bs)
+            return (Bs + out.sum() * 1e-30, v)
+
+        def pspmm_step(state):
+            Bs, _ = state
+            return (Bs + kernp.spmm_tile(blk, cvals, Bs, S.M)[: S.N] * 1e-12, _)
+
+        t_f = _chain_time(fused_step, (B, cvals), trials)
+        t_s = t_m = float("inf")
+        if not FUSED_ONLY:
+            t_s = _chain_time(psddmm_step, (B, cvals), trials)
+            t_m = _chain_time(pspmm_step, (B, cvals), trials)
+        occ = float((~meta.pad_lane).mean())
+        rec = {"kernel": "pallas-bf16", "logM": log_m, "npr": npr, "R": R,
+               "bm": meta.bm, "bn": meta.bn, "n_chunks": meta.n_chunks,
+               "group": meta.group,
+               "occupancy": round(occ, 3),
+               "fused_pair_ms": t_f * 1e3, "sddmm_ms": t_s * 1e3,
+               "spmm_ms": t_m * 1e3,
+               "fused_ns_per_chunk": t_f / meta.n_chunks * 1e9,
+               "fused_pair_gflops": 2 * flops / t_f / 1e9,
+               "sddmm_gflops": flops / t_s / 1e9,
+               "spmm_gflops": flops / t_m / 1e9}
+        print(json.dumps(rec), flush=True)
+
+
+if __name__ == "__main__":
+    main()

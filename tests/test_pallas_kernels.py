@@ -31,18 +31,19 @@ def spmm_oracle(rows, cols, vals, B, out_rows):
     return oracle.spmm_a(S, B.astype(np.float64))
 
 
-def _tile_setup(Mr=700, Nc=500, nnz=3000, seed=0):
+def _tile_setup(Mr=700, Nc=500, nnz=3000, seed=0, group=1):
     rng = np.random.default_rng(seed)
     rows = rng.integers(0, Mr, nnz).astype(np.int64)
     cols = rng.integers(0, Nc, nnz).astype(np.int64)
     bucket = np.zeros(nnz, dtype=np.int64)
-    meta = build_blocked(1, bucket, rows, cols, Mr, Nc)
+    meta = build_blocked(1, bucket, rows, cols, Mr, Nc, group=group)
     blk = BlockedTile(
         lr=jnp.array(meta.lr[0]),
         lc=jnp.array(meta.lc[0]),
         meta=jnp.array(meta.meta[0]),
         bm=meta.bm, bn=meta.bn,
         gr_blocks=meta.gr_blocks, gc_blocks=meta.gc_blocks,
+        group=meta.group,
     )
     max_nnz = meta.n_chunks * CHUNK
     vals = np.zeros(max_nnz, np.float32)
@@ -107,6 +108,25 @@ class TestBlockedMeta:
         trailing = gr[1, np.where(last[1])[0].max() + 1 :]
         assert np.all(trailing == meta.gr_blocks - 1)
 
+    @pytest.mark.parametrize("group", [2, 4, 8])
+    def test_group_alignment(self, group):
+        # With chunk grouping, a kernel grid step (group consecutive chunks)
+        # must never straddle a row-block window: C is a multiple of the
+        # group and every step's chunks share one gr.
+        rows, cols, meta, _, _, _ = _tile_setup(group=group)
+        assert meta.group == group
+        assert meta.n_chunks % group == 0
+        gr, gc, first, last = unpack_meta(meta.meta[0])
+        steps = gr.reshape(-1, group)
+        assert np.all(steps == steps[:, :1])
+        # Flag counts survive the deficit padding (one zero + one flush per
+        # gr group; the flush may sit on a trailing pad chunk by design).
+        assert first.sum() == meta.gr_blocks
+        assert last.sum() == meta.gr_blocks
+        # Coordinates still round-trip.
+        assert np.all(meta.global_rows().reshape(-1)[meta.host_to_chunk] == rows)
+        assert np.all(meta.global_cols().reshape(-1)[meta.host_to_chunk] == cols)
+
     def test_every_gr_flushed_for_empty_rows(self):
         # Matrix with nonzeros only in the top rows: lower row blocks must
         # still get first/last chunks so the output is zeroed.
@@ -120,9 +140,12 @@ class TestBlockedMeta:
 
 
 class TestPallasTileKernels:
-    @pytest.mark.parametrize("precision,tol", [("f32", 1e-5), ("bf16", 3e-2)])
-    def test_against_oracle(self, precision, tol):
-        rows, cols, meta, blk, vals, rng = _tile_setup()
+    @pytest.mark.parametrize(
+        "precision,tol,group",
+        [("f32", 1e-5, 1), ("bf16", 3e-2, 1), ("f32", 1e-5, 4)],
+    )
+    def test_against_oracle(self, precision, tol, group):
+        rows, cols, meta, blk, vals, rng = _tile_setup(group=group)
         Mr, Nc, R = 700, 500, 32
         A = rng.standard_normal((Mr, R)).astype(np.float32)
         B = rng.standard_normal((Nc, R)).astype(np.float32)
