@@ -164,6 +164,11 @@ def main(argv=None) -> int:
     ov.add_argument("--block", type=int, default=1024)
     ov.add_argument("--steps-work", type=int, default=4)
     ov.add_argument("--trials", type=int, default=10)
+    ov.add_argument(
+        "--hlo-topology", default=None, metavar="NAME",
+        help="also AOT-compile for this TPU topology (e.g. v5e:2x4) and "
+        "report the structural start/compute/done overlap evidence",
+    )
     ov.add_argument("-o", "--output-file", default=None)
 
     bl = sub.add_parser("baseline", help="external host-CPU SpMM baseline")
@@ -221,13 +226,22 @@ def main(argv=None) -> int:
         return 0
 
     if args.cmd == "overlap":
-        from distributed_sddmm_tpu.bench.overlap import run_overlap_experiment
+        from distributed_sddmm_tpu.bench.overlap import (
+            hlo_overlap_report, run_overlap_experiment,
+        )
 
         rec = run_overlap_experiment(
             block=args.block, steps_work=args.steps_work, trials=args.trials,
             output_file=args.output_file,
         )
         print(json.dumps(rec))
+        if args.hlo_topology:
+            rec = hlo_overlap_report(
+                topology_name=args.hlo_topology,
+                block=args.block, steps_work=args.steps_work,
+                output_file=args.output_file,
+            )
+            print(json.dumps(rec))
         return 0
 
     if args.cmd == "baseline":

@@ -162,6 +162,14 @@ def benchmark_algorithm(
     """
     if app not in ("vanilla", "gat", "als"):
         raise ValueError(f"unknown app {app!r}; expected vanilla | gat | als")
+    if breakdown and (app != "vanilla" or not fused):
+        # Fail before any measurement: the attribution times the fusedSpMM
+        # op, so injecting it into unfused or gat/als records would mix ops
+        # and units in one JSONL file.
+        raise ValueError(
+            "--breakdown requires app='vanilla' and fused=True (it "
+            "attributes the fusedSpMM op)"
+        )
 
     alg = make_algorithm(algorithm_name, S, R, c, kernel=kernel, devices=devices)
 
@@ -178,12 +186,6 @@ def benchmark_algorithm(
 
     perf_stats = alg.json_perf_statistics()
     if breakdown:
-        if app != "vanilla":
-            raise ValueError(
-                "--breakdown attributes the fusedSpMM op and would mix "
-                "units with the gat/als whole-app perf counters; use "
-                "app='vanilla'"
-            )
         # Region attribution via collective-ablated program variants
         # (reference region timers, `distributed_sparse.h:205-261`).
         A = alg.dummy_initialize(MatMode.A)
@@ -197,6 +199,8 @@ def benchmark_algorithm(
     record = {
         "algorithm": algorithm_name,
         "app": app,
+        "R": alg.R,
+        "c": c,
         "fused": bool(fused),
         "num_trials": trials,
         "elapsed": elapsed,

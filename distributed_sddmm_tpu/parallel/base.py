@@ -302,6 +302,46 @@ class DistributedSparse(abc.ABC):
         return A, B
 
     # ------------------------------------------------------------------ #
+    # Placement observability (reference `distributed_sparse.h:363-387`
+    # ``print_nonzero_distribution`` + `FlexibleGrid.hpp:142-157`)
+    # ------------------------------------------------------------------ #
+
+    def nonzero_distribution_report(self) -> str:
+        """Human-readable per-device nonzero/tile placement report."""
+        lines = [
+            f"{self.algorithm_name or type(self).__name__}: "
+            f"M={self.M} N={self.N} R={self.R} c={self.c}",
+            self.grid.pretty_print(),
+        ]
+        for label, tiles in (("S", self.S_tiles), ("S^T", self.ST_tiles)):
+            if tiles is None:
+                continue
+            per_dev = np.asarray(tiles.nnz_per_device).reshape(-1)
+            mean = per_dev.mean() if per_dev.size else 0.0
+            lines.append(
+                f"  {label}: nnz={tiles.nnz}, tile frame "
+                f"{tiles.tile_rows}x{tiles.tile_cols}, padded max_nnz/device="
+                f"{tiles.max_nnz}, load imbalance max/mean="
+                f"{per_dev.max() / mean if mean else 1.0:.3f}"
+            )
+            shape = np.asarray(tiles.nnz_per_device).shape
+            for flat, nnz in enumerate(per_dev):
+                coords = np.unravel_index(flat, shape)
+                lines.append(
+                    f"    device {tuple(int(x) for x in coords)}: nnz={int(nnz)}"
+                )
+            if tiles.has_blocked:
+                geom = tiles.blk_geom
+                lines.append(
+                    f"    blocked: bm={geom[0]} bn={geom[1]} "
+                    f"blocks={geom[2]}x{geom[3]} group={geom[4]}"
+                )
+        return "\n".join(lines)
+
+    def print_nonzero_distribution(self) -> None:
+        print(self.nonzero_distribution_report())
+
+    # ------------------------------------------------------------------ #
     # Verification fingerprints (reference `scratch.cpp:26-76`)
     # ------------------------------------------------------------------ #
 
@@ -348,6 +388,9 @@ class DistributedSparse(abc.ABC):
         * Replication  = t(no_ring) - t(local) — gathers/reduce-scatters real
         * Propagation  = t(full) - t(no_ring)  — ring permutes real
 
+        Times are TOTALS over ``trials`` calls per variant (matching the
+        ``_timed`` counter unit in :meth:`json_perf_statistics`).
+
         Returns counters under the names the chart pipeline maps
         (``tools/charts.py``): the op name (Computation), ``replication``,
         ``ppermute``, plus ``<op>_total``. Overlap between comm and compute
@@ -375,7 +418,9 @@ class DistributedSparse(abc.ABC):
                 for _ in range(trials):
                     out = runners[op]()
                 jax.block_until_ready(out)
-                times[mode] = (time.perf_counter() - t0) / trials
+                # Totals over `trials` calls — the same unit as the _timed
+                # counters in json_perf_statistics, so records mix cleanly.
+                times[mode] = time.perf_counter() - t0
         comp = times["local"]
         repl = max(times["no_ring"] - comp, 0.0)
         prop = max(times["full"] - times["no_ring"], 0.0)

@@ -86,6 +86,85 @@ class GridSpec:
         coord[perm[2]] = (rank // (dims[perm[0]] * dims[perm[1]])) % dims[perm[2]]
         return tuple(coord)
 
+    def pretty_print(self) -> str:
+        """Human-readable coordinate -> rank -> device map (the reference's
+        ``FlexibleGrid::prettyPrint``, `FlexibleGrid.hpp:142-157`)."""
+        lines = [
+            f"GridSpec {self.nr}x{self.nc}x{self.nh} "
+            f"(rows x cols x layers), adjacency {self.adjacency}, "
+            f"p={self.p}"
+        ]
+        for i in range(self.nr):
+            for j in range(self.nc):
+                for k in range(self.nh):
+                    dev = self.mesh.devices[i, j, k]
+                    lines.append(
+                        f"  (i={i}, j={j}, k={k}) -> rank "
+                        f"{self.flat_rank(i, j, k)} -> {dev!r}"
+                    )
+        return "\n".join(lines)
+
+    def self_test(self, verbose: bool = False) -> bool:
+        """Collective sanity check of the grid wiring (the reference's
+        ``FlexibleGrid::self_test``, `FlexibleGrid.hpp:169-201`, which
+        broadcast known values over every subcommunicator and eyeballed the
+        gather). Here every device reports its named-axis indices and each
+        axis "world" size through an actual shard_map program; the result
+        must reproduce the host-side coordinate math exactly.
+        """
+        import jax
+        import jax.numpy as jnp
+        from jax import lax
+        from jax import shard_map
+
+        # Host-side round trip first.
+        for i in range(self.nr):
+            for j in range(self.nc):
+                for k in range(self.nh):
+                    if self.grid_coords(self.flat_rank(i, j, k)) != (i, j, k):
+                        return False
+
+        def prog():
+            vals = jnp.array(
+                [
+                    lax.axis_index(ROWS),
+                    lax.axis_index(COLS),
+                    lax.axis_index(LAYERS),
+                    lax.psum(1, ROWS),
+                    lax.psum(1, COLS),
+                    lax.psum(1, LAYERS),
+                    lax.psum(1, (ROWS, COLS)),      # rowcol_slice world
+                    lax.psum(1, (ROWS, LAYERS)),    # rowfiber_slice world
+                    lax.psum(1, (COLS, LAYERS)),    # colfiber_slice world
+                ],
+                dtype=jnp.int32,
+            )
+            return vals.reshape(1, 1, 1, -1)
+
+        out = np.asarray(
+            jax.jit(
+                shard_map(
+                    prog, mesh=self.mesh, in_specs=(),
+                    out_specs=P(ROWS, COLS, LAYERS, None),
+                )
+            )()
+        )
+        ok = True
+        for i in range(self.nr):
+            for j in range(self.nc):
+                for k in range(self.nh):
+                    want = (
+                        i, j, k, self.nr, self.nc, self.nh,
+                        self.nr * self.nc, self.nr * self.nh, self.nc * self.nh,
+                    )
+                    got = tuple(out[i, j, k])
+                    if got != want:
+                        ok = False
+                    if verbose:
+                        flag = "OK " if got == want else "FAIL"
+                        print(f"{flag} (i={i}, j={j}, k={k}) got={got} want={want}")
+        return ok
+
 
 def make_grid(
     nr: int,

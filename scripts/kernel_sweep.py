@@ -1,0 +1,144 @@
+"""Resilient TPU kernel-sweep orchestrator.
+
+The per-chip analog of the reference's `local_kernel_benchmark` sweep
+(`/root/reference/local_kernel_benchmark.cpp:276-280`), hardened for the
+tunneled TPU backend the same way bench.py is: every (logM, npr, R, kernel)
+config runs in its OWN worker subprocess (scripts/tune_blocks.py) under a
+hard timeout with process-group kill, failures are retried with backoff,
+and finished configs are checkpointed to the output JSONL so a re-run
+resumes where it left off.
+
+Usage:
+    python scripts/kernel_sweep.py plan.json out.jsonl [--timeout 900]
+
+plan.json: list of {"logM": int, "npr": int, "R": int, "kernel": "xla"|
+"pallas", optional "blocks": "BMxBN", "group": int, "fused_only": bool,
+"trials": int}.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import pathlib
+import signal
+import subprocess
+import sys
+import time
+
+REPO = pathlib.Path(__file__).resolve().parents[1]
+
+
+def config_key(cfg: dict) -> tuple:
+    return (
+        cfg["logM"], cfg["npr"], cfg["R"], cfg["kernel"],
+        cfg.get("blocks", ""), cfg.get("group", 1),
+    )
+
+
+def record_key(rec: dict) -> tuple:
+    blocks = f"{rec['bm']}x{rec['bn']}" if "bm" in rec else ""
+    return (
+        rec["logM"], rec["npr"], rec["R"],
+        "pallas" if rec["kernel"].startswith("pallas") else rec["kernel"],
+        blocks, rec.get("group", 1),
+    )
+
+
+def done_keys(out_path: pathlib.Path) -> set:
+    keys = set()
+    if out_path.exists():
+        for line in out_path.read_text().splitlines():
+            try:
+                keys.add(record_key(json.loads(line)))
+            except (json.JSONDecodeError, KeyError):
+                continue
+    return keys
+
+
+def run_worker(cfg: dict, timeout_s: float) -> list[dict] | None:
+    env = dict(os.environ)
+    env["PYTHONPATH"] = f"{REPO}:{env.get('PYTHONPATH', '')}"
+    if cfg["kernel"] == "xla":
+        env["TUNE_BLOCKS"] = "0x0"  # no pallas configs
+    else:
+        env["TUNE_SKIP_XLA"] = "1"
+        env["TUNE_BLOCKS"] = cfg.get("blocks", "512x512")
+        env["TUNE_GROUP"] = str(cfg.get("group", 1))
+        if cfg.get("fused_only"):
+            env["TUNE_FUSED_ONLY"] = "1"
+    proc = subprocess.Popen(
+        [sys.executable, str(REPO / "scripts" / "tune_blocks.py"),
+         str(cfg["logM"]), str(cfg["npr"]), str(cfg["R"]),
+         str(cfg.get("trials", 5))],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+        text=True, start_new_session=True,
+    )
+    try:
+        stdout, stderr = proc.communicate(timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        try:
+            os.killpg(proc.pid, signal.SIGKILL)
+        except (ProcessLookupError, PermissionError):
+            proc.kill()
+        try:
+            proc.communicate(timeout=10)
+        except subprocess.TimeoutExpired:
+            pass
+        print(f"[sweep] {config_key(cfg)}: timeout {timeout_s:.0f}s", flush=True)
+        return None
+    recs = []
+    for line in (stdout or "").splitlines():
+        try:
+            recs.append(json.loads(line))
+        except json.JSONDecodeError:
+            continue
+    if not recs:
+        tail = (stderr or "").strip().splitlines()[-3:]
+        print(f"[sweep] {config_key(cfg)}: rc={proc.returncode}, no records; "
+              f"stderr tail: {tail}", flush=True)
+        return None
+    return recs
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("plan")
+    ap.add_argument("output")
+    ap.add_argument("--timeout", type=float, default=900.0,
+                    help="per-config hard timeout (seconds)")
+    ap.add_argument("--retries", type=int, default=2)
+    ap.add_argument("--backoff", type=float, default=45.0)
+    args = ap.parse_args(argv)
+
+    plan = json.loads(pathlib.Path(args.plan).read_text())
+    out_path = pathlib.Path(args.output)
+    done = done_keys(out_path)
+
+    todo = [cfg for cfg in plan if config_key(cfg) not in done]
+    print(f"[sweep] {len(plan)} planned, {len(plan) - len(todo)} already done, "
+          f"{len(todo)} to run", flush=True)
+    failures = 0
+    for n, cfg in enumerate(todo):
+        for attempt in range(1 + args.retries):
+            if attempt:
+                time.sleep(args.backoff * attempt)
+            t0 = time.time()
+            recs = run_worker(cfg, args.timeout)
+            if recs is not None:
+                with out_path.open("a") as f:
+                    for rec in recs:
+                        f.write(json.dumps(rec) + "\n")
+                print(f"[sweep] {n + 1}/{len(todo)} {config_key(cfg)} ok "
+                      f"({time.time() - t0:.0f}s)", flush=True)
+                break
+        else:
+            failures += 1
+            print(f"[sweep] {config_key(cfg)} FAILED after retries", flush=True)
+    print(f"[sweep] complete, {failures} failures", flush=True)
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

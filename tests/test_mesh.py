@@ -51,3 +51,37 @@ def test_sharding_helper():
     s = g.sharding("rows", None)
     x = jax.device_put(np.zeros((16, 4)), s)
     assert x.sharding.is_equivalent_to(s, ndim=2)
+
+
+@pytest.mark.parametrize("dims,adjacency", [((2, 2, 2), 3), ((4, 2, 1), 1), ((8, 1, 1), 6)])
+def test_self_test_collective_wiring(dims, adjacency):
+    # The reference's FlexibleGrid::self_test broadcast known values over
+    # every subcommunicator (`FlexibleGrid.hpp:169-201`); here every device
+    # reports axis indices and world sizes through a real shard_map program.
+    g = make_grid(*dims, adjacency=adjacency)
+    assert g.self_test()
+
+
+def test_pretty_print_lists_every_device():
+    g = make_grid(2, 2, 2, adjacency=3)
+    text = g.pretty_print()
+    assert "2x2x2" in text
+    # one line per device plus the header
+    assert len(text.splitlines()) == 1 + 8
+    for rank in range(8):
+        assert f"rank {rank}" in text
+
+
+def test_nonzero_distribution_report():
+    from distributed_sddmm_tpu.bench.harness import make_algorithm
+    from distributed_sddmm_tpu.utils.coo import HostCOO
+
+    S = HostCOO.rmat(log_m=7, edge_factor=6, seed=0)
+    alg = make_algorithm("15d_fusion2", S, 16, 2, devices=jax.devices()[:8])
+    rep = alg.nonzero_distribution_report()
+    assert "load imbalance" in rep and "device" in rep
+    # per-device nnz lines must sum to the matrix nnz for S and S^T
+    import re
+
+    nnz_lines = [int(m) for m in re.findall(r"device \([^)]*\): nnz=(\d+)", rep)]
+    assert sum(nnz_lines) == 2 * S.nnz

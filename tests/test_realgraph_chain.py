@@ -1,0 +1,50 @@
+"""End-to-end real-graph workflow: generate -> mtx write -> permute ->
+file-bench -> chart render (reference `bench_file.cpp` +
+`random_permute.cpp:42-57` + the notebook pipeline, run here as one chain).
+"""
+
+import json
+import pathlib
+
+import pytest
+
+from distributed_sddmm_tpu.bench.cli import main as bench_main
+from distributed_sddmm_tpu.utils.coo import HostCOO
+
+
+def test_chain(tmp_path: pathlib.Path):
+    mtx = tmp_path / "g.mtx"
+    S = HostCOO.rmat(log_m=8, edge_factor=6, seed=3)
+    S.save_mtx(str(mtx))
+
+    # permute (load-balance preprocessing)
+    permuted = tmp_path / "g-permuted.mtx"
+    assert bench_main(["permute", str(mtx), "--seed", "1", "-o", str(permuted)]) == 0
+    Sp = HostCOO.load_mtx(str(permuted))
+    assert (Sp.M, Sp.N, Sp.nnz) == (S.M, S.N, S.nnz)
+
+    # file bench with breakdown on one 1.5D and one 2.5D algorithm
+    records = tmp_path / "records.jsonl"
+    for alg in ("15d_fusion2", "25d_sparse_replicate"):
+        rc = bench_main([
+            "file", str(permuted), alg, "16", "2",
+            "--kernel", "xla", "--trials", "1", "--breakdown",
+            "-o", str(records),
+        ])
+        assert rc == 0
+
+    recs = [json.loads(l) for l in records.read_text().splitlines()]
+    assert len(recs) == 2
+    for rec in recs:
+        assert rec["overall_throughput"] > 0
+        for key in ("replication", "ppermute"):
+            assert key in rec["perf_stats"]
+
+    # chart render consumes the records
+    matplotlib = pytest.importorskip("matplotlib")  # noqa: F841
+    from distributed_sddmm_tpu.tools.charts import main as charts_main
+
+    out = tmp_path / "charts"
+    assert charts_main([str(records), "-o", str(out)]) == 0
+    assert (out / "benchmark.png").exists()
+    assert (out / "winners.json").exists()
