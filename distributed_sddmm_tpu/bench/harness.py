@@ -35,6 +35,7 @@ from distributed_sddmm_tpu.parallel.cannon_sparse_25d import CannonSparse25D
 from distributed_sddmm_tpu.parallel.dense_shift_15d import DenseShift15D
 from distributed_sddmm_tpu.parallel.sparse_shift_15d import SparseShift15D
 from distributed_sddmm_tpu.utils.coo import HostCOO
+from distributed_sddmm_tpu.utils.platform import force_fetch
 
 # The five named configurations of `benchmark_dist.cpp:45-82`.
 ALGORITHM_FACTORIES: dict[str, Callable[..., DistributedSparse]] = {
@@ -100,13 +101,15 @@ def _run_vanilla(alg: DistributedSparse, fused: bool, trials: int, warmup: int):
         return out, mid
 
     for _ in range(warmup):
-        jax.block_until_ready(one_trial())
+        force_fetch(one_trial())
     alg.reset_performance_timers()
     t0 = time.perf_counter()
     out = None
     for _ in range(trials):
         out = one_trial()
-    jax.block_until_ready(out)
+    # Host fetch, not block_until_ready: tunneled backends only execute the
+    # queue on a transfer (see utils.platform.force_fetch).
+    force_fetch(out)
     elapsed = time.perf_counter() - t0
     return elapsed, {}
 
@@ -114,13 +117,13 @@ def _run_vanilla(alg: DistributedSparse, fused: bool, trials: int, warmup: int):
 def _run_gat(alg: DistributedSparse, trials: int, warmup: int, num_layers: int):
     gat = GAT(_gat_layers(alg.R, num_layers), alg)
     for _ in range(warmup):
-        jax.block_until_ready(gat.forward())
+        force_fetch(gat.forward())
     alg.reset_performance_timers()
     t0 = time.perf_counter()
     out = None
     for _ in range(trials):
         out = gat.forward()
-    jax.block_until_ready(out)
+    force_fetch(out)
     return time.perf_counter() - t0, {"gat_heads": [l.num_heads for l in gat.layers]}
 
 
@@ -133,7 +136,7 @@ def _run_als(alg: DistributedSparse, trials: int, warmup: int, cg_iters: int = 1
     alg.reset_performance_timers()
     t0 = time.perf_counter()
     als.run_cg(trials, cg_iters=cg_iters)
-    jax.block_until_ready((als.A, als.B))
+    force_fetch((als.A, als.B))
     elapsed = time.perf_counter() - t0
     return elapsed, {"als_residual": als.compute_residual(), "cg_iters": cg_iters}
 

@@ -355,9 +355,14 @@ class DistributedSparse(abc.ABC):
     # ------------------------------------------------------------------ #
 
     def _timed(self, name: str, fn, *args):
+        from distributed_sddmm_tpu.utils.platform import force_fetch
+
         t0 = time.perf_counter()
         out = fn(*args)
-        jax.block_until_ready(out)
+        # Host fetch, not block_until_ready: tunneled backends only run the
+        # queue on a transfer (utils.platform.force_fetch); one scalar per
+        # output leaf is negligible next to any timed op.
+        force_fetch(out)
         self.total_time[name] += time.perf_counter() - t0
         self.call_count[name] += 1
         return out

@@ -55,3 +55,29 @@ def force_cpu_platform(n_devices: int | None = None, replace: bool = False) -> N
             f"device-count flag could take effect (wanted {n_devices}); "
             "use a fresh process"
         )
+
+
+def force_fetch(tree) -> float:
+    """Execution barrier that works on EVERY backend, tunneled ones included.
+
+    On the experimental tunneled TPU backend ``jax.block_until_ready`` can
+    return before the queued work actually runs; only a host transfer forces
+    the queue. Sums one scalar per leaf to the host (negligible next to any
+    benchmarked work) and returns the total, so timed regions can end with
+    ``force_fetch(out)`` instead of ``block_until_ready``.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    total = 0.0
+    for leaf in jax.tree.leaves(tree):
+        if (
+            hasattr(leaf, "dtype")
+            and jnp.issubdtype(leaf.dtype, jnp.number)
+            and getattr(leaf, "size", 0)
+            # Under a trace (e.g. differentiating through a public op) there
+            # is nothing to fetch — and no queue to force.
+            and not isinstance(leaf, jax.core.Tracer)
+        ):
+            total += float(jnp.asarray(leaf).reshape(-1)[0])
+    return total

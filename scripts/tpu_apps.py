@@ -1,0 +1,87 @@
+"""TPU application + heatmap benchmark runner (single chip).
+
+Runs the reference's application-level benchmarks on real hardware and
+appends records to APPS_TPU.jsonl:
+
+* vanilla fused pairs (`bench_erdos_renyi.cpp` analog) for both kernels,
+* ALS-CG and GAT apps (`benchmark_dist.cpp:88-100`),
+* the R-sweep heatmap (`bench_heatmap.cpp:33-35`) for both kernels.
+
+Timed loops end in host fetches (utils.platform.force_fetch), so the
+numbers are honest on the tunneled backend. Each invocation skips configs
+already recorded, so the TPU queue can re-run it after tunnel outages.
+"""
+
+from __future__ import annotations
+
+import json
+import pathlib
+import sys
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parents[1]))
+
+from distributed_sddmm_tpu.bench.harness import benchmark_algorithm
+from distributed_sddmm_tpu.ops import get_kernel
+from distributed_sddmm_tpu.utils.coo import HostCOO
+
+OUT = pathlib.Path(__file__).resolve().parents[1] / "APPS_TPU.jsonl"
+
+# (app, algorithm, logM, npr, R, kernel, trials)
+PLAN = [
+    ("als", "15d_fusion2", 14, 32, 128, "pallas", 2),
+    ("gat", "15d_fusion2", 14, 32, 64, "pallas", 2),
+    ("als", "15d_fusion2", 14, 32, 128, "xla", 2),
+    ("gat", "15d_fusion2", 14, 32, 64, "xla", 2),
+    # heatmap R-sweep (subset of bench_heatmap.cpp's {64..448}: compile cost
+    # on this backend bounds the grid; every recorded point is real)
+    *[("vanilla", "15d_fusion2", 14, 32, R, k, 5)
+      for R in (64, 128, 256, 448) for k in ("pallas", "xla")],
+]
+
+
+def done_keys() -> set:
+    keys = set()
+    if OUT.exists():
+        for line in OUT.read_text().splitlines():
+            try:
+                r = json.loads(line)
+                # R from the plan, not the record: GAT's per-layer
+                # set_r_value mutates alg.R before the record is written.
+                keys.add((r["app"], r["algorithm"], r["extra"]["logM"],
+                          r["extra"]["npr"], r["extra"]["R_req"],
+                          r["extra"]["kernel_req"]))
+            except (json.JSONDecodeError, KeyError):
+                continue
+    return keys
+
+
+def main() -> int:
+    done = done_keys()
+    mats: dict = {}
+    failures = 0
+    for app, alg, log_m, npr, R, kern, trials in PLAN:
+        key = (app, alg, log_m, npr, R, kern)
+        if key in done:
+            print(f"skip (done): {key}", flush=True)
+            continue
+        if (log_m, npr) not in mats:
+            mats[(log_m, npr)] = HostCOO.rmat(log_m=log_m, edge_factor=npr, seed=0)
+        S = mats[(log_m, npr)]
+        try:
+            rec = benchmark_algorithm(
+                S, alg, str(OUT), fused=True, R=R, c=1, app=app,
+                trials=trials, kernel=get_kernel(kern),
+                extra_info={"extra": {"logM": log_m, "npr": npr,
+                                      "R_req": R, "kernel_req": kern}},
+            )
+            print(json.dumps({"app": app, "R": R, "kernel": kern,
+                              "GFLOPs": round(rec["overall_throughput"], 2),
+                              "elapsed": round(rec["elapsed"], 3)}), flush=True)
+        except Exception as e:  # noqa: BLE001 — record and continue the sweep
+            failures += 1
+            print(f"FAIL {key}: {type(e).__name__}: {e}", flush=True)
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,67 @@
+#!/bin/bash
+# TPU work queue with tunnel-health gating.
+#
+# The tunneled TPU backend in this environment goes down for stretches
+# (backend init or the remote Mosaic compile service hang). This watchdog
+# polls health with a short-timeout probe and, while healthy, drains the
+# queued benchmark plans one at a time (never two TPU processes at once).
+# Everything is resumable: kernel_sweep.py skips configs already recorded.
+#
+# Usage: bash scripts/tpu_queue.sh <max_hours>
+
+set -u
+cd "$(dirname "$0")/.."
+MAX_HOURS=${1:-6}
+DEADLINE=$(( $(date +%s) + MAX_HOURS * 3600 ))
+export PYTHONPATH="/root/repo:${PYTHONPATH:-}"
+
+healthy() {
+  timeout 180 python - <<'EOF' >/dev/null 2>&1
+import jax, jax.numpy as jnp
+from jax.experimental import pallas as pl
+x = jnp.ones((256, 256))
+def body(x_ref, o_ref):
+    o_ref[:] = x_ref[:] * 2.0
+y = pl.pallas_call(body, out_shape=jax.ShapeDtypeStruct((256, 256), jnp.float32))(x)
+assert float(y.sum()) == 2 * 256 * 256
+EOF
+}
+
+run_step() {  # run_step <done-marker> <cmd...>
+  local marker=$1; shift
+  [ -e "$marker" ] && return 0
+  echo "[queue] $(date +%H:%M:%S) running: $*"
+  if "$@"; then
+    touch "$marker"
+    echo "[queue] done: $*"
+  else
+    echo "[queue] FAILED (rc=$?): $*"
+    return 1
+  fi
+}
+
+while [ "$(date +%s)" -lt "$DEADLINE" ]; do
+  if ! healthy; then
+    echo "[queue] $(date +%H:%M:%S) TPU unhealthy; sleeping 600s"
+    sleep 600
+    continue
+  fi
+  echo "[queue] $(date +%H:%M:%S) TPU healthy"
+
+  # 1. chunk-group probe (feeds the DEFAULT_GROUP decision)
+  run_step /tmp/q1.done python scripts/kernel_sweep.py \
+    scripts/plans/group_probe.json KERNELS_TPU.jsonl --timeout 900 --retries 1 \
+    || { sleep 300; continue; }
+
+  # 2. star sweep, XLA vs Pallas (KERNELS_TPU artifact)
+  run_step /tmp/q2.done python scripts/kernel_sweep.py \
+    scripts/plans/star_sweep.json KERNELS_TPU.jsonl --timeout 1500 --retries 1 \
+    || { sleep 300; continue; }
+
+  # 3. application + heatmap benches (APPS_TPU artifact; self-resuming)
+  run_step /tmp/q3.done timeout 7200 python scripts/tpu_apps.py \
+    || { sleep 300; continue; }
+
+  echo "[queue] all steps complete"
+  break
+done
