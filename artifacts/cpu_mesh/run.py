@@ -1,0 +1,62 @@
+"""Application + heatmap benchmark records on the 8-device CPU test mesh.
+
+The distributed-structure complement to APPS_TPU.jsonl (which carries the
+single-chip hardware numbers): ALS-CG and GAT app benchmarks plus the
+R-sweep heatmap run through the full multi-device shard_map programs —
+every collective real — on the virtual CPU mesh, then rendered by the chart
+pipeline. Absolute times are not hardware-meaningful (single host core);
+the artifact evidences the app paths end-to-end at p=8 and feeds
+`tools/charts.py` (reference `benchmark_dist.cpp:88-163`,
+`bench_heatmap.cpp:33-35`).
+
+Run from repo root:
+    XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+    python artifacts/cpu_mesh/run.py
+"""
+
+from __future__ import annotations
+
+import pathlib
+import sys
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parents[2]))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+from distributed_sddmm_tpu.bench.cli import main as bench_main
+from distributed_sddmm_tpu.tools.charts import main as charts_main
+
+HERE = pathlib.Path(__file__).parent
+RECORDS = HERE / "records.jsonl"
+
+RECORDS.unlink(missing_ok=True)
+
+# Applications (reference app selection, `benchmark_dist.cpp:88-100`).
+for app in ("als", "gat"):
+    rc = bench_main([
+        "er", "10", "8", "15d_fusion2", "16", "2",
+        "--app", app, "--trials", "2", "--kernel", "xla",
+        "-o", str(RECORDS),
+    ])
+    assert rc == 0, app
+
+# Heatmap R-sweep over two contrasting strategies
+# (`bench_heatmap.cpp:33-35`, scaled to the single-core host).
+rc = bench_main([
+    "heatmap", "10", "8", "2", "--alg", "15d_fusion2",
+    "--r-values", "32", "64", "128", "--trials", "2", "--kernel", "xla",
+    "-o", str(RECORDS),
+])
+assert rc == 0
+rc = bench_main([
+    "heatmap", "10", "8", "2", "--alg", "25d_sparse_replicate",
+    "--r-values", "32", "64", "128", "--trials", "2", "--kernel", "xla",
+    "-o", str(RECORDS),
+])
+assert rc == 0
+
+rc = charts_main([str(RECORDS), "-o", str(HERE / "charts")])
+assert rc == 0
+print("cpu_mesh bench artifact complete", flush=True)

@@ -63,6 +63,15 @@ def _get_kernel(name: str):
 
 
 def _run_configs(S, alg_names, args, r_values=None):
+    breakdown = getattr(args, "breakdown", False)
+    if breakdown and (args.app != "vanilla" or args.fused != "yes"):
+        # Raise here, not inside the loop: the per-config ValueError catch
+        # below is for divisibility skips and would silently swallow this
+        # usage error, "succeeding" with zero records.
+        raise SystemExit(
+            "--breakdown requires --app vanilla and --fused yes "
+            "(it attributes the fusedSpMM op)"
+        )
     kernel = _get_kernel(args.kernel)
     records = []
     for alg in alg_names:

@@ -190,13 +190,17 @@ def benchmark_algorithm(
     perf_stats = alg.json_perf_statistics()
     if breakdown:
         # Region attribution via collective-ablated program variants
-        # (reference region timers, `distributed_sparse.h:205-261`).
+        # (reference region timers, `distributed_sparse.h:205-261`). The
+        # breakdown REPLACES the whole-call counters: strategies whose
+        # fused_spmm delegates to timed sddmm_a/spmm_a would otherwise
+        # leave those (collective-inclusive) counters alongside the
+        # ablated regions and double-count comm time into Computation.
         A = alg.dummy_initialize(MatMode.A)
         B = alg.dummy_initialize(MatMode.B)
         s_vals = alg.like_s_values(1.0)
         A, B = alg.initial_shift(A, B, KernelMode.SDDMM_A)
-        perf_stats.update(
-            alg.measure_breakdown(A, B, s_vals, op="fusedSpMM", trials=trials)
+        perf_stats = alg.measure_breakdown(
+            A, B, s_vals, op="fusedSpMM", trials=trials
         )
 
     record = {

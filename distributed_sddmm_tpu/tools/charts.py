@@ -88,6 +88,43 @@ def breakdown_chart(records, ax) -> None:
     ax.legend(fontsize=7)
 
 
+def heatmap_chart(records, ax) -> bool:
+    """R x algorithm throughput heatmap (the notebook's winner-heatmap
+    figure, cell 21). Returns False when the records span < 2 R values."""
+    cells: dict = {}
+    for rec in records:
+        if "overall_throughput" not in rec or "algorithm" not in rec:
+            continue
+        if rec.get("app", "vanilla") != "vanilla":
+            continue  # gat/als records carry mutated/app-specific R
+        R = rec.get("R") or rec.get("alg_info", {}).get("r")
+        cells[(_alg_label(rec), R)] = max(
+            cells.get((_alg_label(rec), R), 0.0), rec["overall_throughput"]
+        )
+    algs = sorted({k[0] for k in cells})
+    rs = sorted({k[1] for k in cells})
+    if len(rs) < 2 or not algs:
+        ax.set_axis_off()
+        return False
+    import numpy as np
+
+    grid = np.full((len(algs), len(rs)), np.nan)
+    for (a, r), v in cells.items():
+        grid[algs.index(a), rs.index(r)] = v
+    im = ax.imshow(grid, aspect="auto", cmap="viridis")
+    ax.set_xticks(range(len(rs)), [str(r) for r in rs])
+    ax.set_yticks(range(len(algs)), algs, fontsize=7)
+    ax.set_xlabel("R")
+    ax.set_title("GFLOP/s by (algorithm, R); * = winner")
+    winners = np.nanargmax(np.where(np.isnan(grid), -1, grid), axis=0)
+    for j, i in enumerate(winners):
+        if not np.isnan(grid[i, j]):
+            ax.text(j, i, "*", ha="center", va="center", color="w",
+                    fontsize=14)
+    ax.figure.colorbar(im, ax=ax, shrink=0.8)
+    return True
+
+
 def heatmap_winner(records) -> dict:
     """(R, c) -> winning algorithm by throughput (notebook cell 21)."""
     best: dict = {}
@@ -119,9 +156,10 @@ def main(argv=None) -> int:
     matplotlib.use("Agg")
     import matplotlib.pyplot as plt
 
-    fig, axes = plt.subplots(1, 2, figsize=(12, 5))
+    fig, axes = plt.subplots(1, 3, figsize=(17, 5))
     throughput_chart(records, axes[0])
     breakdown_chart(records, axes[1])
+    heatmap_chart(records, axes[2])
     fig.tight_layout()
     fig.savefig(out / "benchmark.png", dpi=150)
     print(f"wrote {out / 'benchmark.png'}")

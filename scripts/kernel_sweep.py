@@ -31,9 +31,13 @@ REPO = pathlib.Path(__file__).resolve().parents[1]
 
 
 def config_key(cfg: dict) -> tuple:
+    # Defaults must mirror run_worker's env defaults, or an entry without an
+    # explicit "blocks" never matches its own output record and re-runs on
+    # every resume.
+    default_blocks = "512x512" if cfg["kernel"] == "pallas" else ""
     return (
         cfg["logM"], cfg["npr"], cfg["R"], cfg["kernel"],
-        cfg.get("blocks", ""), cfg.get("group", 1),
+        cfg.get("blocks", default_blocks), cfg.get("group", 1),
     )
 
 

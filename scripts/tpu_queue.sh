@@ -27,12 +27,11 @@ assert float(y.sum()) == 2 * 256 * 256
 EOF
 }
 
-run_step() {  # run_step <done-marker> <cmd...>
-  local marker=$1; shift
-  [ -e "$marker" ] && return 0
+run_step() {  # run_step <cmd...> — steps are themselves resumable (they
+  # skip configs already recorded), so no done-markers: a completed step
+  # re-run costs only its output scan.
   echo "[queue] $(date +%H:%M:%S) running: $*"
   if "$@"; then
-    touch "$marker"
     echo "[queue] done: $*"
   else
     echo "[queue] FAILED (rc=$?): $*"
@@ -49,17 +48,17 @@ while [ "$(date +%s)" -lt "$DEADLINE" ]; do
   echo "[queue] $(date +%H:%M:%S) TPU healthy"
 
   # 1. chunk-group probe (feeds the DEFAULT_GROUP decision)
-  run_step /tmp/q1.done python scripts/kernel_sweep.py \
+  run_step python scripts/kernel_sweep.py \
     scripts/plans/group_probe.json KERNELS_TPU.jsonl --timeout 900 --retries 1 \
     || { sleep 300; continue; }
 
   # 2. star sweep, XLA vs Pallas (KERNELS_TPU artifact)
-  run_step /tmp/q2.done python scripts/kernel_sweep.py \
+  run_step python scripts/kernel_sweep.py \
     scripts/plans/star_sweep.json KERNELS_TPU.jsonl --timeout 1500 --retries 1 \
     || { sleep 300; continue; }
 
   # 3. application + heatmap benches (APPS_TPU artifact; self-resuming)
-  run_step /tmp/q3.done timeout 7200 python scripts/tpu_apps.py \
+  run_step timeout 7200 python scripts/tpu_apps.py \
     || { sleep 300; continue; }
 
   echo "[queue] all steps complete"
