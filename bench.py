@@ -162,11 +162,12 @@ def _run_attempt(env_extra: dict, timeout_s: float) -> dict | None:
 
 
 def main() -> None:
-    # Attempt schedule: TPU (auto kernel -> Pallas) with retries, then a CPU
-    # fallback so the driver always records a real measurement. Everything
-    # fits inside ONE total wall-clock budget with the tail reserved for the
-    # CPU fallback — an external harness timeout must never land before the
-    # fallback has had its chance.
+    # Attempt schedule: TPU at chunk-group 4 then chunk-group 1 (the kernel
+    # grid step batching is config-dependent; print the best TPU record),
+    # with a retry, then a CPU fallback so the driver always records a real
+    # measurement. Everything fits inside ONE total wall-clock budget with
+    # the tail reserved for the CPU fallback — an external harness timeout
+    # must never land before the fallback has had its chance.
     total = float(os.environ.get("BENCH_TOTAL_TIMEOUT", "2100"))
     backoff = float(os.environ.get("BENCH_BACKOFF", "20"))
     start = time.monotonic()
@@ -175,10 +176,12 @@ def main() -> None:
 
     cpu_env = {"BENCH_PLATFORM": "cpu", "BENCH_KERNEL": "xla"}
     attempts = [
-        ({}, tpu_budget * 0.6, 0.0),
-        ({}, tpu_budget * 0.4 - backoff, backoff),
+        ({"DSDDMM_CHUNK_GROUP": "4"}, tpu_budget * 0.45, 0.0),
+        ({"DSDDMM_CHUNK_GROUP": "1"}, tpu_budget * 0.35, 0.0),
+        ({}, tpu_budget * 0.2 - backoff, backoff),
         (cpu_env, cpu_reserve, 0.0),
     ]
+    best = None
     errors = 0
     for env_extra, timeout_s, backoff_s in attempts:
         if backoff_s and errors:
@@ -186,11 +189,15 @@ def main() -> None:
         remaining = total - (time.monotonic() - start)
         is_cpu = env_extra.get("BENCH_PLATFORM") == "cpu"
         if not is_cpu:
+            if best is not None and remaining < cpu_reserve + 120:
+                break  # have a TPU record; don't risk the budget tail
             # Never let a TPU attempt eat into the fallback reserve.
             timeout_s = min(timeout_s, remaining - cpu_reserve)
             if timeout_s < 30:
                 continue
         else:
+            if best is not None:
+                break  # CPU fallback only matters when TPU never delivered
             timeout_s = min(timeout_s, max(remaining, 60.0))
         rec = _run_attempt(env_extra, timeout_s)
         if rec is not None:
@@ -198,9 +205,15 @@ def main() -> None:
                 rec["note"] = (
                     "TPU backend unavailable after retries; CPU fallback run"
                 )
-            print(json.dumps(rec))
-            return
-        errors += 1
+                best = rec
+                break
+            if best is None or rec["value"] > best["value"]:
+                best = rec
+        else:
+            errors += 1
+    if best is not None:
+        print(json.dumps(best))
+        return
     print(
         json.dumps(
             {

@@ -30,10 +30,13 @@ import numpy as np
 CHUNK = 128  # nonzeros per chunk = VPU lane count
 
 # Chunks processed per Pallas grid step (see pallas_kernels._tile_call):
-# amortizes the per-step semaphore/DMA fixed cost, tuned on TPU v5e
-# (scripts/tune_blocks.py). Groups are gr-aligned, so larger values cost
-# pad chunks in small row blocks.
-DEFAULT_GROUP = 4
+# amortizes the per-step semaphore/DMA fixed cost (scripts/tune_blocks.py
+# probes this). Groups are gr-aligned, so larger values cost pad chunks in
+# small row blocks. Env-overridable so benchmarks can compare group
+# settings without code edits.
+import os as _os
+
+DEFAULT_GROUP = int(_os.environ.get("DSDDMM_CHUNK_GROUP", "4"))
 
 # meta word packing: | gr (15 bits) | gc (15 bits) | last | first |
 _GR_SHIFT = 17
