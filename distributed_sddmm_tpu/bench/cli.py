@@ -127,7 +127,9 @@ def _add_common(p: argparse.ArgumentParser) -> None:
     p.add_argument("-o", "--output-file", default=None, help="append JSON records here")
 
 
-def main(argv=None) -> int:
+def build_parser() -> argparse.ArgumentParser:
+    """The CLI's argument parser (importable for parse-only validation,
+    e.g. the pod runner's --dry-run)."""
     ap = argparse.ArgumentParser(prog="distributed_sddmm_tpu.bench", description=__doc__)
     sub = ap.add_subparsers(dest="cmd", required=True)
 
@@ -194,8 +196,11 @@ def main(argv=None) -> int:
     vf.add_argument("--c", type=int, default=1)
     vf.add_argument("--alg", default="all")
     vf.add_argument("--kernel", default="xla")
+    return ap
 
-    args = ap.parse_args(argv)
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
 
     if args.cmd == "er":
         S = HostCOO.rmat(log_m=args.log_m, edge_factor=args.edge_factor, seed=0)

@@ -24,6 +24,7 @@ chunk layout is a cheap gather in both directions.
 from __future__ import annotations
 
 import dataclasses
+import os
 
 import numpy as np
 
@@ -34,9 +35,7 @@ CHUNK = 128  # nonzeros per chunk = VPU lane count
 # probes this). Groups are gr-aligned, so larger values cost pad chunks in
 # small row blocks. Env-overridable so benchmarks can compare group
 # settings without code edits.
-import os as _os
-
-DEFAULT_GROUP = int(_os.environ.get("DSDDMM_CHUNK_GROUP", "4"))
+DEFAULT_GROUP = int(os.environ.get("DSDDMM_CHUNK_GROUP", "4"))
 
 # meta word packing: | gr (15 bits) | gc (15 bits) | last | first |
 _GR_SHIFT = 17

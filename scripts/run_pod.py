@@ -19,7 +19,10 @@ pass --coordinator for other clusters.
 from __future__ import annotations
 
 import argparse
+import pathlib
 import sys
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parents[1]))
 
 
 def main(argv=None) -> int:
@@ -28,20 +31,30 @@ def main(argv=None) -> int:
                     help="host:port (omit on Cloud TPU: auto-discovered)")
     ap.add_argument("--num-processes", type=int, default=None)
     ap.add_argument("--process-id", type=int, default=None)
+    ap.add_argument("--dry-run", action="store_true",
+                    help="print the resolved initialize()/bench invocation "
+                    "and exit (testable without a pod)")
     ap.add_argument("bench_args", nargs=argparse.REMAINDER,
                     help="arguments forwarded to distributed_sddmm_tpu.bench")
     args = ap.parse_args(argv)
 
+    init_kwargs = (
+        dict(coordinator_address=args.coordinator,
+             num_processes=args.num_processes, process_id=args.process_id)
+        if args.coordinator else {}
+    )
+    if args.dry_run:
+        # Validate the forwarded bench arguments parse, without touching any
+        # backend or coordinator.
+        from distributed_sddmm_tpu.bench.cli import build_parser
+
+        build_parser().parse_args(args.bench_args)
+        print(f"dry-run ok: initialize({init_kwargs}) -> bench {args.bench_args}")
+        return 0
+
     import jax
 
-    if args.coordinator:
-        jax.distributed.initialize(
-            coordinator_address=args.coordinator,
-            num_processes=args.num_processes,
-            process_id=args.process_id,
-        )
-    else:
-        jax.distributed.initialize()  # Cloud TPU auto-discovery
+    jax.distributed.initialize(**init_kwargs)  # Cloud TPU: auto-discovery
 
     if jax.process_index() == 0:
         print(

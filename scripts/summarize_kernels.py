@@ -50,12 +50,14 @@ def main() -> int:
         lines += [
             "## Star sweep",
             "",
-            "| logM | nnz/row | R | kernel | SDDMM | SpMM | fused pair |",
-            "|---|---|---|---|---|---|---|",
+            "| logM | nnz/row | R | kernel | blocks | group | SDDMM | SpMM | fused pair |",
+            "|---|---|---|---|---|---|---|---|---|",
         ]
         for r in sorted(sweep, key=lambda r: (r["logM"], r["npr"], r["R"], r["kernel"])):
+            blocks = f"{r['bm']}x{r['bn']}" if "bm" in r else "-"
             lines.append(
                 f"| {r['logM']} | {r['npr']} | {r['R']} | {r['kernel']} "
+                f"| {blocks} | {r.get('group', '-')} "
                 f"| {fmt(r.get('sddmm_gflops'))} | {fmt(r.get('spmm_gflops'))} "
                 f"| {fmt(r.get('fused_pair_gflops'))} |"
             )

@@ -108,3 +108,27 @@ class TestKernelSweepCLI:
         assert len(recs) == 1
         assert recs[0]["sddmm_gflops"] > 0 and recs[0]["spmm_gflops"] > 0
         assert "GFLOP" in capsys.readouterr().out
+
+
+def test_run_pod_dry_run(capsys):
+    """The pod runner's wiring is validated without a pod: forwarded bench
+    args must parse and the resolved initialize() kwargs print
+    (`/root/reference/jobscript.sh` analog, SURVEY component #28)."""
+    import importlib.util
+    import pathlib
+
+    spec = importlib.util.spec_from_file_location(
+        "run_pod",
+        pathlib.Path(__file__).resolve().parents[1] / "scripts" / "run_pod.py",
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+
+    assert mod.main(["--dry-run", "er", "16", "32", "15d_fusion2", "128", "2"]) == 0
+    out = capsys.readouterr().out
+    assert "dry-run ok" in out
+
+    import pytest
+
+    with pytest.raises(SystemExit):
+        mod.main(["--dry-run", "er", "not-an-int"])
