@@ -38,6 +38,11 @@ def main(argv=None) -> int:
                     help="arguments forwarded to distributed_sddmm_tpu.bench")
     args = ap.parse_args(argv)
 
+    if args.coordinator is None and (
+        args.num_processes is not None or args.process_id is not None
+    ):
+        ap.error("--num-processes/--process-id require --coordinator "
+                 "(without it, Cloud TPU auto-discovery ignores them)")
     init_kwargs = (
         dict(coordinator_address=args.coordinator,
              num_processes=args.num_processes, process_id=args.process_id)

@@ -29,17 +29,21 @@ def main() -> int:
         r["kernel"].startswith("pallas") and r.get("sddmm_gflops") is None)]
     sweep = [r for r in recs if r not in probe]
 
+    grid_points = sorted({(r["logM"], r["npr"], r["R"]) for r in recs})
     lines = [
         "# KERNELS_TPU — XLA vs Pallas local-kernel sweep (single v5e chip)",
         "",
         "Produced by `scripts/kernel_sweep.py` (resumable orchestrator over",
         "`scripts/tune_blocks.py` workers) on the tunneled TPU backend; the",
-        "reference analog is `local_kernel_benchmark.cpp:276-280`. The",
-        "verdict's full 36-config cross product is not feasible at this",
-        "backend's per-config compile cost (5-12 min each), so the sweep is a",
-        "STAR design around the center (logM=14, nnz/row=32, R=128): every",
-        "axis value of the prescribed grid is measured with the other two",
-        "axes at the center, plus the heavy corner (16, 128, 512).",
+        "reference analog is `local_kernel_benchmark.cpp:276-280`. The full",
+        "36-config cross product is not feasible at this backend's",
+        "per-config compile cost (5-12 min each), so the PLAN",
+        "(`scripts/plans/star_sweep.json`) is a star design around the",
+        "center (logM=14, nnz/row=32, R=128) covering every axis value of",
+        "the prescribed grid, plus the heavy corner (16, 128, 512). This",
+        "file reports whatever the backend allowed so far:",
+        f"**{len(grid_points)} grid point(s) measured** — "
+        + ", ".join(f"({a},{b},{c})" for a, b, c in grid_points) + ".",
         "",
         "GFLOP/s = 2*nnz*R/elapsed per op; fused pair counts both ops",
         "(`benchmark_dist.cpp:147-149`).",
