@@ -1,0 +1,51 @@
+"""Extreme load imbalance: devices with ZERO nonzeros.
+
+Random-permuted real graphs are the normal case (`random_permute.cpp`), but
+nothing stops a user benching an unpermuted corner-concentrated matrix
+where entire devices (and entire fiber layers) own no nonzeros. Every
+strategy must still produce oracle-correct results through its padded
+static-shape tiles (`SpmatLocal.hpp:153-169` analog) — on both kernels.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+from distributed_sddmm_tpu.bench.harness import ALGORITHM_FACTORIES, make_algorithm
+from distributed_sddmm_tpu.ops.kernels import XlaKernel
+from distributed_sddmm_tpu.utils.coo import HostCOO
+from distributed_sddmm_tpu.utils.verify import (
+    fingerprint_algorithm, oracle_fingerprints,
+)
+
+
+def corner_matrix(n=256, nnz=600, seed=0) -> HostCOO:
+    """All nonzeros inside the top-left (n/8 x n/8) corner: most block rows,
+    block cols and 2.5D grid cells are completely empty."""
+    rng = np.random.default_rng(seed)
+    rows = rng.integers(0, n // 8, nnz).astype(np.int64)
+    cols = rng.integers(0, n // 8, nnz).astype(np.int64)
+    vals = rng.standard_normal(nnz)
+    return HostCOO(rows, cols, vals, n, n).deduplicated()
+
+
+@pytest.mark.parametrize("name", sorted(ALGORITHM_FACTORIES))
+@pytest.mark.parametrize("kernel_name", ["xla", "pallas"])
+def test_corner_matrix_fingerprints(name, kernel_name):
+    S = corner_matrix()
+    R, c = 16, 2
+    if kernel_name == "xla":
+        kernel = XlaKernel()
+    else:
+        from distributed_sddmm_tpu.ops.pallas_kernels import PallasKernel
+
+        kernel = PallasKernel(precision="f32", interpret=True)
+    alg = make_algorithm(name, S, R, c, kernel=kernel,
+                         devices=jax.devices()[:8])
+    empty = int((np.asarray(alg.S_tiles.nnz_per_device) == 0).sum())
+    assert empty > 0, "fixture must leave some devices empty"
+    got = fingerprint_algorithm(alg, S)
+    want = oracle_fingerprints(S, R)
+    for op, v in want.items():
+        assert np.isclose(got[op], v, rtol=1e-4), (name, op, got[op], v)
