@@ -1,11 +1,13 @@
 #!/bin/bash
 # TPU work queue with tunnel-health gating.
 #
-# The tunneled TPU backend in this environment goes down for stretches
-# (backend init or the remote Mosaic compile service hang). This watchdog
-# polls health with a short-timeout probe and, while healthy, drains the
-# queued benchmark plans one at a time (never two TPU processes at once).
-# Everything is resumable: kernel_sweep.py skips configs already recorded.
+# The tunneled TPU backend in this environment goes down for stretches, in
+# two distinct modes: the whole backend (init hangs / UNAVAILABLE) or only
+# the remote Mosaic compile service (plain XLA works, Pallas compiles
+# hang). This watchdog probes both tiers and drains whatever work the
+# current health allows, one TPU process at a time. Every step is
+# resumable (kernel_sweep.py / tpu_apps.py skip configs already recorded),
+# so completed steps re-run for only an output scan.
 #
 # Usage: bash scripts/tpu_queue.sh <max_hours>
 
@@ -15,21 +17,26 @@ MAX_HOURS=${1:-6}
 DEADLINE=$(( $(date +%s) + MAX_HOURS * 3600 ))
 export PYTHONPATH="/root/repo:${PYTHONPATH:-}"
 
-healthy() {
-  timeout 180 python - <<'EOF' >/dev/null 2>&1
+healthy_basic() {  # backend up: devices + a matmul round-trip
+  timeout 150 python - <<'EOF' >/dev/null 2>&1
+import jax, jax.numpy as jnp
+x = jnp.ones((256, 256))
+assert float((x @ x).sum()) == 256.0 * 256 * 256
+EOF
+}
+
+healthy_pallas() {  # Mosaic compile service also up
+  timeout 240 python - <<'EOF' >/dev/null 2>&1
 import jax, jax.numpy as jnp
 from jax.experimental import pallas as pl
-x = jnp.ones((256, 256))
 def body(x_ref, o_ref):
     o_ref[:] = x_ref[:] * 2.0
-y = pl.pallas_call(body, out_shape=jax.ShapeDtypeStruct((256, 256), jnp.float32))(x)
+y = pl.pallas_call(body, out_shape=jax.ShapeDtypeStruct((256, 256), jnp.float32))(jnp.ones((256, 256)))
 assert float(y.sum()) == 2 * 256 * 256
 EOF
 }
 
-run_step() {  # run_step <cmd...> — steps are themselves resumable (they
-  # skip configs already recorded), so no done-markers: a completed step
-  # re-run costs only its output scan.
+run_step() {
   echo "[queue] $(date +%H:%M:%S) running: $*"
   if "$@"; then
     echo "[queue] done: $*"
@@ -40,27 +47,30 @@ run_step() {  # run_step <cmd...> — steps are themselves resumable (they
 }
 
 while [ "$(date +%s)" -lt "$DEADLINE" ]; do
-  if ! healthy; then
-    echo "[queue] $(date +%H:%M:%S) TPU unhealthy; sleeping 600s"
+  if ! healthy_basic; then
+    echo "[queue] $(date +%H:%M:%S) TPU backend down; sleeping 600s"
     sleep 600
     continue
   fi
-  echo "[queue] $(date +%H:%M:%S) TPU healthy"
-
-  # 1. chunk-group probe (feeds the DEFAULT_GROUP decision)
+  if healthy_pallas; then
+    echo "[queue] $(date +%H:%M:%S) TPU fully healthy (pallas ok)"
+    run_step python scripts/kernel_sweep.py \
+      scripts/plans/group_probe.json KERNELS_TPU.jsonl --timeout 900 --retries 1 \
+      || { sleep 300; continue; }
+    run_step python scripts/kernel_sweep.py \
+      scripts/plans/star_sweep.json KERNELS_TPU.jsonl --timeout 1500 --retries 1 \
+      || { sleep 300; continue; }
+    run_step timeout 7200 python scripts/tpu_apps.py \
+      || { sleep 300; continue; }
+    echo "[queue] all steps complete"
+    break
+  fi
+  echo "[queue] $(date +%H:%M:%S) backend up, Mosaic down: XLA-only work"
   run_step python scripts/kernel_sweep.py \
-    scripts/plans/group_probe.json KERNELS_TPU.jsonl --timeout 900 --retries 1 \
+    scripts/plans/star_sweep_xla.json KERNELS_TPU.jsonl --timeout 1200 --retries 1 \
     || { sleep 300; continue; }
-
-  # 2. star sweep, XLA vs Pallas (KERNELS_TPU artifact)
-  run_step python scripts/kernel_sweep.py \
-    scripts/plans/star_sweep.json KERNELS_TPU.jsonl --timeout 1500 --retries 1 \
+  run_step env APPS_XLA_ONLY=1 timeout 3600 python scripts/tpu_apps.py \
     || { sleep 300; continue; }
-
-  # 3. application + heatmap benches (APPS_TPU artifact; self-resuming)
-  run_step timeout 7200 python scripts/tpu_apps.py \
-    || { sleep 300; continue; }
-
-  echo "[queue] all steps complete"
-  break
+  echo "[queue] XLA-only steps complete; waiting for Mosaic recovery"
+  sleep 600
 done
