@@ -1,0 +1,165 @@
+"""Analytic communication/compute cost models and the c-optimum.
+
+TPU-native counterpart of the reference notebook's analytic models
+(`ipdps_chart_generator.ipynb` cell 11: ``fusion2model`` /
+``fusionmodel1`` / ``unfusedmodel``), which predict the optimal
+replication factor c for the 1.5D algorithms from communication volume.
+Here the volumes are the jax collective volumes of each strategy
+(all_gather / psum_scatter over the replication axis, ppermute rings), and
+the machine terms are TPU ICI parameters instead of Cori's interconnect.
+
+Per-device word volumes for one fused SDDMM+SpMM pair (R = inner dim,
+p = chips, c = replication; A is M x R, B is N x R, S has nnz nonzeros):
+
+* 1.5D dense-shift (stationary A replicated over c, B rides the ring):
+    replicate  = (c - 1)/c * (M * R * c / p)      [all_gather row world]
+    reduce     = same                              [psum_scatter partials]
+    ring       = (p/c - 1) * (N * R / p) * n_pass  [ppermute of B block]
+  fusion 2 overlaps SDDMM+SpMM in ONE ring pass (n_pass = 1); fusion 1
+  reuses one replication across two passes (n_pass = 2); unfused pays the
+  replication AND reduction twice with two passes.
+* 1.5D sparse-shift (dense stationary R-split, sparse tile rides):
+    replicate  = (c - 1)/c * (N * R * c / p)       [per-stripe all_gather]
+    ring       = (p/c - 1) * 3 * nnz / p * n_pass  [rows/cols/vals travel]
+
+Compute term: 4 * nnz * R / p flops per pair at ``flops_rate``.
+Latency term: ``alpha`` per ring hop (p/c - 1 hops x passes).
+
+The models are intentionally first-order — the same altitude as the
+notebook's — and exist to (a) pick c ahead of a run and (b) sanity-check
+measured scaling curves against theory.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+# TPU v5e-ish defaults: ICI ~4.5e10 words/s effective per link direction
+# (1.6 Tbps bidi across links / 4 bytes), ~1 us collective hop latency,
+# ~2e13 useful flops/s for this kernel family (see KERNELS_TPU.md — the
+# one-hot design runs far below bf16 peak).
+DEFAULT_ICI_WORDS_PER_S = 4.5e10
+DEFAULT_ALPHA_S = 1e-6
+DEFAULT_FLOPS_RATE = 2e13
+
+
+@dataclasses.dataclass(frozen=True)
+class Machine:
+    ici_words_per_s: float = DEFAULT_ICI_WORDS_PER_S
+    alpha_s: float = DEFAULT_ALPHA_S
+    flops_rate: float = DEFAULT_FLOPS_RATE
+
+
+def _dense_shift_words(M, N, R, p, c, n_pass, n_repl):
+    replicate = (c - 1) / c * (M * R * c / p)
+    ring = (p / c - 1) * (N * R / p) * n_pass
+    return n_repl * replicate + ring
+
+
+def _sparse_shift_words(M, N, R, nnz, p, c, n_pass):
+    replicate = (c - 1) / c * (N * R * c / p)
+    ring = (p / c - 1) * (3 * nnz / p) * n_pass
+    return replicate + ring
+
+
+def pair_time(
+    alg: str, M: int, N: int, R: int, nnz: int, p: int, c: int,
+    machine: Machine = Machine(),
+) -> float:
+    """Modeled seconds for one fused SDDMM+SpMM pair on p chips at
+    replication c. ``alg`` in {15d_fusion1, 15d_fusion2, 15d_unfused,
+    15d_sparse}."""
+    if p % c or c < 1:
+        raise ValueError(f"c={c} must divide p={p}")
+    if alg == "15d_fusion2":
+        words = _dense_shift_words(M, N, R, p, c, n_pass=1, n_repl=2)
+    elif alg == "15d_fusion1":
+        words = _dense_shift_words(M, N, R, p, c, n_pass=2, n_repl=2)
+    elif alg == "15d_unfused":
+        words = _dense_shift_words(M, N, R, p, c, n_pass=2, n_repl=4)
+    elif alg == "15d_sparse":
+        words = _sparse_shift_words(M, N, R, nnz, p, c, n_pass=1)
+    else:
+        raise ValueError(f"unknown model {alg!r}")
+    hops = (p / c - 1)
+    compute = 4.0 * nnz * R / p / machine.flops_rate
+    return words / machine.ici_words_per_s + hops * machine.alpha_s + compute
+
+
+def optimal_c(
+    alg: str, M: int, N: int, R: int, nnz: int, p: int,
+    machine: Machine = Machine(),
+) -> int:
+    """argmin_c of :func:`pair_time` over divisors of p."""
+    cs = [c for c in range(1, p + 1) if p % c == 0]
+    return min(cs, key=lambda c: pair_time(alg, M, N, R, nnz, p, c, machine))
+
+
+def model_curves(
+    M: int, N: int, R: int, nnz: int, p: int, machine: Machine = Machine(),
+) -> dict:
+    """{alg: {c: seconds}} over divisors of p — chartable T(c) curves (the
+    notebook's cell-11 figure)."""
+    cs = [c for c in range(1, p + 1) if p % c == 0]
+    return {
+        alg: {c: pair_time(alg, M, N, R, nnz, p, c, machine) for c in cs}
+        for alg in ("15d_fusion2", "15d_fusion1", "15d_unfused", "15d_sparse")
+    }
+
+
+def main(argv=None) -> int:
+    """CLI: print T(c) curves and c* for a configuration; optional PNG."""
+    import argparse
+    import json
+
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("log_m", type=int)
+    ap.add_argument("nnz_per_row", type=int)
+    ap.add_argument("R", type=int)
+    ap.add_argument("p", type=int)
+    ap.add_argument("-o", "--png", default=None, help="write a T(c) figure")
+    args = ap.parse_args(argv)
+
+    M = 1 << args.log_m
+    nnz = M * args.nnz_per_row
+    curves = model_curves(M, M, args.R, nnz, args.p)
+    out = {
+        alg: {
+            "c_optimal": min(series, key=series.get),
+            "ms_by_c": {str(c): round(t * 1e3, 4) for c, t in series.items()},
+        }
+        for alg, series in curves.items()
+    }
+    print(json.dumps(out, indent=2))
+
+    if args.png:
+        import matplotlib
+
+        matplotlib.use("Agg")
+        import matplotlib.pyplot as plt
+
+        fig, ax = plt.subplots(figsize=(7, 5))
+        for alg, series in curves.items():
+            cs = sorted(series)
+            ax.plot(cs, [series[c] * 1e3 for c in cs], marker="o", label=alg)
+        ax.set_xscale("log", base=2)
+        ax.set_yscale("log")
+        ax.set_xlabel("replication factor c")
+        ax.set_ylabel("modeled ms / fused pair")
+        ax.set_title(
+            f"Analytic c tradeoff (M=N=2^{args.log_m}, "
+            f"nnz/row={args.nnz_per_row}, R={args.R}, p={args.p})"
+        )
+        ax.legend(fontsize=8)
+        fig.tight_layout()
+        fig.savefig(args.png, dpi=150)
+        import sys
+
+        print(f"wrote {args.png}", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

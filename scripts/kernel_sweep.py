@@ -114,9 +114,13 @@ def main(argv=None) -> int:
                     help="per-config hard timeout (seconds)")
     ap.add_argument("--retries", type=int, default=2)
     ap.add_argument("--backoff", type=float, default=45.0)
+    ap.add_argument("--kernel-filter", default=None, choices=("xla", "pallas"),
+                    help="run only this kernel's configs from the plan")
     args = ap.parse_args(argv)
 
     plan = json.loads(pathlib.Path(args.plan).read_text())
+    if args.kernel_filter:
+        plan = [cfg for cfg in plan if cfg["kernel"] == args.kernel_filter]
     out_path = pathlib.Path(args.output)
     done = done_keys(out_path)
 

@@ -58,7 +58,7 @@ def done_keys() -> set:
 def main() -> int:
     import os
 
-    xla_only = bool(os.environ.get("APPS_XLA_ONLY"))
+    xla_only = os.environ.get("APPS_XLA_ONLY", "") not in ("", "0")
     done = done_keys()
     mats: dict = {}
     failures = 0

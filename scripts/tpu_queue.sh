@@ -67,7 +67,8 @@ while [ "$(date +%s)" -lt "$DEADLINE" ]; do
   fi
   echo "[queue] $(date +%H:%M:%S) backend up, Mosaic down: XLA-only work"
   run_step python scripts/kernel_sweep.py \
-    scripts/plans/star_sweep_xla.json KERNELS_TPU.jsonl --timeout 1200 --retries 1 \
+    scripts/plans/star_sweep.json KERNELS_TPU.jsonl --timeout 1200 --retries 1 \
+    --kernel-filter xla \
     || { sleep 300; continue; }
   run_step env APPS_XLA_ONLY=1 timeout 3600 python scripts/tpu_apps.py \
     || { sleep 300; continue; }

@@ -1,0 +1,73 @@
+"""Analytic c-optimum models (reference notebook cell 11 parity)."""
+
+import pytest
+
+from distributed_sddmm_tpu.tools.costmodel import (
+    Machine, model_curves, optimal_c, pair_time,
+)
+
+M = N = 1 << 20
+NNZ = M * 32
+P = 64
+
+
+def test_fusion2_beats_fusion1_beats_unfused():
+    # Fewer passes / fewer replications can only help at equal c.
+    for c in (1, 4, 16):
+        t2 = pair_time("15d_fusion2", M, N, 128, NNZ, P, c)
+        t1 = pair_time("15d_fusion1", M, N, 128, NNZ, P, c)
+        tu = pair_time("15d_unfused", M, N, 128, NNZ, P, c)
+        assert t2 <= t1 <= tu
+
+
+def test_replication_tradeoff_interior_optimum():
+    # c=1 maximizes ring volume, c=p maximizes replication volume; for a
+    # square problem at large R the optimum sits strictly inside.
+    c_star = optimal_c("15d_fusion2", M, N, 512, NNZ, P)
+    assert 1 < c_star < P
+
+
+def test_optimum_monotone_in_R_for_sparse_shift():
+    # Sparse-shift's ring volume is R-independent (the sparse tile rides)
+    # while replication grows with R, so larger R pushes c* DOWN (or equal).
+    c_small = optimal_c("15d_sparse", M, N, 32, NNZ, P)
+    c_large = optimal_c("15d_sparse", M, N, 1024, NNZ, P)
+    assert c_large <= c_small
+
+
+def test_dense_shift_optimum_grows_with_moving_side():
+    # A wider moving operand (larger N at fixed M) makes ring traffic
+    # dominate, favoring more replication.
+    c_narrow = optimal_c("15d_fusion2", M, M // 4, 128, NNZ, P)
+    c_wide = optimal_c("15d_fusion2", M, 4 * M, 128, NNZ, P)
+    assert c_wide >= c_narrow
+
+
+def test_curves_shape_and_divisors():
+    curves = model_curves(M, N, 128, NNZ, P)
+    assert set(curves) == {"15d_fusion2", "15d_fusion1", "15d_unfused",
+                           "15d_sparse"}
+    for series in curves.values():
+        assert all(P % c == 0 for c in series)
+        assert all(t > 0 for t in series.values())
+
+
+def test_invalid_c_rejected():
+    with pytest.raises(ValueError):
+        pair_time("15d_fusion2", M, N, 128, NNZ, P, 3)
+    with pytest.raises(ValueError):
+        pair_time("nope", M, N, 128, NNZ, P, 1)
+
+
+def test_machine_scaling_sanity():
+    # Faster interconnect leaves the per-hop latency term dominant, and
+    # hops = p/c - 1 shrink with c — so the optimum moves toward MORE
+    # replication; higher hop latency does the same.
+    fast = Machine(ici_words_per_s=1e13)
+    c_fast = optimal_c("15d_fusion2", M, N, 128, NNZ, P, fast)
+    c_slow = optimal_c("15d_fusion2", M, N, 128, NNZ, P, Machine())
+    assert c_fast >= c_slow
+
+    laggy = Machine(alpha_s=1e-3)
+    c_laggy = optimal_c("15d_fusion2", M, N, 128, NNZ, P, laggy)
+    assert c_laggy >= c_slow
