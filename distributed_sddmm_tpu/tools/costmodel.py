@@ -13,11 +13,14 @@ p = chips, c = replication; A is M x R, B is N x R, S has nnz nonzeros):
 
 * 1.5D dense-shift (stationary A replicated over c, B rides the ring):
     replicate  = (c - 1)/c * (M * R * c / p)      [all_gather row world]
-    reduce     = same                              [psum_scatter partials]
     ring       = (p/c - 1) * (N * R / p) * n_pass  [ppermute of B block]
-  fusion 2 overlaps SDDMM+SpMM in ONE ring pass (n_pass = 1); fusion 1
-  reuses one replication across two passes (n_pass = 2); unfused pays the
-  replication AND reduction twice with two passes.
+  fusion 2 overlaps SDDMM+SpMM in ONE ring pass (n_pass = 1, one
+  replication); fusion 1 reuses one replication across two ring passes
+  (n_pass = 2, n_repl = 1); unfused replicates twice with two passes
+  (n_repl = 2). These coefficients match the notebook's models exactly
+  (fusionmodel1 = 2nr/c + (c-1)nr/p, unfusedmodel = 2nr/c + 2(c-1)nr/p);
+  the SpMM reduce-scatter term is identical across the three variants and
+  is folded out of the comparison, following the notebook's convention.
 * 1.5D sparse-shift (dense stationary R-split, sparse tile rides):
     replicate  = (c - 1)/c * (N * R * c / p)       [per-stripe all_gather]
     ring       = (p/c - 1) * 3 * nnz / p * n_pass  [rows/cols/vals travel]
@@ -69,14 +72,14 @@ def pair_time(
     """Modeled seconds for one fused SDDMM+SpMM pair on p chips at
     replication c. ``alg`` in {15d_fusion1, 15d_fusion2, 15d_unfused,
     15d_sparse}."""
-    if p % c or c < 1:
+    if c < 1 or p % c:
         raise ValueError(f"c={c} must divide p={p}")
     if alg == "15d_fusion2":
-        words = _dense_shift_words(M, N, R, p, c, n_pass=1, n_repl=2)
+        words = _dense_shift_words(M, N, R, p, c, n_pass=1, n_repl=1)
     elif alg == "15d_fusion1":
-        words = _dense_shift_words(M, N, R, p, c, n_pass=2, n_repl=2)
+        words = _dense_shift_words(M, N, R, p, c, n_pass=2, n_repl=1)
     elif alg == "15d_unfused":
-        words = _dense_shift_words(M, N, R, p, c, n_pass=2, n_repl=4)
+        words = _dense_shift_words(M, N, R, p, c, n_pass=2, n_repl=2)
     elif alg == "15d_sparse":
         words = _sparse_shift_words(M, N, R, nnz, p, c, n_pass=1)
     else:
@@ -124,11 +127,15 @@ def main(argv=None) -> int:
     nnz = M * args.nnz_per_row
     curves = model_curves(M, M, args.R, nnz, args.p)
     out = {
-        alg: {
-            "c_optimal": min(series, key=series.get),
-            "ms_by_c": {str(c): round(t * 1e3, 4) for c, t in series.items()},
-        }
-        for alg, series in curves.items()
+        "config": {"log_m": args.log_m, "nnz_per_row": args.nnz_per_row,
+                   "R": args.R, "p": args.p},
+        "models": {
+            alg: {
+                "c_optimal": min(series, key=series.get),
+                "ms_by_c": {str(c): round(t * 1e3, 4) for c, t in series.items()},
+            }
+            for alg, series in curves.items()
+        },
     }
     print(json.dumps(out, indent=2))
 
