@@ -59,6 +59,23 @@ def test_ablated_programs_are_distinct_compilations(S):
     assert ("fused", False, "local") in keys
 
 
+def test_breakdown_through_blocked_programs(S):
+    """The ablation wrappers live in the blocked (Pallas) program builders
+    too — attribution must work when the kernel is chunk-list based."""
+    from distributed_sddmm_tpu.ops.pallas_kernels import PallasKernel
+
+    alg = make_algorithm(
+        "15d_fusion2", S, R=16, c=2,
+        kernel=PallasKernel(precision="f32", interpret=True),
+        devices=jax.devices()[:8],
+    )
+    A = alg.dummy_initialize(MatMode.A)
+    B = alg.dummy_initialize(MatMode.B)
+    bd = alg.measure_breakdown(A, B, alg.like_s_values(1.0), trials=1)
+    assert bd["fusedSpMM"] > 0.0
+    assert set(bd) == {"fusedSpMM", "replication", "ppermute", "fusedSpMM_total"}
+
+
 def test_harness_breakdown_record(S, tmp_path):
     rec = benchmark_algorithm(
         S, "15d_fusion2", str(tmp_path / "r.jsonl"), fused=True, R=16, c=2,
