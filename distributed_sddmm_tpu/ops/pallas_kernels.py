@@ -36,6 +36,7 @@ from __future__ import annotations
 
 import dataclasses
 import functools
+import os
 
 import jax
 import jax.numpy as jnp
@@ -110,6 +111,23 @@ def _gathered(dense_ref, loc_row):
     return ohT, _dotg(dense_ref[:], ohT, 1, 0)
 
 
+def _scattered(scT, ohT_r, loc_row, bm, form):
+    """Scatter-add contribution ``[R, CHUNK] -> [R, BM]`` via one-hot MXU.
+
+    ``form`` selects the contraction orientation: "bt" contracts the gather
+    one-hot's lane axis (an A.B^T-shaped dot_general, reusing ``ohT_r``);
+    "nt" builds the one-hot already transposed (lane axis = BM) from a
+    sublane-relayouted index vector, so the MXU sees a natural A.B
+    contraction and Mosaic never has to transpose a [BM, CHUNK] operand."""
+    if form == "bt":
+        return _dotg(scT, ohT_r, 1, 1)
+    oh = (
+        jax.lax.broadcasted_iota(jnp.int32, (CHUNK, bm), 1)
+        == loc_row.reshape(CHUNK, 1)
+    ).astype(scT.dtype)
+    return _dotg(scT, oh, 1, 0)
+
+
 def _sub_boundaries(meta_ref, acc_ref, t, G, j):
     """Zero the accumulator at a first-of-row-block sub-chunk and return the
     flush predicate for a last-of-row-block one. With group > 1 the grid
@@ -124,11 +142,12 @@ def _sub_boundaries(meta_ref, acc_ref, t, G, j):
     return ((w >> 1) & 1) == 1
 
 
-def _make_fused_body(G):
+def _make_fused_body(G, form):
     def body(meta_ref, lr_ref, lc_ref, sv_ref, at_ref, *rest):
         bt_refs = rest[:G]
         out_ref, mid_ref, acc_ref = rest[G], rest[G + 1], rest[G + 2]
         t = pl.program_id(0)
+        bm = out_ref.shape[1]
         for j in range(G):
             last = _sub_boundaries(meta_ref, acc_ref, t, G, j)
             ohT_r, a_rT = _gathered(at_ref, lr_ref[0, j : j + 1])
@@ -136,7 +155,7 @@ def _make_fused_body(G):
             dots = jnp.sum(a_rT * b_rT, axis=0, keepdims=True) * sv_ref[0, j : j + 1]
             mid_ref[0, j : j + 1] = dots
             scT = (b_rT * dots).astype(bt_refs[j].dtype)
-            acc_ref[:] += _dotg(scT, ohT_r, 1, 1)  # [R, BM]
+            acc_ref[:] += _scattered(scT, ohT_r, lr_ref[0, j : j + 1], bm, form)
 
             @pl.when(last)
             def _():
@@ -159,20 +178,24 @@ def _make_sddmm_body(G):
     return body
 
 
-def _make_spmm_body(G):
+def _make_spmm_body(G, form):
     def body(meta_ref, lr_ref, lc_ref, sv_ref, *rest):
         bt_refs = rest[:G]
         out_ref, acc_ref = rest[G], rest[G + 1]
         t = pl.program_id(0)
+        bm = out_ref.shape[1]
         for j in range(G):
             last = _sub_boundaries(meta_ref, acc_ref, t, G, j)
             _, b_rT = _gathered(bt_refs[j], lc_ref[0, j : j + 1])
-            ohT_r = (
-                jax.lax.broadcasted_iota(jnp.int32, (out_ref.shape[1], CHUNK), 0)
-                == lr_ref[0, j : j + 1]
-            ).astype(bt_refs[j].dtype)
+            if form == "bt":
+                ohT_r = (
+                    jax.lax.broadcasted_iota(jnp.int32, (bm, CHUNK), 0)
+                    == lr_ref[0, j : j + 1]
+                ).astype(bt_refs[j].dtype)
+            else:
+                ohT_r = None  # "nt" builds its one-hot inside _scattered
             scT = (b_rT * sv_ref[0, j : j + 1]).astype(bt_refs[j].dtype)
-            acc_ref[:] += _dotg(scT, ohT_r, 1, 1)
+            acc_ref[:] += _scattered(scT, ohT_r, lr_ref[0, j : j + 1], bm, form)
 
             @pl.when(last)
             def _():
@@ -185,11 +208,12 @@ def _make_spmm_body(G):
     jax.jit,
     static_argnames=(
         "op", "bm", "bn", "gr_blocks", "gc_blocks", "group", "interpret",
+        "scatter_form",
     ),
 )
 def _tile_call(
     meta, lr, lc, sv, at, bt, op, bm, bn, gr_blocks, gc_blocks, group,
-    interpret,
+    interpret, scatter_form="bt",
 ):
     """Launch one chunk-list kernel. ``at``/``bt`` are feature-major padded
     dense operands [R, gr_blocks*bm] / [R, gc_blocks*bn]; ``sv`` is the
@@ -219,7 +243,7 @@ def _tile_call(
     mid_shape = jax.ShapeDtypeStruct((steps, G, CHUNK), jnp.float32)
 
     if op == "fused":
-        body = _make_fused_body(G)
+        body = _make_fused_body(G, scatter_form)
         in_specs = [chunk_spec, chunk_spec, chunk_spec, at_spec, *bt_specs]
         operands = (lr3, lc3, sv3, at, *([bt] * G))
         out_specs, out_shapes = [out_spec, chunk_spec], [out_shape, mid_shape]
@@ -230,7 +254,7 @@ def _tile_call(
         operands = (lr3, lc3, sv3, at, *([bt] * G))
         out_specs, out_shapes, scratch = [chunk_spec], [mid_shape], []
     elif op == "spmm":
-        body = _make_spmm_body(G)
+        body = _make_spmm_body(G, scatter_form)
         in_specs = [chunk_spec, chunk_spec, chunk_spec, *bt_specs]
         operands = (lr3, lc3, sv3, *([bt] * G))
         out_specs, out_shapes = [out_spec], [out_shape]
@@ -279,11 +303,12 @@ def _flat_indices(geom, meta, lr, lc):
 
 
 def _geom_call(geom, op, meta, lr, lc, sv, at, bt):
-    bm, bn, grb, gcb, group, interpret = geom
+    bm, bn, grb, gcb, group, interpret, form = geom
     return tuple(
         _tile_call(
             meta, lr, lc, sv, at, bt, op=op, bm=bm, bn=bn,
             gr_blocks=grb, gc_blocks=gcb, group=group, interpret=interpret,
+            scatter_form=form,
         )
     )
 
@@ -397,11 +422,20 @@ class PallasKernel:
     bf16) or "f32" (full f32 MXU, ~4x slower). Default: bf16 on TPU, f32 in
     interpreter mode (CPU executors lack bf16 matmuls).
     ``interpret``: run in the Pallas interpreter (CPU test meshes).
+    ``scatter_form``: "bt" (reuse the gather one-hot, A.B^T contraction) or
+    "nt" (build a transposed one-hot, natural A.B contraction); identical
+    numerics, different Mosaic lowering — ``scripts/tune_blocks.py`` probes
+    which is faster on hardware. Env default: ``DSDDMM_SCATTER_FORM``.
     """
 
     is_blocked = True
 
-    def __init__(self, precision: str | None = None, interpret: bool | None = None):
+    def __init__(
+        self,
+        precision: str | None = None,
+        interpret: bool | None = None,
+        scatter_form: str | None = None,
+    ):
         if interpret is None:
             interpret = jax.default_backend() != "tpu"
         self.interpret = interpret
@@ -409,7 +443,12 @@ class PallasKernel:
             precision = "f32" if interpret else "bf16"
         if precision not in ("bf16", "f32"):
             raise ValueError(f"precision must be 'bf16' or 'f32', got {precision!r}")
+        if scatter_form is None:
+            scatter_form = os.environ.get("DSDDMM_SCATTER_FORM", "bt")
+        if scatter_form not in ("bt", "nt"):
+            raise ValueError(f"scatter_form must be 'bt' or 'nt', got {scatter_form!r}")
         self.precision = precision
+        self.scatter_form = scatter_form
         self._xla = XlaKernel()
         self.name = f"pallas-{precision}"
 
@@ -454,7 +493,7 @@ class PallasKernel:
     def _geom(self, blk: BlockedTile) -> tuple:
         return (
             blk.bm, blk.bn, blk.gr_blocks, blk.gc_blocks, blk.group,
-            self.interpret,
+            self.interpret, self.scatter_form,
         )
 
     def sddmm_tile_t(self, blk: BlockedTile, vals, at, bt, out_dtype):

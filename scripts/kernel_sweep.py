@@ -38,15 +38,18 @@ def config_key(cfg: dict) -> tuple:
     return (
         cfg["logM"], cfg["npr"], cfg["R"], cfg["kernel"],
         cfg.get("blocks", default_blocks), cfg.get("group", 1),
+        cfg.get("scatter", "bt") if cfg["kernel"] == "pallas" else "",
     )
 
 
 def record_key(rec: dict) -> tuple:
     blocks = f"{rec['bm']}x{rec['bn']}" if "bm" in rec else ""
+    is_pallas = rec["kernel"].startswith("pallas")
     return (
         rec["logM"], rec["npr"], rec["R"],
-        "pallas" if rec["kernel"].startswith("pallas") else rec["kernel"],
+        "pallas" if is_pallas else rec["kernel"],
         blocks, rec.get("group", 1),
+        rec.get("scatter_form", "bt") if is_pallas else "",
     )
 
 
@@ -70,6 +73,7 @@ def run_worker(cfg: dict, timeout_s: float) -> list[dict] | None:
         env["TUNE_SKIP_XLA"] = "1"
         env["TUNE_BLOCKS"] = cfg.get("blocks", "512x512")
         env["TUNE_GROUP"] = str(cfg.get("group", 1))
+        env["TUNE_SCATTER"] = cfg.get("scatter", "bt")
         if cfg.get("fused_only"):
             env["TUNE_FUSED_ONLY"] = "1"
     proc = subprocess.Popen(

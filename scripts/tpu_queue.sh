@@ -60,6 +60,9 @@ while [ "$(date +%s)" -lt "$DEADLINE" ]; do
     run_step python scripts/kernel_sweep.py \
       scripts/plans/star_sweep.json KERNELS_TPU.jsonl --timeout 1500 --retries 1 \
       || { sleep 300; continue; }
+    run_step python scripts/kernel_sweep.py \
+      scripts/plans/scatter_probe.json KERNELS_TPU.jsonl --timeout 900 --retries 1 \
+      || { sleep 300; continue; }
     run_step timeout 7200 python scripts/tpu_apps.py \
       || { sleep 300; continue; }
     echo "[queue] all steps complete"

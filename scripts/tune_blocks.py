@@ -34,6 +34,7 @@ if os.environ.get("TUNE_BLOCKS"):
               for pair in os.environ["TUNE_BLOCKS"].split(",")]
 FUSED_ONLY = bool(os.environ.get("TUNE_FUSED_ONLY"))
 SKIP_XLA = bool(os.environ.get("TUNE_SKIP_XLA"))
+SCATTER_FORM = os.environ.get("TUNE_SCATTER", "bt")
 
 
 def main():
@@ -73,7 +74,7 @@ def main():
                "fused_pair_gflops": 2 * flops / (t_sddmm + t_spmm) / 1e9}
         print(json.dumps(rec), flush=True)
 
-    kernp = PallasKernel()
+    kernp = PallasKernel(scatter_form=SCATTER_FORM)
     for bm_pref, bn_pref in BLOCKS:
         group = int(os.environ.get("TUNE_GROUP", "1"))
         meta = build_blocked(1, np.zeros(S.nnz, np.int64), S.rows, S.cols,
@@ -113,7 +114,7 @@ def main():
         occ = float((~meta.pad_lane).mean())
         rec = {"kernel": "pallas-bf16", "logM": log_m, "npr": npr, "R": R,
                "bm": meta.bm, "bn": meta.bn, "n_chunks": meta.n_chunks,
-               "group": meta.group,
+               "group": meta.group, "scatter_form": SCATTER_FORM,
                "occupancy": round(occ, 3),
                "fused_pair_ms": t_f * 1e3,
                "sddmm_ms": t_s and t_s * 1e3, "spmm_ms": t_m and t_m * 1e3,

@@ -141,15 +141,21 @@ class TestBlockedMeta:
 
 class TestPallasTileKernels:
     @pytest.mark.parametrize(
-        "precision,tol,group",
-        [("f32", 1e-5, 1), ("bf16", 3e-2, 1), ("f32", 1e-5, 4)],
+        "precision,tol,group,form",
+        [
+            ("f32", 1e-5, 1, "bt"),
+            ("bf16", 3e-2, 1, "bt"),
+            ("f32", 1e-5, 4, "bt"),
+            ("f32", 1e-5, 1, "nt"),
+            ("f32", 1e-5, 4, "nt"),
+        ],
     )
-    def test_against_oracle(self, precision, tol, group):
+    def test_against_oracle(self, precision, tol, group, form):
         rows, cols, meta, blk, vals, rng = _tile_setup(group=group)
         Mr, Nc, R = 700, 500, 32
         A = rng.standard_normal((Mr, R)).astype(np.float32)
         B = rng.standard_normal((Nc, R)).astype(np.float32)
-        k = PallasKernel(precision=precision, interpret=True)
+        k = PallasKernel(precision=precision, interpret=True, scatter_form=form)
         vj, Aj, Bj = jnp.array(vals), jnp.array(A), jnp.array(B)
 
         host_vals = vals[meta.host_to_chunk]
