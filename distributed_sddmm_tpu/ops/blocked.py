@@ -37,6 +37,13 @@ CHUNK = 128  # nonzeros per chunk = VPU lane count
 # settings without code edits.
 DEFAULT_GROUP = int(os.environ.get("DSDDMM_CHUNK_GROUP", "4"))
 
+# Preferred dense block sizes for the one-hot kernels' (row, col) windows.
+# Env-overridable for the same reason as DEFAULT_GROUP: bench.py applies the
+# best (blocks, group, scatter form) combination measured in
+# KERNELS_TPU.jsonl without code edits.
+DEFAULT_BLOCK_ROWS = int(os.environ.get("DSDDMM_BLOCK_ROWS", "512"))
+DEFAULT_BLOCK_COLS = int(os.environ.get("DSDDMM_BLOCK_COLS", "512"))
+
 # meta word packing: | gr (15 bits) | gc (15 bits) | last | first |
 _GR_SHIFT = 17
 _GC_SHIFT = 2

@@ -299,7 +299,7 @@ def _flat_indices(geom, meta, lr, lc):
 # don't-cares that the pad positions of value vectors absorb. The integer
 # metadata arrays are explicit arguments with float0 cotangents (custom_vjp
 # must not close over tracers); ``geom`` = (bm, bn, gr_blocks, gc_blocks,
-# group, interpret) rides in nondiff_argnums.
+# group, interpret, scatter_form) rides in nondiff_argnums.
 
 
 def _geom_call(geom, op, meta, lr, lc, sv, at, bt):

@@ -401,11 +401,19 @@ _BLOCK_PAIR_LIMIT = 200_000_000
 
 def _try_build_blocked(n_buckets, bucket, res, tile_rows, tile_cols, swap=False):
     from distributed_sddmm_tpu.ops.blocked import (
-        DEFAULT_GROUP, build_blocked, pick_block,
+        DEFAULT_BLOCK_COLS, DEFAULT_BLOCK_ROWS, DEFAULT_GROUP,
+        build_blocked, pick_block,
     )
 
-    bm = pick_block(max(tile_rows, 1))
-    bn = pick_block(max(tile_cols, 1))
+    local_r, local_c = res.local_r, res.local_c
+    if swap:
+        local_r, local_c = local_c, local_r
+        tile_rows, tile_cols = tile_cols, tile_rows
+    # Estimate the pair grid in the SAME orientation build_blocked will use
+    # (i.e. post-swap) — with asymmetric block preferences the pre-swap
+    # product differs and the guard would check the wrong count.
+    bm = pick_block(max(tile_rows, 1), DEFAULT_BLOCK_ROWS)
+    bn = pick_block(max(tile_cols, 1), DEFAULT_BLOCK_COLS)
     n_pairs = (
         n_buckets
         * max(-(-tile_rows // bm), 1)
@@ -413,11 +421,8 @@ def _try_build_blocked(n_buckets, bucket, res, tile_rows, tile_cols, swap=False)
     )
     if n_pairs > _BLOCK_PAIR_LIMIT:
         return None
-    local_r, local_c = res.local_r, res.local_c
-    if swap:
-        local_r, local_c = local_c, local_r
-        tile_rows, tile_cols = tile_cols, tile_rows
     return build_blocked(
         n_buckets, bucket, local_r, local_c, tile_rows, tile_cols,
+        block_rows=DEFAULT_BLOCK_ROWS, block_cols=DEFAULT_BLOCK_COLS,
         group=DEFAULT_GROUP,
     )

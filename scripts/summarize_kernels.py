@@ -54,14 +54,15 @@ def main() -> int:
         lines += [
             "## Star sweep",
             "",
-            "| logM | nnz/row | R | kernel | blocks | group | SDDMM | SpMM | fused pair |",
-            "|---|---|---|---|---|---|---|---|---|",
+            "| logM | nnz/row | R | kernel | blocks | group | scatter | SDDMM | SpMM | fused pair |",
+            "|---|---|---|---|---|---|---|---|---|---|",
         ]
         for r in sorted(sweep, key=lambda r: (r["logM"], r["npr"], r["R"], r["kernel"])):
             blocks = f"{r['bm']}x{r['bn']}" if "bm" in r else "-"
+            form = r.get("scatter_form", "bt") if r["kernel"].startswith("pallas") else "-"
             lines.append(
                 f"| {r['logM']} | {r['npr']} | {r['R']} | {r['kernel']} "
-                f"| {blocks} | {r.get('group', '-')} "
+                f"| {blocks} | {r.get('group', '-')} | {form} "
                 f"| {fmt(r.get('sddmm_gflops'))} | {fmt(r.get('spmm_gflops'))} "
                 f"| {fmt(r.get('fused_pair_gflops'))} |"
             )
@@ -71,13 +72,15 @@ def main() -> int:
         lines += [
             "## Block/group tuning probe (logM=16, nnz/row=32, R=128, fused pair)",
             "",
-            "| blocks | group | chunks | occupancy | ns/chunk | GFLOP/s |",
-            "|---|---|---|---|---|---|",
+            "| blocks | group | scatter | chunks | occupancy | ns/chunk | GFLOP/s |",
+            "|---|---|---|---|---|---|---|",
         ]
         for r in sorted(probe, key=lambda r: (r.get("bm", 0), r.get("bn", 0),
-                                              r.get("group", 1))):
+                                              r.get("group", 1),
+                                              r.get("scatter_form", "bt"))):
             lines.append(
                 f"| {r.get('bm')}x{r.get('bn')} | {r.get('group', 1)} "
+                f"| {r.get('scatter_form', 'bt')} "
                 f"| {r.get('n_chunks')} | {r.get('occupancy')} "
                 f"| {fmt(r.get('fused_ns_per_chunk'))} "
                 f"| {fmt(r.get('fused_pair_gflops'))} |"
