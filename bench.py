@@ -146,6 +146,7 @@ def _best_measured_env() -> dict | None:
         "DSDDMM_BLOCK_COLS": str(best["bn"]),
         "DSDDMM_CHUNK_GROUP": str(best.get("group", 1)),
         "DSDDMM_SCATTER_FORM": best.get("scatter_form", "bt"),
+        "DSDDMM_CHUNK": str(best.get("chunk", 128)),
     }
 
 
@@ -228,6 +229,7 @@ def main() -> None:
         "DSDDMM_BLOCK_ROWS": os.environ.get("DSDDMM_BLOCK_ROWS", "512"),
         "DSDDMM_BLOCK_COLS": os.environ.get("DSDDMM_BLOCK_COLS", "512"),
         "DSDDMM_SCATTER_FORM": os.environ.get("DSDDMM_SCATTER_FORM", "bt"),
+        "DSDDMM_CHUNK": os.environ.get("DSDDMM_CHUNK", "128"),
         **attempts[0][0],
     }
     if tuned is not None and tuned != first_rung_effective:

@@ -28,7 +28,12 @@ import os
 
 import numpy as np
 
-CHUNK = 128  # nonzeros per chunk = VPU lane count
+# Nonzeros per chunk. 128 = one VPU lane row per nonzero; 256 doubles the
+# one-hot matmuls' N dimension (same FLOPs/nnz, fewer+larger MXU ops and
+# half the per-sub-chunk fixed cost). Env-overridable for whole-process
+# probes only (scripts/tune_blocks.py) — every module snapshots it at
+# import, so it must never change inside a running process.
+CHUNK = int(os.environ.get("DSDDMM_CHUNK", "128"))
 
 # Chunks processed per Pallas grid step (see pallas_kernels._tile_call):
 # amortizes the per-step semaphore/DMA fixed cost (scripts/tune_blocks.py

@@ -39,6 +39,7 @@ def config_key(cfg: dict) -> tuple:
         cfg["logM"], cfg["npr"], cfg["R"], cfg["kernel"],
         cfg.get("blocks", default_blocks), cfg.get("group", 1),
         cfg.get("scatter", "bt") if cfg["kernel"] == "pallas" else "",
+        cfg.get("chunk", 128) if cfg["kernel"] == "pallas" else 0,
     )
 
 
@@ -50,6 +51,7 @@ def record_key(rec: dict) -> tuple:
         "pallas" if is_pallas else rec["kernel"],
         blocks, rec.get("group", 1),
         rec.get("scatter_form", "bt") if is_pallas else "",
+        rec.get("chunk", 128) if is_pallas else 0,
     )
 
 
@@ -74,6 +76,7 @@ def run_worker(cfg: dict, timeout_s: float) -> list[dict] | None:
         env["TUNE_BLOCKS"] = cfg.get("blocks", "512x512")
         env["TUNE_GROUP"] = str(cfg.get("group", 1))
         env["TUNE_SCATTER"] = cfg.get("scatter", "bt")
+        env["DSDDMM_CHUNK"] = str(cfg.get("chunk", 128))
         if cfg.get("fused_only"):
             env["TUNE_FUSED_ONLY"] = "1"
     proc = subprocess.Popen(

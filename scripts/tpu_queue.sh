@@ -54,17 +54,33 @@ while [ "$(date +%s)" -lt "$DEADLINE" ]; do
   fi
   if healthy_pallas; then
     echo "[queue] $(date +%H:%M:%S) TPU fully healthy (pallas ok)"
-    run_step python scripts/kernel_sweep.py \
-      scripts/plans/group_probe.json KERNELS_TPU.jsonl --timeout 900 --retries 1 \
-      || { sleep 300; continue; }
-    run_step python scripts/kernel_sweep.py \
-      scripts/plans/star_sweep.json KERNELS_TPU.jsonl --timeout 1500 --retries 1 \
-      || { sleep 300; continue; }
+    # Sweep steps are resumable and retry internally; a PARTIAL failure
+    # (rc=1 with some configs done) must not trap the queue re-probing the
+    # same pathological config before later steps ever run. Record the
+    # failure, finish the rest of the pipeline, then cycle back so only the
+    # missing configs re-run. A Mosaic-tier outage mid-pipeline is caught by
+    # the re-probe before tpu_apps and routes back to the tier gates.
+    failed=""
     run_step python scripts/kernel_sweep.py \
       scripts/plans/scatter_probe.json KERNELS_TPU.jsonl --timeout 900 --retries 1 \
-      || { sleep 300; continue; }
+      || failed=1
+    run_step python scripts/kernel_sweep.py \
+      scripts/plans/chunk_probe.json KERNELS_TPU.jsonl --timeout 900 --retries 1 \
+      || failed=1
+    run_step python scripts/kernel_sweep.py \
+      scripts/plans/group_probe.json KERNELS_TPU.jsonl --timeout 900 --retries 1 \
+      || failed=1
+    run_step python scripts/kernel_sweep.py \
+      scripts/plans/star_sweep.json KERNELS_TPU.jsonl --timeout 1500 --retries 1 \
+      || failed=1
+    if [ -n "$failed" ] && ! healthy_pallas; then continue; fi
     run_step timeout 7200 python scripts/tpu_apps.py \
       || { sleep 300; continue; }
+    if [ -n "$failed" ]; then
+      echo "[queue] sweep steps had failures; cycling to retry missing configs"
+      sleep 300
+      continue
+    fi
     echo "[queue] all steps complete"
     break
   fi

@@ -115,6 +115,7 @@ def main():
         rec = {"kernel": "pallas-bf16", "logM": log_m, "npr": npr, "R": R,
                "bm": meta.bm, "bn": meta.bn, "n_chunks": meta.n_chunks,
                "group": meta.group, "scatter_form": SCATTER_FORM,
+               "chunk": CHUNK,
                "occupancy": round(occ, 3),
                "fused_pair_ms": t_f * 1e3,
                "sddmm_ms": t_s and t_s * 1e3, "spmm_ms": t_m and t_m * 1e3,
