@@ -1,0 +1,152 @@
+"""Measure the tile-kernel vs distributed-program gap on one chip.
+
+The headline bench times the FULL 1.5D dense-shift fused program (shard_map
+ring + relayouts + the Pallas tile kernel); scripts/tune_blocks.py times the
+bare tile kernel. Round 2 recorded 47 GFLOP/s for the former when the latter
+measured 73 — this script pins down how much of that gap remains by timing
+both in ONE process on the same matrix at the tuned kernel config, plus the
+transpose/pad relayouts (`PallasKernel.prep`) alone.
+
+Appends one JSON record to DIST_GAP.jsonl. Resumable: skips when a record
+for the current (logM, npr, R, group, blocks, scatter, chunk) exists.
+
+Usage: python scripts/dist_gap.py [logM npr R trials]
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import pathlib
+import sys
+
+REPO = pathlib.Path(__file__).resolve().parents[1]
+sys.path.insert(0, str(REPO))
+
+import numpy as np
+
+OUT = REPO / "DIST_GAP.jsonl"
+
+
+def _apply_tuned_env(log_m: int, npr: int, R: int) -> None:
+    """Measure the SAME kernel config the headline bench would run: apply
+    bench.py's best-measured env overrides (explicit env still wins). Must
+    run before the package import — the knobs snapshot at import time."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location("bench", REPO / "bench.py")
+    bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+    os.environ.setdefault("BENCH_LOG_M", str(log_m))
+    os.environ.setdefault("BENCH_NNZ_PER_ROW", str(npr))
+    os.environ.setdefault("BENCH_R", str(R))
+    tuned = bench._best_measured_env() or {}
+    for k, v in tuned.items():
+        os.environ.setdefault(k, v)
+
+
+def main() -> int:
+    log_m = int(sys.argv[1]) if len(sys.argv) > 1 else 16
+    npr = int(sys.argv[2]) if len(sys.argv) > 2 else 32
+    R = int(sys.argv[3]) if len(sys.argv) > 3 else 128
+    trials = int(sys.argv[4]) if len(sys.argv) > 4 else 5
+    _apply_tuned_env(log_m, npr, R)
+
+    import jax
+    import jax.numpy as jnp
+
+    from distributed_sddmm_tpu.bench.kernels import _chain_time
+    from distributed_sddmm_tpu.common import MatMode
+    from distributed_sddmm_tpu.ops.blocked import (
+        CHUNK, DEFAULT_BLOCK_COLS, DEFAULT_BLOCK_ROWS, DEFAULT_GROUP,
+        build_blocked,
+    )
+    from distributed_sddmm_tpu.ops.pallas_kernels import BlockedTile, PallasKernel
+    from distributed_sddmm_tpu.parallel.dense_shift_15d import DenseShift15D
+    from distributed_sddmm_tpu.utils.coo import HostCOO
+
+    kern = PallasKernel()
+    cfg = {
+        "logM": log_m, "npr": npr, "R": R,
+        "blocks": f"{DEFAULT_BLOCK_ROWS}x{DEFAULT_BLOCK_COLS}",
+        "group": DEFAULT_GROUP, "scatter_form": kern.scatter_form,
+        "chunk": CHUNK, "backend": jax.default_backend(),
+    }
+    if OUT.exists():
+        for line in OUT.read_text().splitlines():
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if all(rec.get(k) == v for k, v in cfg.items()):
+                print(f"skip (done): {cfg}", flush=True)
+                return 0
+
+    S = HostCOO.rmat(log_m=log_m, edge_factor=npr, seed=0)
+    flops_pair = 2.0 * S.nnz * 2.0 * R
+    rng = np.random.default_rng(0)
+
+    # --- bare tile kernel (tune_blocks.py's measurement) ----------------- #
+    meta = build_blocked(
+        1, np.zeros(S.nnz, np.int64), S.rows, S.cols, S.M, S.N,
+        block_rows=DEFAULT_BLOCK_ROWS, block_cols=DEFAULT_BLOCK_COLS,
+        group=DEFAULT_GROUP,
+    )
+    blk = BlockedTile(
+        lr=jnp.array(meta.lr[0]), lc=jnp.array(meta.lc[0]),
+        meta=jnp.array(meta.meta[0]), bm=meta.bm, bn=meta.bn,
+        gr_blocks=meta.gr_blocks, gc_blocks=meta.gc_blocks, group=meta.group,
+    )
+    vals_np = np.zeros(meta.n_chunks * CHUNK, np.float32)
+    vals_np[meta.host_to_chunk] = 1.0
+    cvals = jnp.array(vals_np)
+    A = jnp.array(rng.standard_normal((S.M, R)), jnp.float32)
+    B = jnp.array(rng.standard_normal((S.N, R)), jnp.float32)
+
+    def tile_step(state):
+        Bs, _ = state
+        o, _mid = kern.fused_tile(blk, cvals, A, Bs)
+        return (Bs + o[: S.N] * 1e-12, _)
+
+    t_tile = _chain_time(tile_step, (B, cvals), trials)
+
+    # --- relayouts alone (prep A + prep B) ------------------------------- #
+    # Both operands ride the loop carry: a closure-constant prep would be
+    # hoisted out of the timed fori_loop by XLA's invariant code motion.
+    def prep_step(state):
+        As, Bs = state
+        at = kern.prep(As, meta.rows_pad)
+        bt = kern.prep(Bs, meta.cols_pad)
+        s = at.astype(jnp.float32).sum() + bt.astype(jnp.float32).sum()
+        return (As + s * 1e-30, Bs + s * 1e-30)
+
+    t_prep = _chain_time(prep_step, (A, B), trials)
+
+    # --- full distributed fused program (bench.py's measurement) --------- #
+    alg = DenseShift15D(S, R=R, c=1, fusion_approach=2, kernel=kern)
+    Ad = alg.dummy_initialize(MatMode.A)
+    Bd = alg.like_b_matrix(0.01)
+    pair = alg.fused_program(alg.like_s_values(1.0), MatMode.A)
+
+    def dist_step(state):
+        Ab, _ = state
+        out, _mid = pair(Ab, Bd)
+        return (Ab + out * 1e-12, _)
+
+    t_dist = _chain_time(dist_step, (Ad, cvals), trials)
+
+    rec = dict(cfg)
+    rec.update(
+        tile_ms=t_tile * 1e3, dist_ms=t_dist * 1e3, prep_ms=t_prep * 1e3,
+        tile_gflops=flops_pair / t_tile / 1e9,
+        dist_gflops=flops_pair / t_dist / 1e9,
+        dist_over_tile=t_dist / t_tile,
+    )
+    with OUT.open("a") as f:
+        f.write(json.dumps(rec) + "\n")
+    print(json.dumps(rec), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    main()

@@ -74,6 +74,7 @@ while [ "$(date +%s)" -lt "$DEADLINE" ]; do
       scripts/plans/star_sweep.json KERNELS_TPU.jsonl --timeout 1500 --retries 1 \
       || failed=1
     if [ -n "$failed" ] && ! healthy_pallas; then continue; fi
+    run_step timeout 1800 python scripts/dist_gap.py || true
     run_step timeout 7200 python scripts/tpu_apps.py \
       || { sleep 300; continue; }
     if [ -n "$failed" ]; then
