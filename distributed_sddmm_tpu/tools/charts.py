@@ -137,10 +137,62 @@ def heatmap_winner(records) -> dict:
     return {f"R={k[0]},c={k[1]}": v[0] for k, v in sorted(best.items(), key=str)}
 
 
+# Fixed per-kernel colors (identity encoding): colorblind-safe blue/orange
+# pair, assigned by entity, never by position in the file.
+_KERNEL_COLORS = {"xla": "#4477AA", "pallas": "#EE7733"}
+
+
+def _kernel_points(records) -> dict:
+    """(logM, npr, R) -> {kernel: best fused-pair GFLOP/s} from
+    KERNELS_TPU.jsonl records, skipping partial/malformed lines."""
+    points: dict = collections.OrderedDict()
+    for rec in records:
+        g = rec.get("fused_pair_gflops")
+        key = (rec.get("logM"), rec.get("npr"), rec.get("R"))
+        if g is None or any(v is None for v in key):
+            continue
+        kern = "pallas" if str(rec.get("kernel", "")).startswith("pallas") else "xla"
+        # Best record per (grid point, kernel): probes rerun configs.
+        points.setdefault(key, {})
+        points[key][kern] = max(points[key].get(kern, 0.0), g)
+    return points
+
+
+def kernels_chart(records, ax) -> bool:
+    """XLA-vs-Pallas fused-pair GFLOP/s grouped by sweep grid point
+    (KERNELS_TPU.jsonl schema from scripts/kernel_sweep.py; reference
+    analog: the `local_kernel_benchmark.cpp:264-267` table)."""
+    points = _kernel_points(records)
+    if not points:
+        return False
+    keys = sorted(points)
+    width = 0.38
+    for i, kern in enumerate(("xla", "pallas")):
+        xs = [k + (i - 0.5) * width for k in range(len(keys))]
+        ys = [points[k].get(kern, 0.0) for k in keys]
+        bars = ax.bar(xs, ys, width=width * 0.94, color=_KERNEL_COLORS[kern],
+                      label=kern, zorder=2)
+        for rect, y in zip(bars, ys):
+            if y:
+                ax.annotate(f"{y:.0f}", (rect.get_x() + rect.get_width() / 2, y),
+                            ha="center", va="bottom", fontsize=6, color="#444444")
+    ax.set_xticks(range(len(keys)),
+                  [f"2^{m}\n{n}/row\nR={r}" for m, n, r in keys], fontsize=7)
+    ax.set_ylabel("fused-pair GFLOP/s")
+    ax.set_title("Local kernel sweep: XLA vs Pallas (single chip)")
+    ax.legend(frameon=False)
+    ax.grid(axis="y", color="#dddddd", linewidth=0.6, zorder=0)
+    ax.spines[["top", "right"]].set_visible(False)
+    return True
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("results", help="JSON-lines results file from the harness")
     ap.add_argument("-o", "--out-dir", default="charts")
+    ap.add_argument("--kernels", action="store_true",
+                    help="results file is a KERNELS_TPU.jsonl kernel sweep; "
+                         "render the XLA-vs-Pallas comparison instead")
     args = ap.parse_args(argv)
 
     records = load_records(args.results)
@@ -155,6 +207,17 @@ def main(argv=None) -> int:
 
     matplotlib.use("Agg")
     import matplotlib.pyplot as plt
+
+    if args.kernels:
+        n_points = len(_kernel_points(records))
+        fig, ax = plt.subplots(figsize=(max(6.0, 1.6 * n_points), 4.5))
+        if not kernels_chart(records, ax):
+            print("no kernel-sweep records found", file=sys.stderr)
+            return 1
+        fig.tight_layout()
+        fig.savefig(out / "kernels.png", dpi=150)
+        print(f"wrote {out / 'kernels.png'}")
+        return 0
 
     fig, axes = plt.subplots(1, 3, figsize=(17, 5))
     throughput_chart(records, axes[0])

@@ -96,6 +96,32 @@ class TestCharts:
         src.write_text("")
         assert charts.main([str(src)]) == 1
 
+    def test_kernels_mode(self, tmp_path):
+        records = [
+            {"kernel": "xla", "logM": 14, "npr": 32, "R": 128,
+             "fused_pair_gflops": 16.0},
+            {"kernel": "pallas-bf16", "logM": 14, "npr": 32, "R": 128,
+             "bm": 512, "bn": 512, "group": 4, "fused_pair_gflops": 80.0},
+            # second record for the same (point, kernel): best one wins
+            {"kernel": "pallas-bf16", "logM": 14, "npr": 32, "R": 128,
+             "bm": 256, "bn": 512, "group": 1, "fused_pair_gflops": 40.0},
+            {"kernel": "pallas-bf16", "logM": 16, "npr": 32, "R": 128,
+             "bm": 512, "bn": 512, "group": 4, "fused_pair_gflops": 70.0},
+        ]
+        src = tmp_path / "k.jsonl"
+        with open(src, "w") as f:
+            for r in records:
+                f.write(json.dumps(r) + "\n")
+        rc = charts.main([str(src), "--kernels", "-o", str(tmp_path / "out")])
+        assert rc == 0
+        assert (tmp_path / "out" / "kernels.png").exists()
+        # A harness-records file in --kernels mode has nothing to plot.
+        src2 = tmp_path / "h.jsonl"
+        src2.write_text(json.dumps({"algorithm": "15d_sparse",
+                                    "overall_throughput": 1.0}) + "\n")
+        assert charts.main([str(src2), "--kernels",
+                            "-o", str(tmp_path / "out2")]) == 1
+
 
 class TestKernelSweepCLI:
     def test_tiny_sweep_smoke(self, capsys):

@@ -123,3 +123,66 @@ def test_cli_verify(capsys):
     assert main(["verify", "--log-m", "6", "--edge-factor", "4",
                  "--R", "16", "--c", "2"]) == 0
     assert "OK" in capsys.readouterr().out
+
+
+class TestBestMeasuredEnv:
+    """bench.py steers the headline measurement from KERNELS_TPU.jsonl; the
+    selection must pick the fastest matching Pallas record and tolerate
+    junk/missing files."""
+
+    def _bench(self):
+        import importlib.util
+        import pathlib
+
+        root = pathlib.Path(__file__).resolve().parents[1]
+        spec = importlib.util.spec_from_file_location("bench_mod", root / "bench.py")
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        return mod
+
+    def test_picks_fastest_matching_record(self, tmp_path, monkeypatch):
+        bench = self._bench()
+        recs = [
+            {"kernel": "xla", "logM": 16, "npr": 32, "R": 128,
+             "fused_pair_gflops": 999.0},  # wrong kernel — ignored
+            {"kernel": "pallas-bf16", "logM": 14, "npr": 32, "R": 128,
+             "bm": 512, "bn": 512, "group": 8,
+             "fused_pair_gflops": 500.0},  # wrong grid point — ignored
+            {"kernel": "pallas-bf16", "logM": 16, "npr": 32, "R": 128,
+             "bm": 512, "bn": 512, "group": 1,
+             "fused_pair_gflops": 60.0},
+            {"kernel": "pallas-bf16", "logM": 16, "npr": 32, "R": 128,
+             "bm": 256, "bn": 512, "group": 4, "scatter_form": "nt",
+             "chunk": 256, "fused_pair_gflops": 90.0},
+            "not json at all",
+        ]
+        p = tmp_path / "KERNELS_TPU.jsonl"
+        p.write_text(
+            "\n".join(r if isinstance(r, str) else json.dumps(r) for r in recs)
+        )
+        # _best_measured_env resolves the JSONL next to bench.__file__ at
+        # call time; repoint only the module, never the shared os.path.
+        monkeypatch.setattr(bench, "__file__", str(tmp_path / "bench.py"))
+        for var in ("BENCH_LOG_M", "BENCH_NNZ_PER_ROW", "BENCH_R"):
+            monkeypatch.delenv(var, raising=False)
+        env = bench._best_measured_env()
+        assert env == {
+            "DSDDMM_BLOCK_ROWS": "256",
+            "DSDDMM_BLOCK_COLS": "512",
+            "DSDDMM_CHUNK_GROUP": "4",
+            "DSDDMM_SCATTER_FORM": "nt",
+            "DSDDMM_CHUNK": "256",
+        }
+
+    def test_missing_file_and_no_match(self, tmp_path, monkeypatch):
+        bench = self._bench()
+        monkeypatch.setattr(bench, "__file__", str(tmp_path / "bench.py"))
+        for var in ("BENCH_LOG_M", "BENCH_NNZ_PER_ROW", "BENCH_R"):
+            monkeypatch.delenv(var, raising=False)
+        assert bench._best_measured_env() is None  # no file
+        (tmp_path / "KERNELS_TPU.jsonl").write_text(
+            json.dumps({"kernel": "pallas-bf16", "logM": 11, "npr": 2,
+                        "R": 8, "bm": 512, "bn": 512,
+                        "fused_pair_gflops": 5.0}) + "\n"
+        )
+        assert bench._best_measured_env() is None  # no matching grid point
