@@ -36,12 +36,18 @@ _CATEGORY = {
 
 
 def load_records(path: str) -> list:
+    """Load JSON-lines records, skipping malformed lines — the producers
+    append under hard-kill timeouts, so a truncated tail line is normal."""
     records = []
     with open(path) as f:
         for line in f:
             line = line.strip()
-            if line:
+            if not line:
+                continue
+            try:
                 records.append(json.loads(line))
+            except json.JSONDecodeError:
+                print(f"skipping malformed line: {line[:60]!r}", file=sys.stderr)
     return records
 
 
@@ -149,20 +155,21 @@ def _kernel_points(records) -> dict:
     for rec in records:
         g = rec.get("fused_pair_gflops")
         key = (rec.get("logM"), rec.get("npr"), rec.get("R"))
-        if g is None or any(v is None for v in key):
+        if g is None or any(v is None for v in key) or "kernel" not in rec:
             continue
-        kern = "pallas" if str(rec.get("kernel", "")).startswith("pallas") else "xla"
+        kern = "pallas" if str(rec["kernel"]).startswith("pallas") else "xla"
         # Best record per (grid point, kernel): probes rerun configs.
         points.setdefault(key, {})
         points[key][kern] = max(points[key].get(kern, 0.0), g)
     return points
 
 
-def kernels_chart(records, ax) -> bool:
+def kernels_chart(records, ax, points=None) -> bool:
     """XLA-vs-Pallas fused-pair GFLOP/s grouped by sweep grid point
     (KERNELS_TPU.jsonl schema from scripts/kernel_sweep.py; reference
     analog: the `local_kernel_benchmark.cpp:264-267` table)."""
-    points = _kernel_points(records)
+    if points is None:
+        points = _kernel_points(records)
     if not points:
         return False
     keys = sorted(points)
@@ -209,9 +216,9 @@ def main(argv=None) -> int:
     import matplotlib.pyplot as plt
 
     if args.kernels:
-        n_points = len(_kernel_points(records))
-        fig, ax = plt.subplots(figsize=(max(6.0, 1.6 * n_points), 4.5))
-        if not kernels_chart(records, ax):
+        points = _kernel_points(records)
+        fig, ax = plt.subplots(figsize=(max(6.0, 1.6 * len(points)), 4.5))
+        if not kernels_chart(records, ax, points):
             print("no kernel-sweep records found", file=sys.stderr)
             return 1
         fig.tight_layout()

@@ -1,0 +1,85 @@
+"""Resume-key contract of the TPU kernel-sweep orchestrator.
+
+scripts/kernel_sweep.py resumes by matching each plan config's
+``config_key`` against ``record_key`` of the records tune_blocks.py emits.
+A silent mismatch makes a config re-run on every queue cycle (burning the
+flaky TPU window) or — worse — skip as spuriously "done". This test builds
+the record each worker invocation WOULD emit (same env-default rules) for
+every config of every checked-in plan and asserts the keys round-trip.
+"""
+
+import importlib.util
+import json
+import pathlib
+
+import pytest
+
+ROOT = pathlib.Path(__file__).resolve().parents[1]
+
+
+def _sweep():
+    spec = importlib.util.spec_from_file_location(
+        "kernel_sweep", ROOT / "scripts" / "kernel_sweep.py"
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _worker_record(cfg: dict) -> dict:
+    """The record tune_blocks.py emits for this config (env-default rules
+    mirrored from kernel_sweep.run_worker + tune_blocks.main)."""
+    rec = {"logM": cfg["logM"], "npr": cfg["npr"], "R": cfg["R"]}
+    if cfg["kernel"] == "xla":
+        rec["kernel"] = "xla"
+    else:
+        rec["kernel"] = "pallas-bf16"
+        bm, bn = (int(x) for x in cfg.get("blocks", "512x512").split("x"))
+        rec.update(
+            bm=bm, bn=bn, group=cfg.get("group", 1),
+            scatter_form=cfg.get("scatter", "bt"),
+            chunk=cfg.get("chunk", 128),
+        )
+    return rec
+
+
+def plan_configs():
+    for plan in sorted((ROOT / "scripts" / "plans").glob("*.json")):
+        for cfg in json.loads(plan.read_text()):
+            yield pytest.param(cfg, id=f"{plan.stem}-{json.dumps(cfg, sort_keys=True)[:60]}")
+
+
+@pytest.mark.parametrize("cfg", plan_configs())
+def test_plan_config_roundtrips(cfg):
+    sweep = _sweep()
+    assert sweep.config_key(cfg) == sweep.record_key(_worker_record(cfg))
+
+
+def test_legacy_records_still_match():
+    """Records written before the scatter_form/chunk fields existed must
+    keep matching their plan configs (or the queue re-runs finished work)."""
+    sweep = _sweep()
+    legacy = {"kernel": "pallas-bf16", "logM": 16, "npr": 32, "R": 128,
+              "bm": 512, "bn": 512, "group": 4}
+    cfg = {"kernel": "pallas", "logM": 16, "npr": 32, "R": 128,
+           "blocks": "512x512", "group": 4}
+    assert sweep.record_key(legacy) == sweep.config_key(cfg)
+    legacy_xla = {"kernel": "xla", "logM": 16, "npr": 32, "R": 128}
+    cfg_xla = {"kernel": "xla", "logM": 16, "npr": 32, "R": 128}
+    assert sweep.record_key(legacy_xla) == sweep.config_key(cfg_xla)
+
+
+def test_checked_in_records_parse():
+    """Every line of the committed KERNELS_TPU.jsonl must be consumable by
+    the resume scan (done_keys silently drops broken lines — a typo'd
+    record would re-run its config forever)."""
+    sweep = _sweep()
+    path = ROOT / "KERNELS_TPU.jsonl"
+    if not path.exists():
+        pytest.skip("no sweep records yet")
+    lines = [l for l in path.read_text().splitlines() if l.strip()]
+    keys = sweep.done_keys(path)
+    assert len(keys) >= 1
+    for line in lines:
+        rec = json.loads(line)  # must all be valid JSON
+        assert sweep.record_key(rec) in keys
