@@ -59,12 +59,25 @@ def main() -> int:
     import os
 
     xla_only = os.environ.get("APPS_XLA_ONLY", "") not in ("", "0")
+    # APPS_SUBSET splits the plan so the queue can land the short
+    # application benches (the round-directive evidence) inside a brief
+    # tunnel-health window before committing to the longer heatmap sweep:
+    # "apps" = ALS/GAT only, "heatmap" = vanilla R-sweep only, "all".
+    subset = os.environ.get("APPS_SUBSET", "all")
+    if subset not in ("apps", "heatmap", "all"):
+        print(f"unknown APPS_SUBSET={subset!r} (want apps|heatmap|all)",
+              file=sys.stderr)
+        return 2
     done = done_keys()
     mats: dict = {}
     failures = 0
     for app, alg, log_m, npr, R, kern, trials in PLAN:
         if xla_only and kern != "xla":
             continue  # Mosaic compile service down; run the XLA half
+        if subset == "apps" and app == "vanilla":
+            continue
+        if subset == "heatmap" and app != "vanilla":
+            continue
         key = (app, alg, log_m, npr, R, kern)
         if key in done:
             print(f"skip (done): {key}", flush=True)
