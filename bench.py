@@ -147,6 +147,7 @@ def _best_measured_env() -> dict | None:
         "DSDDMM_CHUNK_GROUP": str(best.get("group", 1)),
         "DSDDMM_SCATTER_FORM": best.get("scatter_form", "bt"),
         "DSDDMM_CHUNK": str(best.get("chunk", 128)),
+        "DSDDMM_BATCH_STEP": "1" if best.get("batch_step") else "0",
     }
 
 
@@ -230,6 +231,7 @@ def main() -> None:
         "DSDDMM_BLOCK_COLS": os.environ.get("DSDDMM_BLOCK_COLS", "512"),
         "DSDDMM_SCATTER_FORM": os.environ.get("DSDDMM_SCATTER_FORM", "bt"),
         "DSDDMM_CHUNK": os.environ.get("DSDDMM_CHUNK", "128"),
+        "DSDDMM_BATCH_STEP": os.environ.get("DSDDMM_BATCH_STEP", "0"),
         **attempts[0][0],
     }
     if tuned is not None and tuned != first_rung_effective:

@@ -101,31 +101,133 @@ def _meta_gc(m, t):
 
 
 def _gathered(dense_ref, loc_row):
-    """Gather the chunk's rows of a feature-major block via one-hot MXU.
+    """Gather rows of a feature-major block via one-hot MXU.
 
-    Returns ``(one_hotT [block, CHUNK], rows_T [R, CHUNK])``."""
+    ``loc_row`` is ``[1, W]`` (W = CHUNK, or G*CHUNK on the step-batched
+    path). Returns ``(one_hotT [block, W], rows_T [R, W])``."""
     ohT = (
-        jax.lax.broadcasted_iota(jnp.int32, (dense_ref.shape[1], CHUNK), 0)
+        jax.lax.broadcasted_iota(
+            jnp.int32, (dense_ref.shape[1], loc_row.shape[1]), 0
+        )
         == loc_row
     ).astype(dense_ref.dtype)
     return ohT, _dotg(dense_ref[:], ohT, 1, 0)
 
 
 def _scattered(scT, ohT_r, loc_row, bm, form):
-    """Scatter-add contribution ``[R, CHUNK] -> [R, BM]`` via one-hot MXU.
+    """Scatter-add contribution ``[R, W] -> [R, BM]`` via one-hot MXU
+    (``W`` = CHUNK, or G*CHUNK on the step-batched path).
 
     ``form`` selects the contraction orientation: "bt" contracts the gather
     one-hot's lane axis (an A.B^T-shaped dot_general, reusing ``ohT_r``);
     "nt" builds the one-hot already transposed (lane axis = BM) from a
     sublane-relayouted index vector, so the MXU sees a natural A.B
-    contraction and Mosaic never has to transpose a [BM, CHUNK] operand."""
+    contraction and Mosaic never has to transpose a [BM, W] operand."""
     if form == "bt":
         return _dotg(scT, ohT_r, 1, 1)
+    w = scT.shape[1]
     oh = (
-        jax.lax.broadcasted_iota(jnp.int32, (CHUNK, bm), 1)
-        == loc_row.reshape(CHUNK, 1)
+        jax.lax.broadcasted_iota(jnp.int32, (w, bm), 1)
+        == loc_row.reshape(w, 1)
     ).astype(scT.dtype)
     return _dotg(scT, oh, 1, 0)
+
+
+def _lane_concat(ref, G):
+    """[1, G, CHUNK] chunk-data block -> [1, G*CHUNK] along lanes."""
+    if G == 1:
+        return ref[0, 0:1]
+    return jnp.concatenate([ref[0, j : j + 1] for j in range(G)], axis=1)
+
+
+def _step_boundaries(meta_ref, acc_ref, t, G):
+    """Step-batched zero/flush: the group alignment of ``build_blocked``
+    puts every (bucket, gr) group on whole-step boundaries, so the zero
+    flag can only sit on the step's FIRST chunk and the flush flag only on
+    its LAST."""
+
+    @pl.when((meta_ref[t * G] & 1) == 1)
+    def _():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    return ((meta_ref[t * G + G - 1] >> 1) & 1) == 1
+
+
+def _make_fused_body_batched(G, form):
+    def body(meta_ref, lr_ref, lc_ref, sv_ref, at_ref, *rest):
+        bt_refs = rest[:G]
+        out_ref, mid_ref, acc_ref = rest[G], rest[G + 1], rest[G + 2]
+        t = pl.program_id(0)
+        bm = out_ref.shape[1]
+        last = _step_boundaries(meta_ref, acc_ref, t, G)
+        lr_all = _lane_concat(lr_ref, G)
+        ohT_all, a_rT = _gathered(at_ref, lr_all)
+        b_rT = jnp.concatenate(
+            [_gathered(bt_refs[j], lc_ref[0, j : j + 1])[1] for j in range(G)],
+            axis=1,
+        ) if G > 1 else _gathered(bt_refs[0], lc_ref[0, 0:1])[1]
+        sv_all = _lane_concat(sv_ref, G)
+        dots = jnp.sum(a_rT * b_rT, axis=0, keepdims=True) * sv_all
+        for j in range(G):
+            mid_ref[0, j : j + 1] = dots[:, j * CHUNK : (j + 1) * CHUNK]
+        scT = (b_rT * dots).astype(at_ref.dtype)
+        acc_ref[:] += _scattered(scT, ohT_all, lr_all, bm, form)
+
+        @pl.when(last)
+        def _():
+            out_ref[:] = acc_ref[:]
+
+    return body
+
+
+def _make_spmm_body_batched(G, form):
+    def body(meta_ref, lr_ref, lc_ref, sv_ref, *rest):
+        bt_refs = rest[:G]
+        out_ref, acc_ref = rest[G], rest[G + 1]
+        t = pl.program_id(0)
+        bm = out_ref.shape[1]
+        last = _step_boundaries(meta_ref, acc_ref, t, G)
+        lr_all = _lane_concat(lr_ref, G)
+        b_rT = jnp.concatenate(
+            [_gathered(bt_refs[j], lc_ref[0, j : j + 1])[1] for j in range(G)],
+            axis=1,
+        ) if G > 1 else _gathered(bt_refs[0], lc_ref[0, 0:1])[1]
+        sv_all = _lane_concat(sv_ref, G)
+        scT = (b_rT * sv_all).astype(bt_refs[0].dtype)
+        if form == "bt":
+            ohT_all = (
+                jax.lax.broadcasted_iota(
+                    jnp.int32, (bm, G * CHUNK), 0
+                )
+                == lr_all
+            ).astype(scT.dtype)
+        else:
+            ohT_all = None
+        acc_ref[:] += _scattered(scT, ohT_all, lr_all, bm, form)
+
+        @pl.when(last)
+        def _():
+            out_ref[:] = acc_ref[:]
+
+    return body
+
+
+def _make_sddmm_body_batched(G):
+    def body(meta_ref, lr_ref, lc_ref, sv_ref, at_ref, *rest):
+        bt_refs = rest[:G]
+        mid_ref = rest[G]
+        lr_all = _lane_concat(lr_ref, G)
+        _, a_rT = _gathered(at_ref, lr_all)
+        b_rT = jnp.concatenate(
+            [_gathered(bt_refs[j], lc_ref[0, j : j + 1])[1] for j in range(G)],
+            axis=1,
+        ) if G > 1 else _gathered(bt_refs[0], lc_ref[0, 0:1])[1]
+        sv_all = _lane_concat(sv_ref, G)
+        dots = jnp.sum(a_rT * b_rT, axis=0, keepdims=True) * sv_all
+        for j in range(G):
+            mid_ref[0, j : j + 1] = dots[:, j * CHUNK : (j + 1) * CHUNK]
+
+    return body
 
 
 def _sub_boundaries(meta_ref, acc_ref, t, G, j):
@@ -208,12 +310,12 @@ def _make_spmm_body(G, form):
     jax.jit,
     static_argnames=(
         "op", "bm", "bn", "gr_blocks", "gc_blocks", "group", "interpret",
-        "scatter_form",
+        "scatter_form", "batch_step",
     ),
 )
 def _tile_call(
     meta, lr, lc, sv, at, bt, op, bm, bn, gr_blocks, gc_blocks, group,
-    interpret, scatter_form="bt",
+    interpret, scatter_form="bt", batch_step=False,
 ):
     """Launch one chunk-list kernel. ``at``/``bt`` are feature-major padded
     dense operands [R, gr_blocks*bm] / [R, gc_blocks*bn]; ``sv`` is the
@@ -243,18 +345,24 @@ def _tile_call(
     mid_shape = jax.ShapeDtypeStruct((steps, G, CHUNK), jnp.float32)
 
     if op == "fused":
-        body = _make_fused_body(G, scatter_form)
+        body = (_make_fused_body_batched if batch_step else _make_fused_body)(
+            G, scatter_form
+        )
         in_specs = [chunk_spec, chunk_spec, chunk_spec, at_spec, *bt_specs]
         operands = (lr3, lc3, sv3, at, *([bt] * G))
         out_specs, out_shapes = [out_spec, chunk_spec], [out_shape, mid_shape]
         scratch = [pltpu.VMEM((R, bm), jnp.float32)]
     elif op == "sddmm":
-        body = _make_sddmm_body(G)
+        body = (
+            _make_sddmm_body_batched(G) if batch_step else _make_sddmm_body(G)
+        )
         in_specs = [chunk_spec, chunk_spec, chunk_spec, at_spec, *bt_specs]
         operands = (lr3, lc3, sv3, at, *([bt] * G))
         out_specs, out_shapes, scratch = [chunk_spec], [mid_shape], []
     elif op == "spmm":
-        body = _make_spmm_body(G, scatter_form)
+        body = (_make_spmm_body_batched if batch_step else _make_spmm_body)(
+            G, scatter_form
+        )
         in_specs = [chunk_spec, chunk_spec, chunk_spec, *bt_specs]
         operands = (lr3, lc3, sv3, *([bt] * G))
         out_specs, out_shapes = [out_spec], [out_shape]
@@ -299,16 +407,16 @@ def _flat_indices(geom, meta, lr, lc):
 # don't-cares that the pad positions of value vectors absorb. The integer
 # metadata arrays are explicit arguments with float0 cotangents (custom_vjp
 # must not close over tracers); ``geom`` = (bm, bn, gr_blocks, gc_blocks,
-# group, interpret, scatter_form) rides in nondiff_argnums.
+# group, interpret, scatter_form, batch_step) rides in nondiff_argnums.
 
 
 def _geom_call(geom, op, meta, lr, lc, sv, at, bt):
-    bm, bn, grb, gcb, group, interpret, form = geom
+    bm, bn, grb, gcb, group, interpret, form, batch = geom
     return tuple(
         _tile_call(
             meta, lr, lc, sv, at, bt, op=op, bm=bm, bn=bn,
             gr_blocks=grb, gc_blocks=gcb, group=group, interpret=interpret,
-            scatter_form=form,
+            scatter_form=form, batch_step=batch,
         )
     )
 
@@ -426,6 +534,10 @@ class PallasKernel:
     "nt" (build a transposed one-hot, natural A.B contraction); identical
     numerics, different Mosaic lowering — ``scripts/tune_blocks.py`` probes
     which is faster on hardware. Env default: ``DSDDMM_SCATTER_FORM``.
+    ``batch_step``: batch the stationary-side gather and the scatter across
+    a grid step's G sub-chunks into single [.., G*CHUNK]-wide MXU ops
+    (legal because group alignment pins a step inside one row-block
+    window); identical numerics. Env default: ``DSDDMM_BATCH_STEP``.
     """
 
     is_blocked = True
@@ -435,6 +547,7 @@ class PallasKernel:
         precision: str | None = None,
         interpret: bool | None = None,
         scatter_form: str | None = None,
+        batch_step: bool | None = None,
     ):
         if interpret is None:
             interpret = jax.default_backend() != "tpu"
@@ -447,8 +560,11 @@ class PallasKernel:
             scatter_form = os.environ.get("DSDDMM_SCATTER_FORM", "bt")
         if scatter_form not in ("bt", "nt"):
             raise ValueError(f"scatter_form must be 'bt' or 'nt', got {scatter_form!r}")
+        if batch_step is None:
+            batch_step = os.environ.get("DSDDMM_BATCH_STEP", "0") not in ("", "0")
         self.precision = precision
         self.scatter_form = scatter_form
+        self.batch_step = bool(batch_step)
         self._xla = XlaKernel()
         self.name = f"pallas-{precision}"
 
@@ -493,7 +609,7 @@ class PallasKernel:
     def _geom(self, blk: BlockedTile) -> tuple:
         return (
             blk.bm, blk.bn, blk.gr_blocks, blk.gc_blocks, blk.group,
-            self.interpret, self.scatter_form,
+            self.interpret, self.scatter_form, self.batch_step,
         )
 
     def sddmm_tile_t(self, blk: BlockedTile, vals, at, bt, out_dtype):

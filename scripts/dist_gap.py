@@ -70,7 +70,8 @@ def main() -> int:
         "logM": log_m, "npr": npr, "R": R,
         "blocks": f"{DEFAULT_BLOCK_ROWS}x{DEFAULT_BLOCK_COLS}",
         "group": DEFAULT_GROUP, "scatter_form": kern.scatter_form,
-        "chunk": CHUNK, "backend": jax.default_backend(),
+        "chunk": CHUNK, "batch_step": kern.batch_step,
+        "backend": jax.default_backend(),
     }
     if OUT.exists():
         for line in OUT.read_text().splitlines():

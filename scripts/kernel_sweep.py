@@ -40,6 +40,7 @@ def config_key(cfg: dict) -> tuple:
         cfg.get("blocks", default_blocks), cfg.get("group", 1),
         cfg.get("scatter", "bt") if cfg["kernel"] == "pallas" else "",
         cfg.get("chunk", 128) if cfg["kernel"] == "pallas" else 0,
+        bool(cfg.get("batch")) if cfg["kernel"] == "pallas" else False,
     )
 
 
@@ -52,6 +53,7 @@ def record_key(rec: dict) -> tuple:
         blocks, rec.get("group", 1),
         rec.get("scatter_form", "bt") if is_pallas else "",
         rec.get("chunk", 128) if is_pallas else 0,
+        bool(rec.get("batch_step")) if is_pallas else False,
     )
 
 
@@ -77,6 +79,7 @@ def run_worker(cfg: dict, timeout_s: float) -> list[dict] | None:
         env["TUNE_GROUP"] = str(cfg.get("group", 1))
         env["TUNE_SCATTER"] = cfg.get("scatter", "bt")
         env["DSDDMM_CHUNK"] = str(cfg.get("chunk", 128))
+        env["TUNE_BATCH"] = "1" if cfg.get("batch") else "0"
         if cfg.get("fused_only"):
             env["TUNE_FUSED_ONLY"] = "1"
     proc = subprocess.Popen(

@@ -54,8 +54,8 @@ def main() -> int:
         lines += [
             "## Star sweep",
             "",
-            "| logM | nnz/row | R | kernel | blocks | group | scatter | SDDMM | SpMM | fused pair |",
-            "|---|---|---|---|---|---|---|---|---|---|",
+            "| logM | nnz/row | R | kernel | blocks | group | scatter | batch | SDDMM | SpMM | fused pair |",
+            "|---|---|---|---|---|---|---|---|---|---|---|",
         ]
         for r in sorted(sweep, key=lambda r: (r["logM"], r["npr"], r["R"], r["kernel"])):
             blocks = f"{r['bm']}x{r['bn']}" if "bm" in r else "-"
@@ -63,6 +63,7 @@ def main() -> int:
             lines.append(
                 f"| {r['logM']} | {r['npr']} | {r['R']} | {r['kernel']} "
                 f"| {blocks} | {r.get('group', '-')} | {form} "
+                f"| {'y' if r.get('batch_step') else '-'} "
                 f"| {fmt(r.get('sddmm_gflops'))} | {fmt(r.get('spmm_gflops'))} "
                 f"| {fmt(r.get('fused_pair_gflops'))} |"
             )
@@ -72,15 +73,19 @@ def main() -> int:
         lines += [
             "## Block/group tuning probe (logM=16, nnz/row=32, R=128, fused pair)",
             "",
-            "| blocks | group | scatter | chunks | occupancy | ns/chunk | GFLOP/s |",
-            "|---|---|---|---|---|---|---|",
+            "| blocks | group | scatter | batch | chunk | chunks | occupancy | ns/chunk | GFLOP/s |",
+            "|---|---|---|---|---|---|---|---|---|",
         ]
         for r in sorted(probe, key=lambda r: (r.get("bm", 0), r.get("bn", 0),
                                               r.get("group", 1),
-                                              r.get("scatter_form", "bt"))):
+                                              r.get("scatter_form", "bt"),
+                                              bool(r.get("batch_step")),
+                                              r.get("chunk", 128))):
             lines.append(
                 f"| {r.get('bm')}x{r.get('bn')} | {r.get('group', 1)} "
                 f"| {r.get('scatter_form', 'bt')} "
+                f"| {'y' if r.get('batch_step') else '-'} "
+                f"| {r.get('chunk', 128)} "
                 f"| {r.get('n_chunks')} | {r.get('occupancy')} "
                 f"| {fmt(r.get('fused_ns_per_chunk'))} "
                 f"| {fmt(r.get('fused_pair_gflops'))} |"

@@ -67,6 +67,9 @@ while [ "$(date +%s)" -lt "$DEADLINE" ]; do
     run_step python scripts/kernel_sweep.py \
       scripts/plans/chunk_probe.json KERNELS_TPU.jsonl --timeout 900 --retries 1 \
       || failed=1
+    run_step python scripts/kernel_sweep.py \
+      scripts/plans/batch_probe.json KERNELS_TPU.jsonl --timeout 900 --retries 1 \
+      || failed=1
     # ALS/GAT application records (round-directive evidence with none yet)
     # land before the long sweeps so a short health window still records
     # them. Re-gate on the Mosaic tier first when a probe step failed —

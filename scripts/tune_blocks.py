@@ -35,6 +35,7 @@ if os.environ.get("TUNE_BLOCKS"):
 FUSED_ONLY = bool(os.environ.get("TUNE_FUSED_ONLY"))
 SKIP_XLA = bool(os.environ.get("TUNE_SKIP_XLA"))
 SCATTER_FORM = os.environ.get("TUNE_SCATTER", "bt")
+BATCH_STEP = os.environ.get("TUNE_BATCH", "0") not in ("", "0")
 
 
 def main():
@@ -74,7 +75,7 @@ def main():
                "fused_pair_gflops": 2 * flops / (t_sddmm + t_spmm) / 1e9}
         print(json.dumps(rec), flush=True)
 
-    kernp = PallasKernel(scatter_form=SCATTER_FORM)
+    kernp = PallasKernel(scatter_form=SCATTER_FORM, batch_step=BATCH_STEP)
     for bm_pref, bn_pref in BLOCKS:
         group = int(os.environ.get("TUNE_GROUP", "1"))
         meta = build_blocked(1, np.zeros(S.nnz, np.int64), S.rows, S.cols,
@@ -115,7 +116,7 @@ def main():
         rec = {"kernel": "pallas-bf16", "logM": log_m, "npr": npr, "R": R,
                "bm": meta.bm, "bn": meta.bn, "n_chunks": meta.n_chunks,
                "group": meta.group, "scatter_form": SCATTER_FORM,
-               "chunk": CHUNK,
+               "chunk": CHUNK, "batch_step": BATCH_STEP,
                "occupancy": round(occ, 3),
                "fused_pair_ms": t_f * 1e3,
                "sddmm_ms": t_s and t_s * 1e3, "spmm_ms": t_m and t_m * 1e3,

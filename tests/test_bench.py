@@ -172,6 +172,7 @@ class TestBestMeasuredEnv:
             "DSDDMM_CHUNK_GROUP": "4",
             "DSDDMM_SCATTER_FORM": "nt",
             "DSDDMM_CHUNK": "256",
+            "DSDDMM_BATCH_STEP": "0",
         }
 
     def test_missing_file_and_no_match(self, tmp_path, monkeypatch):

@@ -141,21 +141,27 @@ class TestBlockedMeta:
 
 class TestPallasTileKernels:
     @pytest.mark.parametrize(
-        "precision,tol,group,form",
+        "precision,tol,group,form,batch",
         [
-            ("f32", 1e-5, 1, "bt"),
-            ("bf16", 3e-2, 1, "bt"),
-            ("f32", 1e-5, 4, "bt"),
-            ("f32", 1e-5, 1, "nt"),
-            ("f32", 1e-5, 4, "nt"),
+            ("f32", 1e-5, 1, "bt", False),
+            ("bf16", 3e-2, 1, "bt", False),
+            ("f32", 1e-5, 4, "bt", False),
+            ("f32", 1e-5, 1, "nt", False),
+            ("f32", 1e-5, 4, "nt", False),
+            ("f32", 1e-5, 1, "bt", True),
+            ("f32", 1e-5, 4, "bt", True),
+            ("f32", 1e-5, 4, "nt", True),
+            ("f32", 1e-5, 8, "bt", True),
+            ("bf16", 3e-2, 4, "bt", True),
         ],
     )
-    def test_against_oracle(self, precision, tol, group, form):
+    def test_against_oracle(self, precision, tol, group, form, batch):
         rows, cols, meta, blk, vals, rng = _tile_setup(group=group)
         Mr, Nc, R = 700, 500, 32
         A = rng.standard_normal((Mr, R)).astype(np.float32)
         B = rng.standard_normal((Nc, R)).astype(np.float32)
-        k = PallasKernel(precision=precision, interpret=True, scatter_form=form)
+        k = PallasKernel(precision=precision, interpret=True,
+                         scatter_form=form, batch_step=batch)
         vj, Aj, Bj = jnp.array(vals), jnp.array(A), jnp.array(B)
 
         host_vals = vals[meta.host_to_chunk]

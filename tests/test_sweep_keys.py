@@ -39,6 +39,7 @@ def _worker_record(cfg: dict) -> dict:
             bm=bm, bn=bn, group=cfg.get("group", 1),
             scatter_form=cfg.get("scatter", "bt"),
             chunk=cfg.get("chunk", 128),
+            batch_step=bool(cfg.get("batch")),
         )
     return rec
 
