@@ -121,7 +121,7 @@ def _bench_one(S: HostCOO, R: int, kernel_name: str, trials: int) -> dict:
         t_fused = _chain_time(fused_step, (B, vals), trials)
 
     flops = 2.0 * S.nnz * R
-    return {
+    rec = {
         "M": S.M, "N": S.N, "nnz": S.nnz, "R": R, "kernel": kernel_name,
         "sddmm_ms": t_sddmm * 1e3, "spmm_ms": t_spmm * 1e3,
         "fused_pair_ms": t_fused * 1e3,
@@ -129,6 +129,13 @@ def _bench_one(S: HostCOO, R: int, kernel_name: str, trials: int) -> dict:
         "spmm_gflops": flops / t_spmm / 1e9,
         "fused_pair_gflops": 2 * flops / t_fused / 1e9,
     }
+    if kernel_name != "xla":
+        # Record the active tuning knobs so the table is reproducible.
+        rec.update(
+            bm=meta.bm, bn=meta.bn, group=meta.group, chunk=CHUNK,
+            scatter_form=kern.scatter_form, batch_step=kern.batch_step,
+        )
+    return rec
 
 
 def run_kernel_benchmark(

@@ -130,8 +130,8 @@ def build_blocked(
     local_c: np.ndarray,
     tile_rows: int,
     tile_cols: int,
-    block_rows: int = 512,
-    block_cols: int = 512,
+    block_rows: int | None = None,
+    block_cols: int | None = None,
     group: int = 1,
 ) -> BlockedMeta:
     """Build the chunk-list encoding.
@@ -165,6 +165,10 @@ def build_blocked(
       nothing to the accumulator first), and a flag therefore does NOT
       imply the chunk carries real nonzeros.
     """
+    if block_rows is None:
+        block_rows = DEFAULT_BLOCK_ROWS
+    if block_cols is None:
+        block_cols = DEFAULT_BLOCK_COLS
     bm = pick_block(tile_rows, block_rows)
     bn = pick_block(tile_cols, block_cols)
     gr_blocks = max(-(-tile_rows // bm), 1)

@@ -140,6 +140,24 @@ def _lane_concat(ref, G):
     return jnp.concatenate([ref[0, j : j + 1] for j in range(G)], axis=1)
 
 
+def _gathered_cols(bt_refs, lc_ref, G):
+    """Per-sub-chunk moving-side gathers, lane-concatenated to [R, G*CHUNK]
+    (each sub-chunk has its own bt window, so these cannot batch)."""
+    if G == 1:
+        return _gathered(bt_refs[0], lc_ref[0, 0:1])[1]
+    return jnp.concatenate(
+        [_gathered(bt_refs[j], lc_ref[0, j : j + 1])[1] for j in range(G)],
+        axis=1,
+    )
+
+
+def _write_mid(mid_ref, dots, G):
+    """Scatter the [1, G*CHUNK] dots row back into the [1, G, CHUNK] mid
+    output block, sub-chunk by sub-chunk."""
+    for j in range(G):
+        mid_ref[0, j : j + 1] = dots[:, j * CHUNK : (j + 1) * CHUNK]
+
+
 def _step_boundaries(meta_ref, acc_ref, t, G):
     """Step-batched zero/flush: the group alignment of ``build_blocked``
     puts every (bucket, gr) group on whole-step boundaries, so the zero
@@ -162,14 +180,10 @@ def _make_fused_body_batched(G, form):
         last = _step_boundaries(meta_ref, acc_ref, t, G)
         lr_all = _lane_concat(lr_ref, G)
         ohT_all, a_rT = _gathered(at_ref, lr_all)
-        b_rT = jnp.concatenate(
-            [_gathered(bt_refs[j], lc_ref[0, j : j + 1])[1] for j in range(G)],
-            axis=1,
-        ) if G > 1 else _gathered(bt_refs[0], lc_ref[0, 0:1])[1]
+        b_rT = _gathered_cols(bt_refs, lc_ref, G)
         sv_all = _lane_concat(sv_ref, G)
         dots = jnp.sum(a_rT * b_rT, axis=0, keepdims=True) * sv_all
-        for j in range(G):
-            mid_ref[0, j : j + 1] = dots[:, j * CHUNK : (j + 1) * CHUNK]
+        _write_mid(mid_ref, dots, G)
         scT = (b_rT * dots).astype(at_ref.dtype)
         acc_ref[:] += _scattered(scT, ohT_all, lr_all, bm, form)
 
@@ -188,10 +202,7 @@ def _make_spmm_body_batched(G, form):
         bm = out_ref.shape[1]
         last = _step_boundaries(meta_ref, acc_ref, t, G)
         lr_all = _lane_concat(lr_ref, G)
-        b_rT = jnp.concatenate(
-            [_gathered(bt_refs[j], lc_ref[0, j : j + 1])[1] for j in range(G)],
-            axis=1,
-        ) if G > 1 else _gathered(bt_refs[0], lc_ref[0, 0:1])[1]
+        b_rT = _gathered_cols(bt_refs, lc_ref, G)
         sv_all = _lane_concat(sv_ref, G)
         scT = (b_rT * sv_all).astype(bt_refs[0].dtype)
         if form == "bt":
@@ -218,14 +229,10 @@ def _make_sddmm_body_batched(G):
         mid_ref = rest[G]
         lr_all = _lane_concat(lr_ref, G)
         _, a_rT = _gathered(at_ref, lr_all)
-        b_rT = jnp.concatenate(
-            [_gathered(bt_refs[j], lc_ref[0, j : j + 1])[1] for j in range(G)],
-            axis=1,
-        ) if G > 1 else _gathered(bt_refs[0], lc_ref[0, 0:1])[1]
+        b_rT = _gathered_cols(bt_refs, lc_ref, G)
         sv_all = _lane_concat(sv_ref, G)
         dots = jnp.sum(a_rT * b_rT, axis=0, keepdims=True) * sv_all
-        for j in range(G):
-            mid_ref[0, j : j + 1] = dots[:, j * CHUNK : (j + 1) * CHUNK]
+        _write_mid(mid_ref, dots, G)
 
     return body
 

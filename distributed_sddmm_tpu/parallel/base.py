@@ -318,11 +318,16 @@ class DistributedSparse(abc.ABC):
                 continue
             per_dev = np.asarray(tiles.nnz_per_device).reshape(-1)
             mean = per_dev.mean() if per_dev.size else 0.0
+            # Real entries over padded slots, device-resident copies counted
+            # on both sides — valid for sharded AND replicated tile classes.
+            slots = float(tiles.rows.size)
+            occ = per_dev.sum() / slots if slots else 1.0
             lines.append(
                 f"  {label}: nnz={tiles.nnz}, tile frame "
                 f"{tiles.tile_rows}x{tiles.tile_cols}, padded max_nnz/device="
                 f"{tiles.max_nnz}, load imbalance max/mean="
-                f"{per_dev.max() / mean if mean else 1.0:.3f}"
+                f"{per_dev.max() / mean if mean else 1.0:.3f}, "
+                f"slot occupancy={occ:.3f}"
             )
             shape = np.asarray(tiles.nnz_per_device).shape
             for flat, nnz in enumerate(per_dev):

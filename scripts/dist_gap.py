@@ -8,7 +8,8 @@ both in ONE process on the same matrix at the tuned kernel config, plus the
 transpose/pad relayouts (`PallasKernel.prep`) alone.
 
 Appends one JSON record to DIST_GAP.jsonl. Resumable: skips when a record
-for the current (logM, npr, R, group, blocks, scatter, chunk) exists.
+for the current (logM, npr, R, blocks, group, scatter, chunk, batch,
+backend) configuration exists.
 
 Usage: python scripts/dist_gap.py [logM npr R trials]
 """
