@@ -1,0 +1,61 @@
+"""Worker for the two-process jax.distributed test (test_multiprocess.py).
+
+Each invocation is one "host" of a 2-process CPU pod: it initializes
+jax.distributed against the shared coordinator (the same wiring
+`scripts/run_pod.py` performs on a real pod), builds a strategy over the
+GLOBAL 4-device mesh (2 processes x 2 local devices), runs distributed ops,
+and prints device-computed fingerprints as one JSON line. The parent test
+compares the two processes' fingerprints against each other and against the
+same strategy program run on a single-process 4-device mesh.
+
+Usage: python tests/_mp_worker.py <process_id> <coordinator_port>
+"""
+
+import json
+import os
+import pathlib
+import sys
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parents[1]))
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+
+def main() -> None:
+    pid = int(sys.argv[1])
+    port = int(sys.argv[2])
+    jax.distributed.initialize(
+        coordinator_address=f"127.0.0.1:{port}", num_processes=2, process_id=pid
+    )
+    assert jax.device_count() == 4 and jax.local_device_count() == 2
+
+    import jax.numpy as jnp
+
+    from distributed_sddmm_tpu.common import MatMode
+    from distributed_sddmm_tpu.parallel.dense_shift_15d import DenseShift15D
+    from distributed_sddmm_tpu.utils.coo import HostCOO
+
+    # Identical host data on every process (SPMD ingest contract: the same
+    # seed everywhere, device_put places only the addressable shards).
+    S = HostCOO.erdos_renyi(96, 80, 4, seed=5, values="normal")
+    alg = DenseShift15D(S, R=16, c=2, fusion_approach=2)
+    A = alg.dummy_initialize(MatMode.A)
+    B = alg.dummy_initialize(MatMode.B)
+    out, mid = alg.fused_spmm(A, B, alg.like_s_values(1.0))
+
+    # Device-side fingerprints: jitted global reductions produce replicated
+    # scalars every process can fetch (host gathers would need non-local
+    # shards).
+    fp_out = float(jnp.sum(out * out))
+    fp_mid = float(jnp.sum(mid * mid))
+    print(json.dumps({"pid": pid, "fp_out": fp_out, "fp_mid": fp_mid}),
+          flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -80,14 +80,13 @@ def test_nonzero_distribution_report():
     alg = make_algorithm("15d_fusion2", S, 16, 2, devices=jax.devices()[:8])
     rep = alg.nonzero_distribution_report()
     assert "load imbalance" in rep and "device" in rep
-    # slot occupancy (real nnz / padded chunk-layout slots) is reported and
-    # sane: in (0, 1] for a nonempty matrix.
-    import re as _re
-
-    occs = [float(m) for m in _re.findall(r"slot occupancy=([0-9.]+)", rep)]
-    assert occs and all(0.0 < o <= 1.0 for o in occs)
     # per-device nnz lines must sum to the matrix nnz for S and S^T
     import re
+
+    # slot occupancy (real nnz / padded chunk-layout slots) is reported and
+    # sane: in (0, 1] for a nonempty matrix.
+    occs = [float(m) for m in re.findall(r"slot occupancy=([0-9.]+)", rep)]
+    assert occs and all(0.0 < o <= 1.0 for o in occs)
 
     nnz_lines = [int(m) for m in re.findall(r"device \([^)]*\): nnz=(\d+)", rep)]
     assert sum(nnz_lines) == 2 * S.nnz

@@ -55,6 +55,9 @@ class TestGradients:
         for name, kern in [
             ("xla", XlaKernel()),
             ("pallas", PallasKernel(precision="f32", interpret=True)),
+            # the step-batched forward must compose with the same VJPs
+            ("pallas-batched", PallasKernel(precision="f32", interpret=True,
+                                            batch_step=True)),
         ]:
             S, alg, A, B = _setup(kern)
             sv = alg.like_s_values(1.0)
@@ -67,9 +70,10 @@ class TestGradients:
             grads[name] = (
                 alg.host_a(gA), alg.host_b(gB), alg.gather_s_values(gv)
             )
-        for x, y in zip(grads["xla"], grads["pallas"]):
-            scale = np.abs(x).max() + 1
-            np.testing.assert_allclose(x / scale, y / scale, atol=1e-5)
+        for other in ("pallas", "pallas-batched"):
+            for x, y in zip(grads["xla"], grads[other]):
+                scale = np.abs(x).max() + 1
+                np.testing.assert_allclose(x / scale, y / scale, atol=1e-5)
 
     def test_pallas_unfused_op_grads(self):
         # sddmm and spmm custom VJPs individually (the fused VJP composes
