@@ -19,7 +19,16 @@ def fmt(v) -> str:
 def main() -> int:
     src = pathlib.Path(sys.argv[1] if len(sys.argv) > 1 else REPO / "KERNELS_TPU.jsonl")
     dst = pathlib.Path(sys.argv[2] if len(sys.argv) > 2 else REPO / "KERNELS_TPU.md")
-    recs = [json.loads(l) for l in src.read_text().splitlines() if l.strip()]
+    recs = []
+    for l in src.read_text().splitlines():
+        if not l.strip():
+            continue
+        try:
+            recs.append(json.loads(l))
+        except json.JSONDecodeError:
+            # A truncated tail line is normal: producers append under
+            # hard-kill timeouts.
+            print(f"skipping malformed line: {l[:60]!r}", file=sys.stderr)
     if not recs:
         print("no records", file=sys.stderr)
         return 1

@@ -84,6 +84,11 @@ while [ "$(date +%s)" -lt "$DEADLINE" ]; do
     run_step python scripts/kernel_sweep.py \
       scripts/plans/star_sweep.json KERNELS_TPU.jsonl --timeout 1500 --retries 1 \
       || failed=1
+    # Regenerate the derived artifacts from whatever measurements exist
+    # (CPU-only work; safe alongside the TPU being idle between steps).
+    run_step python scripts/summarize_kernels.py || true
+    run_step python -m distributed_sddmm_tpu.tools.charts \
+      KERNELS_TPU.jsonl --kernels -o artifacts/kernels_chart || true
     if [ -n "$failed" ] && ! healthy_pallas; then continue; fi
     run_step timeout 1800 python scripts/dist_gap.py || true
     run_step timeout 7200 python scripts/tpu_apps.py \
@@ -103,6 +108,9 @@ while [ "$(date +%s)" -lt "$DEADLINE" ]; do
     || { sleep 300; continue; }
   run_step env APPS_XLA_ONLY=1 timeout 3600 python scripts/tpu_apps.py \
     || { sleep 300; continue; }
+  run_step python scripts/summarize_kernels.py || true
+  run_step python -m distributed_sddmm_tpu.tools.charts \
+    KERNELS_TPU.jsonl --kernels -o artifacts/kernels_chart || true
   echo "[queue] XLA-only steps complete; waiting for Mosaic recovery"
   sleep 600
 done
