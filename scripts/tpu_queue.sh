@@ -62,13 +62,13 @@ while [ "$(date +%s)" -lt "$DEADLINE" ]; do
     # the re-probe before tpu_apps and routes back to the tier gates.
     failed=""
     run_step python scripts/kernel_sweep.py \
+      scripts/plans/batch_probe.json KERNELS_TPU.jsonl --timeout 900 --retries 1 \
+      || failed=1
+    run_step python scripts/kernel_sweep.py \
       scripts/plans/scatter_probe.json KERNELS_TPU.jsonl --timeout 900 --retries 1 \
       || failed=1
     run_step python scripts/kernel_sweep.py \
       scripts/plans/chunk_probe.json KERNELS_TPU.jsonl --timeout 900 --retries 1 \
-      || failed=1
-    run_step python scripts/kernel_sweep.py \
-      scripts/plans/batch_probe.json KERNELS_TPU.jsonl --timeout 900 --retries 1 \
       || failed=1
     # ALS/GAT application records (round-directive evidence with none yet)
     # land before the long sweeps so a short health window still records
