@@ -61,23 +61,28 @@ while [ "$(date +%s)" -lt "$DEADLINE" ]; do
     # missing configs re-run. A Mosaic-tier outage mid-pipeline is caught by
     # the re-probe before tpu_apps and routes back to the tier gates.
     failed=""
-    run_step python scripts/kernel_sweep.py \
-      scripts/plans/batch_probe.json KERNELS_TPU.jsonl --timeout 900 --retries 1 \
-      || failed=1
-    run_step python scripts/kernel_sweep.py \
-      scripts/plans/scatter_probe.json KERNELS_TPU.jsonl --timeout 900 --retries 1 \
-      || failed=1
-    run_step python scripts/kernel_sweep.py \
-      scripts/plans/chunk_probe.json KERNELS_TPU.jsonl --timeout 900 --retries 1 \
-      || failed=1
-    # ALS/GAT application records (round-directive evidence with none yet)
-    # land before the long sweeps so a short health window still records
-    # them. Re-gate on the Mosaic tier first when a probe step failed —
-    # this step's Pallas configs would otherwise hang to the full timeout
-    # during a mid-pipeline Mosaic outage.
-    if [ -n "$failed" ] && ! healthy_pallas; then continue; fi
+    # ALS/GAT application records first (round-directive evidence with none
+    # yet, and known-compilable kernels): a short health window still
+    # records them before the novel kernel-variant probes, whose compiles
+    # are the likeliest to hang.
     run_step env APPS_SUBSET=apps timeout 3600 python scripts/tpu_apps.py \
       || failed=1
+    # Mosaic may have died mid-apps; re-gate before the probes, whose
+    # compiles would each hang to their full timeout.
+    if [ -n "$failed" ] && ! healthy_pallas; then continue; fi
+    # Novel-variant probes: tight per-config timeout, no retry — a Mosaic
+    # compile hang is deterministic, and a second 900 s attempt would only
+    # delay the rest of the pipeline (known-good compiles run in ~2-3 min).
+    run_step python scripts/kernel_sweep.py \
+      scripts/plans/batch_probe.json KERNELS_TPU.jsonl --timeout 600 --retries 0 \
+      || failed=1
+    run_step python scripts/kernel_sweep.py \
+      scripts/plans/scatter_probe.json KERNELS_TPU.jsonl --timeout 600 --retries 0 \
+      || failed=1
+    run_step python scripts/kernel_sweep.py \
+      scripts/plans/chunk_probe.json KERNELS_TPU.jsonl --timeout 600 --retries 0 \
+      || failed=1
+    if [ -n "$failed" ] && ! healthy_pallas; then continue; fi
     run_step python scripts/kernel_sweep.py \
       scripts/plans/group_probe.json KERNELS_TPU.jsonl --timeout 900 --retries 1 \
       || failed=1
