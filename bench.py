@@ -238,15 +238,11 @@ def main() -> None:
         (cpu_env, cpu_reserve, 0.0),
     ]
     # What the first fixed rung actually resolves to: its own env_extra over
-    # whatever the parent process exported, over blocked.py's defaults.
-    first_rung_effective = {
-        "DSDDMM_BLOCK_ROWS": os.environ.get("DSDDMM_BLOCK_ROWS", "512"),
-        "DSDDMM_BLOCK_COLS": os.environ.get("DSDDMM_BLOCK_COLS", "512"),
-        "DSDDMM_SCATTER_FORM": os.environ.get("DSDDMM_SCATTER_FORM", "bt"),
-        "DSDDMM_CHUNK": os.environ.get("DSDDMM_CHUNK", "128"),
-        "DSDDMM_BATCH_STEP": os.environ.get("DSDDMM_BATCH_STEP", "0"),
-        **attempts[0][0],
-    }
+    # whatever the parent process exported, over blocked.py's defaults —
+    # read from blocked.py itself so the dedup can't drift from the knobs.
+    from distributed_sddmm_tpu.ops.blocked import knob_env_defaults
+
+    first_rung_effective = {**knob_env_defaults(), **attempts[0][0]}
     if tuned is not None and tuned != first_rung_effective:
         # Lead with the sweep's best (blocks, group, scatter) combination;
         # the fixed-group rungs stay as fallbacks (and as a regression check

@@ -49,6 +49,27 @@ DEFAULT_GROUP = int(os.environ.get("DSDDMM_CHUNK_GROUP", "4"))
 DEFAULT_BLOCK_ROWS = int(os.environ.get("DSDDMM_BLOCK_ROWS", "512"))
 DEFAULT_BLOCK_COLS = int(os.environ.get("DSDDMM_BLOCK_COLS", "512"))
 
+# Scatter contraction form ("bt"/"nt") and step batching for the Pallas
+# kernels (consumed by ops/pallas_kernels.PallasKernel.__init__); defined
+# here so every knob default lives in one module.
+DEFAULT_SCATTER_FORM = os.environ.get("DSDDMM_SCATTER_FORM", "bt")
+DEFAULT_BATCH_STEP = os.environ.get("DSDDMM_BATCH_STEP", "0") not in ("", "0")
+
+
+def knob_env_defaults() -> dict:
+    """The effective kernel-knob values as the env-var strings bench.py
+    passes to its workers — the single source of truth for its
+    tuned-vs-first-rung dedup. Values reflect this process's environment
+    (each knob is env-overridable), falling back to the literals above."""
+    return {
+        "DSDDMM_BLOCK_ROWS": str(DEFAULT_BLOCK_ROWS),
+        "DSDDMM_BLOCK_COLS": str(DEFAULT_BLOCK_COLS),
+        "DSDDMM_CHUNK_GROUP": str(DEFAULT_GROUP),
+        "DSDDMM_SCATTER_FORM": DEFAULT_SCATTER_FORM,
+        "DSDDMM_CHUNK": str(CHUNK),
+        "DSDDMM_BATCH_STEP": "1" if DEFAULT_BATCH_STEP else "0",
+    }
+
 # meta word packing: | gr (15 bits) | gc (15 bits) | last | first |
 _GR_SHIFT = 17
 _GC_SHIFT = 2

@@ -43,6 +43,7 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from distributed_sddmm_tpu.ops import blocked
 from distributed_sddmm_tpu.ops.blocked import (
     CHUNK, _GC_SHIFT, _GR_SHIFT, MAX_BLOCKS, unpack_meta,
 )
@@ -564,11 +565,17 @@ class PallasKernel:
         if precision not in ("bf16", "f32"):
             raise ValueError(f"precision must be 'bf16' or 'f32', got {precision!r}")
         if scatter_form is None:
-            scatter_form = os.environ.get("DSDDMM_SCATTER_FORM", "bt")
+            # Construction-time env read (docstring contract), falling back
+            # to blocked.py's import-time snapshot — the one home for every
+            # kernel-knob default.
+            scatter_form = os.environ.get(
+                "DSDDMM_SCATTER_FORM", blocked.DEFAULT_SCATTER_FORM)
         if scatter_form not in ("bt", "nt"):
             raise ValueError(f"scatter_form must be 'bt' or 'nt', got {scatter_form!r}")
         if batch_step is None:
-            batch_step = os.environ.get("DSDDMM_BATCH_STEP", "0") not in ("", "0")
+            raw = os.environ.get("DSDDMM_BATCH_STEP")
+            batch_step = (raw not in ("", "0")) if raw is not None \
+                else blocked.DEFAULT_BATCH_STEP
         self.precision = precision
         self.scatter_form = scatter_form
         self.batch_step = bool(batch_step)

@@ -70,6 +70,17 @@ def throughput_chart(records, ax) -> None:
 
 
 def breakdown_chart(records, ax) -> None:
+    """Stacked {Computation, Replication, Propagation} seconds per algorithm.
+
+    Bias note (consumers of these bars, read this): the region counters come
+    from ``base.measure_breakdown``'s collective ablation, whose "local"
+    variant replaces the replication ``all_gather`` with a concat of c local
+    copies (``parallel/loops.py``). That keeps shapes but adds memory
+    traffic the true program does not have, so at c > 1 the Computation bar
+    is mildly INFLATED and the Replication bar correspondingly deflated —
+    the same first-order altitude as the reference's barrier-separated
+    timers (`distributed_sparse.h:205-261`), not an exact decomposition.
+    """
     per_alg: dict = collections.defaultdict(lambda: collections.defaultdict(float))
     for rec in records:
         stats = rec.get("perf_stats") or {}
@@ -90,7 +101,8 @@ def breakdown_chart(records, ax) -> None:
         bottoms = [b + v for b, v in zip(bottoms, vals)]
     ax.set_xticks(range(len(algs)), algs, rotation=45, ha="right", fontsize=7)
     ax.set_ylabel("seconds")
-    ax.set_title("Time breakdown")
+    ax.set_title("Time breakdown (ablation estimate; c>1 inflates Computation)",
+                 fontsize=9)
     ax.legend(fontsize=7)
 
 

@@ -45,7 +45,13 @@ def config_key(cfg: dict) -> tuple:
 
 
 def record_key(rec: dict) -> tuple:
-    blocks = f"{rec['bm']}x{rec['bn']}" if "bm" in rec else ""
+    # Prefer the REQUESTED blocks echoed by tune_blocks (blocks_req): the
+    # realized bm/bn can differ when pick_block clamps the preference, and
+    # keying on the realized pair would re-run such configs forever. Records
+    # predating the echo fall back to the realized pair (never clamped in
+    # the committed data).
+    blocks = rec.get("blocks_req") or (
+        f"{rec['bm']}x{rec['bn']}" if "bm" in rec else "")
     is_pallas = rec["kernel"].startswith("pallas")
     return (
         rec["logM"], rec["npr"], rec["R"],
@@ -55,6 +61,29 @@ def record_key(rec: dict) -> tuple:
         rec.get("chunk", 128) if is_pallas else 0,
         bool(rec.get("batch_step")) if is_pallas else False,
     )
+
+
+def preflight_key(cfg: dict) -> tuple:
+    """Kernel-configuration identity used by scripts/preflight_kernels.py
+    (grid size excluded — compile validity doesn't depend on logM/npr).
+    ``or``-normalized because preflight records carry explicit nulls for
+    absent knobs while plan configs simply omit them."""
+    return (cfg.get("blocks") or "512x512", cfg.get("group") or 1,
+            cfg.get("chunk") or 128, cfg.get("scatter") or "bt",
+            bool(cfg.get("batch")), cfg["R"])
+
+
+def failed_preflight_keys(path: pathlib.Path) -> set:
+    """Kernel configs the offline Mosaic AOT check proved uncompilable —
+    running them on the chip would only burn the health window on a
+    deterministic failure. Only ``compile-error`` counts: a preflight
+    timeout or garbled output is not proof the config can't compile."""
+    try:
+        report = json.loads(path.read_text())
+    except (OSError, json.JSONDecodeError):
+        return set()
+    return {preflight_key(rec) for rec in report.get("configs", [])
+            if rec.get("status") == "compile-error"}
 
 
 def done_keys(out_path: pathlib.Path) -> set:
@@ -126,6 +155,9 @@ def main(argv=None) -> int:
     ap.add_argument("--backoff", type=float, default=45.0)
     ap.add_argument("--kernel-filter", default=None, choices=("xla", "pallas"),
                     help="run only this kernel's configs from the plan")
+    ap.add_argument("--preflight", default=str(REPO / "PREFLIGHT.json"),
+                    help="offline Mosaic compile report; configs it marks "
+                         "failed are skipped (pass an absent path to disable)")
     args = ap.parse_args(argv)
 
     plan = json.loads(pathlib.Path(args.plan).read_text())
@@ -133,9 +165,17 @@ def main(argv=None) -> int:
         plan = [cfg for cfg in plan if cfg["kernel"] == args.kernel_filter]
     out_path = pathlib.Path(args.output)
     done = done_keys(out_path)
+    bad = failed_preflight_keys(pathlib.Path(args.preflight))
+    not_done = [cfg for cfg in plan if config_key(cfg) not in done]
+    skipped = [cfg for cfg in not_done if cfg["kernel"] == "pallas"
+               and preflight_key(cfg) in bad]
+    for cfg in skipped:
+        print(f"[sweep] skipping {config_key(cfg)}: failed offline Mosaic "
+              f"preflight ({args.preflight})", flush=True)
 
-    todo = [cfg for cfg in plan if config_key(cfg) not in done]
-    print(f"[sweep] {len(plan)} planned, {len(plan) - len(todo)} already done, "
+    todo = [cfg for cfg in not_done if cfg not in skipped]
+    print(f"[sweep] {len(plan)} planned, {len(plan) - len(not_done)} "
+          f"already done, {len(skipped)} preflight-skipped, "
           f"{len(todo)} to run", flush=True)
     failures = 0
     for n, cfg in enumerate(todo):

@@ -1,0 +1,223 @@
+"""Offline Mosaic compile pre-flight for planned kernel-sweep configs.
+
+The tunneled TPU backend makes every on-device compile expensive (minutes)
+and every hang costly (it eats a health window), but `artifacts/
+multichip_hlo/run_pallas.py` established that the Mosaic/TPU compiler runs
+LOCALLY against a `jax.experimental.topologies` target — no chips, no
+tunnel. So before any plan config reaches `scripts/tpu_queue.sh`, this
+validator AOT-compiles its exact Pallas kernel configuration (blocks,
+group, chunk, scatter form, step batching, R) for a single v5e core on a
+tiny R-mat and records ok / compile-error per config. A config that cannot
+compile here cannot compile on the chip either (same compiler), so the
+queue can skip it instead of timing out on it.
+
+The reference has no analog (its kernels are prebuilt MKL/cuSPARSE calls,
+`sparse_kernels.cpp:94-121`); this is tunnel-environment insurance.
+
+Usage: python scripts/preflight_kernels.py [plan.json ...] [-o PREFLIGHT.json]
+Defaults to every scripts/plans/*.json. Exit code 1 when any config fails.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import pathlib
+import sys
+import time
+
+REPO = pathlib.Path(__file__).resolve().parents[1]
+sys.path.insert(0, str(REPO))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import importlib.util
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import topologies
+
+# Compile for one core of the same generation the queue measures on.
+TOPOLOGY = "v5e:2x4"
+
+# The config-identity key is OWNED by the consumer (kernel_sweep skips
+# failed keys); importing it keeps producer and consumer from drifting.
+_ks_spec = importlib.util.spec_from_file_location(
+    "kernel_sweep", pathlib.Path(__file__).with_name("kernel_sweep.py"))
+_ks = importlib.util.module_from_spec(_ks_spec)
+_ks_spec.loader.exec_module(_ks)
+preflight_key = _ks.preflight_key
+
+
+def pallas_configs(plans: list[pathlib.Path]) -> list[dict]:
+    seen, out = set(), []
+    for plan in plans:
+        for cfg in json.loads(plan.read_text()):
+            if cfg.get("kernel") != "pallas":
+                continue
+            key = preflight_key(cfg)
+            if key in seen:
+                continue
+            seen.add(key)
+            out.append({"plan": plan.name, **cfg})
+    return out
+
+
+def compile_one(cfg: dict, device) -> dict:
+    """AOT-compile fused/sddmm/spmm tile kernels for one config; tiny
+    graph, real (blocks, group, chunk, scatter, batch, R) knobs."""
+    # Chunk size is snapshotted at import inside ops.blocked, so configs
+    # with a non-default chunk run in a fresh subprocess (see main()).
+    from distributed_sddmm_tpu.ops.blocked import CHUNK, build_blocked
+    from distributed_sddmm_tpu.ops.pallas_kernels import BlockedTile, PallasKernel
+    from distributed_sddmm_tpu.utils.coo import HostCOO
+
+    assert CHUNK == cfg.get("chunk", 128), (CHUNK, cfg)
+    bm, bn = (int(x) for x in cfg.get("blocks", "512x512").split("x"))
+    R, group = cfg["R"], cfg.get("group", 1)
+
+    S = HostCOO.rmat(log_m=11, edge_factor=8, seed=0)
+    meta = build_blocked(1, np.zeros(S.nnz, np.int64), S.rows, S.cols,
+                         S.M, S.N, block_rows=bm, block_cols=bn, group=group)
+    # A clamped probe would validate a DIFFERENT kernel than the plan's and
+    # record a false 'ok' for the unclamped key; fail loudly instead (the
+    # probe matrix must be enlarged, or the plan config is one tune_blocks
+    # would tombstone anyway).
+    if (meta.bm, meta.bn) != (bm, bn):
+        raise RuntimeError(
+            f"probe clamped blocks {bm}x{bn} -> {meta.bm}x{meta.bn}; "
+            f"preflight cannot vouch for this config")
+    sharding = jax.sharding.SingleDeviceSharding(device)
+
+    def sds(shape, dtype=jnp.float32):
+        return jax.ShapeDtypeStruct(shape, dtype, sharding=sharding)
+
+    blk = BlockedTile(
+        lr=sds(meta.lr[0].shape, jnp.int32), lc=sds(meta.lc[0].shape, jnp.int32),
+        meta=sds(meta.meta[0].shape, jnp.int32), bm=meta.bm, bn=meta.bn,
+        gr_blocks=meta.gr_blocks, gc_blocks=meta.gc_blocks, group=meta.group,
+    )
+    kern = PallasKernel(precision="bf16", interpret=False,
+                        scatter_form=cfg.get("scatter", "bt"),
+                        batch_step=bool(cfg.get("batch")))
+    cvals = sds((meta.n_chunks * CHUNK,))
+    A, B = sds((S.M, R)), sds((S.N, R))
+    rows_pad = meta.gr_blocks * meta.bm
+
+    report = {}
+    # blk is a pytree of ShapeDtypeStructs, so it must flow through lower()
+    # as an argument, not a closure constant. All three ops compile
+    # regardless of the plan's fused_only flag: the preflight key has no
+    # fused_only axis, so a fused-only probe config would otherwise mask
+    # the full config sharing its key.
+    ops = {
+        "fused": lambda: jax.jit(kern.fused_tile).lower(blk, cvals, A, B),
+        "sddmm": lambda: jax.jit(kern.sddmm_tile).lower(blk, cvals, A, B),
+        "spmm": lambda: jax.jit(
+            kern.spmm_tile, static_argnums=3
+        ).lower(blk, cvals, B, rows_pad),
+    }
+    for name, build in ops.items():
+        t0 = time.monotonic()
+        build().compile()
+        report[f"{name}_compile_s"] = round(time.monotonic() - t0, 2)
+    return report
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("plans", nargs="*", help="plan JSONs (default: scripts/plans/*)")
+    ap.add_argument("-o", "--output", default=str(REPO / "PREFLIGHT.json"))
+    ap.add_argument("--config-json", default=None,
+                    help="(internal) compile ONE config, passed as JSON")
+    args = ap.parse_args(argv)
+
+    if args.config_json:
+        cfg = json.loads(args.config_json)
+        topo = topologies.get_topology_desc(platform="tpu", topology_name=TOPOLOGY)
+        print(json.dumps(compile_one(cfg, topo.devices[0])))
+        return 0
+
+    plans = [pathlib.Path(p) for p in args.plans] or sorted(
+        (REPO / "scripts" / "plans").glob("*.json"))
+    configs = pallas_configs(plans)
+    results, failures = [], 0
+    out_path = pathlib.Path(args.output)
+
+    def flush_report():
+        # Rewritten per-config: an outer timeout (the queue wraps this
+        # script in one) must not discard finished results and leave a
+        # stale report in force.
+        out = {"topology": TOPOLOGY,
+               "note": "offline Mosaic AOT compile check; a compile-error "
+                       "here means the queue would hang/fail on this config",
+               "complete": len(results) == len(configs),
+               "configs": results}
+        # Atomic replace: an outer SIGTERM mid-write must not truncate the
+        # report (a broken JSON disables all preflight skipping AND
+        # clobbers the committed known-good file).
+        tmp = out_path.with_suffix(".tmp")
+        tmp.write_text(json.dumps(out, indent=1))
+        os.replace(tmp, out_path)
+
+    import subprocess
+
+    for cfg in configs:
+        env = dict(os.environ)
+        env["DSDDMM_CHUNK"] = str(cfg.get("chunk", 128))
+        env["PYTHONPATH"] = f"{REPO}:{env.get('PYTHONPATH', '')}"
+        t0 = time.monotonic()
+        rec = {k: cfg.get(k) for k in
+               ("plan", "logM", "npr", "R", "blocks", "group", "chunk",
+                "scatter", "batch", "fused_only")}
+        try:
+            proc = subprocess.run(
+                [sys.executable, __file__, "--config-json", json.dumps(cfg)],
+                env=env, capture_output=True, text=True, timeout=600)
+        except subprocess.TimeoutExpired:
+            # One hanging compile must not lose the whole report — record
+            # it and move on. NOTE: a timeout is not proof of
+            # uncompilability, so kernel_sweep deliberately does NOT skip
+            # these (only status == "compile-error"); the nonzero exit
+            # here just flags that preflight could not vouch for
+            # everything.
+            rec.update(status="timeout", wall_s=round(time.monotonic() - t0, 1))
+            results.append(rec)
+            failures += 1
+            flush_report()
+            print(f"[preflight] timeout       R={cfg['R']} "
+                  f"blocks={cfg.get('blocks', '512x512')}", flush=True)
+            continue
+        rec["wall_s"] = round(time.monotonic() - t0, 1)
+        if proc.returncode == 0:
+            try:
+                rec.update(status="ok", **json.loads(
+                    proc.stdout.strip().splitlines()[-1]))
+            except (json.JSONDecodeError, IndexError):
+                rec.update(status="bad-output", stderr=proc.stderr[-800:])
+                failures += 1
+        else:
+            tail = "\n".join(proc.stderr.strip().splitlines()[-12:])
+            # A clamped probe means this probe matrix cannot represent the
+            # config — NOT that the config can't compile at its real grid
+            # size; give it a status failed_preflight_keys ignores.
+            status = ("probe-invalid" if "preflight cannot vouch" in tail
+                      else "compile-error")
+            rec.update(status=status, error=tail)
+            failures += 1
+        results.append(rec)
+        flush_report()
+        print(f"[preflight] {rec['status']:13s} "
+              f"R={cfg['R']} blocks={cfg.get('blocks', '512x512')} "
+              f"g={cfg.get('group', 1)} chunk={cfg.get('chunk', 128)} "
+              f"scatter={cfg.get('scatter', 'bt')} batch={bool(cfg.get('batch'))} "
+              f"({rec['wall_s']}s)", flush=True)
+
+    print(f"[preflight] {len(results) - failures}/{len(results)} ok -> {out_path}")
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

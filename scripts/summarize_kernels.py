@@ -32,6 +32,14 @@ def main() -> int:
     if not recs:
         print("no records", file=sys.stderr)
         return 1
+    n_skipped = sum(1 for r in recs if r.get("skipped"))
+    recs = [r for r in recs if not r.get("skipped")]
+    if n_skipped:
+        print(f"dropping {n_skipped} timing-free tombstone record(s) "
+              "(clamped block preference)", file=sys.stderr)
+    if not recs:
+        print("no measured records", file=sys.stderr)
+        return 1
 
     # Group-probe rows (same config, varying blocks/group) vs sweep rows.
     probe = [r for r in recs if r.get("fused_only") or (

