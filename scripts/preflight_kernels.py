@@ -135,6 +135,11 @@ def main(argv=None) -> int:
     args = ap.parse_args(argv)
 
     if args.config_json:
+        # Pure AOT work — pin the default backend to CPU so nothing can
+        # reach for the tunnel even in environments that export
+        # JAX_PLATFORMS for it (env var alone is ignored here because
+        # sitecustomize pre-imports jax; the config update is not).
+        jax.config.update("jax_platforms", "cpu")
         cfg = json.loads(args.config_json)
         topo = topologies.get_topology_desc(platform="tpu", topology_name=TOPOLOGY)
         print(json.dumps(compile_one(cfg, topo.devices[0])))
@@ -146,15 +151,24 @@ def main(argv=None) -> int:
     results, failures = [], 0
     out_path = pathlib.Path(args.output)
 
+    # Carry forward prior results for configs this run hasn't reached yet:
+    # an outer timeout must not discard the committed report's knowledge
+    # (each fresh result replaces its key as the run progresses).
+    old_by_key = {}
+    try:
+        for rec in json.loads(out_path.read_text()).get("configs", []):
+            old_by_key[preflight_key(rec)] = rec
+    except (OSError, json.JSONDecodeError, KeyError):
+        pass
+
     def flush_report():
-        # Rewritten per-config: an outer timeout (the queue wraps this
-        # script in one) must not discard finished results and leave a
-        # stale report in force.
+        fresh = {preflight_key(r) for r in results}
+        merged = results + [r for k, r in old_by_key.items() if k not in fresh]
         out = {"topology": TOPOLOGY,
                "note": "offline Mosaic AOT compile check; a compile-error "
                        "here means the queue would hang/fail on this config",
                "complete": len(results) == len(configs),
-               "configs": results}
+               "configs": merged}
         # Atomic replace: an outer SIGTERM mid-write must not truncate the
         # report (a broken JSON disables all preflight skipping AND
         # clobbers the committed known-good file).
@@ -164,18 +178,39 @@ def main(argv=None) -> int:
 
     import subprocess
 
-    for cfg in configs:
+    def run_config(cfg: dict):
         env = dict(os.environ)
         env["DSDDMM_CHUNK"] = str(cfg.get("chunk", 128))
+        env["JAX_PLATFORMS"] = "cpu"
         env["PYTHONPATH"] = f"{REPO}:{env.get('PYTHONPATH', '')}"
+        return subprocess.run(
+            [sys.executable, __file__, "--config-json", json.dumps(cfg)],
+            env=env, capture_output=True, text=True, timeout=600)
+
+    # Canary: the long-measured headline config must compile. If it does
+    # not, the AOT environment itself is broken (jax upgrade, missing
+    # libtpu AOT, ...) and NO failure this run can be trusted as
+    # config-specific — bail without poisoning the report.
+    canary = {"logM": 14, "npr": 32, "R": 128, "kernel": "pallas",
+              "blocks": "512x512", "group": 4}
+    try:
+        cp = run_config(canary)
+    except subprocess.TimeoutExpired:
+        cp = None
+    if cp is None or cp.returncode != 0:
+        tail = "" if cp is None else "\n".join(
+            cp.stderr.strip().splitlines()[-8:])
+        print("[preflight] CANARY FAILED — AOT environment broken, "
+              f"leaving existing report untouched\n{tail}", file=sys.stderr)
+        return 3
+
+    for cfg in configs:
         t0 = time.monotonic()
         rec = {k: cfg.get(k) for k in
                ("plan", "logM", "npr", "R", "blocks", "group", "chunk",
                 "scatter", "batch", "fused_only")}
         try:
-            proc = subprocess.run(
-                [sys.executable, __file__, "--config-json", json.dumps(cfg)],
-                env=env, capture_output=True, text=True, timeout=600)
+            proc = run_config(cfg)
         except subprocess.TimeoutExpired:
             # One hanging compile must not lose the whole report — record
             # it and move on. NOTE: a timeout is not proof of

@@ -67,6 +67,15 @@ while [ "$(date +%s)" -lt "$DEADLINE" ]; do
     # missing configs re-run. A Mosaic-tier outage mid-pipeline is caught by
     # the re-probe before tpu_apps and routes back to the tier gates.
     failed=""
+    # One-shot AOT-load probe first (~2 min): if locally AOT-compiled
+    # executables can load on the tunneled chip, every later sweep compile
+    # can move off-chip. Self-recording; skipped once answered. Exit 2 =
+    # backend flaked mid-probe (no answer written) — retry next cycle. The
+    # probe bounds its own phases (600s each, process-group kills); the
+    # outer timeout is a generous backstop above that worst case.
+    if [ ! -f AOT_LOAD.json ]; then
+      run_step timeout 1500 python scripts/aot_load_probe.py || true
+    fi
     # ALS/GAT application records first (round-directive evidence with none
     # yet, and known-compilable kernels): a short health window still
     # records them before the novel kernel-variant probes, whose compiles
