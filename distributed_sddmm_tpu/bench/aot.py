@@ -1,0 +1,113 @@
+"""Ahead-of-time compile/serialize/load helpers for the kernel benchmarks.
+
+On this environment's tunneled TPU backend, on-device Pallas compiles route
+through a remote Mosaic service costing 2-12 minutes per distinct program —
+the binding constraint on sweep breadth (see KERNELS_TPU.md). The Mosaic/
+TPU compiler itself runs fine locally against a `jax.experimental.
+topologies` AOT target (established by `scripts/preflight_kernels.py`), and
+`scripts/aot_load_probe.py` tests whether such executables can be
+deserialized onto the live chip. When that answer is yes, the sweep
+pipeline uses this module to move every compile off-chip:
+
+* `compile_chain_pair` (offline, CPU-pinned process): AOT-compile the
+  chained-trials program — ``fori_loop(0, n, step)`` for n in
+  {1, 1+trials}, the exact shape `bench.kernels._chain_time` jits — for
+  one topology device, and serialize both executables to a directory.
+* `load_chain_pair` + `chain_time_loaded` (on the TPU process): load the
+  pair onto the real device and reproduce `_chain_time`'s timing protocol
+  (warm both trip counts, time n=1, time n=1+trials, difference /
+  trials).
+
+The reference has no analog (its kernels are prebuilt library calls,
+`sparse_kernels.cpp:94-121`); this is tunnel-environment engineering to
+make the benchmark breadth of `local_kernel_benchmark.cpp:276-280`
+affordable here.
+"""
+
+from __future__ import annotations
+
+import pathlib
+import pickle
+import time
+
+import jax
+import jax.numpy as jnp
+
+
+def _chain(step_fn, n: int):
+    """The chained-trials program — must stay in lockstep with
+    `bench.kernels._chain_time`'s jitted chain (same fori_loop shape), or
+    AOT timings stop being comparable to on-device ones."""
+
+    @jax.jit
+    def chain(state):
+        return jax.lax.fori_loop(0, n, lambda _, s: step_fn(s), state)
+
+    return chain
+
+
+def trip_counts(trials: int) -> tuple[int, int]:
+    return (1, 1 + trials)
+
+
+def compile_chain_pair(step_fn, state, trials: int, device,
+                       out_dir: str | pathlib.Path, name: str) -> dict:
+    """AOT-compile ``step_fn``'s chain for both trip counts against
+    ``device`` (a topology AOT device) and serialize to
+    ``out_dir/{name}_{n}.pkl``. Returns {n: compile_seconds}."""
+    from jax.experimental import serialize_executable as se
+
+    sharding = jax.sharding.SingleDeviceSharding(device)
+
+    def sds(x):
+        x = jnp.asarray(x)
+        return jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=sharding)
+
+    out_dir = pathlib.Path(out_dir)
+    out_dir.mkdir(parents=True, exist_ok=True)
+    sds_state = jax.tree_util.tree_map(sds, state)
+    times = {}
+    for n in trip_counts(trials):
+        t0 = time.monotonic()
+        compiled = _chain(step_fn, n).lower(sds_state).compile()
+        payload = se.serialize(compiled)
+        (out_dir / f"{name}_{n}.pkl").write_bytes(pickle.dumps(payload))
+        times[n] = round(time.monotonic() - t0, 2)
+    return times
+
+
+def load_chain_pair(out_dir: str | pathlib.Path, name: str, trials: int,
+                    device) -> dict:
+    """Deserialize the chain pair onto ``device``. Returns {n: callable}.
+    Raises on any load failure — callers fall back to on-device jit."""
+    from jax.experimental import serialize_executable as se
+
+    out_dir = pathlib.Path(out_dir)
+    loaded = {}
+    for n in trip_counts(trials):
+        serialized, in_tree, out_tree = pickle.loads(
+            (out_dir / f"{name}_{n}.pkl").read_bytes())
+        loaded[n] = se.deserialize_and_load(
+            serialized, in_tree, out_tree, backend=device.client,
+            execution_devices=[device])
+    return loaded
+
+
+def chain_time_loaded(loaded: dict, state, trials: int) -> float:
+    """`_chain_time`'s measurement protocol over pre-loaded executables:
+    warm both trip counts (first runs pay upload/cache effects), then time
+    each once and take the per-trial difference."""
+
+    def run(n):
+        out = loaded[n](state)
+        # Host fetch forces execution on the tunneled backend.
+        float(jnp.asarray(out[0]).sum())
+
+    run(1)
+    run(1 + trials)
+    t0 = time.perf_counter()
+    run(1)
+    t_one = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    run(1 + trials)
+    return max((time.perf_counter() - t0 - t_one) / trials, 1e-9)

@@ -230,7 +230,28 @@ def phase_b() -> int:
             entry["ok"] = False
             entry["error"] = f"{type(e).__name__}: {e}"[:500]
         report["programs"][name] = entry
+
     report["ok"] = all(p.get("ok") for p in report["programs"].values())
+    # An exception mid-phase is ambiguous: a genuine re-homing
+    # incompatibility OR a tunnel flake after the init check. Don't let
+    # one flake permanently foreclose AOT mode — only record a "no" once
+    # exceptions have repeated enough to be deterministic (numerics
+    # mismatches, by contrast, are conclusive immediately).
+    exceptions = [p for p in report["programs"].values() if "error" in p]
+    if exceptions and not report["ok"]:
+        attempts_file = CACHE / "phase_b_attempts"
+        try:
+            attempts = int(attempts_file.read_text()) + 1
+        except (OSError, ValueError):
+            attempts = 1
+        attempts_file.write_text(str(attempts))
+        if attempts < 3:
+            print(json.dumps(report, indent=1))
+            print(f"[aot-probe] inconclusive (exception, attempt {attempts}/3)"
+                  " — not recording; will retry next cycle", file=sys.stderr)
+            return 2
+        report["inconclusive_after_attempts"] = attempts
+
     print(json.dumps(report, indent=1))
     out_path = os.environ.get("AOT_LOAD_OUT", str(REPO / "AOT_LOAD.json"))
     pathlib.Path(out_path).write_text(json.dumps(report, indent=1))

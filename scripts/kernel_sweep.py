@@ -97,6 +97,71 @@ def done_keys(out_path: pathlib.Path) -> set:
     return keys
 
 
+def aot_validated() -> bool:
+    """True when the AOT-load probe recorded that locally compiled
+    executables load and produce correct numerics on this backend
+    (AOT_LOAD.json, written by scripts/aot_load_probe.py)."""
+    if os.environ.get("KERNEL_SWEEP_NO_AOT", "") not in ("", "0"):
+        return False
+    try:
+        return bool(json.loads(
+            (REPO / "AOT_LOAD.json").read_text()).get("ok"))
+    except (OSError, json.JSONDecodeError):
+        return False
+
+
+def _aot_code_hash() -> str:
+    """Fingerprint of the sources that determine the compiled kernels —
+    stale serialized executables must never be timed as current code."""
+    import hashlib
+
+    h = hashlib.sha256()
+    for rel in ("distributed_sddmm_tpu/ops/pallas_kernels.py",
+                "distributed_sddmm_tpu/ops/blocked.py",
+                "distributed_sddmm_tpu/bench/aot.py",
+                "scripts/tune_blocks.py",
+                "scripts/aot_compile_kernels.py"):
+        h.update((REPO / rel).read_bytes())
+    return h.hexdigest()[:10]
+
+
+def aot_precompile(cfg: dict, env: dict, timeout_s: float = 420.0) -> str | None:
+    """Build this config's serialized chain pairs offline (CPU-pinned
+    subprocess, local Mosaic compile — seconds, no tunnel). Returns the
+    cache dir to pass as TUNE_LOAD_DIR, or None to use on-device compile.
+    The cache key carries fused_only (op set differs) and a source hash
+    (old binaries must not masquerade as current kernels)."""
+    key = "_".join(str(p) for p in config_key(cfg)).replace("/", "-")
+    out_dir = REPO / "artifacts" / "aot_kernels" / (
+        key + f"_t{cfg.get('trials', 5)}"
+        + f"_f{1 if cfg.get('fused_only') else 0}_{_aot_code_hash()}")
+    meta_path = out_dir / "meta.json"
+    if meta_path.exists():
+        try:
+            ok = bool(json.loads(meta_path.read_text()).get("ok"))
+        except (OSError, json.JSONDecodeError):
+            ok = False
+        return str(out_dir) if ok else None
+    cenv = dict(env, JAX_PLATFORMS="cpu")
+    try:
+        proc = subprocess.run(
+            [sys.executable, str(REPO / "scripts" / "aot_compile_kernels.py"),
+             str(cfg["logM"]), str(cfg["npr"]), str(cfg["R"]),
+             str(cfg.get("trials", 5)), str(out_dir)],
+            env=cenv, capture_output=True, text=True, timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        print(f"[sweep] AOT precompile timed out for {config_key(cfg)}; "
+              "using on-device compile", flush=True)
+        return None
+    if proc.returncode != 0 or not (out_dir / "meta.json").exists():
+        tail = (proc.stderr or "").strip().splitlines()[-3:]
+        print(f"[sweep] AOT precompile failed for {config_key(cfg)} "
+              f"(rc={proc.returncode}, {tail}); using on-device compile",
+              flush=True)
+        return None
+    return str(out_dir)
+
+
 def run_worker(cfg: dict, timeout_s: float) -> list[dict] | None:
     env = dict(os.environ)
     env["PYTHONPATH"] = f"{REPO}:{env.get('PYTHONPATH', '')}"
@@ -111,6 +176,10 @@ def run_worker(cfg: dict, timeout_s: float) -> list[dict] | None:
         env["TUNE_BATCH"] = "1" if cfg.get("batch") else "0"
         if cfg.get("fused_only"):
             env["TUNE_FUSED_ONLY"] = "1"
+        if aot_validated():
+            load_dir = aot_precompile(cfg, env)
+            if load_dir:
+                env["TUNE_LOAD_DIR"] = load_dir
     proc = subprocess.Popen(
         [sys.executable, str(REPO / "scripts" / "tune_blocks.py"),
          str(cfg["logM"]), str(cfg["npr"]), str(cfg["R"]),
