@@ -225,8 +225,12 @@ def _aot_validated() -> bool:
     try:
         with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                "AOT_LOAD.json")) as f:
-            return bool(json.load(f).get("ok"))
-    except (OSError, json.JSONDecodeError):
+            rep = json.load(f)
+        # The offline compiler targets ONE device; on a multi-chip backend
+        # the worker would discard the dir anyway — don't spend precompile
+        # budget on it (the probe records its backend's device count).
+        return bool(rep.get("ok")) and int(rep.get("n_devices", 1)) == 1
+    except (OSError, json.JSONDecodeError, ValueError):
         return False
 
 

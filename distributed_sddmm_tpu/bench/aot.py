@@ -50,28 +50,36 @@ def trip_counts(trials: int) -> tuple[int, int]:
     return (1, 1 + trials)
 
 
+def save_executable(compiled, out_dir: str | pathlib.Path, name: str,
+                    n: int) -> None:
+    """Single owner of the on-disk format `load_chain_pair` reads: a pickle
+    of serialize_executable's (serialized, in_tree, out_tree) tuple at
+    ``{name}_{n}.pkl``."""
+    from jax.experimental import serialize_executable as se
+
+    out_dir = pathlib.Path(out_dir)
+    out_dir.mkdir(parents=True, exist_ok=True)
+    (out_dir / f"{name}_{n}.pkl").write_bytes(
+        pickle.dumps(se.serialize(compiled)))
+
+
 def compile_chain_pair(step_fn, state, trials: int, device,
                        out_dir: str | pathlib.Path, name: str) -> dict:
     """AOT-compile ``step_fn``'s chain for both trip counts against
     ``device`` (a topology AOT device) and serialize to
     ``out_dir/{name}_{n}.pkl``. Returns {n: compile_seconds}."""
-    from jax.experimental import serialize_executable as se
-
     sharding = jax.sharding.SingleDeviceSharding(device)
 
     def sds(x):
         x = jnp.asarray(x)
         return jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=sharding)
 
-    out_dir = pathlib.Path(out_dir)
-    out_dir.mkdir(parents=True, exist_ok=True)
     sds_state = jax.tree_util.tree_map(sds, state)
     times = {}
     for n in trip_counts(trials):
         t0 = time.monotonic()
         compiled = _chain(step_fn, n).lower(sds_state).compile()
-        payload = se.serialize(compiled)
-        (out_dir / f"{name}_{n}.pkl").write_bytes(pickle.dumps(payload))
+        save_executable(compiled, out_dir, name, n)
         times[n] = round(time.monotonic() - t0, 2)
     return times
 
@@ -93,16 +101,13 @@ def load_chain_pair(out_dir: str | pathlib.Path, name: str, trials: int,
     return loaded
 
 
-def chain_time_loaded(loaded: dict, state, trials: int) -> float:
-    """`_chain_time`'s measurement protocol over pre-loaded executables:
-    warm both trip counts (first runs pay upload/cache effects), then time
-    each once and take the per-trial difference."""
-
-    def run(n):
-        out = loaded[n](state)
-        # Host fetch forces execution on the tunneled backend.
-        float(jnp.asarray(out[0]).sum())
-
+def timed_difference(run, trials: int) -> float:
+    """`_chain_time`'s measurement protocol over an arbitrary ``run(n)``
+    callable (which must BLOCK until the n-trip chain executed — end in a
+    host fetch on tunneled backends): warm both trip counts, time each
+    once, per-trial difference, clamped positive. The single home for this
+    protocol — bench.py's worker keeps its own only because its negative-
+    difference policy differs (uniform-cost estimate, documented there)."""
     run(1)
     run(1 + trials)
     t0 = time.perf_counter()
@@ -111,3 +116,14 @@ def chain_time_loaded(loaded: dict, state, trials: int) -> float:
     t0 = time.perf_counter()
     run(1 + trials)
     return max((time.perf_counter() - t0 - t_one) / trials, 1e-9)
+
+
+def chain_time_loaded(loaded: dict, state, trials: int) -> float:
+    """`timed_difference` over pre-loaded chain executables."""
+
+    def run(n):
+        out = loaded[n](state)
+        # Host fetch forces execution on the tunneled backend.
+        float(jnp.asarray(out[0]).sum())
+
+    return timed_difference(run, trials)

@@ -44,10 +44,8 @@ def main() -> int:
     bench = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(bench)
 
-    from jax.experimental import serialize_executable as se
-
     from distributed_sddmm_tpu.ops.pallas_kernels import PallasKernel
-    from distributed_sddmm_tpu.parallel.mesh import GridSpec, make_grid
+    from distributed_sddmm_tpu.parallel.mesh import make_grid
 
     # The on-device worker's get_kernel("auto") resolves to the bf16 Mosaic
     # kernel on TPU; compile exactly that.
@@ -59,10 +57,8 @@ def main() -> int:
 
     topo = topologies.get_topology_desc(platform="tpu", topology_name=TOPOLOGY)
     g = alg.grid
-    tpu_grid = make_grid(g.nr, g.nc, g.nh, adjacency=g.adjacency,
+    alg.grid = make_grid(g.nr, g.nc, g.nh, adjacency=g.adjacency,
                          devices=[topo.devices[0]])
-    alg.grid = GridSpec(mesh=tpu_grid.mesh, nr=g.nr, nc=g.nc, nh=g.nh,
-                        adjacency=g.adjacency)
     alg._programs.clear()
     prog = alg._program("fused", use_st=False)
     mesh = alg.grid.mesh
@@ -79,11 +75,12 @@ def main() -> int:
                  "BENCH_TRIALS") + tuple(sorted(knob_env_defaults()))
     report = {"ok": True, "build_s": build_s, "compile_s": {}, "env": {
         k: os.environ.get(k, "") for k in key_names}}
+    from distributed_sddmm_tpu.bench import aot
+
     for n in (1, 1 + trials):
         t0 = time.monotonic()
         compiled = bench.make_headline_chain(prog, n).lower(*arg_sds).compile()
-        payload = se.serialize(compiled)
-        (out_dir / f"headline_{n}.pkl").write_bytes(__import__("pickle").dumps(payload))
+        aot.save_executable(compiled, out_dir, "headline", n)
         report["compile_s"][n] = round(time.monotonic() - t0, 1)
     (out_dir / "meta.json").write_text(json.dumps(report, indent=1))
     print(json.dumps(report))

@@ -198,7 +198,8 @@ def phase_b() -> int:
 
     meta = json.loads((CACHE / "meta.json").read_text())
     report = {"phase": "b", "platform": dev.platform,
-              "device": str(dev), "programs": {}}
+              "device": str(dev), "n_devices": jax.device_count(),
+              "programs": {}}
     make_chain, make_xla_chain, state, xla_state = build_programs()
 
     for name, st in (("pallas_fused", state), ("xla_matmul", xla_state)):

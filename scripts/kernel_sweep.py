@@ -104,9 +104,10 @@ def aot_validated() -> bool:
     if os.environ.get("KERNEL_SWEEP_NO_AOT", "") not in ("", "0"):
         return False
     try:
-        return bool(json.loads(
-            (REPO / "AOT_LOAD.json").read_text()).get("ok"))
-    except (OSError, json.JSONDecodeError):
+        rep = json.loads((REPO / "AOT_LOAD.json").read_text())
+        # Single-device serialized targets only (see bench._aot_validated).
+        return bool(rep.get("ok")) and int(rep.get("n_devices", 1)) == 1
+    except (OSError, json.JSONDecodeError, ValueError):
         return False
 
 
