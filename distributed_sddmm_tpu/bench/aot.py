@@ -34,6 +34,18 @@ import jax
 import jax.numpy as jnp
 
 
+# (op, use_st) strategy programs each app touches — shared between the
+# offline compiler (scripts/aot_compile_apps.py) and the injecting runner
+# (scripts/tpu_apps.py) so the two can't drift. GAT is deliberately absent:
+# its per-layer feature widths retrace, and the inject_program wrapper's
+# jit fallback covers it.
+APP_PROGRAM_KEYS = {
+    "als": (("sddmm", False), ("sddmm", True), ("spmm", False),
+            ("spmm", True), ("fused", False), ("fused", True)),
+    "vanilla": (("fused", False),),
+}
+
+
 def _chain(step_fn, n: int):
     """The chained-trials program — must stay in lockstep with
     `bench.kernels._chain_time`'s jitted chain (same fori_loop shape), or
@@ -84,21 +96,24 @@ def compile_chain_pair(step_fn, state, trials: int, device,
     return times
 
 
+def load_executable(out_dir: str | pathlib.Path, name: str, n: int, device):
+    """Deserialize one saved executable onto ``device``. Raises on any
+    failure — callers fall back to the jitted path."""
+    from jax.experimental import serialize_executable as se
+
+    serialized, in_tree, out_tree = pickle.loads(
+        (pathlib.Path(out_dir) / f"{name}_{n}.pkl").read_bytes())
+    return se.deserialize_and_load(
+        serialized, in_tree, out_tree, backend=device.client,
+        execution_devices=[device])
+
+
 def load_chain_pair(out_dir: str | pathlib.Path, name: str, trials: int,
                     device) -> dict:
     """Deserialize the chain pair onto ``device``. Returns {n: callable}.
     Raises on any load failure — callers fall back to on-device jit."""
-    from jax.experimental import serialize_executable as se
-
-    out_dir = pathlib.Path(out_dir)
-    loaded = {}
-    for n in trip_counts(trials):
-        serialized, in_tree, out_tree = pickle.loads(
-            (out_dir / f"{name}_{n}.pkl").read_bytes())
-        loaded[n] = se.deserialize_and_load(
-            serialized, in_tree, out_tree, backend=device.client,
-            execution_devices=[device])
-    return loaded
+    return {n: load_executable(out_dir, name, n, device)
+            for n in trip_counts(trials)}
 
 
 def timed_difference(run, trials: int) -> float:

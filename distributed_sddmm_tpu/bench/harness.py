@@ -155,6 +155,7 @@ def benchmark_algorithm(
     devices=None,
     extra_info: Optional[dict] = None,
     breakdown: bool = False,
+    post_build=None,
 ) -> dict:
     """Run one benchmark configuration; append a JSON record to
     ``output_file`` (if given) and return it.
@@ -175,6 +176,10 @@ def benchmark_algorithm(
         )
 
     alg = make_algorithm(algorithm_name, S, R, c, kernel=kernel, devices=devices)
+    if post_build is not None:
+        # Hook for callers that prepare the strategy before any program
+        # runs — e.g. tpu_apps injecting offline-AOT-compiled executables.
+        post_build(alg)
 
     if app == "vanilla":
         elapsed, app_stats = _run_vanilla(alg, fused, trials, warmup)

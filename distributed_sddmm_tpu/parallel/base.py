@@ -235,6 +235,38 @@ class DistributedSparse(abc.ABC):
         """Global column order -> resident layout (identity default)."""
         return X
 
+    def inject_program(self, op: str, use_st: bool, loaded) -> None:
+        """Install a pre-built executable (e.g. a `deserialize_and_load`
+        result from an offline AOT compile, `scripts/aot_compile_apps.py`)
+        as this op's cached program under the CURRENT ablation mode.
+
+        Loaded executables are shape-rigid while the jitted program
+        retraces, so the installed wrapper falls back to the strategy's
+        own jit whenever the executable rejects a call (e.g. GAT's
+        per-layer feature widths) — correctness never depends on the
+        injection, only compile latency does.
+        """
+        import sys
+
+        from distributed_sddmm_tpu.parallel.loops import ablation
+
+        key = (op, use_st, ablation())
+        fallback = self._program(op, use_st)
+        warned = []
+
+        def dispatch(*args):
+            try:
+                return loaded(*args)
+            except Exception as e:  # noqa: BLE001 — any rejection -> jit
+                if not warned:
+                    warned.append(1)
+                    print(f"[aot] injected {op}/{use_st} program rejected a "
+                          f"call ({type(e).__name__}: {e}); jit fallback",
+                          file=sys.stderr)
+                return fallback(*args)
+
+        self._programs[key] = dispatch
+
     def dense_project(self, X: jax.Array, W: jax.Array, mode: MatMode) -> jax.Array:
         """Local dense projection ``X @ W`` in the canonical layout (the
         GAT per-head GEMM, reference `gat.hpp:88`). W is (R_in, R_out) in

@@ -88,6 +88,50 @@ def test_tune_blocks_setup_shapes():
     assert out[0].shape == B.shape
 
 
+def test_inject_program_roundtrip(tmp_path):
+    """A strategy program serialized offline and injected back produces
+    the same numerics as the jitted path, and shape-mismatched calls fall
+    back to the jit instead of failing (the GAT case)."""
+    import jax
+
+    from distributed_sddmm_tpu.common import MatMode
+    from distributed_sddmm_tpu.ops.kernels import XlaKernel
+    from distributed_sddmm_tpu.parallel.dense_shift_15d import DenseShift15D
+    from distributed_sddmm_tpu.utils.coo import HostCOO
+
+    dev = jax.devices("cpu")[0]
+    S = HostCOO.erdos_renyi(96, 80, 4, seed=5, values="normal")
+    alg = DenseShift15D(S, R=16, c=1, fusion_approach=2, kernel=XlaKernel(),
+                        devices=[dev])
+    A = alg.dummy_initialize(MatMode.A)
+    B = alg.dummy_initialize(MatMode.B)
+    ones = alg.like_s_values(1.0)
+    ref = np.asarray(alg.sddmm_a(A, B, ones))
+
+    prog = alg._program("sddmm", use_st=False)
+    args = (A, B, *alg._tile_args(alg.S_tiles, ones))
+
+    def sds_like(x):
+        return jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=x.sharding)
+
+    compiled = prog.lower(*(sds_like(x) for x in args)).compile()
+    aot.save_executable(compiled, tmp_path, "sddmm_a", 0)
+    loaded = aot.load_executable(tmp_path, "sddmm_a", 0, dev)
+
+    alg2 = DenseShift15D(S, R=16, c=1, fusion_approach=2, kernel=XlaKernel(),
+                        devices=[dev])
+    alg2.inject_program("sddmm", False, loaded)
+    got = np.asarray(alg2.sddmm_a(A, B, ones))
+    np.testing.assert_allclose(got, ref, rtol=1e-6)
+
+    # Shape mismatch (different R) must fall back to the jit, not raise.
+    alg2.set_r_value(8)
+    A8 = alg2.dummy_initialize(MatMode.A)
+    B8 = alg2.dummy_initialize(MatMode.B)
+    out8 = alg2.sddmm_a(A8, B8, ones)
+    assert np.asarray(out8).shape == np.asarray(ones).shape
+
+
 def test_chain_matches_chain_time_protocol(tmp_path):
     """aot._chain must mirror bench.kernels._chain_time's jitted fori_loop
     shape — a drift would make AOT timings incomparable to on-device ones."""
