@@ -111,6 +111,28 @@ while [ "$(date +%s)" -lt "$DEADLINE" ]; do
       KERNELS_TPU.jsonl --kernels -o artifacts/kernels_chart || true
     if [ -n "$failed" ] && ! healthy_pallas; then continue; fi
     run_step timeout 1800 python scripts/dist_gap.py || true
+    # Region-attribution breakdown on real hardware (round-4 stretch
+    # directive): one run, resumable via the output file's existence.
+    # Single chip forces c=1/nr=1, so Replication/Propagation are
+    # STRUCTURALLY zero here — the run proves the attribution pipeline on
+    # the target silicon; the c>1 bar shapes live in the CPU-mesh renders
+    # (artifacts/cpu_mesh). The ablation variants compile on-device (they
+    # are distinct programs the AOT caches don't cover), so re-gate Mosaic
+    # first — dist_gap above may have outlived the service.
+    if [ ! -f artifacts/tpu_breakdown/records.jsonl ]; then
+      if ! healthy_pallas; then continue; fi
+      mkdir -p artifacts/tpu_breakdown
+      run_step timeout 2400 python -m distributed_sddmm_tpu.bench \
+        er 14 32 15d_fusion2 128 1 --kernel pallas --trials 2 --breakdown \
+        -o artifacts/tpu_breakdown/records.jsonl || failed=1
+    fi
+    if [ -f artifacts/tpu_breakdown/records.jsonl ]; then
+      # Charts re-render every cycle like the other derived artifacts — a
+      # one-time render failure must not be locked in by the guard above.
+      run_step python -m distributed_sddmm_tpu.tools.charts \
+        artifacts/tpu_breakdown/records.jsonl -o artifacts/tpu_breakdown \
+        || true
+    fi
     run_step timeout 7200 python scripts/tpu_apps.py \
       || { sleep 300; continue; }
     if [ -n "$failed" ]; then
