@@ -234,12 +234,22 @@ def main(argv=None) -> int:
                 rec.update(status="bad-output", stderr=proc.stderr[-800:])
                 failures += 1
         else:
-            tail = "\n".join(proc.stderr.strip().splitlines()[-12:])
+            stderr_full = proc.stderr or ""
+            tail = "\n".join(stderr_full.strip().splitlines()[-12:])
             # A clamped probe means this probe matrix cannot represent the
             # config — NOT that the config can't compile at its real grid
-            # size; give it a status failed_preflight_keys ignores.
-            status = ("probe-invalid" if "preflight cannot vouch" in tail
-                      else "compile-error")
+            # size; a libtpu lockfile/busy clash means another local
+            # process held the TPU plugin (e.g. a concurrent preflight) —
+            # both get statuses failed_preflight_keys ignores, so neither
+            # can ever blacklist a measurable config. Classify on the FULL
+            # stderr (the signature can scroll above the stored tail).
+            if "preflight cannot vouch" in stderr_full:
+                status = "probe-invalid"
+            elif ("libtpu_lockfile" in stderr_full
+                  or "already in use" in stderr_full):
+                status = "env-transient"
+            else:
+                status = "compile-error"
             rec.update(status=status, error=tail)
             failures += 1
         results.append(rec)

@@ -104,6 +104,22 @@ while [ "$(date +%s)" -lt "$DEADLINE" ]; do
     run_step python scripts/kernel_sweep.py \
       scripts/plans/star_sweep.json KERNELS_TPU.jsonl --timeout 1500 --retries 1 \
       || failed=1
+    # Full reference cross-product (local_kernel_benchmark.cpp's grid) —
+    # affordable ONLY when AOT loads were validated (compiles then cost
+    # seconds offline instead of minutes on-chip), so gate on the probe's
+    # recorded answer.
+    # Same predicate the sweep itself applies (ok AND single-device AND
+    # not env-disabled) — a weaker shell copy could open the gate while
+    # run_worker silently falls back to on-chip compiles.
+    if python -c "
+import importlib.util, sys
+spec = importlib.util.spec_from_file_location('ks', 'scripts/kernel_sweep.py')
+m = importlib.util.module_from_spec(spec); spec.loader.exec_module(m)
+sys.exit(0 if m.aot_validated() else 1)" 2>/dev/null; then
+      run_step python scripts/kernel_sweep.py \
+        scripts/plans/full_cross.json KERNELS_TPU.jsonl --timeout 900 --retries 1 \
+        || failed=1
+    fi
     # Regenerate the derived artifacts from whatever measurements exist
     # (CPU-only work; safe alongside the TPU being idle between steps).
     run_step python scripts/summarize_kernels.py || true
