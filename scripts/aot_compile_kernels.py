@@ -45,15 +45,32 @@ def main() -> int:
     from distributed_sddmm_tpu.bench import aot
     from distributed_sddmm_tpu.ops.pallas_kernels import PallasKernel
 
+    import os
+
+    if os.environ.get("AOTC_KERNEL", "pallas") == "xla":
+        # The flat XLA-kernel chains (tune_blocks' non-Pallas branch).
+        from distributed_sddmm_tpu.ops.kernels import XlaKernel
+
+        S, A, B, _flops = tune.build_inputs(log_m, npr, R)
+        kern = XlaKernel()
+        rows, cols, vals = tune.xla_operands(S)
+        steps = tune.xla_steps(kern, rows, cols, vals, S, A)
+        topo = topologies.get_topology_desc(platform="tpu",
+                                            topology_name=TOPOLOGY)
+        report = {"ok": True, "kernel": "xla", "compile_s": {}}
+        for name, step in steps.items():
+            report["compile_s"][name] = aot.compile_chain_pair(
+                step, (B, vals), trials, topo.devices[0], out_dir, name)
+        (out_dir / "meta.json").write_text(json.dumps(report, indent=1))
+        print(json.dumps(report))
+        return 0
+
     if len(tune.BLOCKS) != 1:
         print("aot_compile_kernels expects exactly one TUNE_BLOCKS pair",
               file=sys.stderr)
         return 1
     bm_pref, bn_pref = tune.BLOCKS[0]
-    import os
-
     group = int(os.environ.get("TUNE_GROUP", "1"))
-
     S, A, B, _flops = tune.build_inputs(log_m, npr, R)
     meta, blk, cvals = tune.build_blk(S, bm_pref, bn_pref, group)
     if blk is None:

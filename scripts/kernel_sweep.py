@@ -143,7 +143,9 @@ def aot_precompile(cfg: dict, env: dict, timeout_s: float = 420.0) -> str | None
         except (OSError, json.JSONDecodeError):
             ok = False
         return str(out_dir) if ok else None
-    cenv = dict(env, JAX_PLATFORMS="cpu")
+    # Set unconditionally: a stray AOTC_KERNEL in the inherited env must
+    # never flip a pallas precompile into the xla branch (or vice versa).
+    cenv = dict(env, JAX_PLATFORMS="cpu", AOTC_KERNEL=cfg["kernel"])
     try:
         proc = subprocess.run(
             [sys.executable, str(REPO / "scripts" / "aot_compile_kernels.py"),
@@ -177,10 +179,10 @@ def run_worker(cfg: dict, timeout_s: float) -> list[dict] | None:
         env["TUNE_BATCH"] = "1" if cfg.get("batch") else "0"
         if cfg.get("fused_only"):
             env["TUNE_FUSED_ONLY"] = "1"
-        if aot_validated():
-            load_dir = aot_precompile(cfg, env)
-            if load_dir:
-                env["TUNE_LOAD_DIR"] = load_dir
+    if aot_validated():
+        load_dir = aot_precompile(cfg, env)
+        if load_dir:
+            env["TUNE_LOAD_DIR"] = load_dir
     proc = subprocess.Popen(
         [sys.executable, str(REPO / "scripts" / "tune_blocks.py"),
          str(cfg["logM"]), str(cfg["npr"]), str(cfg["R"]),

@@ -77,6 +77,30 @@ def build_blk(S, bm_pref: int, bn_pref: int, group: int):
     return meta, blk, jnp.array(vals_np)
 
 
+def xla_operands(S):
+    """Device COO operands for the XLA kernel branch (single home for the
+    dtype/shape conversion the offline AOT compiler must replicate)."""
+    return (jnp.array(S.rows.astype(np.int32)),
+            jnp.array(S.cols.astype(np.int32)),
+            jnp.array(S.vals.astype(np.float32)))
+
+
+def xla_steps(kern, rows, cols, vals, S, A) -> dict:
+    """The XLA-kernel chained-trial step functions (shared with the
+    offline AOT compiler). The moving operand B rides the chained state."""
+
+    def sddmm_step(state):
+        Bs, v = state
+        out = kern.sddmm(rows, cols, v, A, Bs)
+        return (Bs + out.sum() * 1e-30, v)
+
+    def spmm_step(state):
+        Bs, _ = state
+        return (Bs + kern.spmm(rows, cols, vals, Bs, S.M)[: S.N] * 1e-12, _)
+
+    return {"xla_sddmm": sddmm_step, "xla_spmm": spmm_step}
+
+
 def pallas_steps(kernp, blk, cvals, S, A) -> dict:
     """The three chained-trial step functions (shared with the offline AOT
     compiler so the serialized programs are byte-identical in structure).
@@ -146,22 +170,15 @@ def main():
 
     if not SKIP_XLA:
         kern = XlaKernel()
-        rows = jnp.array(S.rows.astype(np.int32))
-        cols = jnp.array(S.cols.astype(np.int32))
-        vals = jnp.array(S.vals.astype(np.float32))
+        rows, cols, vals = xla_operands(S)
+        steps = xla_steps(kern, rows, cols, vals, S, A)
 
-        def sddmm_step(state):
-            Bs, v = state
-            out = kern.sddmm(rows, cols, v, A, Bs)
-            return (Bs + out.sum() * 1e-30, v)
-
-        def spmm_step(state):
-            Bs, _ = state
-            return (Bs + kern.spmm(rows, cols, vals, Bs, S.M)[: S.N] * 1e-12, _)
-
-        t_sddmm = _chain_time(sddmm_step, (B, vals), trials)
-        t_spmm = _chain_time(spmm_step, (B, vals), trials)
+        t_sddmm, aot_s = _timed_op("xla_sddmm", steps["xla_sddmm"],
+                                   (B, vals), trials)
+        t_spmm, aot_m = _timed_op("xla_spmm", steps["xla_spmm"],
+                                  (B, vals), trials)
         rec = {"kernel": "xla", "logM": log_m, "npr": npr, "R": R,
+               "aot": aot_s and aot_m,
                "sddmm_ms": t_sddmm * 1e3, "spmm_ms": t_spmm * 1e3,
                "sddmm_gflops": flops / t_sddmm / 1e9,
                "spmm_gflops": flops / t_spmm / 1e9,
