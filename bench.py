@@ -104,9 +104,10 @@ def worker() -> None:
     alg, prog, A, B, targs = build_headline(kernel)
     nnz = alg.S_tiles.nnz
 
-    # Pre-serialized AOT executables (offline Mosaic compile) when the
-    # orchestrator validated loads on this backend; on-device jit otherwise
-    # or on ANY failure along the AOT path.
+    # Pre-serialized AOT executables (offline compile — Mosaic or flat XLA
+    # depending on the rung's kernel) when the orchestrator validated loads
+    # on this backend; on-device jit otherwise or on ANY failure along the
+    # AOT path.
     chains = None
     used_aot = False
     aot_dir = os.environ.get("BENCH_AOT_DIR", "")
@@ -255,9 +256,9 @@ def _bench_code_hash() -> str:
 def _maybe_aot_dir(env_extra: dict, timeout_s: float = 420.0) -> str | None:
     """Offline-compile the headline chain for this attempt's knobs and
     return the cache dir for BENCH_AOT_DIR — or None for on-device compile
-    (not validated / compile failed / XLA or CPU rung)."""
-    if env_extra.get("BENCH_PLATFORM") == "cpu" or \
-            env_extra.get("BENCH_KERNEL") == "xla" or not _aot_validated():
+    (not validated / compile failed / CPU rung). TPU rungs of BOTH kernels
+    qualify — the Mosaic-outage rescue rung gets a flat XLA program."""
+    if env_extra.get("BENCH_PLATFORM") == "cpu" or not _aot_validated():
         return None
     here = os.path.dirname(os.path.abspath(__file__))
     env = dict(os.environ)
@@ -267,7 +268,8 @@ def _maybe_aot_dir(env_extra: dict, timeout_s: float = 420.0) -> str | None:
     from distributed_sddmm_tpu.ops.blocked import knob_env_defaults
 
     key_names = ("BENCH_LOG_M", "BENCH_NNZ_PER_ROW", "BENCH_R",
-                 "BENCH_TRIALS") + tuple(sorted(knob_env_defaults()))
+                 "BENCH_TRIALS", "BENCH_KERNEL") + tuple(
+                     sorted(knob_env_defaults()))
     knobs = "_".join(
         f"{k.rsplit('_', 1)[-1]}{env.get(k, '')}" for k in key_names)
     out_dir = os.path.join(here, "artifacts", "aot_bench",

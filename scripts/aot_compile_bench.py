@@ -44,12 +44,17 @@ def main() -> int:
     bench = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(bench)
 
+    from distributed_sddmm_tpu.ops.kernels import XlaKernel
     from distributed_sddmm_tpu.ops.pallas_kernels import PallasKernel
     from distributed_sddmm_tpu.parallel.mesh import make_grid
 
-    # The on-device worker's get_kernel("auto") resolves to the bf16 Mosaic
-    # kernel on TPU; compile exactly that.
-    kernel = PallasKernel(precision="bf16", interpret=False)
+    # Compile exactly what the on-device worker would run: get_kernel
+    # ("auto") resolves to the bf16 Mosaic kernel on TPU; the Mosaic-outage
+    # rescue rung exports BENCH_KERNEL=xla and gets the flat XLA program.
+    if os.environ.get("BENCH_KERNEL", "auto") == "xla":
+        kernel = XlaKernel()
+    else:
+        kernel = PallasKernel(precision="bf16", interpret=False)
     t0 = time.monotonic()
     alg, _prog, A, B, targs = bench.build_headline(
         kernel, devices=jax.devices("cpu")[:1])
@@ -72,7 +77,8 @@ def main() -> int:
     from distributed_sddmm_tpu.ops.blocked import knob_env_defaults
 
     key_names = ("BENCH_LOG_M", "BENCH_NNZ_PER_ROW", "BENCH_R",
-                 "BENCH_TRIALS") + tuple(sorted(knob_env_defaults()))
+                 "BENCH_TRIALS", "BENCH_KERNEL") + tuple(
+                     sorted(knob_env_defaults()))
     report = {"ok": True, "build_s": build_s, "compile_s": {}, "env": {
         k: os.environ.get(k, "") for k in key_names}}
     from distributed_sddmm_tpu.bench import aot
