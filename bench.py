@@ -218,21 +218,39 @@ def _best_measured_env() -> dict | None:
     }
 
 
-def _aot_validated() -> bool:
+_AOT_GATE = None
+
+
+def _aot_gate():
+    """The shared AOT-gate policy module, imported from its FILE — going
+    through the package would execute distributed_sddmm_tpu/__init__ and
+    pull jax into this deliberately backend-free orchestrator process."""
+    global _AOT_GATE
+    if _AOT_GATE is None:
+        import importlib.util
+
+        p = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         "distributed_sddmm_tpu", "bench", "aot_gate.py")
+        spec = importlib.util.spec_from_file_location("_aot_gate_file", p)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        _AOT_GATE = mod
+    return _AOT_GATE
+
+
+def _aot_validated(program: str | None = None) -> bool:
     """AOT_LOAD.json (scripts/aot_load_probe.py) recorded that re-homed
-    executables load correctly on this backend."""
+    executables load correctly on this backend. ``program`` gates on one
+    probe program ("pallas_fused"/"xla_matmul") so one program's failure
+    doesn't foreclose AOT mode for the other; no argument = ALL programs.
+    Policy lives in aot_gate (shared with the sweep/apps/dist-gap)."""
     if os.environ.get("BENCH_NO_AOT", "") not in ("", "0"):
         return False
-    try:
-        with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                               "AOT_LOAD.json")) as f:
-            rep = json.load(f)
-        # The offline compiler targets ONE device; on a multi-chip backend
-        # the worker would discard the dir anyway — don't spend precompile
-        # budget on it (the probe records its backend's device count).
-        return bool(rep.get("ok")) and int(rep.get("n_devices", 1)) == 1
-    except (OSError, json.JSONDecodeError, ValueError):
-        return False
+    gate = _aot_gate()
+    rep = gate.load_verdict(
+        os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     "AOT_LOAD.json"))
+    return gate.probe_validated(rep, program)
 
 
 def _bench_code_hash() -> str:
@@ -258,7 +276,11 @@ def _maybe_aot_dir(env_extra: dict, timeout_s: float = 420.0) -> str | None:
     return the cache dir for BENCH_AOT_DIR — or None for on-device compile
     (not validated / compile failed / CPU rung). TPU rungs of BOTH kernels
     qualify — the Mosaic-outage rescue rung gets a flat XLA program."""
-    if env_extra.get("BENCH_PLATFORM") == "cpu" or not _aot_validated():
+    # Kernel resolved from the MERGED env — the worker and the cache key
+    # both see os.environ ∪ env_extra, and the gate must agree with them.
+    merged_kernel = {**os.environ, **env_extra}.get("BENCH_KERNEL", "auto")
+    if env_extra.get("BENCH_PLATFORM") == "cpu" or not _aot_validated(
+            _aot_gate().probe_program(merged_kernel)):
         return None
     here = os.path.dirname(os.path.abspath(__file__))
     env = dict(os.environ)
@@ -296,15 +318,25 @@ def _maybe_aot_dir(env_extra: dict, timeout_s: float = 420.0) -> str | None:
                                           "aot_compile_bench.py"), out_dir],
             env=env, capture_output=True, text=True, timeout=timeout_s)
     except subprocess.TimeoutExpired:
+        # One timeout is not a deterministic failure — it may be this
+        # machine's load spike, or a capped remaining-window budget.
+        # aot_gate.timeout_strike tombstones only after two strikes from
+        # INDEPENDENT episodes (>=30 min apart; bench and dist_gap share
+        # this cache dir, so same-spike strikes must not compound).
         print("[bench] AOT precompile timed out; on-device compile",
               file=sys.stderr)
-        record_failure(f"timeout after {timeout_s:.0f}s")
+        if _aot_gate().timeout_strike(out_dir,
+                                      full_budget=timeout_s >= 420.0):
+            record_failure(f"repeated timeouts ({timeout_s:.0f}s budget)")
         return None
     if proc.returncode != 0 or not os.path.exists(meta):
         tail = (proc.stderr or "").strip().splitlines()[-3:]
         print(f"[bench] AOT precompile failed (rc={proc.returncode}, {tail}); "
               "on-device compile", file=sys.stderr)
-        record_failure(f"rc={proc.returncode}: {tail}")
+        if proc.returncode >= 0 and not os.path.exists(meta):
+            # Negative rc = signal kill (transient); an existing meta is
+            # the compiler's own verdict — never clobber it with ours.
+            record_failure(f"rc={proc.returncode}: {tail}")
         return None
     return out_dir
 

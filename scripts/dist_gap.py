@@ -183,8 +183,15 @@ def main() -> int:
     bench = _load_bench()
     _apply_tuned_env(bench, log_m, npr, R)
     # bench's AOT cache + compiler read the trip count from the env; a
-    # mismatch would serialize pairs the loader can never find.
+    # mismatch would serialize pairs the loader can never find. When
+    # BENCH_TRIALS was already exported it wins over argv, so re-derive
+    # trials from the env — loader and compiler must agree on the names.
     os.environ.setdefault("BENCH_TRIALS", str(trials))
+    try:
+        trials = int(os.environ["BENCH_TRIALS"])
+    except ValueError:
+        # Malformed export: fall back to argv and force agreement.
+        os.environ["BENCH_TRIALS"] = str(trials)
 
     if compile_dir is not None:
         compile_dir.mkdir(parents=True, exist_ok=True)
@@ -222,7 +229,7 @@ def main() -> int:
     # Offline-compile the tile/prep chains when loads are validated (the
     # subprocess is local + seconds; failures fall back per program).
     tile_dir = None
-    if jax.device_count() == 1 and bench._aot_validated():
+    if jax.device_count() == 1 and bench._aot_validated("pallas_fused"):
         d = _tile_cache_dir(bench, log_m, npr, R, trials)
         if not (d / "meta.json").exists():
             env = dict(os.environ, JAX_PLATFORMS="cpu")
@@ -232,14 +239,27 @@ def main() -> int:
                     [sys.executable, __file__, "--aot-compile", str(d),
                      str(log_m), str(npr), str(R), str(trials)],
                     env=env, capture_output=True, text=True, timeout=420)
-                if proc.returncode != 0:
+                if proc.returncode > 0:
                     fail = "\n".join(
                         (proc.stderr or "").strip().splitlines()[-5:])
+                elif proc.returncode < 0:
+                    # Signal kill (OOM etc.) — transient, no tombstone.
+                    print(f"[dist-gap] AOT precompile killed "
+                          f"(rc={proc.returncode}); on-device compile "
+                          "this run", file=sys.stderr)
             except subprocess.TimeoutExpired:
-                fail = "timeout after 420s"
-            if fail is not None:
+                # Same strike policy as bench/kernel_sweep (aot_gate):
+                # skip AOT this run; tombstone only after timeouts from
+                # two independent load episodes.
+                print("[dist-gap] AOT precompile timed out; on-device "
+                      "compile this run", file=sys.stderr)
+                if bench._aot_gate().timeout_strike(d):
+                    fail = "repeated timeouts (420s budget)"
+            if fail is not None and not (d / "meta.json").exists():
                 # Negative cache + diagnostics: a deterministic local
                 # compile failure must not re-spend its timeout each run.
+                # An existing meta is the compiler's own verdict (written
+                # as its final act) — never clobber it with ours.
                 print(f"[dist-gap] AOT precompile failed: {fail}",
                       file=sys.stderr)
                 d.mkdir(parents=True, exist_ok=True)

@@ -97,18 +97,38 @@ def done_keys(out_path: pathlib.Path) -> set:
     return keys
 
 
-def aot_validated() -> bool:
+_AOT_GATE = None
+
+
+def _aot_gate():
+    """Shared AOT-gate policy module, imported from its FILE — the package
+    __init__ would pull jax into this backend-free orchestrator."""
+    global _AOT_GATE
+    if _AOT_GATE is None:
+        import importlib.util
+
+        p = REPO / "distributed_sddmm_tpu" / "bench" / "aot_gate.py"
+        spec = importlib.util.spec_from_file_location("_aot_gate_file", str(p))
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        _AOT_GATE = mod
+    return _AOT_GATE
+
+
+def aot_validated(program: str | None = None) -> bool:
     """True when the AOT-load probe recorded that locally compiled
     executables load and produce correct numerics on this backend
-    (AOT_LOAD.json, written by scripts/aot_load_probe.py)."""
+    (AOT_LOAD.json, written by scripts/aot_load_probe.py).
+
+    ``program`` gates on one probe program ("pallas_fused"/"xla_matmul") —
+    one program's failure must not foreclose AOT mode for the other; with
+    no argument, ALL programs must be validated. Policy shared with
+    bench.py via aot_gate."""
     if os.environ.get("KERNEL_SWEEP_NO_AOT", "") not in ("", "0"):
         return False
-    try:
-        rep = json.loads((REPO / "AOT_LOAD.json").read_text())
-        # Single-device serialized targets only (see bench._aot_validated).
-        return bool(rep.get("ok")) and int(rep.get("n_devices", 1)) == 1
-    except (OSError, json.JSONDecodeError, ValueError):
-        return False
+    gate = _aot_gate()
+    return gate.probe_validated(
+        gate.load_verdict(REPO / "AOT_LOAD.json"), program)
 
 
 def _aot_code_hash() -> str:
@@ -143,6 +163,12 @@ def aot_precompile(cfg: dict, env: dict, timeout_s: float = 420.0) -> str | None
         except (OSError, json.JSONDecodeError):
             ok = False
         return str(out_dir) if ok else None
+    def tombstone(reason: str) -> None:
+        # Negative cache: a deterministic local compile failure must not
+        # re-spend its ~420s timeout on every retry of every queue cycle.
+        out_dir.mkdir(parents=True, exist_ok=True)
+        meta_path.write_text(json.dumps({"ok": False, "error": reason}))
+
     # Set unconditionally: a stray AOTC_KERNEL in the inherited env must
     # never flip a pallas precompile into the xla branch (or vice versa).
     cenv = dict(env, JAX_PLATFORMS="cpu", AOTC_KERNEL=cfg["kernel"])
@@ -153,6 +179,13 @@ def aot_precompile(cfg: dict, env: dict, timeout_s: float = 420.0) -> str | None
              str(cfg.get("trials", 5)), str(out_dir)],
             env=cenv, capture_output=True, text=True, timeout=timeout_s)
     except subprocess.TimeoutExpired:
+        # A timeout on a loaded machine is not proof of a deterministic
+        # failure (the preflight treats timeouts as non-conclusive).
+        # aot_gate.timeout_strike tombstones only after strikes from two
+        # INDEPENDENT load episodes (>=30 min apart) — the retry loop's
+        # same-spike repeats count as one.
+        if _aot_gate().timeout_strike(out_dir):
+            tombstone(f"repeated timeouts ({timeout_s:.0f}s budget)")
         print(f"[sweep] AOT precompile timed out for {config_key(cfg)}; "
               "using on-device compile", flush=True)
         return None
@@ -161,6 +194,9 @@ def aot_precompile(cfg: dict, env: dict, timeout_s: float = 420.0) -> str | None
         print(f"[sweep] AOT precompile failed for {config_key(cfg)} "
               f"(rc={proc.returncode}, {tail}); using on-device compile",
               flush=True)
+        if proc.returncode >= 0 and not (out_dir / "meta.json").exists():
+            # Negative rc = signal kill (OOM etc.) — transient, no tombstone.
+            tombstone(f"rc={proc.returncode}: {tail}")
         return None
     return str(out_dir)
 
@@ -179,7 +215,7 @@ def run_worker(cfg: dict, timeout_s: float) -> list[dict] | None:
         env["TUNE_BATCH"] = "1" if cfg.get("batch") else "0"
         if cfg.get("fused_only"):
             env["TUNE_FUSED_ONLY"] = "1"
-    if aot_validated():
+    if aot_validated(_aot_gate().probe_program(cfg["kernel"])):
         load_dir = aot_precompile(cfg, env)
         if load_dir:
             env["TUNE_LOAD_DIR"] = load_dir

@@ -84,7 +84,7 @@ def _aot_post_build(app: str, log_m: int, npr: int, R: int):
     if app not in APP_AOT_KEYS:
         return None
     bench, code_hash = _bench_module()
-    if not bench._aot_validated():
+    if not bench._aot_validated("pallas_fused"):
         return None
 
     from distributed_sddmm_tpu.ops.blocked import knob_env_defaults

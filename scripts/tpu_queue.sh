@@ -73,7 +73,12 @@ while [ "$(date +%s)" -lt "$DEADLINE" ]; do
     # backend flaked mid-probe (no answer written) — retry next cycle. The
     # probe bounds its own phases (600s each, process-group kills); the
     # outer timeout is a generous backstop above that worst case.
-    if [ ! -f AOT_LOAD.json ]; then
+    # --check-stale: exit 0 = recorded verdict current+complete; any
+    # other rc (3 = missing/stale/incomplete, 1 = checker crashed) =
+    # (re-)probe. Verdicts from older per-program chain versions (e.g.
+    # v1's bf16-precision xla false-negative) are pruned by the checker
+    # while still-valid sibling verdicts keep gating their AOT modes.
+    if ! python scripts/aot_load_probe.py --check-stale; then
       run_step timeout 1500 python scripts/aot_load_probe.py || true
     fi
     # ALS/GAT application records first (round-directive evidence with none
@@ -108,14 +113,15 @@ while [ "$(date +%s)" -lt "$DEADLINE" ]; do
     # affordable ONLY when AOT loads were validated (compiles then cost
     # seconds offline instead of minutes on-chip), so gate on the probe's
     # recorded answer.
-    # Same predicate the sweep itself applies (ok AND single-device AND
-    # not env-disabled) — a weaker shell copy could open the gate while
-    # run_worker silently falls back to on-chip compiles.
+    # Gate on the PALLAS probe program specifically: Mosaic on-chip
+    # compiles (2-12 min each) are what make the full grid unaffordable;
+    # an xla-only validation must not open it (pallas configs would all
+    # fall back to on-chip Mosaic compiles and burn the window).
     if python -c "
 import importlib.util, sys
 spec = importlib.util.spec_from_file_location('ks', 'scripts/kernel_sweep.py')
 m = importlib.util.module_from_spec(spec); spec.loader.exec_module(m)
-sys.exit(0 if m.aot_validated() else 1)" 2>/dev/null; then
+sys.exit(0 if m.aot_validated('pallas_fused') else 1)" 2>/dev/null; then
       run_step python scripts/kernel_sweep.py \
         scripts/plans/full_cross.json KERNELS_TPU.jsonl --timeout 900 --retries 1 \
         || failed=1

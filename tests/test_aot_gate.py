@@ -1,0 +1,105 @@
+"""Pin the shared AOT-gate policy (distributed_sddmm_tpu/bench/aot_gate.py):
+verdict gating per probe program, and the independent-episode timeout-strike
+rule that decides when a permanent ok:false tombstone is justified."""
+
+import time
+
+from distributed_sddmm_tpu.bench import aot_gate
+
+
+def _verdict(pallas_ok, xla_ok, n_devices=1, overall=None, versions=None):
+    versions = versions or aot_gate.PROGRAM_VERSIONS
+    progs = {"pallas_fused": {"ok": pallas_ok,
+                              "program_version": versions["pallas_fused"]},
+             "xla_matmul": {"ok": xla_ok,
+                            "program_version": versions["xla_matmul"]}}
+    return {"ok": (pallas_ok and xla_ok) if overall is None else overall,
+            "n_devices": n_devices, "programs": progs}
+
+
+def test_probe_program_mapping():
+    assert aot_gate.probe_program("xla") == "xla_matmul"
+    assert aot_gate.probe_program("pallas") == "pallas_fused"
+    assert aot_gate.probe_program("auto") == "pallas_fused"
+
+
+def test_probe_validated_per_program():
+    rep = _verdict(pallas_ok=True, xla_ok=False)
+    assert aot_gate.probe_validated(rep, "pallas_fused")
+    assert not aot_gate.probe_validated(rep, "xla_matmul")
+    # No-arg = ALL programs (the conservative historical contract).
+    assert not aot_gate.probe_validated(rep)
+    assert aot_gate.probe_validated(_verdict(True, True))
+
+
+def test_probe_validated_rejects_version_stale_entries():
+    # A verdict earned by an older probe chain must not open any gate,
+    # even when the queue's --check-stale pruning hasn't run yet.
+    stale = {n: v - 1 for n, v in aot_gate.PROGRAM_VERSIONS.items()}
+    rep = _verdict(True, True, versions=stale)
+    assert not aot_gate.probe_validated(rep, "pallas_fused")
+    assert not aot_gate.probe_validated(rep, "xla_matmul")
+    assert not aot_gate.probe_validated(rep)
+    # Entries with no program_version field are implicitly version 1.
+    rep1 = _verdict(True, True)
+    for e in rep1["programs"].values():
+        del e["program_version"]
+    assert aot_gate.probe_validated(rep1, "pallas_fused") == (
+        aot_gate.PROGRAM_VERSIONS["pallas_fused"] == 1)
+    assert aot_gate.probe_validated(rep1, "xla_matmul") == (
+        aot_gate.PROGRAM_VERSIONS["xla_matmul"] == 1)
+
+
+def test_probe_validated_rejects_multichip_and_garbage():
+    assert not aot_gate.probe_validated(_verdict(True, True, n_devices=8))
+    assert not aot_gate.probe_validated({})
+    assert not aot_gate.probe_validated({"n_devices": "x", "ok": True})
+    assert not aot_gate.probe_validated({}, "pallas_fused")
+
+
+def test_load_verdict_missing(tmp_path):
+    assert aot_gate.load_verdict(tmp_path / "nope.json") == {}
+    bad = tmp_path / "bad.json"
+    bad.write_text("{not json")
+    assert aot_gate.load_verdict(bad) == {}
+
+
+def test_timeout_strike_same_episode_not_conclusive(tmp_path):
+    d = tmp_path / "cfg"
+    # First strike: never conclusive.
+    assert not aot_gate.timeout_strike(d)
+    # Seconds later (retry loop / sibling script, same load spike): still
+    # one episode, still not conclusive.
+    assert not aot_gate.timeout_strike(d)
+    assert not aot_gate.timeout_strike(d)
+
+
+def test_timeout_strike_independent_episodes_conclusive(tmp_path):
+    d = tmp_path / "cfg"
+    assert not aot_gate.timeout_strike(d)
+    # Age the recorded strike past the episode window.
+    f = d / "timeouts"
+    old = time.time() - aot_gate.STRIKE_WINDOW_S - 60
+    f.write_text(f"{old:.0f}")
+    assert aot_gate.timeout_strike(d)
+
+
+def test_timeout_strike_capped_budget_never_counts(tmp_path):
+    d = tmp_path / "cfg"
+    old = time.time() - aot_gate.STRIKE_WINDOW_S - 60
+    d.mkdir()
+    (d / "timeouts").write_text(f"{old:.0f}")
+    # Capped budget: not conclusive even against an old strike, and the
+    # history is not extended.
+    assert not aot_gate.timeout_strike(d, full_budget=False)
+    assert (d / "timeouts").read_text() == f"{old:.0f}"
+
+
+def test_timeout_strike_ignores_legacy_counters(tmp_path):
+    d = tmp_path / "cfg"
+    d.mkdir()
+    # Pre-policy files held small integer counts; "2" must not be read as
+    # an epoch from 1970 (which would look like an ancient strike and
+    # tombstone immediately).
+    (d / "timeouts").write_text("2")
+    assert not aot_gate.timeout_strike(d)
