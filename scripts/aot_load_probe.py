@@ -66,9 +66,12 @@ def _load_aot_gate():
 
 PROGRAM_VERSIONS = _load_aot_gate().PROGRAM_VERSIONS
 # Identity of the cached phase-A outputs; any program change re-builds.
+# JSON-normalized (lists, not tuples): cache_is_fresh compares against the
+# json round-trip of this value, and ["a", 1] != ("a", 1) in Python —
+# tuples here would make the cache permanently "stale".
 PROBE_VERSION = max(PROGRAM_VERSIONS.values())
-PROBE_KEY = (TOPOLOGY, LOG_M, NPR, R, TRIALS,
-             tuple(sorted(PROGRAM_VERSIONS.items())))
+PROBE_KEY = [TOPOLOGY, LOG_M, NPR, R, TRIALS,
+             [[n, v] for n, v in sorted(PROGRAM_VERSIONS.items())]]
 
 
 def check_stale(out_path: pathlib.Path) -> int:

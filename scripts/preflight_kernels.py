@@ -126,13 +126,84 @@ def compile_one(cfg: dict, device) -> dict:
     return report
 
 
+# The r_split far corner: the reference sweeps R to 4096
+# (`local_kernel_benchmark.cpp:278`); full-R one-hot blocks cannot compile
+# there (the configs report proves it), and the DESIGNED escape is the
+# 1.5D sparse-shift feature split (`15D_sparse_shift.hpp:139-157` analog):
+# per-device kernels see R*c/p columns. This compiles the blocked Mosaic
+# programs of that path for the full 8-device v5e topology, proving the
+# prescribed grid's far corner is reachable by design.
+RSPLIT_CFG = {"R": 4096, "c": 1, "logM": 13, "npr": 8}
+
+
+def compile_rsplit(cfg: dict) -> dict:
+    """AOT-compile the blocked 1.5D sparse-shift sddmm+spmm programs (the
+    fused pair chains exactly these two, `distributed_sparse.h:296-312`)
+    over the v5e:2x4 topology mesh at per-device R-slices."""
+    jax.config.update("jax_platforms", "cpu")
+    from distributed_sddmm_tpu.common import MatMode
+    from distributed_sddmm_tpu.ops.pallas_kernels import PallasKernel
+    from distributed_sddmm_tpu.parallel.mesh import GridSpec, make_grid
+    from distributed_sddmm_tpu.parallel.sparse_shift_15d import SparseShift15D
+    from distributed_sddmm_tpu.utils.coo import HostCOO
+
+    cpu = jax.devices()
+    assert len(cpu) >= 8, "needs XLA_FLAGS=--xla_force_host_platform_device_count=8"
+    R, c = cfg["R"], cfg["c"]
+    topo = topologies.get_topology_desc(platform="tpu", topology_name=TOPOLOGY)
+    S = HostCOO.rmat(log_m=cfg["logM"], edge_factor=cfg["npr"], seed=0)
+    # Ingest on the CPU mesh with the interpret kernel (builds the blocked
+    # chunk-list metadata), then retarget the topology mesh with the real
+    # Mosaic kernel — the run_pallas.py census pattern.
+    alg = SparseShift15D(S, R, c=c, devices=cpu[:8],
+                         kernel=PallasKernel(precision="f32", interpret=True))
+    A = alg.dummy_initialize(MatMode.A)
+    B = alg.dummy_initialize(MatMode.B)
+    vals = alg.like_s_values(1.0)
+    g = alg.grid
+    tpu_grid = make_grid(g.nr, g.nc, g.nh, adjacency=g.adjacency,
+                         devices=list(topo.devices))
+    alg.grid = GridSpec(mesh=tpu_grid.mesh, nr=g.nr, nc=g.nc, nh=g.nh,
+                        adjacency=g.adjacency)
+    alg.kernel = PallasKernel(precision="bf16", interpret=False)
+    alg._programs.clear()
+    mesh = alg.grid.mesh
+
+    def sds_like(x):
+        return jax.ShapeDtypeStruct(
+            x.shape, x.dtype,
+            sharding=jax.sharding.NamedSharding(mesh, x.sharding.spec))
+
+    bm, bn, *_ = alg.S_tiles.blk_geom
+    rec = {**cfg, "p": 8, "r_local": R * c // 8, "blocks": f"{bm}x{bn}",
+           "strategy": "15d_sparse", "kernel": "pallas-bf16 blocked",
+           "topology": TOPOLOGY}
+    for op, call_args in (
+        ("sddmm", (A, B, *alg._sddmm_args(alg.S_tiles, vals))),
+        ("spmm", (B, *alg._spmm_args(alg.S_tiles, vals))),
+    ):
+        t0 = time.monotonic()
+        prog = alg._program(op, False)
+        compiled = prog.lower(*(sds_like(a) for a in call_args)).compile()
+        rec[f"{op}_compile_s"] = round(time.monotonic() - t0, 2)
+        rec[f"{op}_mosaic_calls"] = compiled.as_text().count(
+            'custom_call_target="tpu_custom_call"')
+    return rec
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("plans", nargs="*", help="plan JSONs (default: scripts/plans/*)")
     ap.add_argument("-o", "--output", default=str(REPO / "PREFLIGHT.json"))
     ap.add_argument("--config-json", default=None,
                     help="(internal) compile ONE config, passed as JSON")
+    ap.add_argument("--rsplit-json", default=None,
+                    help="(internal) compile the r_split programs, cfg as JSON")
     args = ap.parse_args(argv)
+
+    if args.rsplit_json:
+        print(json.dumps(compile_rsplit(json.loads(args.rsplit_json))))
+        return 0
 
     if args.config_json:
         # Pure AOT work — pin the default backend to CPU so nothing can
@@ -155,9 +226,12 @@ def main(argv=None) -> int:
     # an outer timeout must not discard the committed report's knowledge
     # (each fresh result replaces its key as the run progresses).
     old_by_key = {}
+    rsplit_state = {}
     try:
-        for rec in json.loads(out_path.read_text()).get("configs", []):
+        old_report = json.loads(out_path.read_text())
+        for rec in old_report.get("configs", []):
             old_by_key[preflight_key(rec)] = rec
+        rsplit_state = old_report.get("r_split") or {}
     except (OSError, json.JSONDecodeError, KeyError):
         pass
 
@@ -169,6 +243,8 @@ def main(argv=None) -> int:
                        "here means the queue would hang/fail on this config",
                "complete": len(results) == len(configs),
                "configs": merged}
+        if rsplit_state:
+            out["r_split"] = rsplit_state
         # Atomic replace: an outer SIGTERM mid-write must not truncate the
         # report (a broken JSON disables all preflight skipping AND
         # clobbers the committed known-good file).
@@ -260,7 +336,52 @@ def main(argv=None) -> int:
               f"scatter={cfg.get('scatter', 'bt')} batch={bool(cfg.get('batch'))} "
               f"({rec['wall_s']}s)", flush=True)
 
-    print(f"[preflight] {len(results) - failures}/{len(results)} ok -> {out_path}")
+    # Per-config tally frozen here: the r_split outcome below feeds the
+    # exit code but must not misattribute its failure to the config list.
+    cfg_failures = failures
+
+    # r_split far-corner proof (resumable: a matching ok record stands).
+    rsplit_current = (rsplit_state.get("status") == "ok"
+                      and all(rsplit_state.get(k) == v
+                              for k, v in RSPLIT_CFG.items()))
+    if not rsplit_current:
+        t0 = time.monotonic()
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["PYTHONPATH"] = f"{REPO}:{env.get('PYTHONPATH', '')}"
+        env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "")
+                            + " --xla_force_host_platform_device_count=8").strip()
+        try:
+            proc = subprocess.run(
+                [sys.executable, __file__, "--rsplit-json",
+                 json.dumps(RSPLIT_CFG)],
+                env=env, capture_output=True, text=True, timeout=900)
+        except subprocess.TimeoutExpired:
+            proc = None
+        wall = round(time.monotonic() - t0, 1)
+        if proc is not None and proc.returncode == 0:
+            try:
+                rsplit_state = {"status": "ok", "wall_s": wall, **json.loads(
+                    proc.stdout.strip().splitlines()[-1])}
+            except (json.JSONDecodeError, IndexError):
+                rsplit_state = {"status": "bad-output", "wall_s": wall,
+                                **RSPLIT_CFG, "stderr": proc.stderr[-800:]}
+                failures += 1
+        else:
+            tail = "timeout" if proc is None else "\n".join(
+                (proc.stderr or "").strip().splitlines()[-12:])
+            rsplit_state = {"status": "compile-error", "wall_s": wall,
+                            **RSPLIT_CFG, "error": tail}
+            failures += 1
+        flush_report()
+        print(f"[preflight] r_split {rsplit_state['status']} "
+              f"R={RSPLIT_CFG['R']} c={RSPLIT_CFG['c']} "
+              f"r_local={RSPLIT_CFG['R'] * RSPLIT_CFG['c'] // 8} "
+              f"({wall}s)", flush=True)
+
+    print(f"[preflight] {len(results) - cfg_failures}/{len(results)} "
+          f"configs ok, r_split {rsplit_state.get('status', '?')} "
+          f"-> {out_path}")
     return 1 if failures else 0
 
 
