@@ -201,7 +201,9 @@ def aot_precompile(cfg: dict, env: dict, timeout_s: float = 420.0) -> str | None
     return str(out_dir)
 
 
-def run_worker(cfg: dict, timeout_s: float) -> list[dict] | None:
+def worker_env(cfg: dict) -> dict:
+    """The tune_blocks subprocess env for one plan config — also what the
+    offline AOT compiler keys its cache on, so prewarm and run must agree."""
     env = dict(os.environ)
     env["PYTHONPATH"] = f"{REPO}:{env.get('PYTHONPATH', '')}"
     if cfg["kernel"] == "xla":
@@ -215,6 +217,11 @@ def run_worker(cfg: dict, timeout_s: float) -> list[dict] | None:
         env["TUNE_BATCH"] = "1" if cfg.get("batch") else "0"
         if cfg.get("fused_only"):
             env["TUNE_FUSED_ONLY"] = "1"
+    return env
+
+
+def run_worker(cfg: dict, timeout_s: float) -> list[dict] | None:
+    env = worker_env(cfg)
     if aot_validated(_aot_gate().probe_program(cfg["kernel"])):
         load_dir = aot_precompile(cfg, env)
         if load_dir:
@@ -266,6 +273,12 @@ def main(argv=None) -> int:
     ap.add_argument("--preflight", default=str(REPO / "PREFLIGHT.json"),
                     help="offline Mosaic compile report; configs it marks "
                          "failed are skipped (pass an absent path to disable)")
+    ap.add_argument("--prewarm", action="store_true",
+                    help="only build the offline AOT caches for every "
+                         "not-yet-measured config (CPU-pinned, no TPU "
+                         "touched) so a healthy window spends zero time "
+                         "on local compiles; ignores the AOT-load verdict "
+                         "because it runs BEFORE the verdict exists")
     args = ap.parse_args(argv)
 
     plan = json.loads(pathlib.Path(args.plan).read_text())
@@ -285,6 +298,26 @@ def main(argv=None) -> int:
     print(f"[sweep] {len(plan)} planned, {len(plan) - len(not_done)} "
           f"already done, {len(skipped)} preflight-skipped, "
           f"{len(todo)} to run", flush=True)
+    if args.prewarm:
+        warmed = failures = 0
+        # Yield only when the verdict NEWLY appears (a healthy window just
+        # began); a verdict from some past window must not no-op prewarm.
+        verdict_preexisting = (REPO / "AOT_LOAD.json").exists()
+        for n, cfg in enumerate(todo):
+            if not verdict_preexisting and (REPO / "AOT_LOAD.json").exists():
+                # A healthy window has begun (the probe is its first
+                # step): stop competing for the single CPU core with real
+                # measurements — the sweep warms remaining caches lazily.
+                print("[prewarm] AOT_LOAD.json appeared; yielding to the "
+                      "healthy-tier pipeline", flush=True)
+                break
+            d = aot_precompile(cfg, worker_env(cfg))
+            warmed += d is not None
+            failures += d is None
+            print(f"[prewarm] {n + 1}/{len(todo)} {config_key(cfg)} "
+                  f"{'ok' if d else 'FAILED'}", flush=True)
+        print(f"[prewarm] {warmed}/{len(todo)} caches ready", flush=True)
+        return 1 if failures else 0
     failures = 0
     for n, cfg in enumerate(todo):
         for attempt in range(1 + args.retries):

@@ -143,7 +143,7 @@ def compile_rsplit(cfg: dict) -> dict:
     jax.config.update("jax_platforms", "cpu")
     from distributed_sddmm_tpu.common import MatMode
     from distributed_sddmm_tpu.ops.pallas_kernels import PallasKernel
-    from distributed_sddmm_tpu.parallel.mesh import GridSpec, make_grid
+    from distributed_sddmm_tpu.parallel.mesh import make_grid
     from distributed_sddmm_tpu.parallel.sparse_shift_15d import SparseShift15D
     from distributed_sddmm_tpu.utils.coo import HostCOO
 
@@ -161,10 +161,8 @@ def compile_rsplit(cfg: dict) -> dict:
     B = alg.dummy_initialize(MatMode.B)
     vals = alg.like_s_values(1.0)
     g = alg.grid
-    tpu_grid = make_grid(g.nr, g.nc, g.nh, adjacency=g.adjacency,
+    alg.grid = make_grid(g.nr, g.nc, g.nh, adjacency=g.adjacency,
                          devices=list(topo.devices))
-    alg.grid = GridSpec(mesh=tpu_grid.mesh, nr=g.nr, nc=g.nc, nh=g.nh,
-                        adjacency=g.adjacency)
     alg.kernel = PallasKernel(precision="bf16", interpret=False)
     alg._programs.clear()
     mesh = alg.grid.mesh
