@@ -164,6 +164,7 @@ def worker() -> None:
         "value": round(gflops_per_chip, 3),
         "unit": "GFLOP/s/chip",
         "vs_baseline": round(gflops_per_chip / BASELINE_GFLOPS, 3),
+        "backend": jax.default_backend(),
     }
     if used_aot:
         rec["aot"] = True
@@ -401,7 +402,11 @@ def main() -> None:
     total = float(os.environ.get("BENCH_TOTAL_TIMEOUT", "2100"))
     backoff = float(os.environ.get("BENCH_BACKOFF", "20"))
     start = time.monotonic()
-    cpu_reserve = min(600.0, total / 3)
+    # The queue's mid-round banking run sets this: a CPU record can never
+    # be banked, so skipping the fallback rung hands its reserve to the
+    # TPU rungs instead of burning health-window minutes on a throwaway.
+    skip_cpu = os.environ.get("BENCH_SKIP_CPU_FALLBACK", "") not in ("", "0")
+    cpu_reserve = 0.0 if skip_cpu else min(600.0, total / 3)
     tpu_budget = total - cpu_reserve
 
     cpu_env = {"BENCH_PLATFORM": "cpu", "BENCH_KERNEL": "xla"}
@@ -433,6 +438,8 @@ def main() -> None:
             time.sleep(backoff_s)
         remaining = total - (time.monotonic() - start)
         is_cpu = env_extra.get("BENCH_PLATFORM") == "cpu"
+        if is_cpu and skip_cpu:
+            continue
         if env_extra.get("BENCH_KERNEL") == "xla" and best is not None:
             continue  # the XLA rung is a Mosaic-outage rescue, never faster
         if not is_cpu:
@@ -460,6 +467,20 @@ def main() -> None:
         rec = _run_attempt(env_extra, timeout_s)
         if rec is not None:
             if is_cpu:
+                mid = _midround_tpu_record()
+                if mid is not None:
+                    # The hardware DID answer this round, just not right
+                    # now: the queue's healthy-window headline run is this
+                    # round's real-TPU measurement of the same program.
+                    mid["note"] = (
+                        "TPU backend unavailable at bench time; value is "
+                        "this round's committed mid-round real-TPU run "
+                        "(artifacts/bench_midround/record.json); the "
+                        f"live CPU fallback measured {rec['value']} "
+                        f"{rec['unit']}"
+                    )
+                    best = mid
+                    break
                 rec["note"] = (
                     "TPU backend unavailable after retries; CPU fallback run"
                     + _committed_tpu_note()
@@ -471,7 +492,19 @@ def main() -> None:
         else:
             errors += 1
     if best is not None:
+        # Stamped so a banked copy of this record can later prove it
+        # measured these exact sources (see _midround_tpu_record).
+        best.setdefault("code_hash", _bench_code_hash())
         print(json.dumps(best))
+        return
+    mid = _midround_tpu_record()
+    if mid is not None:
+        mid["note"] = (
+            "all live bench attempts failed or timed out; value is this "
+            "round's committed mid-round real-TPU run "
+            "(artifacts/bench_midround/record.json)"
+        )
+        print(json.dumps(mid))
         return
     print(
         json.dumps(
@@ -485,6 +518,30 @@ def main() -> None:
             }
         )
     )
+
+
+def _midround_tpu_record(path: str | None = None) -> dict | None:
+    """A banked headline record from a mid-round healthy window (written
+    by the queue's headline step via --validate-midround). Lets a round
+    whose health window closed before bench time still report the number
+    the hardware produced. Valid only when the measuring backend was
+    really the TPU AND the record's code_hash matches the CURRENT
+    sources — a banked number must never masquerade as a measurement of
+    code it didn't run (including a previous round's record surviving in
+    artifacts/)."""
+    if path is None:
+        path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "artifacts", "bench_midround", "record.json")
+    try:
+        with open(path) as f:
+            rec = json.loads(f.read().strip().splitlines()[-1])
+    except (OSError, json.JSONDecodeError, IndexError):
+        return None
+    if rec.get("backend") != "tpu" or not rec.get("value", 0) > 0:
+        return None
+    if rec.get("code_hash") != _bench_code_hash():
+        return None
+    return rec
 
 
 def _committed_tpu_note() -> str:
@@ -505,5 +562,10 @@ if __name__ == "__main__":
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
     if "--worker" in sys.argv:
         worker()
+    elif "--validate-midround" in sys.argv:
+        # Bankability check for the queue: ONE validator (shared with the
+        # fallback reader) decides what counts as a real-TPU record.
+        target = sys.argv[sys.argv.index("--validate-midround") + 1]
+        sys.exit(0 if _midround_tpu_record(target) is not None else 1)
     else:
         main()

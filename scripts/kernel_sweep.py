@@ -300,15 +300,22 @@ def main(argv=None) -> int:
           f"{len(todo)} to run", flush=True)
     if args.prewarm:
         warmed = failures = 0
-        # Yield only when the verdict NEWLY appears (a healthy window just
-        # began); a verdict from some past window must not no-op prewarm.
-        verdict_preexisting = (REPO / "AOT_LOAD.json").exists()
+        # Yield when the verdict file is WRITTEN after we start (the
+        # probe re-answering = a healthy window just began); a verdict
+        # merely left over from a past window must not no-op prewarm.
+        t_start = time.time()
+
+        def _healthy_window_began() -> bool:
+            try:
+                return (REPO / "AOT_LOAD.json").stat().st_mtime > t_start
+            except OSError:
+                return False
+
         for n, cfg in enumerate(todo):
-            if not verdict_preexisting and (REPO / "AOT_LOAD.json").exists():
-                # A healthy window has begun (the probe is its first
-                # step): stop competing for the single CPU core with real
+            if _healthy_window_began():
+                # Stop competing for the single CPU core with real
                 # measurements — the sweep warms remaining caches lazily.
-                print("[prewarm] AOT_LOAD.json appeared; yielding to the "
+                print("[prewarm] AOT_LOAD.json refreshed; yielding to the "
                       "healthy-tier pipeline", flush=True)
                 break
             d = aot_precompile(cfg, worker_env(cfg))

@@ -81,6 +81,33 @@ while [ "$(date +%s)" -lt "$DEADLINE" ]; do
     if ! python scripts/aot_load_probe.py --check-stale; then
       run_step timeout 1500 python scripts/aot_load_probe.py || true
     fi
+    # Mid-round headline record: the driver runs bench.py at round END,
+    # which loses the round's headline if the tunnel is down right then.
+    # Bank a real-TPU full-program record from THIS healthy window; the
+    # bench's fallback path reports it (clearly noted) if the end-of-round
+    # run can't reach the chip. Kept only when the measuring backend was
+    # really the TPU (bench records its backend per attempt). Runs first:
+    # it is the driver's primary metric, and its tuned kernel config is
+    # long-measured (known-compilable).
+    # BENCH_SKIP_CPU_FALLBACK: a CPU record can never be banked, so the
+    # banking run hands the fallback reserve to the TPU rungs instead.
+    # bench.py --validate-midround is the ONE validator (shared with the
+    # fallback reader) for what counts as a bankable real-TPU record.
+    if [ ! -f artifacts/bench_midround/record.json ]; then
+      mkdir -p artifacts/bench_midround
+      if run_step timeout 2400 env BENCH_SKIP_CPU_FALLBACK=1 bash -c \
+          'python bench.py > artifacts/bench_midround/record.tmp'; then
+        if python bench.py --validate-midround \
+            artifacts/bench_midround/record.tmp; then
+          mv artifacts/bench_midround/record.tmp \
+             artifacts/bench_midround/record.json
+          echo "[queue] mid-round real-TPU headline banked:"
+          cat artifacts/bench_midround/record.json
+        else
+          echo "[queue] bench produced no bankable TPU record"
+        fi
+      fi
+    fi
     # ALS/GAT application records first (round-directive evidence with none
     # yet, and known-compilable kernels): a short health window still
     # records them before the novel kernel-variant probes, whose compiles

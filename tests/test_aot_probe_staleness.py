@@ -1,0 +1,96 @@
+"""Pin scripts/aot_load_probe.py's verdict-staleness protocol.
+
+The queue re-probes only when ``--check-stale`` says so; a wrong answer
+either burns a health window re-answering a current verdict or lets a
+stale one keep (mis)gating AOT modes. The matrix here mirrors the manual
+verification the protocol shipped with."""
+
+import importlib.util
+import json
+import pathlib
+
+import pytest
+
+
+@pytest.fixture(scope="module")
+def probe():
+    spec = importlib.util.spec_from_file_location(
+        "aot_load_probe",
+        pathlib.Path(__file__).resolve().parents[1]
+        / "scripts" / "aot_load_probe.py")
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _write(tmp_path, payload):
+    f = tmp_path / "AOT_LOAD.json"
+    f.write_text(json.dumps(payload))
+    return f
+
+
+def test_missing_file_needs_probe(probe, tmp_path):
+    assert probe.check_stale(tmp_path / "AOT_LOAD.json") == 3
+
+
+def test_corrupt_file_unlinked(probe, tmp_path):
+    f = tmp_path / "AOT_LOAD.json"
+    f.write_text("{not json")
+    assert probe.check_stale(f) == 3
+    assert not f.exists()
+
+
+def test_current_complete_verdict_stands(probe, tmp_path):
+    progs = {n: {"ok": True, "program_version": v}
+             for n, v in probe.PROGRAM_VERSIONS.items()}
+    f = _write(tmp_path, {"ok": True, "programs": progs})
+    assert probe.check_stale(f) == 0
+    assert f.exists()
+
+
+def test_stale_sibling_pruned_valid_kept(probe, tmp_path):
+    """A bumped program loses its verdict; the unchanged sibling keeps
+    gating its own AOT modes while the probe re-answers."""
+    names = sorted(probe.PROGRAM_VERSIONS)
+    stale_name, kept_name = names[-1], names[0]
+    progs = {
+        kept_name: {"ok": True,
+                    "program_version": probe.PROGRAM_VERSIONS[kept_name]},
+        stale_name: {"ok": True,
+                     "program_version":
+                         probe.PROGRAM_VERSIONS[stale_name] + 1},
+    }
+    f = _write(tmp_path, {"ok": True, "programs": progs})
+    assert probe.check_stale(f) == 3
+    rep = json.loads(f.read_text())
+    assert list(rep["programs"]) == [kept_name]
+    assert rep["ok"] is False  # a program's verdict is now missing
+
+
+def test_all_stale_unlinks(probe, tmp_path):
+    progs = {n: {"ok": True, "program_version": v + 1}
+             for n, v in probe.PROGRAM_VERSIONS.items()}
+    f = _write(tmp_path, {"ok": True, "programs": progs})
+    assert probe.check_stale(f) == 3
+    assert not f.exists()
+
+
+def test_phase_a_record_current_stands(probe, tmp_path):
+    f = _write(tmp_path, {"ok": False, "stage": "phase-a",
+                          "program_versions": dict(probe.PROGRAM_VERSIONS)})
+    assert probe.check_stale(f) == 0
+
+
+def test_phase_a_record_stale_unlinked(probe, tmp_path):
+    old = {n: v - 1 for n, v in probe.PROGRAM_VERSIONS.items()}
+    f = _write(tmp_path, {"ok": False, "stage": "phase-a",
+                          "program_versions": old})
+    assert probe.check_stale(f) == 3
+    assert not f.exists()
+
+
+def test_probe_key_json_roundtrip_stable(probe):
+    """cache_is_fresh compares against the JSON round-trip of PROBE_KEY;
+    tuples would never equal their round-tripped lists."""
+    rt = json.loads(json.dumps(list(probe.PROBE_KEY)))
+    assert rt == list(probe.PROBE_KEY)
