@@ -52,6 +52,68 @@ run_step() {
   fi
 }
 
+# Mid-round headline banking: the driver runs bench.py at round END, which
+# loses the round's headline if the tunnel is down right then. Bank a
+# real-TPU full-program record from THIS window; bench.py's fallback path
+# reports it (clearly noted) when the end-of-round run can't reach the
+# chip. BENCH_SKIP_CPU_FALLBACK because a CPU record can never be banked;
+# bench.py --validate-midround is the ONE validator (shared with the
+# fallback reader) of what counts as bankable. $1 = outer timeout,
+# $2 = "xla" when called from the Mosaic-outage rescue tier.
+bank_headline() {
+  local t=$1 kern=${2:-}
+  local dir=artifacts/bench_midround rec=artifacts/bench_midround/record.json
+  mkdir -p "$dir"
+  # "Exists" is not "valid": a record whose code_hash no longer matches
+  # current sources would be rejected by the fallback reader anyway, so
+  # it must not block re-banking — run it through the one validator.
+  if [ -f "$rec" ] && python bench.py --validate-midround "$rec"; then
+    # Only the Pallas tier upgrades a valid record, only one banked by
+    # the slower xla rescue kernel, and only a bounded number of times
+    # (each attempt costs up to $t seconds of a scarce window).
+    if [ -n "$kern" ] || ! grep -q "xla kernel" "$rec"; then
+      return 0
+    fi
+    local n=0
+    [ -f "$dir/upgrade_attempts" ] && n=$(cat "$dir/upgrade_attempts")
+    if [ "$n" -ge 2 ]; then
+      echo "[queue] pallas upgrade attempts exhausted; keeping xla record"
+      return 0
+    fi
+    echo $((n + 1)) > "$dir/upgrade_attempts"
+  fi
+  local extra=(BENCH_SKIP_CPU_FALLBACK=1)
+  [ -n "$kern" ] && extra+=(BENCH_KERNEL="$kern")
+  if run_step timeout "$t" env "${extra[@]}" bash -c \
+      'python bench.py > artifacts/bench_midround/record.tmp'; then
+    if python bench.py --validate-midround \
+        artifacts/bench_midround/record.tmp; then
+      python - <<'EOF'
+import json, os
+p = "artifacts/bench_midround/"
+new = json.loads(open(p + "record.tmp").read().strip().splitlines()[-1])
+try:
+    old = json.loads(open(p + "record.json").read().strip().splitlines()[-1])
+except Exception:
+    old = {"value": 0.0}
+# Strict >: when all live attempts fail, bench.py's fallback prints the
+# EXISTING banked record back out (equal value) — replacing with that
+# self-referential copy must not be logged as a fresh bank.
+if new.get("value", 0.0) > old.get("value", 0.0):
+    os.replace(p + "record.tmp", p + "record.json")
+    print(f"[queue] banked mid-round real-TPU headline: {new['value']} "
+          f"{new.get('unit', '')}")
+else:
+    print(f"[queue] kept existing banked record "
+          f"({old['value']} >= {new['value']})")
+EOF
+    else
+      echo "[queue] bench produced no bankable TPU record"
+    fi
+  fi
+  return 0
+}
+
 while [ "$(date +%s)" -lt "$DEADLINE" ]; do
   if ! healthy_basic; then
     echo "[queue] $(date +%H:%M:%S) TPU backend down; sleeping 600s"
@@ -89,25 +151,10 @@ while [ "$(date +%s)" -lt "$DEADLINE" ]; do
     # really the TPU (bench records its backend per attempt). Runs first:
     # it is the driver's primary metric, and its tuned kernel config is
     # long-measured (known-compilable).
-    # BENCH_SKIP_CPU_FALLBACK: a CPU record can never be banked, so the
-    # banking run hands the fallback reserve to the TPU rungs instead.
-    # bench.py --validate-midround is the ONE validator (shared with the
-    # fallback reader) for what counts as a bankable real-TPU record.
-    if [ ! -f artifacts/bench_midround/record.json ]; then
-      mkdir -p artifacts/bench_midround
-      if run_step timeout 2400 env BENCH_SKIP_CPU_FALLBACK=1 bash -c \
-          'python bench.py > artifacts/bench_midround/record.tmp'; then
-        if python bench.py --validate-midround \
-            artifacts/bench_midround/record.tmp; then
-          mv artifacts/bench_midround/record.tmp \
-             artifacts/bench_midround/record.json
-          echo "[queue] mid-round real-TPU headline banked:"
-          cat artifacts/bench_midround/record.json
-        else
-          echo "[queue] bench produced no bankable TPU record"
-        fi
-      fi
-    fi
+    # A record banked by the XLA-only tier (Mosaic-outage rescue kernel)
+    # is real but slow; with Mosaic healthy, re-bank for the tuned Pallas
+    # kernel and keep whichever record is faster.
+    bank_headline 2400
     # ALS/GAT application records first (round-directive evidence with none
     # yet, and known-compilable kernels): a short health window still
     # records them before the novel kernel-variant probes, whose compiles
@@ -193,6 +240,9 @@ sys.exit(0 if m.aot_validated('pallas_fused') else 1)" 2>/dev/null; then
     break
   fi
   echo "[queue] $(date +%H:%M:%S) backend up, Mosaic down: XLA-only work"
+  # A slower-but-real headline beats sweep points for the driver's
+  # metric; bank it first in case the backend dies again.
+  bank_headline 2400 xla
   run_step python scripts/kernel_sweep.py \
     scripts/plans/star_sweep.json KERNELS_TPU.jsonl --timeout 1200 --retries 1 \
     --kernel-filter xla \
