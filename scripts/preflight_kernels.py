@@ -292,15 +292,12 @@ def main(argv=None) -> int:
             # these (only status == "compile-error"); the nonzero exit
             # here just flags that preflight could not vouch for
             # everything.
-            rec.update(status="timeout", wall_s=round(time.monotonic() - t0, 1))
-            results.append(rec)
-            failures += 1
-            flush_report()
-            print(f"[preflight] timeout       R={cfg['R']} "
-                  f"blocks={cfg.get('blocks', '512x512')}", flush=True)
-            continue
+            proc = None
         rec["wall_s"] = round(time.monotonic() - t0, 1)
-        if proc.returncode == 0:
+        if proc is None:
+            rec.update(status="timeout")
+            failures += 1
+        elif proc.returncode == 0:
             try:
                 rec.update(status="ok", **json.loads(
                     proc.stdout.strip().splitlines()[-1]))
@@ -326,6 +323,19 @@ def main(argv=None) -> int:
                 status = "compile-error"
             rec.update(status=status, error=tail)
             failures += 1
+        # Transient outcomes (lockfile clash, timeout) are not evidence
+        # about the CONFIG — they must not clobber a committed ok record
+        # (e.g. a concurrent prewarm holding the libtpu lock would
+        # otherwise downgrade the whole report to env-transient). The
+        # config then no longer counts as failed: its record IS ok.
+        old = old_by_key.get(preflight_key(rec))
+        if (rec["status"] in ("env-transient", "timeout")
+                and old is not None and old.get("status") == "ok"):
+            print(f"[preflight] {rec['status']} for R={cfg['R']} "
+                  f"blocks={cfg.get('blocks', '512x512')}; keeping the "
+                  "prior ok record", flush=True)
+            rec = old
+            failures -= 1
         results.append(rec)
         flush_report()
         print(f"[preflight] {rec['status']:13s} "

@@ -66,8 +66,12 @@ bank_headline() {
   mkdir -p "$dir"
   # "Exists" is not "valid": a record whose code_hash no longer matches
   # current sources would be rejected by the fallback reader anyway, so
-  # it must not block re-banking — run it through the one validator.
+  # it must not block re-banking — run it through the one validator. The
+  # merge below also needs this: a stale record's value must not outbid
+  # a fresh valid one.
+  local old_valid=0
   if [ -f "$rec" ] && python bench.py --validate-midround "$rec"; then
+    old_valid=1
     # Only the Pallas tier upgrades a valid record, only one banked by
     # the slower xla rescue kernel, and only a bounded number of times
     # (each attempt costs up to $t seconds of a scarce window).
@@ -88,19 +92,29 @@ bank_headline() {
       'python bench.py > artifacts/bench_midround/record.tmp'; then
     if python bench.py --validate-midround \
         artifacts/bench_midround/record.tmp; then
-      python - <<'EOF'
+      BANK_OLD_VALID=$old_valid python - <<'EOF'
 import json, os
 p = "artifacts/bench_midround/"
 new = json.loads(open(p + "record.tmp").read().strip().splitlines()[-1])
-try:
-    old = json.loads(open(p + "record.json").read().strip().splitlines()[-1])
-except Exception:
-    old = {"value": 0.0}
+old = {"value": 0.0}
+# An INVALID pre-existing record (stale code_hash) must not outbid a
+# fresh valid one — its value only competes when the validator passed.
+if os.environ.get("BANK_OLD_VALID") == "1":
+    try:
+        old = json.loads(
+            open(p + "record.json").read().strip().splitlines()[-1])
+    except Exception:
+        pass
 # Strict >: when all live attempts fail, bench.py's fallback prints the
 # EXISTING banked record back out (equal value) — replacing with that
 # self-referential copy must not be logged as a fresh bank.
 if new.get("value", 0.0) > old.get("value", 0.0):
     os.replace(p + "record.tmp", p + "record.json")
+    # A newly banked record starts with a fresh pallas-upgrade budget.
+    try:
+        os.unlink(p + "upgrade_attempts")
+    except FileNotFoundError:
+        pass
     print(f"[queue] banked mid-round real-TPU headline: {new['value']} "
           f"{new.get('unit', '')}")
 else:
