@@ -243,11 +243,68 @@ def phase_a() -> None:
     print(json.dumps({"phase": "a", "ok": True, **records}))
 
 
+def conclusive_error(msg: str) -> bool:
+    """Exception text that proves re-homing can NEVER work here, as opposed
+    to a tunnel flake. A deserialize-format version mismatch ("cached
+    executable is axon format vX, this build is vY") is a property of the
+    (local serializer, tunnel build) pair: an executable serialized by this
+    libtpu can never load on this backend build, so the "no" is recorded
+    immediately instead of burning two more health-window cycles on the
+    exception retry budget. The match is the specific version-mismatch
+    phrase — a generic deserialize failure (e.g. a payload truncated by a
+    flaky tunnel) must stay retryable. Policy-home note: belongs in
+    bench/aot_gate.py with the other permanence rules; moving it edits a
+    bench-code_hash-covered file, so it rides the next batched package
+    edit (one that is anyway followed by a headline re-bank)."""
+    return ("PJRT_Executable_DeserializeAndLoad" in msg
+            and " format v" in msg and "this build is" in msg)
+
+
+def _settled(entry: dict) -> bool:
+    """An entry that answers its program's question for good: a success,
+    a numerics verdict, or a conclusive (deterministic) error. Retryable
+    tunnel flakes are not settled."""
+    return "error" not in entry or conclusive_error(entry["error"])
+
+
+def _merge_write(out_path: pathlib.Path, report: dict,
+                 new_programs: dict) -> dict:
+    """Write ``new_programs`` over the still-chain-current entries already
+    on disk. A transient outcome (sibling flake, retry pass) must never
+    clobber a settled recorded verdict — the same guard PREFLIGHT.json
+    applies to its ok records. Returns the merged report as written."""
+    try:
+        prior = json.loads(out_path.read_text()).get("programs") or {}
+    except (OSError, json.JSONDecodeError, AttributeError):
+        prior = {}
+    progs = {}
+    for n in set(prior) | set(new_programs):
+        pe, ne = prior.get(n), new_programs.get(n)
+        prior_settled = (
+            pe is not None
+            and pe.get("program_version", 1) == PROGRAM_VERSIONS.get(n)
+            and _settled(pe))
+        if ne is not None and _settled(ne):
+            progs[n] = ne  # a fresh settled verdict always wins
+        elif prior_settled:
+            progs[n] = pe
+        elif ne is not None:
+            progs[n] = ne  # both unsettled: record the freshest attempt
+        # prior chain-stale entries drop here (check_stale would prune)
+    merged = dict(report, programs=progs)
+    merged["ok"] = (set(progs) >= set(PROGRAM_VERSIONS)
+                    and all(p.get("ok") for p in progs.values()))
+    out_path.write_text(json.dumps(merged, indent=1))
+    return merged
+
+
 def phase_b() -> int:
     """Load the serialized executables onto the real tunneled chip.
 
-    Returns 0 (answer recorded, good or bad) or 2 (backend unreachable —
-    retryable; no answer file is written so the queue probes again)."""
+    Returns 0 (every program's answer recorded, good or bad) or 2
+    (retryable: backend unreachable, or some program hit a transient
+    exception with retry budget left — settled sibling verdicts ARE
+    merge-recorded before returning, so their gates stop waiting)."""
     import numpy as np
     import jax
 
@@ -302,13 +359,17 @@ def phase_b() -> int:
         report["programs"][name] = entry
 
     report["ok"] = all(p.get("ok") for p in report["programs"].values())
+    out_path = pathlib.Path(
+        os.environ.get("AOT_LOAD_OUT", str(REPO / "AOT_LOAD.json")))
     # An exception mid-phase is ambiguous: a genuine re-homing
     # incompatibility OR a tunnel flake after the init check. Don't let
     # one flake permanently foreclose AOT mode — only record a "no" once
     # exceptions have repeated enough to be deterministic (numerics
-    # mismatches, by contrast, are conclusive immediately).
-    exceptions = [p for p in report["programs"].values() if "error" in p]
-    if exceptions and not report["ok"]:
+    # mismatches and conclusive_error texts, by contrast, are conclusive
+    # immediately).
+    unsettled = {n: e for n, e in report["programs"].items()
+                 if not _settled(e)}
+    if unsettled:
         attempts_file = CACHE / "phase_b_attempts"
         try:
             attempts = int(attempts_file.read_text()) + 1
@@ -317,14 +378,33 @@ def phase_b() -> int:
         attempts_file.write_text(str(attempts))
         if attempts < 3:
             print(json.dumps(report, indent=1))
-            print(f"[aot-probe] inconclusive (exception, attempt {attempts}/3)"
-                  " — not recording; will retry next cycle", file=sys.stderr)
+            # Programs that ARE answered must not wait on a flaky
+            # sibling's retry budget: merge-record them now so their
+            # gates stop re-probing; check_stale keeps the queue
+            # retrying for whatever is still missing.
+            answered = {n: e for n, e in report["programs"].items()
+                        if _settled(e)}
+            if answered:
+                merged = _merge_write(out_path, report, answered)
+                if all(_settled(e) for e in merged["programs"].values()) \
+                        and set(merged["programs"]) >= set(PROGRAM_VERSIONS):
+                    # Prior settled verdicts fill the gap this run's
+                    # flakes left: everything is answered after all.
+                    print("[aot-probe] all programs settled after merge",
+                          file=sys.stderr)
+                    return 0
+                print(f"[aot-probe] recorded {sorted(answered)}; sibling "
+                      f"exception retryable (attempt {attempts}/3)",
+                      file=sys.stderr)
+            else:
+                print(f"[aot-probe] inconclusive (exception, attempt "
+                      f"{attempts}/3) — not recording; will retry next "
+                      "cycle", file=sys.stderr)
             return 2
         report["inconclusive_after_attempts"] = attempts
 
     print(json.dumps(report, indent=1))
-    out_path = os.environ.get("AOT_LOAD_OUT", str(REPO / "AOT_LOAD.json"))
-    pathlib.Path(out_path).write_text(json.dumps(report, indent=1))
+    _merge_write(out_path, report, report["programs"])
     return 0
 
 

@@ -89,6 +89,75 @@ def test_phase_a_record_stale_unlinked(probe, tmp_path):
     assert not f.exists()
 
 
+def test_conclusive_error_classification(probe):
+    """A deserialize-format version mismatch is deterministic for the
+    (local serializer, tunnel build) pair — phase B records the "no"
+    immediately instead of spending the 3-attempt exception budget; a
+    generic tunnel flake stays retryable."""
+    fmt = ("JaxRuntimeError: INVALID_ARGUMENT: "
+           "PJRT_Executable_DeserializeAndLoad: cached executable is axon "
+           "format v269857241, this build is v9 — clear the JAX persistent "
+           "cache")
+    assert probe.conclusive_error(fmt)
+    assert not probe.conclusive_error(
+        "JaxRuntimeError: UNAVAILABLE: tunnel reset by peer")
+    assert not probe.conclusive_error(
+        "TimeoutError: backend init hung")
+    # A generic deserialize failure (e.g. payload truncated by a flaky
+    # tunnel) is NOT conclusive — only the version-mismatch phrase is.
+    assert not probe.conclusive_error(
+        "JaxRuntimeError: INVALID_ARGUMENT: "
+        "PJRT_Executable_DeserializeAndLoad: failed to parse serialized "
+        "executable: wire format error")
+
+
+def test_merge_write_flake_cannot_clobber_settled(probe, tmp_path):
+    """Review-pinned scenario: a recorded ok verdict must survive a
+    sibling re-probe in which its own program hits a transient flake."""
+    names = sorted(probe.PROGRAM_VERSIONS)
+    a, b = names[0], names[1 % len(names)]
+    f = _write(tmp_path, {"ok": False, "programs": {
+        a: {"ok": True, "program_version": probe.PROGRAM_VERSIONS[a]}}})
+    report = {"phase": "b", "programs": {
+        a: {"ok": False, "program_version": probe.PROGRAM_VERSIONS[a],
+            "error": "JaxRuntimeError: UNAVAILABLE: tunnel reset"},
+        b: {"ok": True, "program_version": probe.PROGRAM_VERSIONS[b]}}}
+    merged = probe._merge_write(f, report, report["programs"])
+    assert merged["programs"][a]["ok"] is True  # prior settled kept
+    assert merged["programs"][b]["ok"] is True
+    assert merged["ok"] is (set(names) <= {a, b})
+    on_disk = json.loads(f.read_text())
+    assert on_disk["programs"][a]["ok"] is True
+
+
+def test_merge_write_fresh_settled_wins(probe, tmp_path):
+    names = sorted(probe.PROGRAM_VERSIONS)
+    a = names[0]
+    f = _write(tmp_path, {"ok": False, "programs": {
+        a: {"ok": True, "program_version": probe.PROGRAM_VERSIONS[a]}}})
+    fmt_err = ("PJRT_Executable_DeserializeAndLoad: cached executable is "
+               "axon format v1, this build is v9")
+    report = {"programs": {
+        a: {"ok": False, "program_version": probe.PROGRAM_VERSIONS[a],
+            "error": fmt_err}}}
+    merged = probe._merge_write(f, report, report["programs"])
+    # conclusive error = settled: the fresh "no" replaces the stale "yes"
+    assert merged["programs"][a]["ok"] is False
+    assert merged["ok"] is False
+
+
+def test_merge_write_drops_chain_stale_prior(probe, tmp_path):
+    names = sorted(probe.PROGRAM_VERSIONS)
+    a = names[0]
+    f = _write(tmp_path, {"ok": True, "programs": {
+        a: {"ok": True,
+            "program_version": probe.PROGRAM_VERSIONS[a] + 1}}})
+    report = {"programs": {}}
+    merged = probe._merge_write(f, report, {})
+    assert merged["programs"] == {}
+    assert merged["ok"] is False
+
+
 def test_probe_key_json_roundtrip_stable(probe):
     """cache_is_fresh compares against the JSON round-trip of PROBE_KEY;
     tuples would never equal their round-tripped lists."""
