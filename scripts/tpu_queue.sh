@@ -81,6 +81,29 @@ run_step() {
   fi
 }
 
+# Measurement records are only durable once committed: the round-5 container
+# reset threw away an ANSWERED AOT_LOAD.json (plus session logs) because the
+# queue wrote but never committed it. Commit the record files after every
+# drained tier; no-op when nothing changed. Only ever `add`s the known
+# record paths — never package sources, so a mid-edit working tree can't be
+# swept into a queue commit.
+commit_records() {
+  local msg=${1:-"Queue: bank measurement records"}
+  local all=(AOT_LOAD.json KERNELS_TPU.jsonl KERNELS_TPU.md DIST_GAP.jsonl
+    APPS_TPU.jsonl PREFLIGHT.json artifacts/bench_midround
+    artifacts/tpu_breakdown artifacts/kernels_chart artifacts/costmodel)
+  local paths=() p
+  for p in "${all[@]}"; do [ -e "$p" ] && paths+=("$p"); done
+  [ ${#paths[@]} -eq 0 ] && return 0
+  if [ -n "$(git status --porcelain -- "${paths[@]}" 2>/dev/null)" ]; then
+    git add -A -- "${paths[@]}" 2>/dev/null
+    # Pathspec-limited commit: an interactive session's concurrently
+    # staged files must never ride along in a queue commit.
+    git commit -q -m "$msg" -- "${paths[@]}" 2>/dev/null \
+      && echo "[queue] committed: $msg"
+  fi
+}
+
 # Mid-round headline banking: the driver runs bench.py at round END, which
 # loses the round's headline if the tunnel is down right then. Bank a
 # real-TPU full-program record from THIS window; bench.py's fallback path
@@ -185,6 +208,7 @@ while [ "$(date +%s)" -lt "$DEADLINE" ]; do
     # while still-valid sibling verdicts keep gating their AOT modes.
     if ! python scripts/aot_load_probe.py --check-stale; then
       run_step timeout 1500 python scripts/aot_load_probe.py || true
+      commit_records "Queue: AOT-load probe verdict"
     fi
     # Mid-round headline record: the driver runs bench.py at round END,
     # which loses the round's headline if the tunnel is down right then.
@@ -198,12 +222,14 @@ while [ "$(date +%s)" -lt "$DEADLINE" ]; do
     # is real but slow; with Mosaic healthy, re-bank for the tuned Pallas
     # kernel and keep whichever record is faster.
     bank_headline 2400
+    commit_records "Queue: bank real-TPU headline record"
     # ALS/GAT application records first (round-directive evidence with none
     # yet, and known-compilable kernels): a short health window still
     # records them before the novel kernel-variant probes, whose compiles
     # are the likeliest to hang.
     run_step env APPS_SUBSET=apps timeout 3600 python scripts/tpu_apps.py \
       || failed=1
+    commit_records "Queue: ALS/GAT TPU application records"
     # Mosaic may have died mid-apps; re-gate before the probes, whose
     # compiles would each hang to their full timeout.
     if [ -n "$failed" ] && ! healthy_pallas; then continue; fi
@@ -248,8 +274,10 @@ sys.exit(0 if m.aot_validated('pallas_fused') else 1)" 2>/dev/null; then
     run_step python scripts/summarize_kernels.py || true
     run_step python -m distributed_sddmm_tpu.tools.charts \
       KERNELS_TPU.jsonl --kernels -o artifacts/kernels_chart || true
+    commit_records "Queue: kernel-sweep TPU grid points + derived charts"
     if [ -n "$failed" ] && ! healthy_pallas; then continue; fi
     run_step timeout 1800 python scripts/dist_gap.py || true
+    commit_records "Queue: tile-vs-distributed gap record"
     # Region-attribution breakdown on real hardware (round-4 stretch
     # directive): one run, resumable via the output file's existence.
     # Single chip forces c=1/nr=1, so Replication/Propagation are
@@ -273,7 +301,8 @@ sys.exit(0 if m.aot_validated('pallas_fused') else 1)" 2>/dev/null; then
         || true
     fi
     run_step timeout 7200 python scripts/tpu_apps.py \
-      || { sleep 300; continue; }
+      || { commit_records "Queue: partial TPU app/heatmap records"; sleep 300; continue; }
+    commit_records "Queue: TPU app + heatmap records and breakdown"
     if [ -n "$failed" ]; then
       echo "[queue] sweep steps had failures; cycling to retry missing configs"
       sleep 300
@@ -286,15 +315,17 @@ sys.exit(0 if m.aot_validated('pallas_fused') else 1)" 2>/dev/null; then
   # A slower-but-real headline beats sweep points for the driver's
   # metric; bank it first in case the backend dies again.
   bank_headline 2400 xla
+  commit_records "Queue: bank XLA-tier real-TPU headline record"
   run_step python scripts/kernel_sweep.py \
     scripts/plans/star_sweep.json KERNELS_TPU.jsonl --timeout 1200 --retries 1 \
     --kernel-filter xla \
-    || { sleep 300; continue; }
+    || { commit_records "Queue: partial XLA-tier sweep points"; sleep 300; continue; }
   run_step env APPS_XLA_ONLY=1 timeout 3600 python scripts/tpu_apps.py \
-    || { sleep 300; continue; }
+    || { commit_records "Queue: partial XLA-tier app records"; sleep 300; continue; }
   run_step python scripts/summarize_kernels.py || true
   run_step python -m distributed_sddmm_tpu.tools.charts \
     KERNELS_TPU.jsonl --kernels -o artifacts/kernels_chart || true
+  commit_records "Queue: XLA-tier sweep + app records"
   echo "[queue] XLA-only steps complete; waiting for Mosaic recovery"
   sleep 600
 done
