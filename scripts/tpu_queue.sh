@@ -116,6 +116,21 @@ bank_headline() {
   local t=$1 kern=${2:-}
   local dir=artifacts/bench_midround rec=artifacts/bench_midround/record.json
   mkdir -p "$dir"
+  # The knob overrides bench.py will apply from the best measured record
+  # (bench._best_measured_env). When new sweep points change this tuning
+  # (e.g. the step-batch probe landing 195 GFLOP/s tiles vs the 83.6 the
+  # first bank ran under), the banked headline must be re-attempted: the
+  # driver-visible number should track the best MEASURED config, not the
+  # knobs of whichever window happened to bank first.
+  local tune_sig
+  tune_sig=$(python - <<'EOF' 2>/dev/null
+import importlib.util, json
+spec = importlib.util.spec_from_file_location('b', 'bench.py')
+m = importlib.util.module_from_spec(spec)
+spec.loader.exec_module(m)
+print(json.dumps(m._best_measured_env(), sort_keys=True))
+EOF
+  )
   # "Exists" is not "valid": a record whose code_hash no longer matches
   # current sources would be rejected by the fallback reader anyway, so
   # it must not block re-banking — run it through the one validator. The
@@ -124,19 +139,30 @@ bank_headline() {
   local old_valid=0
   if [ -f "$rec" ] && python bench.py --validate-midround "$rec"; then
     old_valid=1
-    # Only the Pallas tier upgrades a valid record, only one banked by
-    # the slower xla rescue kernel, and only a bounded number of times
-    # (each attempt costs up to $t seconds of a scarce window).
-    if [ -n "$kern" ] || ! grep -q "xla kernel" "$rec"; then
+    # The xla rescue tier never touches a valid record.
+    if [ -n "$kern" ]; then
       return 0
     fi
-    local n=0
-    [ -f "$dir/upgrade_attempts" ] && n=$(cat "$dir/upgrade_attempts")
-    if [ "$n" -ge 2 ]; then
-      echo "[queue] pallas upgrade attempts exhausted; keeping xla record"
-      return 0
+    if ! grep -q "xla kernel" "$rec"; then
+      # Valid Pallas record: re-attempt ONLY when the measured tuning
+      # changed since it was banked (strict > in the merge keeps the
+      # better record either way, so a re-attempt can't lose ground).
+      if [ "$(cat "$dir/banked_env" 2>/dev/null)" = "$tune_sig" ]; then
+        return 0
+      fi
+      echo "[queue] measured tuning changed since last bank; re-banking"
+    else
+      # A record banked by the slower xla rescue kernel: upgrade to the
+      # Pallas kernel a bounded number of times (each attempt costs up
+      # to $t seconds of a scarce window).
+      local n=0
+      [ -f "$dir/upgrade_attempts" ] && n=$(cat "$dir/upgrade_attempts")
+      if [ "$n" -ge 2 ]; then
+        echo "[queue] pallas upgrade attempts exhausted; keeping xla record"
+        return 0
+      fi
+      echo $((n + 1)) > "$dir/upgrade_attempts"
     fi
-    echo $((n + 1)) > "$dir/upgrade_attempts"
   fi
   local extra=(BENCH_SKIP_CPU_FALLBACK=1)
   [ -n "$kern" ] && extra+=(BENCH_KERNEL="$kern")
@@ -173,6 +199,10 @@ else:
     print(f"[queue] kept existing banked record "
           f"({old['value']} >= {new['value']})")
 EOF
+      # The attempt ran to completion under this tuning — don't re-attempt
+      # until the measured tuning changes again. (A failed/timed-out
+      # attempt falls through without recording, so it retries next cycle.)
+      echo "$tune_sig" > "$dir/banked_env"
     else
       echo "[queue] bench produced no bankable TPU record"
     fi
