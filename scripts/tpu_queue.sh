@@ -267,17 +267,17 @@ while [ "$(date +%s)" -lt "$DEADLINE" ]; do
     # compile hang is deterministic, and a second 900 s attempt would only
     # delay the rest of the pipeline (known-good compiles run in ~2-3 min).
     run_step python scripts/kernel_sweep.py \
-      scripts/plans/batch_probe.json KERNELS_TPU.jsonl --timeout 600 --retries 0 \
+      scripts/plans/batch_probe.json KERNELS_TPU.jsonl --timeout 1500 --retries 0 \
       || failed=1
     run_step python scripts/kernel_sweep.py \
-      scripts/plans/scatter_probe.json KERNELS_TPU.jsonl --timeout 600 --retries 0 \
+      scripts/plans/scatter_probe.json KERNELS_TPU.jsonl --timeout 1500 --retries 0 \
       || failed=1
     run_step python scripts/kernel_sweep.py \
-      scripts/plans/chunk_probe.json KERNELS_TPU.jsonl --timeout 600 --retries 0 \
+      scripts/plans/chunk_probe.json KERNELS_TPU.jsonl --timeout 1500 --retries 0 \
       || failed=1
     if [ -n "$failed" ] && ! healthy_pallas; then continue; fi
     run_step python scripts/kernel_sweep.py \
-      scripts/plans/group_probe.json KERNELS_TPU.jsonl --timeout 900 --retries 1 \
+      scripts/plans/group_probe.json KERNELS_TPU.jsonl --timeout 1500 --retries 1 \
       || failed=1
     run_step python scripts/kernel_sweep.py \
       scripts/plans/star_sweep.json KERNELS_TPU.jsonl --timeout 1500 --retries 1 \
