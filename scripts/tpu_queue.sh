@@ -263,9 +263,18 @@ while [ "$(date +%s)" -lt "$DEADLINE" ]; do
     # Mosaic may have died mid-apps; re-gate before the probes, whose
     # compiles would each hang to their full timeout.
     if [ -n "$failed" ] && ! healthy_pallas; then continue; fi
-    # Novel-variant probes: tight per-config timeout, no retry — a Mosaic
-    # compile hang is deterministic, and a second 900 s attempt would only
-    # delay the rest of the pipeline (known-good compiles run in ~2-3 min).
+    # Cheapest evidence first, in case this window is short: the star
+    # sweep's XLA half compiles in ~1-2 min per config (no Mosaic), and
+    # dist_gap reuses the headline chain already in the XLA compilation
+    # cache from the banking step. Both are round-directive artifacts.
+    run_step python scripts/kernel_sweep.py \
+      scripts/plans/star_sweep.json KERNELS_TPU.jsonl --timeout 1200 --retries 1 \
+      --kernel-filter xla \
+      || failed=1
+    commit_records "Queue: XLA star-sweep grid points"
+    run_step timeout 1800 python scripts/dist_gap.py || true
+    commit_records "Queue: tile-vs-distributed gap record"
+    # Novel-variant Mosaic probes (5-12 min compile each).
     run_step python scripts/kernel_sweep.py \
       scripts/plans/batch_probe.json KERNELS_TPU.jsonl --timeout 1500 --retries 0 \
       || failed=1
