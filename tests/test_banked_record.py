@@ -1,0 +1,43 @@
+"""Guard: the banked mid-round TPU headline must stay bankable.
+
+The driver's end-of-round `bench.py` run falls back to
+`artifacts/bench_midround/record.json` when the TPU tunnel is down —
+but ONLY if the record's `code_hash` still matches the current sources
+(`bench._midround_tpu_record`). An edit to `bench.py`,
+`scripts/aot_compile_bench.py`, or anything under `distributed_sddmm_tpu/`
+invalidates the banked record until a healthy window re-banks it.
+
+This test makes that invariant visible in the suite: if it fails, either
+revert the source edit or re-run the queue's banking step on hardware
+before the round ends. (Rounds 3 and 4 lost their headline to exactly
+this staleness mode.)
+"""
+
+import json
+import pathlib
+import subprocess
+import sys
+
+import pytest
+
+REPO = pathlib.Path(__file__).resolve().parents[1]
+RECORD = REPO / "artifacts" / "bench_midround" / "record.json"
+
+
+def test_banked_record_valid_for_current_sources():
+    if not RECORD.exists():
+        pytest.skip("no banked mid-round record (fresh tree / pre-window)")
+    rec = json.loads(RECORD.read_text())
+    assert rec.get("backend") == "tpu"
+    assert rec.get("value", 0) > 0
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "bench.py"), "--validate-midround",
+         str(RECORD)],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 0, (
+        "banked headline record no longer validates against current "
+        "sources — a package/bench.py edit changed the code hash. "
+        "Re-bank on hardware (scripts/tpu_queue.sh healthy tier) or "
+        "revert the edit before round end."
+    )
