@@ -1,0 +1,49 @@
+"""Autotune subsystem: problem-fingerprinted plan selection.
+
+The paper's central artifact is a winner map — which of the five algorithm
+configurations (1.5D dense/sparse shift, 2.5D Cannon dense/sparse, plus
+fusion strategy) wins at a given (M, nnz/row, R, p, c). This package turns
+that knowledge — analytic (``tools/costmodel.py``), measured offline
+(``KERNELS_TPU.jsonl``, ``artifacts/cpu_mesh``), or measured on demand —
+into automatic plan selection at run time, following the auto-tuning
+pattern of communication-avoiding frameworks (Bharadwaj et al., IPDPS
+2022; replication-factor selection after Koanantakool et al.'s 2.5D work).
+
+Layout:
+
+* :mod:`.fingerprint` — canonical problem signature + stable cache key
+* :mod:`.candidates`  — legal candidate-plan enumeration, cost-model
+  ranking, HBM-footprint guards (heavy corners route to the chunked XLA
+  kernel instead of OOMing)
+* :mod:`.measure`     — short measured trials with per-trial timeout and
+  retry-with-backoff; degrades to cost-model ranking, never hangs
+* :mod:`.cache`       — versioned, atomically-written JSON plan cache
+  under ``artifacts/plan_cache/``, warm-started from committed sweep and
+  heatmap records
+* :mod:`.plan`        — the :class:`Plan` record and :func:`get_plan`
+  entry point
+
+Entry points::
+
+    from distributed_sddmm_tpu.autotune import Problem, get_plan
+    plan = get_plan(Problem.from_coo(S, R))    # model-ranked, cached
+    alg = plan.instantiate(S, R=R)             # a DistributedSparse
+
+or ``--algorithm auto`` on the bench CLI.
+"""
+
+from distributed_sddmm_tpu.autotune.candidates import Candidate, enumerate_candidates
+from distributed_sddmm_tpu.autotune.cache import PlanCache, SCHEMA_VERSION
+from distributed_sddmm_tpu.autotune.fingerprint import Problem, make_fingerprint
+from distributed_sddmm_tpu.autotune.plan import Plan, get_plan
+
+__all__ = [
+    "Candidate",
+    "Plan",
+    "PlanCache",
+    "Problem",
+    "SCHEMA_VERSION",
+    "enumerate_candidates",
+    "get_plan",
+    "make_fingerprint",
+]

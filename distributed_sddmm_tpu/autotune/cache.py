@@ -1,0 +1,196 @@
+"""Versioned, atomically-written JSON plan cache + warm-start seeding.
+
+One file per fingerprint key under ``artifacts/plan_cache/`` — atomic
+writes (temp file + ``os.replace``) mean a reader can never observe a
+half-written plan, and per-key files mean concurrent tuners of different
+problems never contend. Every file carries ``schema_version``; a bump
+invalidates old entries (they read as misses and are overwritten on the
+next store). Corrupt or truncated files — a killed process, a full disk —
+also read as misses: the cache is a pure accelerator, never a source of
+errors.
+
+Warm start: before the first measurement a cold cache consults the repo's
+committed knowledge — ``KERNELS_TPU.jsonl`` (which kernel family wins a
+grid point on real TPU) and the heatmap-style records under
+``artifacts/cpu_mesh`` (which algorithm/c wins a problem shape on the
+8-device mesh). A matching record yields a seed plan dict (source
+``"seed"``) that selection verifies for legality before trusting.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+import pathlib
+import tempfile
+
+from distributed_sddmm_tpu.autotune.fingerprint import Problem
+
+_REPO = pathlib.Path(__file__).resolve().parents[2]
+
+#: Plan-record schema generation. Bump on any incompatible change to the
+#: stored plan dict; old entries then read as misses.
+SCHEMA_VERSION = 1
+
+DEFAULT_CACHE_DIR = _REPO / "artifacts" / "plan_cache"
+
+
+def default_cache_dir() -> pathlib.Path:
+    """``DSDDMM_PLAN_CACHE`` env override, else the repo artifact dir —
+    read per call so tests and CI can redirect without reimporting."""
+    env = os.environ.get("DSDDMM_PLAN_CACHE")
+    return pathlib.Path(env) if env else DEFAULT_CACHE_DIR
+
+
+class PlanCache:
+    """File-per-key JSON plan store with corrupt/stale recovery."""
+
+    def __init__(self, root: str | os.PathLike | None = None):
+        self.root = pathlib.Path(root) if root is not None else default_cache_dir()
+
+    def _path(self, key: str) -> pathlib.Path:
+        return self.root / f"{key}.json"
+
+    def load(self, key: str) -> dict | None:
+        """The stored plan dict, or None on miss / corruption / version
+        mismatch. Never raises for file-content reasons."""
+        try:
+            raw = self._path(key).read_text()
+        except OSError:
+            return None
+        try:
+            rec = json.loads(raw)
+        except json.JSONDecodeError:
+            return None
+        if not isinstance(rec, dict):
+            return None
+        if rec.get("schema_version") != SCHEMA_VERSION:
+            return None
+        if rec.get("fingerprint_key") not in (None, key):
+            return None  # renamed/copied file; do not serve a foreign plan
+        return rec
+
+    def store(self, key: str, plan_dict: dict) -> None:
+        """Atomic write: a concurrent reader sees the old entry or the new
+        one, never a prefix."""
+        self.root.mkdir(parents=True, exist_ok=True)
+        rec = dict(plan_dict)
+        rec["schema_version"] = SCHEMA_VERSION
+        rec["fingerprint_key"] = key
+        fd, tmp = tempfile.mkstemp(
+            dir=self.root, prefix=f".{key}.", suffix=".tmp"
+        )
+        try:
+            with os.fdopen(fd, "w") as f:
+                f.write(json.dumps(rec, indent=1, sort_keys=True))
+            os.replace(tmp, self._path(key))
+        except BaseException:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
+
+    def invalidate(self, key: str) -> None:
+        try:
+            os.unlink(self._path(key))
+        except OSError:
+            pass
+
+
+# --------------------------------------------------------------------- #
+# Warm-start seeding from committed offline knowledge
+# --------------------------------------------------------------------- #
+
+
+def _read_jsonl(path: pathlib.Path) -> list[dict]:
+    try:
+        lines = path.read_text().splitlines()
+    except OSError:
+        return []
+    out = []
+    for line in lines:
+        try:
+            rec = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        if isinstance(rec, dict):
+            out.append(rec)
+    return out
+
+
+def _log2_bucket(x: float) -> int:
+    return max(int(round(math.log2(max(x, 1)))), 0)
+
+
+def seed_kernel_family(
+    problem: Problem,
+    backend: str,
+    path: str | os.PathLike | None = None,
+) -> str | None:
+    """Best-measured kernel family at the nearest swept grid point
+    (KERNELS_TPU.jsonl rows are keyed (logM, npr, R) on a real chip, so
+    they only inform TPU backends)."""
+    if backend != "tpu":
+        return None
+    p = pathlib.Path(path) if path is not None else _REPO / "KERNELS_TPU.jsonl"
+    want = (_log2_bucket(problem.M), problem.npr_bucket, problem.R)
+    best: tuple[float, str] | None = None
+    for rec in _read_jsonl(p):
+        if rec.get("skipped"):
+            continue
+        key = (rec.get("logM"), rec.get("npr"), rec.get("R"))
+        if key != want:
+            continue
+        g = rec.get("fused_pair_gflops")
+        fam = str(rec.get("kernel", "")).split("-")[0]
+        if g and fam and (best is None or g > best[0]):
+            best = (g, fam)
+    return best[1] if best else None
+
+
+def seed_winner_plan(
+    problem: Problem,
+    p: int,
+    path: str | os.PathLike | None = None,
+) -> dict | None:
+    """Winning (algorithm, c) from committed heatmap-style records whose
+    problem shape and mesh size match (exact M/N/p, nnz/row and R within
+    the same power-of-two bucket). Returns a partial plan dict or None."""
+    rp = (
+        pathlib.Path(path)
+        if path is not None
+        else _REPO / "artifacts" / "cpu_mesh" / "records.jsonl"
+    )
+    best: tuple[float, dict] | None = None
+    for rec in _read_jsonl(rp):
+        info = rec.get("alg_info") or {}
+        if rec.get("app", "vanilla") != "vanilla" or not rec.get("fused", False):
+            continue
+        if info.get("m") != problem.M or info.get("n") != problem.N:
+            continue
+        if info.get("p") != p:
+            continue
+        nnz = info.get("nnz") or 0
+        if _log2_bucket(nnz / max(problem.M, 1)) != _log2_bucket(
+            problem.nnz_per_row
+        ):
+            continue
+        if _log2_bucket(rec.get("R", 0)) != _log2_bucket(problem.R):
+            continue
+        g = rec.get("overall_throughput", 0.0)
+        if g and (best is None or g > best[0]):
+            best = (
+                g,
+                {
+                    "algorithm": rec.get("algorithm"),
+                    "c": rec.get("c"),
+                    "source": "seed",
+                    "seed_evidence": {
+                        "file": str(rp),
+                        "overall_throughput": g,
+                    },
+                },
+            )
+    return best[1] if best else None

@@ -1,0 +1,242 @@
+"""Candidate-plan enumeration, cost-model ranking, HBM-footprint guards.
+
+A candidate names everything the harness needs to build a strategy:
+algorithm (which also fixes fusion strategy and r_split), replication
+factor c, kernel family, an optional Pallas block config, and an optional
+gather budget that forces the chunked XLA kernel. Enumeration applies the
+same legality constraints the strategy constructors enforce (c | p;
+square p/c and R divisibility for the 2.5D grids; (p/c) | R for
+sparse-shift) so every emitted candidate is constructible.
+
+Two pruning layers follow enumeration:
+
+* **HBM guard** (:func:`hbm_guard`): estimates the per-device footprint of
+  the dominant allocations. A candidate whose *kernel intermediates*
+  (the XLA gather/scatter [nnz, R] arrays) blow the budget is not dropped
+  — it is routed to the chunked XLA kernel (``gather_budget`` set below
+  the tile footprint), which is exactly how the reference grid's heavy
+  corner (logM=16, nnz/row=128, R=512) becomes runnable. Only candidates
+  whose *resident* state (dense operands + tiles) cannot fit are pruned.
+* **Cost model** (:func:`rank_candidates`): orders survivors by the
+  analytic pair time from ``tools/costmodel.py`` (1.5D models from the
+  reference notebook; 2.5D extensions). The model is first-order — it
+  picks what to *measure first* and is the final arbiter only when
+  measurement is unavailable.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+
+from distributed_sddmm_tpu.autotune.fingerprint import Problem
+from distributed_sddmm_tpu.tools import costmodel
+
+#: The five named algorithm configurations (bench/harness.py factory keys)
+#: mapped to their analytic cost model. r_split is implied: sparse-shift
+#: and both 2.5D strategies split R, the dense-shift fusions do not.
+#: fusion2 leads: rank_candidates' sort is stable, so on modeled-cost ties
+#: the headline single-ring-pass fusion wins enumeration order.
+ALGORITHM_MODELS = {
+    "15d_fusion2": "15d_fusion2",
+    "15d_fusion1": "15d_fusion1",
+    "15d_sparse": "15d_sparse",
+    "25d_dense_replicate": "25d_dense",
+    "25d_sparse_replicate": "25d_sparse",
+}
+
+#: Pallas block configs worth trying, best-measured first
+#: (KERNELS_TPU.jsonl: (512, 512) wins the headline point at 73.3 vs 38.4
+#: for (256, 512)). None = the env-default knobs.
+PALLAS_BLOCKS = (None, (512, 512), (256, 512))
+
+#: Default per-device memory budget for the footprint guard, in bytes.
+#: v5e-ish HBM (16 GiB) with headroom for XLA workspace and the program
+#: itself. CPU test meshes share the bound — it only ever *tightens*
+#: selection, and an 8-device host mesh splits one host's RAM anyway.
+DEFAULT_HBM_BYTES = 12 * (1 << 30)
+
+_DTYPE_BYTES = {"float32": 4, "bfloat16": 2, "float16": 2, "float64": 8}
+
+
+@dataclasses.dataclass(frozen=True)
+class Candidate:
+    """One constructible plan shape (pre-selection)."""
+
+    algorithm: str
+    c: int
+    kernel: str = "xla"              # "xla" | "pallas"
+    block: tuple | None = None       # Pallas (block_rows, block_cols)
+    gather_budget: int | None = None  # set => chunked XLA kernel forced
+
+    @property
+    def chunked(self) -> bool:
+        return self.gather_budget is not None
+
+    @property
+    def r_split(self) -> bool:
+        return self.algorithm in (
+            "15d_sparse", "25d_dense_replicate", "25d_sparse_replicate"
+        )
+
+
+def legal_c_values(algorithm: str, p: int, R: int) -> list[int]:
+    """Replication factors the named algorithm's constructor would accept
+    at (p, R) — one place that mirrors every constructor's checks."""
+    out = []
+    for c in range(1, p + 1):
+        if p % c:
+            continue
+        if algorithm in ("15d_fusion1", "15d_fusion2"):
+            out.append(c)
+        elif algorithm == "15d_sparse":
+            if R % (p // c) == 0:
+                out.append(c)
+        else:  # 2.5D grids
+            s = math.isqrt(p // c)
+            if s * s * c != p:
+                continue
+            if algorithm == "25d_dense_replicate" and R % s == 0:
+                out.append(c)
+            elif algorithm == "25d_sparse_replicate" and R % (s * c) == 0:
+                out.append(c)
+    return out
+
+
+def _resident_bytes(problem: Problem, cand: Candidate, p: int) -> float:
+    """Per-device bytes that stay allocated for the life of the strategy:
+    both dense operands (the stationary one replicated c-fold for the
+    dense-replicating strategies) plus the padded tile structure (rows,
+    cols, mask, vals ~ 4 words per nonzero, S and S^T both resident)."""
+    b = _DTYPE_BYTES.get(problem.dtype, 4)
+    dense = (problem.M + problem.N) * problem.R * b / p
+    if cand.algorithm in ("15d_fusion1", "15d_fusion2"):
+        dense += (problem.M * problem.R * b / p) * (cand.c - 1)
+    elif cand.algorithm == "25d_dense_replicate":
+        dense *= cand.c
+    tiles = 2 * problem.nnz * 4 * 4 / p
+    if cand.algorithm == "25d_sparse_replicate":
+        tiles *= cand.c
+    return dense + tiles
+
+
+def _xla_intermediate_elems(problem: Problem, cand: Candidate, p: int) -> float:
+    """Elements of the largest [local_nnz, R_local] intermediate the
+    un-chunked XLA kernel materializes per ring step (gather product /
+    scatter contributions). Local nnz follows the block-row tiling: nnz/p
+    scaled by the stationary replication. R_local is the resident feature
+    width, which each r_split strategy divides differently: sparse-shift
+    splits R over the full shift axis p/c, the 2.5D grids only over
+    sqrt(p/c) (dense-replicating, cols axis) or sqrt(p/c)*c (sparse-
+    replicating, cols x layers fiber)."""
+    local_nnz = problem.nnz / p
+    r_div = 1
+    if cand.algorithm in ("15d_fusion1", "15d_fusion2"):
+        local_nnz *= cand.c
+    elif cand.algorithm == "15d_sparse":
+        r_div = max(p // cand.c, 1)
+    elif cand.algorithm == "25d_dense_replicate":
+        local_nnz *= cand.c  # tiles live on the s x s grid: nnz/(s*s)
+        r_div = max(math.isqrt(p // cand.c), 1)
+    elif cand.algorithm == "25d_sparse_replicate":
+        local_nnz *= cand.c
+        r_div = max(math.isqrt(p // cand.c) * cand.c, 1)
+    return local_nnz * max(problem.R / r_div, 1)
+
+
+def hbm_guard(
+    problem: Problem,
+    cand: Candidate,
+    p: int,
+    budget_bytes: int = DEFAULT_HBM_BYTES,
+) -> Candidate | None:
+    """Route or prune one candidate against the memory budget.
+
+    Returns the candidate (possibly rewritten onto the chunked XLA kernel)
+    or None when no rewrite can make it fit. Never returns a candidate
+    whose un-chunked XLA intermediates exceed the budget — the OOM corner
+    must be impossible to *select*, not merely unlikely.
+    """
+    b = _DTYPE_BYTES.get(problem.dtype, 4)
+    resident = _resident_bytes(problem, cand, p)
+    if resident > budget_bytes:
+        return None
+    if cand.kernel != "xla":
+        return cand
+    headroom = budget_bytes - resident
+    inter = _xla_intermediate_elems(problem, cand, p)
+    # Gather + scatter intermediates live simultaneously in the fused pass.
+    if 2 * inter * b <= headroom:
+        return cand
+    # Chunk the kernel: budget the scan segment so one segment's
+    # intermediates use at most half the headroom (elements, not bytes —
+    # XLA_GATHER_BUDGET is an element count).
+    seg_budget = int(headroom / (4 * b))
+    if seg_budget < problem.R:  # cannot fit even one nonzero's row
+        return None
+    return dataclasses.replace(cand, gather_budget=seg_budget)
+
+
+def enumerate_candidates(
+    problem: Problem,
+    p: int,
+    kernels: tuple[str, ...] = ("xla",),
+    budget_bytes: int = DEFAULT_HBM_BYTES,
+) -> list[Candidate]:
+    """All constructible, memory-safe candidates for (problem, machine)."""
+    out = []
+    for algorithm in ALGORITHM_MODELS:
+        for c in legal_c_values(algorithm, p, problem.R):
+            for kernel in kernels:
+                blocks = PALLAS_BLOCKS if kernel == "pallas" else (None,)
+                for block in blocks:
+                    cand = Candidate(
+                        algorithm=algorithm, c=c, kernel=kernel, block=block
+                    )
+                    cand = hbm_guard(problem, cand, p, budget_bytes)
+                    if cand is not None:
+                        out.append(cand)
+    return out
+
+
+def model_cost(
+    problem: Problem,
+    cand: Candidate,
+    p: int,
+    machine: costmodel.Machine | None = None,
+) -> float:
+    """Analytic seconds per fused pair for one candidate.
+
+    The kernel family adjusts the compute rate: when the sweep records
+    carry measured rates for both families, their ratio at the nearest
+    grid point scales the model's flops term (the collective terms are
+    kernel-independent). The chunked kernel is charged a small sequential
+    overhead so an un-chunked sibling of equal volume outranks it.
+    """
+    if machine is None:
+        machine = costmodel.Machine()
+    rate = costmodel.measured_flops_rate(cand.kernel) or machine.flops_rate
+    m = costmodel.Machine(
+        ici_words_per_s=machine.ici_words_per_s,
+        alpha_s=machine.alpha_s,
+        flops_rate=rate,
+    )
+    t = costmodel.pair_time(
+        ALGORITHM_MODELS[cand.algorithm],
+        problem.M, problem.N, problem.R, problem.nnz, p, cand.c, m,
+    )
+    if cand.chunked:
+        t *= 1.1
+    return t
+
+
+def rank_candidates(
+    problem: Problem,
+    cands: list[Candidate],
+    p: int,
+    machine: costmodel.Machine | None = None,
+) -> list[tuple[Candidate, float]]:
+    """(candidate, modeled seconds) sorted fastest-first."""
+    scored = [(cand, model_cost(problem, cand, p, machine)) for cand in cands]
+    scored.sort(key=lambda cs: cs[1])
+    return scored

@@ -1,0 +1,143 @@
+"""Canonical problem fingerprints for plan-cache lookup.
+
+A fingerprint is the complete set of inputs that determine which plan is
+best: the problem (M, N, nnz, nnz/row bucket, R, dtype), the machine
+(mesh shape, backend, which kernel families are available), and the code
+generation (a hash of the program-shaping package sources). Two processes
+given the same inputs MUST produce the same key — the cache-hit fast path
+and cross-restart reuse both depend on it — so the key is a SHA-256 of the
+canonical-JSON field dict, never ``hash()`` (randomized per process) or
+``repr()`` of anything with unstable ordering.
+
+The nnz/row term is bucketed to the nearest power of two: sparsity-regime
+boundaries in the winner map are octave-scale (the reference sweeps
+nnz/row in {8, 32, 128}), and exact-nnz keys would make every R-mat seed a
+cold miss. M, N and nnz stay exact — tile geometry and the HBM guards
+depend on them exactly.
+
+This module deliberately imports neither jax nor the strategy code:
+fingerprints must be computable in a subprocess (stability tests) and in
+tooling without pulling up a backend. The machine terms are plain
+arguments; callers with a live backend use :func:`machine_signature`.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import hashlib
+import json
+import pathlib
+
+_PKG = pathlib.Path(__file__).resolve().parents[1]
+
+#: Fingerprint field-schema generation. Bump when the field set or any
+#: bucketing rule changes so stale cache entries cannot alias new keys.
+FINGERPRINT_VERSION = 1
+
+
+@dataclasses.dataclass(frozen=True)
+class Problem:
+    """The tuning-relevant description of one SDDMM+SpMM workload."""
+
+    M: int
+    N: int
+    nnz: int
+    R: int
+    dtype: str = "float32"
+
+    @classmethod
+    def from_coo(cls, S, R: int, dtype: str = "float32") -> "Problem":
+        """Build from a :class:`~distributed_sddmm_tpu.utils.coo.HostCOO`."""
+        return cls(M=int(S.M), N=int(S.N), nnz=int(S.nnz), R=int(R),
+                   dtype=dtype)
+
+    @property
+    def nnz_per_row(self) -> float:
+        return self.nnz / max(self.M, 1)
+
+    @property
+    def npr_bucket(self) -> int:
+        """nnz/row rounded to the nearest power of two (>= 1)."""
+        npr = max(self.nnz_per_row, 1.0)
+        b = 1
+        while b * 2 <= npr * (2 ** 0.5):  # round at the geometric midpoint
+            b *= 2
+        return b
+
+
+@functools.lru_cache(maxsize=1)
+def code_hash() -> str:
+    """Hash of the program-shaping sources (``ops/`` + ``parallel/``).
+
+    A plan measured under one code generation must not claim validity under
+    another — ring structure, tile ingest and kernel lowering all shape the
+    programs a plan names. Autotune's own modules (and models/bench/tools)
+    are excluded on purpose: editing selection logic or apps does not
+    change what a (algorithm, c, kernel) plan executes, and including them
+    would cold-start the cache on every subsystem tweak.
+    """
+    h = hashlib.sha256()
+    for sub in ("ops", "parallel"):
+        for f in sorted((_PKG / sub).glob("*.py")):
+            h.update(f.name.encode())
+            h.update(f.read_bytes())
+    return h.hexdigest()[:12]
+
+
+@dataclasses.dataclass(frozen=True)
+class Fingerprint:
+    """Canonical signature + stable key. ``fields`` is the exact dict the
+    key hashes; it is stored alongside cached plans so a cache file is
+    self-describing."""
+
+    fields: tuple  # canonical (name, value) pairs, fixed order
+    key: str
+
+    def as_dict(self) -> dict:
+        return dict(self.fields)
+
+
+def machine_signature(devices=None) -> tuple[int, str, tuple[str, ...]]:
+    """(p, backend, available kernel families) for the live jax runtime.
+
+    The only function here that touches jax — callers without a backend
+    (subprocess key checks, offline tooling) pass the terms explicitly to
+    :func:`make_fingerprint`.
+    """
+    import jax
+
+    if devices is None:
+        devices = jax.devices()
+    backend = devices[0].platform
+    kernels = ("pallas", "xla") if backend == "tpu" else ("xla",)
+    return len(devices), backend, kernels
+
+
+def make_fingerprint(
+    problem: Problem,
+    p: int,
+    backend: str,
+    kernels: tuple[str, ...] = ("xla",),
+    code: str | None = None,
+) -> Fingerprint:
+    """Build the canonical fingerprint for (problem, machine, code)."""
+    fields = (
+        ("fingerprint_version", FINGERPRINT_VERSION),
+        ("M", problem.M),
+        ("N", problem.N),
+        ("nnz", problem.nnz),
+        ("npr_bucket", problem.npr_bucket),
+        ("R", problem.R),
+        ("dtype", problem.dtype),
+        ("p", int(p)),
+        ("backend", str(backend)),
+        ("kernels", tuple(sorted(kernels))),
+        ("code_hash", code if code is not None else code_hash()),
+    )
+    blob = json.dumps(
+        [[k, list(v) if isinstance(v, tuple) else v] for k, v in fields],
+        separators=(",", ":"),
+    )
+    key = hashlib.sha256(blob.encode()).hexdigest()[:16]
+    return Fingerprint(fields=fields, key=key)

@@ -1,0 +1,165 @@
+"""Short measured trials for candidate plans, with timeout and backoff.
+
+The measurement path exists because the analytic models are first-order:
+when the backend is healthy, a few short trials through the existing
+``bench/harness.py`` timing path beat any model. But the tunneled TPU
+backend is *not* always healthy — round 5's sweep log is a string of
+"attempt timed out after 600s" entries — so every trial runs under a
+per-trial timeout with retry-and-exponential-backoff, and a candidate
+whose trials all fail is simply dropped. When every candidate drops, the
+caller (``plan.get_plan``) falls back to cost-model ranking: a flaky
+backend degrades selection quality, it never hangs or raises.
+
+Timeouts use ``signal.setitimer(ITIMER_REAL)``, which can only arm on the
+main thread; off the main thread trials run unbounded (documented —
+autotune from worker threads should pass ``mode="model"`` instead). The
+trial function is injectable (``trial_fn``) so tests simulate timeouts and
+count invocations without ever touching a backend.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import signal
+import threading
+import time
+from typing import Callable, Optional
+
+from distributed_sddmm_tpu.autotune.candidates import Candidate
+from distributed_sddmm_tpu.autotune.fingerprint import Problem
+
+
+class MeasureTimeout(Exception):
+    """One measured trial exceeded its wall-clock budget."""
+
+
+def _build_kernel(cand: Candidate):
+    """The kernel instance a candidate names (chunked XLA = budget
+    override; Pallas block config applied via :func:`block_knobs`)."""
+    from distributed_sddmm_tpu.ops.kernels import XlaKernel, get_kernel
+
+    if cand.kernel == "xla":
+        return XlaKernel(gather_budget=cand.gather_budget)
+    return get_kernel(cand.kernel)
+
+
+@contextlib.contextmanager
+def block_knobs(cand: Candidate):
+    """Apply a candidate's Pallas block config while its strategy is
+    BUILT (the blocked tile chunk lists bake geometry at ingest).
+
+    The knob defaults live as module attributes of ``ops.blocked``,
+    initialized from env at first import — so a per-candidate config must
+    rebind the module attributes; mutating the env vars here would be a
+    silent no-op (the snapshot already happened)."""
+    if cand.kernel != "pallas" or cand.block is None:
+        yield
+        return
+    from distributed_sddmm_tpu.ops import blocked
+
+    saved = (blocked.DEFAULT_BLOCK_ROWS, blocked.DEFAULT_BLOCK_COLS)
+    blocked.DEFAULT_BLOCK_ROWS, blocked.DEFAULT_BLOCK_COLS = cand.block
+    try:
+        yield
+    finally:
+        blocked.DEFAULT_BLOCK_ROWS, blocked.DEFAULT_BLOCK_COLS = saved
+
+
+def default_trial(
+    S, problem: Problem, cand: Candidate, trials: int, warmup: int
+) -> dict:
+    """One short measured run through the bench harness timing path.
+    Returns the harness record (``overall_throughput`` in GFLOP/s)."""
+    from distributed_sddmm_tpu.bench.harness import benchmark_algorithm
+
+    with block_knobs(cand):
+        return benchmark_algorithm(
+            S,
+            cand.algorithm,
+            None,
+            fused=True,
+            R=problem.R,
+            c=cand.c,
+            trials=trials,
+            warmup=warmup,
+            kernel=_build_kernel(cand),
+        )
+
+
+def _call_with_timeout(fn: Callable[[], dict], timeout_s: float) -> dict:
+    """Run ``fn`` under a SIGALRM deadline (main thread only)."""
+    if timeout_s <= 0 or threading.current_thread() is not threading.main_thread():
+        return fn()
+
+    def on_alarm(signum, frame):
+        raise MeasureTimeout(f"trial exceeded {timeout_s:.0f}s")
+
+    prev = signal.signal(signal.SIGALRM, on_alarm)
+    signal.setitimer(signal.ITIMER_REAL, timeout_s)
+    try:
+        return fn()
+    finally:
+        signal.setitimer(signal.ITIMER_REAL, 0.0)
+        signal.signal(signal.SIGALRM, prev)
+
+
+def measure_candidates(
+    S,
+    problem: Problem,
+    cands: list[Candidate],
+    *,
+    trials: int = 2,
+    warmup: int = 1,
+    timeout_s: float = 120.0,
+    retries: int = 1,
+    backoff_s: float = 2.0,
+    trial_fn: Optional[Callable] = None,
+    sleep: Callable[[float], None] = time.sleep,
+) -> list[tuple[Candidate, dict]]:
+    """Measure each candidate; return the (candidate, record) pairs that
+    produced a number, fastest-first by measured throughput.
+
+    Per candidate: up to ``retries + 1`` attempts, each under ``timeout_s``
+    wall-clock, with ``backoff_s * 2**attempt`` sleeps between (a flaky
+    tunnel often recovers within one backoff window; a dead one fails fast
+    instead of serializing 600s hangs across the whole candidate list).
+    Construction errors (divisibility, kernel availability) drop the
+    candidate immediately — retrying a deterministic failure wastes budget.
+    """
+    import sys
+
+    run = trial_fn or default_trial
+    out = []
+    for cand in cands:
+        last_err = None
+        for attempt in range(retries + 1):
+            try:
+                rec = _call_with_timeout(
+                    lambda: run(S, problem, cand, trials, warmup), timeout_s
+                )
+                out.append((cand, rec))
+                last_err = None
+                break
+            except (MeasureTimeout, TimeoutError) as e:
+                last_err = e
+                if attempt < retries:
+                    sleep(backoff_s * (2 ** attempt))
+            except ValueError as e:
+                last_err = e
+                break  # unconstructible here; enumeration bug or stale seed
+            except Exception as e:  # noqa: BLE001 — any failure = drop + note
+                last_err = e
+                if attempt < retries:
+                    sleep(backoff_s * (2 ** attempt))
+        if last_err is not None:
+            # The degradation (candidate dropped, possibly down to pure
+            # cost-model ranking) must be observable, not silent.
+            print(
+                f"[autotune] dropped {cand.algorithm} c={cand.c} "
+                f"kernel={cand.kernel}: {type(last_err).__name__}: {last_err}",
+                file=sys.stderr,
+            )
+    out.sort(
+        key=lambda cr: cr[1].get("overall_throughput", 0.0), reverse=True
+    )
+    return out

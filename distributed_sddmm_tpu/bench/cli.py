@@ -46,13 +46,17 @@ HEATMAP_R_VALUES = [64, 128, 192, 256, 320, 384, 448]
 
 
 def _resolve_algs(name: str) -> list[str]:
+    if name == "auto":
+        # Autotuned: the plan (algorithm, c, kernel) is selected per
+        # (matrix, R) in _run_configs through the autotune subsystem.
+        return ["auto"]
     if name in ALG_GROUPS:
         return ALG_GROUPS[name]
     if name in ALGORITHM_FACTORIES:
         return [name]
     raise SystemExit(
         f"unknown algorithm {name!r}; expected one of "
-        f"{sorted(ALGORITHM_FACTORIES) + sorted(ALG_GROUPS)}"
+        f"{sorted(ALGORITHM_FACTORIES) + sorted(ALG_GROUPS) + ['auto']}"
     )
 
 
@@ -72,37 +76,72 @@ def _run_configs(S, alg_names, args, r_values=None):
             "--breakdown requires --app vanilla and --fused yes "
             "(it attributes the fusedSpMM op)"
         )
-    kernel = _get_kernel(args.kernel)
     records = []
     for alg in alg_names:
         for R in r_values or [args.R]:
+            plan = None
+            if alg == "auto":
+                # Autotuned path: fingerprint the problem, recall or select
+                # a plan (algorithm + c + kernel); the positional c and
+                # --kernel are superseded by the plan's choices.
+                from distributed_sddmm_tpu.autotune import Problem, get_plan
+
+                mode = getattr(args, "plan_mode", "model")
+                plan = get_plan(
+                    Problem.from_coo(S, R),
+                    S=S if mode in ("auto", "measure") else None,
+                    mode=mode,
+                )
+                run_alg, run_c, kernel = plan.algorithm, plan.c, plan.make_kernel()
+                print(
+                    f"plan[{plan.source}] {run_alg} c={run_c} "
+                    f"kernel={plan.kernel}"
+                    + (" (chunked)" if plan.gather_budget else ""),
+                    file=sys.stderr,
+                )
+            else:
+                run_alg, run_c, kernel = alg, args.c, _get_kernel(args.kernel)
             for fused in ([True, False] if args.fused == "both" else [args.fused == "yes"]):
+                # The plan's Pallas block config applies at strategy BUILD
+                # (tile ingest bakes the geometry), so the whole benchmark
+                # call runs under the plan's knobs — otherwise the record
+                # would claim a block config that never ran.
+                if plan is not None:
+                    from distributed_sddmm_tpu.autotune.measure import block_knobs
+
+                    knobs = block_knobs(plan.candidate())
+                else:
+                    import contextlib
+
+                    knobs = contextlib.nullcontext()
                 try:
-                    rec = benchmark_algorithm(
-                        S,
-                        alg,
-                        args.output_file,
-                        fused=fused,
-                        R=R,
-                        c=args.c,
-                        app=args.app,
-                        trials=args.trials,
-                        warmup=args.warmup,
-                        kernel=kernel,
-                        breakdown=getattr(args, "breakdown", False),
-                    )
+                    with knobs:
+                        rec = benchmark_algorithm(
+                            S,
+                            run_alg,
+                            args.output_file,
+                            fused=fused,
+                            R=R,
+                            c=run_c,
+                            app=args.app,
+                            trials=args.trials,
+                            warmup=args.warmup,
+                            kernel=kernel,
+                            breakdown=getattr(args, "breakdown", False),
+                            extra_info={"plan": plan.to_dict()} if plan else None,
+                        )
                 except ValueError as e:
                     # Divisibility constraints differ per algorithm
                     # (reference exits; the sweep driver skips instead).
-                    print(f"skip {alg} R={R} c={args.c}: {e}", file=sys.stderr)
+                    print(f"skip {run_alg} R={R} c={run_c}: {e}", file=sys.stderr)
                     continue
                 records.append(rec)
                 print(
                     json.dumps(
                         {
-                            "algorithm": alg,
+                            "algorithm": run_alg,
                             "R": R,
-                            "c": args.c,
+                            "c": run_c,
                             "fused": fused,
                             "elapsed": round(rec["elapsed"], 4),
                             "GFLOPs": round(rec["overall_throughput"], 3),
@@ -117,6 +156,12 @@ def _add_common(p: argparse.ArgumentParser) -> None:
     p.add_argument("--trials", type=int, default=5)
     p.add_argument("--warmup", type=int, default=1)
     p.add_argument("--kernel", default="auto", help="xla | pallas | auto")
+    p.add_argument(
+        "--plan-mode", default="model", choices=["model", "auto", "measure"],
+        help="with an 'auto' algorithm: 'model' selects by cost model / "
+        "cache only (fast, no trial runs); 'measure' times the top "
+        "candidates first; 'auto' measures when possible",
+    )
     p.add_argument("--fused", default="yes", choices=["yes", "no", "both"])
     p.add_argument(
         "--breakdown", action="store_true",

@@ -42,7 +42,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-from jax import shard_map
+from distributed_sddmm_tpu.compat import shard_map
 
 
 def _program(p: int, steps_work: int, serialize: bool):

@@ -1,0 +1,62 @@
+"""Version-compat shims over the small set of jax APIs that moved.
+
+The package targets the current jax surface (top-level ``jax.shard_map``
+with the varying-mesh-axes checker, ``lax.pcast``), but must also run on
+jax 0.4.x containers where ``shard_map`` lives in ``jax.experimental``
+and takes ``check_rep`` instead of ``check_vma``. Everything in the
+package imports these names from here instead of hard-coding one jax
+generation's layout.
+
+* :func:`shard_map` — accepts the modern keyword surface
+  (``check_vma``); on old jax it maps onto the experimental entry point
+  with ``check_rep=False``. Replication checking is disabled there
+  because the old checker has no equivalent of ``lax.pcast`` for
+  loop-carried inits (see :func:`pvary`), so rolled ring loops cannot
+  satisfy it; the check is a static optimization aid, not a correctness
+  requirement.
+* :func:`pvary` — marks an array device-varying over mesh axes
+  (``lax.pcast(..., to="varying")``). Identity on jax generations whose
+  shard_map has no varying-axes type system: there is nothing to mark.
+"""
+
+from __future__ import annotations
+
+from jax import lax
+
+try:  # modern jax: top-level export, check_vma keyword
+    from jax import shard_map as _shard_map
+
+    _HAS_VMA = True
+except ImportError:  # jax 0.4.x: experimental module, check_rep keyword
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    _HAS_VMA = False
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma: bool = True):
+    """``jax.shard_map`` with one keyword surface across jax generations."""
+    if _HAS_VMA:
+        return _shard_map(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_vma=check_vma,
+        )
+    return _shard_map(
+        f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+        check_rep=False,
+    )
+
+
+if hasattr(lax, "pcast"):
+
+    def pvary(x, axes):
+        return lax.pcast(x, axes, to="varying")
+
+elif hasattr(lax, "pvary"):
+
+    def pvary(x, axes):
+        return lax.pvary(x, axes)
+
+else:
+
+    def pvary(x, axes):
+        return x

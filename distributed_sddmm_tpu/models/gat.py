@@ -53,13 +53,34 @@ class GATLayer:
         return self.features_per_head * self.num_heads
 
 
+def _supports_programs(d_ops: DistributedSparse) -> bool:
+    """True when the strategy exposes the raw sddmm/spmm program
+    accessors and needs no pre/post skew — then one whole layer (all
+    heads: project -> SDDMM -> LeakyReLU -> SpMM -> ReLU -> concat)
+    compiles as ONE program."""
+    return (
+        hasattr(d_ops, "sddmm_program")
+        and hasattr(d_ops, "spmm_program")
+        and type(d_ops).initial_shift is DistributedSparse.initial_shift
+        and type(d_ops).de_shift is DistributedSparse.de_shift
+    )
+
+
 class GAT:
+    """``use_programs``: ``"auto"`` (default) compiles each layer's full
+    multi-head computation into one jitted program when the strategy
+    supports it (the 1.5D dense-shift strategies); per-op counters then
+    show ``gatLayer`` once per layer instead of 4 dispatches per head —
+    the same dispatch-elimination treatment the headline bench gets from
+    ``fused_program``."""
+
     def __init__(
         self,
         layers: list[GATLayer],
         d_ops: DistributedSparse,
         leaky_relu_alpha: float = 0.2,
         seed: int = 0,
+        use_programs: str | bool = "auto",
     ):
         if d_ops.M != d_ops.N:
             raise ValueError("GAT requires a square adjacency matrix")
@@ -74,6 +95,11 @@ class GAT:
         self.d_ops = d_ops
         self.layers = layers
         self.leaky_relu_alpha = leaky_relu_alpha
+        if use_programs == "auto":
+            self._use_programs = _supports_programs(d_ops)
+        else:
+            self._use_programs = bool(use_programs) and _supports_programs(d_ops)
+        self._layer_programs: dict = {}
 
         key = jax.random.key(seed)
         for layer in layers:
@@ -116,6 +142,45 @@ class GAT:
         h, _ = d.de_shift(h, None, KernelMode.SPMM_A)
         return jnp.maximum(h, 0)  # gat.hpp:103
 
+    def _layer_program(self, i: int):
+        """ONE jitted program for layer ``i``: every head's projection,
+        SDDMM logits, LeakyReLU, SpMM aggregation and ReLU, plus the head
+        concat — the raw-program composition of
+        :meth:`compute_self_attention_head` (same math, one dispatch)."""
+        if i in self._layer_programs:
+            return self._layer_programs[i]
+        d = self.d_ops
+        layer = self.layers[i]
+        alpha = self.leaky_relu_alpha
+        mode = MatMode.A
+
+        d.set_r_value(layer.input_features)
+        sddmm = d.sddmm_program(mode)
+        spmm = d.spmm_program(mode)
+        ones = d.like_s_values(1.0)
+
+        def head(X, w):
+            A = d._skew_cols(
+                jnp.einsum("...r,rk->...k", d._unskew_cols(X, mode), w), mode
+            )
+            logits = sddmm(A, A, ones)  # A==B: GAT mandates M == N
+            att = jnp.maximum(logits, 0) + jnp.minimum(logits, 0) * alpha
+            return jnp.maximum(spmm(A, att), 0)
+
+        def layer_fn(X, *weights):
+            heads = [head(X, w) for w in weights]
+            return d._skew_cols(
+                jnp.concatenate(
+                    [d._unskew_cols(h, mode) for h in heads], axis=-1
+                ),
+                mode,
+            )
+
+        d.set_r_value(layer.output_features)
+        prog = jax.jit(layer_fn, out_shardings=d.a_sharding())
+        self._layer_programs[i] = prog
+        return prog
+
     def forward(self, X: jax.Array | None = None) -> jax.Array:
         """Full forward pass (`gat.hpp:106-112`).
 
@@ -127,9 +192,35 @@ class GAT:
             d.set_r_value(self.layers[0].input_features)
             X = d.dummy_initialize(MatMode.A) * (1.0 / (d.M * self.layers[0].input_features))
         for i, layer in enumerate(self.layers):
-            heads = [
-                self.compute_self_attention_head(X, i, j)
-                for j in range(layer.num_heads)
-            ]
-            X = d.concat_heads(heads, MatMode.A)
+            if self._use_programs:
+                prog = self._layer_program(i)
+                d.set_r_value(layer.output_features)
+                X = d._timed("gatLayer", prog, X, *layer.weights)
+            else:
+                heads = [
+                    self.compute_self_attention_head(X, i, j)
+                    for j in range(layer.num_heads)
+                ]
+                X = d.concat_heads(heads, MatMode.A)
         return X
+
+    @classmethod
+    def from_plan(
+        cls, S, layers: list[GATLayer], plan=None, devices=None,
+        plan_mode: str = "model", **kw,
+    ) -> "GAT":
+        """Build GAT on an autotune-selected strategy (R fingerprinted at
+        the first layer's input width). The selected plan is kept on
+        ``self.plan``; on the dense-shift strategies the plan route lands
+        every layer on the one-program-per-layer path automatically."""
+        from distributed_sddmm_tpu.autotune import Problem, get_plan
+
+        R = layers[0].input_features
+        if plan is None:
+            plan = get_plan(
+                Problem.from_coo(S, R), devices, S=S, mode=plan_mode
+            )
+        alg = plan.instantiate(S, R=R, devices=devices)
+        model = cls(layers, alg, **kw)
+        model.plan = plan
+        return model

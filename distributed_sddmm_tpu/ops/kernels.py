@@ -24,10 +24,23 @@ already the static-shape solution XLA requires.
 
 from __future__ import annotations
 
+import os
 from typing import Protocol
 
 import jax
 import jax.numpy as jnp
+
+# Element budget for the gathered/scattered [nnz, R] intermediates of the
+# XLA kernel. Both ops materialize nnz*R-element arrays (A[rows]/B[cols]
+# and the scatter contributions); past this budget they switch to a
+# sequential scan over nnz segments so peak memory stays bounded — the
+# reference grid's heavy corner (logM=16, nnz/row=128, R=512) needs
+# ~17 GB per gather otherwise, more than a v5e chip's HBM. Shapes are
+# static under jit, so this is a trace-time branch, not runtime control
+# flow. The default (2^29 elements ≈ 2 GB f32 per intermediate) keeps the
+# headline config (2^16 rows, nnz/row=32, R=128 → 2.7e8) on the fused
+# single-pass path.
+XLA_GATHER_BUDGET = int(os.environ.get("DSDDMM_XLA_GATHER_BUDGET", str(1 << 29)))
 
 
 class LocalKernel(Protocol):
@@ -62,17 +75,72 @@ class LocalKernel(Protocol):
 
 
 class XlaKernel:
-    """Gather-dot SDDMM + segment-sum SpMM in pure XLA ops."""
+    """Gather-dot SDDMM + segment-sum SpMM in pure XLA ops.
+
+    ``gather_budget`` overrides the module-level :data:`XLA_GATHER_BUDGET`
+    for this instance — the autotuner's chunked-kernel candidate is exactly
+    an ``XlaKernel`` with a budget below the tile's nnz*R footprint, which
+    forces the sequential-scan path regardless of the env default.
+    """
 
     name = "xla"
 
+    def __init__(self, gather_budget: int | None = None):
+        self._gather_budget = gather_budget
+
+    @property
+    def gather_budget(self) -> int:
+        # Falls back to the module global at CALL time, so tests (and env
+        # overrides applied after import) that rebind XLA_GATHER_BUDGET
+        # still govern default-constructed kernels.
+        if self._gather_budget is not None:
+            return self._gather_budget
+        return XLA_GATHER_BUDGET
+
     def sddmm(self, rows, cols, vals, A, B):
-        dots = jnp.sum(A[rows] * B[cols], axis=-1)
+        n, r = rows.shape[0], A.shape[-1]
+        budget = self.gather_budget
+        if n * r <= budget:
+            dots = jnp.sum(A[rows] * B[cols], axis=-1)
+            return vals * dots.astype(vals.dtype)
+        seg = max(1, budget // r)
+        n_seg = -(-n // seg)
+        pad = n_seg * seg - n
+        rows_p = jnp.pad(rows, (0, pad)).reshape(n_seg, seg)
+        cols_p = jnp.pad(cols, (0, pad)).reshape(n_seg, seg)
+        dots = jax.lax.map(
+            lambda rc: jnp.sum(A[rc[0]] * B[rc[1]], axis=-1), (rows_p, cols_p)
+        ).reshape(-1)[:n]
         return vals * dots.astype(vals.dtype)
 
     def spmm(self, rows, cols, vals, B, out_rows: int):
-        contrib = vals[:, None] * B[cols]
-        return jax.ops.segment_sum(contrib, rows, num_segments=out_rows)
+        n, r = rows.shape[0], B.shape[-1]
+        out_dtype = jnp.result_type(vals.dtype, B.dtype)
+        budget = self.gather_budget
+        if n * r <= budget:
+            contrib = vals[:, None] * B[cols]
+            return jax.ops.segment_sum(contrib, rows, num_segments=out_rows)
+        seg = max(1, budget // r)
+        n_seg = -(-n // seg)
+        pad = n_seg * seg - n
+        # Pad entries land at row 0 with val 0 — inert under accumulate,
+        # exactly the tile padding convention documented above.
+        rows_p = jnp.pad(rows, (0, pad)).reshape(n_seg, seg)
+        cols_p = jnp.pad(cols, (0, pad)).reshape(n_seg, seg)
+        vals_p = jnp.pad(vals, (0, pad)).reshape(n_seg, seg)
+
+        def step(acc, rcv):
+            rr, cc, vv = rcv
+            return acc + jax.ops.segment_sum(
+                vv[:, None] * B[cc], rr, num_segments=out_rows
+            ), None
+
+        out, _ = jax.lax.scan(
+            step,
+            jnp.zeros((out_rows, r), dtype=out_dtype),
+            (rows_p, cols_p, vals_p),
+        )
+        return out
 
 
 _REGISTRY = {"xla": XlaKernel}

@@ -35,7 +35,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec as P
-from jax import shard_map
+from distributed_sddmm_tpu.compat import shard_map
 
 from distributed_sddmm_tpu.common import MatMode, divide_round_up
 from distributed_sddmm_tpu.parallel.base import DistributedSparse
@@ -503,6 +503,27 @@ class DenseShift15D(DistributedSparse):
         if use_st:
             return lambda A, B: prog(B, A, *args)
         return lambda A, B: prog(A, B, *args)
+
+    def sddmm_program(self, mode: MatMode = MatMode.A):
+        """Raw-program accessor: ``f(A, B, vals) -> tile vals`` with no
+        host-side timing wrappers — composable inside a larger jitted
+        program (the GAT per-layer chain builds logits with this, applies
+        LeakyReLU, then aggregates through :meth:`spmm_program`, all in
+        ONE compiled program per layer)."""
+        use_st = mode == MatMode.B
+        tiles = self.ST_tiles if use_st else self.S_tiles
+        prog = self._program("sddmm", use_st)
+        if use_st:
+            return lambda A, B, vals: prog(B, A, *self._tile_args(tiles, vals))
+        return lambda A, B, vals: prog(A, B, *self._tile_args(tiles, vals))
+
+    def spmm_program(self, mode: MatMode = MatMode.A):
+        """Raw-program accessor: ``f(mov, vals) -> dense`` (``mov`` is the
+        traveling operand — B for A-mode output, A for B-mode)."""
+        use_st = mode == MatMode.B
+        tiles = self.ST_tiles if use_st else self.S_tiles
+        prog = self._program("spmm", use_st)
+        return lambda mov, vals: prog(mov, *self._tile_args(tiles, vals))
 
     def fused_spmm(self, A, B, s_vals, mode: MatMode = MatMode.A):
         op = "fused" if self.fusion_approach == 2 else "fused_twopass"

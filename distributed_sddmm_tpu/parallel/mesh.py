@@ -115,7 +115,7 @@ class GridSpec:
         import jax
         import jax.numpy as jnp
         from jax import lax
-        from jax import shard_map
+        from distributed_sddmm_tpu.compat import shard_map
 
         # Host-side round trip first.
         for i in range(self.nr):

@@ -34,7 +34,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec as P
-from jax import shard_map
+from distributed_sddmm_tpu.compat import shard_map
 
 from distributed_sddmm_tpu.common import MatMode, divide_round_up
 from distributed_sddmm_tpu.parallel.base import DistributedSparse

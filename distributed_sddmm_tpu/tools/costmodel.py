@@ -37,6 +37,7 @@ from __future__ import annotations
 
 import dataclasses
 import json
+import math
 import pathlib
 
 _REPO = pathlib.Path(__file__).resolve().parents[2]
@@ -122,26 +123,82 @@ def _sparse_shift_words(M, N, R, nnz, p, c, n_pass):
     return replicate + ring
 
 
+def _sqrtpc(p: int, c: int) -> int:
+    """sqrt(p/c) for the 2.5D grids; raises when p/c is not a square
+    (mirrors the strategy constructors' constraint)."""
+    if c < 1 or p % c:
+        raise ValueError(f"c={c} must divide p={p}")
+    s = math.isqrt(p // c)
+    if s * s * c != p:
+        raise ValueError(f"2.5D models require p/c square (p={p}, c={c})")
+    return s
+
+
+def _cannon_dense_words(M, N, R, p, c):
+    """2.5D Cannon, dense replicated: first-order per-device words.
+
+    Grid sqrt(p/c) x sqrt(p/c) x c (R split over cols); both dense blocks
+    ride the Cannon rotation while each of the c layers covers s/c of the
+    s shift steps, and the layer axis carries the one-time dense broadcast
+    plus the output reduce-scatter. Same altitude as the notebook's 1.5D
+    models — the 2.5D strategies are not in the notebook, so these extend
+    it following Koanantakool et al.'s 2.5D volume accounting.
+    """
+    s = _sqrtpc(p, c)
+    block_a = (M / (s * c)) * (R / s)
+    block_b = (N / (s * c)) * (R / s)
+    steps = max(s // c, 1)
+    replicate = (c - 1) / c * c * (block_a + block_b)  # layer broadcast
+    ring = steps * (block_a + block_b)
+    reduce_out = (c - 1) / c * c * block_a             # fiber reduce-scatter
+    return replicate + ring + reduce_out
+
+
+def _cannon_sparse_words(M, N, R, nnz, p, c):
+    """2.5D Cannon, sparse replicated: the sparse tiles are resident
+    (replication paid once at ingest, not per pair); the dense blocks ride
+    and the R-split (cols x layers) fiber carries the output reduction."""
+    s = _sqrtpc(p, c)
+    block_a = (M / s) * (R / (s * c))
+    block_b = (N / s) * (R / (s * c))
+    steps = max(s // c, 1)
+    ring = steps * (block_a + block_b)
+    reduce_out = (c - 1) / c * c * block_a
+    return ring + reduce_out
+
+
 def pair_time(
     alg: str, M: int, N: int, R: int, nnz: int, p: int, c: int,
     machine: Machine = Machine(),
 ) -> float:
     """Modeled seconds for one fused SDDMM+SpMM pair on p chips at
     replication c. ``alg`` in {15d_fusion1, 15d_fusion2, 15d_unfused,
-    15d_sparse}."""
+    15d_sparse, 25d_dense, 25d_sparse}. Raises ValueError for (p, c)
+    combinations the named algorithm cannot run (non-divisor c, non-square
+    p/c) — callers enumerating c filter on that, exactly as the strategy
+    constructors do."""
     if c < 1 or p % c:
         raise ValueError(f"c={c} must divide p={p}")
     if alg == "15d_fusion2":
         words = _dense_shift_words(M, N, R, p, c, n_pass=1, n_repl=1)
+        hops = p / c - 1
     elif alg == "15d_fusion1":
         words = _dense_shift_words(M, N, R, p, c, n_pass=2, n_repl=1)
+        hops = 2 * (p / c - 1)
     elif alg == "15d_unfused":
         words = _dense_shift_words(M, N, R, p, c, n_pass=2, n_repl=2)
+        hops = 2 * (p / c - 1)
     elif alg == "15d_sparse":
         words = _sparse_shift_words(M, N, R, nnz, p, c, n_pass=1)
+        hops = p / c - 1
+    elif alg == "25d_dense":
+        words = _cannon_dense_words(M, N, R, p, c)
+        hops = max(_sqrtpc(p, c) // c, 1)
+    elif alg == "25d_sparse":
+        words = _cannon_sparse_words(M, N, R, nnz, p, c)
+        hops = max(_sqrtpc(p, c) // c, 1)
     else:
         raise ValueError(f"unknown model {alg!r}")
-    hops = (p / c - 1)
     compute = 4.0 * nnz * R / p / machine.flops_rate
     return words / machine.ici_words_per_s + hops * machine.alpha_s + compute
 
@@ -150,9 +207,19 @@ def optimal_c(
     alg: str, M: int, N: int, R: int, nnz: int, p: int,
     machine: Machine = Machine(),
 ) -> int:
-    """argmin_c of :func:`pair_time` over divisors of p."""
-    cs = [c for c in range(1, p + 1) if p % c == 0]
-    return min(cs, key=lambda c: pair_time(alg, M, N, R, nnz, p, c, machine))
+    """argmin_c of :func:`pair_time` over the divisors of p the algorithm
+    accepts (2.5D models reject non-square p/c)."""
+    times = {}
+    for c in range(1, p + 1):
+        if p % c:
+            continue
+        try:
+            times[c] = pair_time(alg, M, N, R, nnz, p, c, machine)
+        except ValueError:
+            continue
+    if not times:
+        raise ValueError(f"no legal c for {alg!r} at p={p}")
+    return min(times, key=times.get)
 
 
 def model_curves(

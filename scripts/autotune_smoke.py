@@ -1,0 +1,106 @@
+"""CPU-mesh autotune smoke run: cost-model-only plan selection.
+
+Exercises the whole selection pipeline — fingerprint, candidate
+enumeration, HBM guards, cost-model ranking, cache store/recall — with
+zero measured trials, on the same virtual 8-device CPU mesh the test
+suite uses. Fast enough for CI (a tier-1 test runs it as a subprocess);
+useful standalone as a health check that every probe problem still gets
+a constructible plan, including the heavy corner (logM=16, nnz/row=128,
+R=512) that must route onto the chunked XLA kernel rather than a >HBM
+gather.
+
+Usage::
+
+    python scripts/autotune_smoke.py [--devices 8] [-o out.json]
+
+Prints one JSON summary; exits nonzero if any probe problem fails to
+produce a plan or the heavy corner is not chunk-routed.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import pathlib
+import sys
+import tempfile
+import time
+
+REPO = pathlib.Path(__file__).resolve().parents[1]
+sys.path.insert(0, str(REPO))
+
+
+# Probe problems spanning the five algorithm configs' home regimes
+# (paper heatmap axes: size, density, R). Shapes are scaled down from the
+# reference grid so the smoke run needs no big host allocations — the
+# selection path is size-independent; only the chosen plans differ.
+PROBES = [
+    {"name": "headline", "M": 1 << 12, "npr": 32, "R": 128},
+    {"name": "dense_rows", "M": 1 << 10, "npr": 128, "R": 64},
+    {"name": "sparse_highR", "M": 1 << 12, "npr": 8, "R": 512},
+    {"name": "small_lowR", "M": 1 << 10, "npr": 8, "R": 16},
+    {"name": "square_midR", "M": 1 << 11, "npr": 32, "R": 256},
+    # The reference grid's OOM corner at full size, probed single-device
+    # (the kernel-sweep context where its nnz*R gather ~ 17 GB first blew
+    # HBM): must emerge chunk-routed, never crash or prune away.
+    {"name": "heavy_corner", "M": 1 << 16, "npr": 128, "R": 512, "p": 1},
+]
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--devices", type=int, default=8)
+    ap.add_argument("-o", "--output-file", default=None)
+    args = ap.parse_args(argv)
+
+    from distributed_sddmm_tpu.utils.platform import force_cpu_platform
+
+    force_cpu_platform(n_devices=args.devices, replace=True)
+
+    from distributed_sddmm_tpu.autotune import PlanCache, Problem, get_plan
+
+    ok = True
+    results = []
+    with tempfile.TemporaryDirectory() as tmp:
+        cache = PlanCache(tmp)
+        import jax
+
+        for probe in PROBES:
+            prob = Problem(
+                M=probe["M"], N=probe["M"], nnz=probe["M"] * probe["npr"],
+                R=probe["R"],
+            )
+            devices = jax.devices()[: probe["p"]] if "p" in probe else None
+            t0 = time.perf_counter()
+            try:
+                plan = get_plan(prob, devices, mode="model", cache=cache)
+            except Exception as e:  # noqa: BLE001 — a smoke run reports, not raises
+                results.append({"probe": probe, "error": f"{type(e).__name__}: {e}"})
+                ok = False
+                continue
+            cold_s = time.perf_counter() - t0
+            t0 = time.perf_counter()
+            get_plan(prob, devices, mode="model", cache=cache)  # warm: cache hit
+            warm_s = time.perf_counter() - t0
+            rec = {
+                "probe": probe,
+                "plan": plan.to_dict(),
+                "cold_s": round(cold_s, 4),
+                "warm_s": round(warm_s, 4),
+            }
+            if probe["name"] == "heavy_corner" and plan.kernel == "xla":
+                rec["chunk_routed"] = plan.gather_budget is not None
+                ok &= rec["chunk_routed"]
+            results.append(rec)
+
+    out = {"ok": ok, "devices": args.devices, "mode": "model", "probes": results}
+    blob = json.dumps(out, indent=1)
+    print(blob)
+    if args.output_file:
+        with open(args.output_file, "w") as f:
+            f.write(blob + "\n")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

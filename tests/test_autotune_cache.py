@@ -1,0 +1,172 @@
+"""Plan cache: recovery, invalidation, stability, zero-trial hits."""
+
+import json
+import subprocess
+import sys
+
+from distributed_sddmm_tpu.autotune import cache as cache_mod
+from distributed_sddmm_tpu.autotune import Problem, get_plan
+from distributed_sddmm_tpu.autotune.cache import PlanCache
+from distributed_sddmm_tpu.autotune.fingerprint import make_fingerprint
+from distributed_sddmm_tpu.utils.coo import HostCOO
+
+PROBLEM = Problem(M=256, N=256, nnz=2048, R=16)
+
+
+def _plan_dict():
+    return {
+        "algorithm": "15d_fusion2", "c": 2, "kernel": "xla", "block": None,
+        "gather_budget": None, "source": "model", "predicted_ms": 1.0,
+        "measured_gflops": None,
+    }
+
+
+def test_store_load_roundtrip(tmp_path):
+    cache = PlanCache(tmp_path)
+    cache.store("abc123", _plan_dict())
+    rec = cache.load("abc123")
+    assert rec is not None
+    assert rec["algorithm"] == "15d_fusion2"
+    assert rec["schema_version"] == cache_mod.SCHEMA_VERSION
+    assert rec["fingerprint_key"] == "abc123"
+
+
+def test_corrupt_file_reads_as_miss(tmp_path):
+    cache = PlanCache(tmp_path)
+    cache.store("k1", _plan_dict())
+    (tmp_path / "k1.json").write_text("{not json at all")
+    assert cache.load("k1") is None
+    # ...and the cache recovers: a store overwrites the corrupt entry.
+    cache.store("k1", _plan_dict())
+    assert cache.load("k1") is not None
+
+
+def test_truncated_file_reads_as_miss(tmp_path):
+    cache = PlanCache(tmp_path)
+    cache.store("k2", _plan_dict())
+    full = (tmp_path / "k2.json").read_text()
+    (tmp_path / "k2.json").write_text(full[: len(full) // 2])
+    assert cache.load("k2") is None
+
+
+def test_schema_version_bump_invalidates(tmp_path, monkeypatch):
+    cache = PlanCache(tmp_path)
+    cache.store("k3", _plan_dict())
+    assert cache.load("k3") is not None
+    monkeypatch.setattr(cache_mod, "SCHEMA_VERSION", cache_mod.SCHEMA_VERSION + 1)
+    assert cache.load("k3") is None
+
+
+def test_renamed_file_not_served_under_foreign_key(tmp_path):
+    """A copied/renamed cache file must not answer for a different
+    fingerprint (the stored record pins its own key)."""
+    cache = PlanCache(tmp_path)
+    cache.store("orig", _plan_dict())
+    (tmp_path / "other.json").write_text((tmp_path / "orig.json").read_text())
+    assert cache.load("other") is None
+
+
+def test_fingerprint_stable_across_process_restart():
+    """The cache key for identical inputs must be identical in a fresh
+    interpreter — restart reuse depends on it (no per-process hash
+    randomization, no dict-order dependence)."""
+    fp = make_fingerprint(PROBLEM, p=8, backend="cpu", kernels=("xla",))
+    code = (
+        "from distributed_sddmm_tpu.autotune.fingerprint import "
+        "Problem, make_fingerprint; "
+        "print(make_fingerprint(Problem(M=256, N=256, nnz=2048, R=16), "
+        "p=8, backend='cpu', kernels=('xla',)).key)"
+    )
+    out = subprocess.run(
+        [sys.executable, "-c", code], capture_output=True, text=True,
+        timeout=120, check=True,
+    )
+    assert out.stdout.strip() == fp.key
+
+
+def test_fingerprint_key_sensitivity():
+    base = make_fingerprint(PROBLEM, p=8, backend="cpu", kernels=("xla",))
+    assert make_fingerprint(PROBLEM, p=4, backend="cpu").key != base.key
+    assert (
+        make_fingerprint(PROBLEM, p=8, backend="tpu", kernels=("xla",)).key
+        != base.key
+    )
+    other = Problem(M=256, N=256, nnz=2048, R=32)
+    assert make_fingerprint(other, p=8, backend="cpu").key != base.key
+
+
+def test_npr_bucket_rounds_to_octaves():
+    assert Problem(M=256, N=256, nnz=2048, R=16).npr_bucket == 8
+    assert Problem(M=256, N=256, nnz=2100, R=16).npr_bucket == 8
+    assert Problem(M=256, N=256, nnz=256 * 100, R=16).npr_bucket == 128
+    assert Problem(M=256, N=256, nnz=100, R=16).npr_bucket == 1
+
+
+def test_cache_hit_performs_zero_measured_trials(tmp_path):
+    """A warm cache answers without building or timing anything, fast."""
+    import time
+
+    S = HostCOO.rmat(log_m=6, edge_factor=4, seed=0)
+    prob = Problem.from_coo(S, 16)
+    cache = PlanCache(tmp_path)
+    calls = []
+
+    def fake_trial(S_, problem, cand, trials, warmup):
+        calls.append(cand)
+        return {"overall_throughput": 1.0, "algorithm": cand.algorithm}
+
+    plan1 = get_plan(
+        prob, S=S, mode="measure", cache=cache, trial_fn=fake_trial,
+        top_k=2, backoff_s=0.0,
+    )
+    assert plan1.source == "measured"
+    assert calls  # the cold path did measure
+    n_cold = len(calls)
+
+    t0 = time.perf_counter()
+    plan2 = get_plan(
+        prob, S=S, mode="measure", cache=cache, trial_fn=fake_trial,
+        top_k=2, backoff_s=0.0,
+    )
+    elapsed = time.perf_counter() - t0
+    assert len(calls) == n_cold  # ZERO new trials on the hit
+    assert plan2.to_dict() == plan1.to_dict()
+    assert elapsed < 1.0
+
+
+def test_warm_start_seed_from_committed_records():
+    """The committed cpu_mesh heatmap records seed the matching problem
+    shape (M=N=1024, nnz/row~8, p=8): winner 15d_fusion2 at c=2."""
+    prob = Problem(M=1024, N=1024, nnz=8165, R=32)
+    seed = cache_mod.seed_winner_plan(prob, p=8)
+    assert seed is not None
+    assert seed["algorithm"] == "15d_fusion2"
+    assert seed["c"] == 2
+    assert seed["source"] == "seed"
+
+
+def test_warm_start_no_match_is_none():
+    assert cache_mod.seed_winner_plan(
+        Problem(M=4096, N=4096, nnz=32768, R=32), p=8
+    ) is None
+    # Kernel-family seeding only informs TPU backends (the sweep measured
+    # real chips).
+    assert cache_mod.seed_kernel_family(
+        Problem(M=1 << 16, N=1 << 16, nnz=(1 << 16) * 32, R=128), "cpu"
+    ) is None
+
+
+def test_atomic_write_leaves_no_tmp_droppings(tmp_path):
+    cache = PlanCache(tmp_path)
+    for i in range(5):
+        cache.store(f"k{i}", _plan_dict())
+    leftovers = [p.name for p in tmp_path.iterdir() if p.suffix == ".tmp"]
+    assert leftovers == []
+    assert len(list(tmp_path.glob("*.json"))) == 5
+
+
+def test_stored_file_is_valid_json_with_version(tmp_path):
+    cache = PlanCache(tmp_path)
+    cache.store("kk", _plan_dict())
+    rec = json.loads((tmp_path / "kk.json").read_text())
+    assert rec["schema_version"] == cache_mod.SCHEMA_VERSION

@@ -1,0 +1,31 @@
+"""The CI smoke entry point: cost-model-only autotune on the CPU mesh."""
+
+import json
+import pathlib
+import subprocess
+import sys
+
+REPO = pathlib.Path(__file__).resolve().parents[1]
+
+
+def test_autotune_smoke_script(tmp_path):
+    out_file = tmp_path / "smoke.json"
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "scripts" / "autotune_smoke.py"),
+         "-o", str(out_file)],
+        capture_output=True, text=True, timeout=300,
+        env={"PATH": "/usr/bin:/bin:/usr/local/bin", "HOME": "/tmp",
+             "DSDDMM_PLAN_CACHE": str(tmp_path / "cache")},
+    )
+    assert proc.returncode == 0, proc.stdout[-2000:] + proc.stderr[-2000:]
+    rep = json.loads(out_file.read_text())
+    assert rep["ok"] is True
+    by_name = {r["probe"]["name"]: r for r in rep["probes"]}
+    assert len(by_name) == 6 and not any("error" in r for r in rep["probes"])
+    # The OOM corner emerged chunk-routed (never crash, never prune-away).
+    heavy = by_name["heavy_corner"]
+    assert heavy["chunk_routed"] is True
+    # Cost-model-only mode answers quickly even cold; warm hits are
+    # well under a second (the cache-hit latency bar).
+    for r in rep["probes"]:
+        assert r["warm_s"] < 1.0

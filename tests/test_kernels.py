@@ -51,3 +51,27 @@ def test_spmm_transpose_via_swap():
     out = XlaKernel().spmm(cols, rows, vals, jnp.array(A), out_rows=S.N)
     expected = oracle.spmm_b(S, A.astype(np.float64))
     np.testing.assert_allclose(np.asarray(out), expected, rtol=1e-4, atol=1e-5)
+
+
+def test_chunked_paths_match_single_pass(monkeypatch):
+    """Past XLA_GATHER_BUDGET both ops fall back to sequential nnz
+    segments (the reference grid's heavy corner would otherwise need an
+    nnz*R gather larger than HBM); the segmented results must be
+    bit-compatible with the one-pass path, including a ragged final
+    segment and inert padding."""
+    from distributed_sddmm_tpu.ops import kernels as K
+
+    S, A, B = _setup(M=48, N=40, R=8, seed=3)
+    rows, cols, vals = _tile(S, S.nnz + 5)  # nnz+5 not divisible by seg
+    k = XlaKernel()
+    one_sddmm = k.sddmm(rows, cols, vals, jnp.array(A), jnp.array(B))
+    one_spmm = k.spmm(rows, cols, vals, jnp.array(B), out_rows=S.M)
+    # 7*R elements per segment: forces many segments plus a ragged tail.
+    monkeypatch.setattr(K, "XLA_GATHER_BUDGET", 7 * A.shape[1])
+    chunked_sddmm = k.sddmm(rows, cols, vals, jnp.array(A), jnp.array(B))
+    chunked_spmm = k.spmm(rows, cols, vals, jnp.array(B), out_rows=S.M)
+    np.testing.assert_allclose(
+        np.asarray(chunked_sddmm), np.asarray(one_sddmm), rtol=1e-5,
+        atol=1e-6)
+    np.testing.assert_allclose(
+        np.asarray(chunked_spmm), np.asarray(one_spmm), rtol=1e-5, atol=1e-6)
