@@ -23,9 +23,9 @@ import json
 import math
 import os
 import pathlib
-import tempfile
 
 from distributed_sddmm_tpu.autotune.fingerprint import Problem
+from distributed_sddmm_tpu.utils.atomic import atomic_write_json
 
 _REPO = pathlib.Path(__file__).resolve().parents[2]
 
@@ -72,25 +72,14 @@ class PlanCache:
         return rec
 
     def store(self, key: str, plan_dict: dict) -> None:
-        """Atomic write: a concurrent reader sees the old entry or the new
-        one, never a prefix."""
-        self.root.mkdir(parents=True, exist_ok=True)
+        """Atomic write (shared ``utils.atomic`` helper): a concurrent
+        reader sees the old entry or the new one, never a prefix. The
+        helper's fault hook can corrupt the landed file — ``load`` then
+        reads it as a miss, the recovery the corruption tests pin."""
         rec = dict(plan_dict)
         rec["schema_version"] = SCHEMA_VERSION
         rec["fingerprint_key"] = key
-        fd, tmp = tempfile.mkstemp(
-            dir=self.root, prefix=f".{key}.", suffix=".tmp"
-        )
-        try:
-            with os.fdopen(fd, "w") as f:
-                f.write(json.dumps(rec, indent=1, sort_keys=True))
-            os.replace(tmp, self._path(key))
-        except BaseException:
-            try:
-                os.unlink(tmp)
-            except OSError:
-                pass
-            raise
+        atomic_write_json(self._path(key), rec)
 
     def invalidate(self, key: str) -> None:
         try:

@@ -10,26 +10,29 @@ whose trials all fail is simply dropped. When every candidate drops, the
 caller (``plan.get_plan``) falls back to cost-model ranking: a flaky
 backend degrades selection quality, it never hangs or raises.
 
-Timeouts use ``signal.setitimer(ITIMER_REAL)``, which can only arm on the
-main thread; off the main thread trials run unbounded (documented —
-autotune from worker threads should pass ``mode="model"`` instead). The
-trial function is injectable (``trial_fn``) so tests simulate timeouts and
-count invocations without ever touching a backend.
+Timeouts run through the shared resilience utility
+(``resilience.retry.call_with_timeout``): a daemon-thread join bound that
+works from ANY thread — the SIGALRM path this replaced could only arm on
+the main thread, so worker-thread autotuning ran unbounded. Backoff
+between retries carries proportional jitter (fixed steps re-synchronize
+workers that failed together) and a max-elapsed cap (a dead backend fails
+fast instead of compounding exponential sleeps). The trial function is
+injectable (``trial_fn``) so tests simulate timeouts and count
+invocations without ever touching a backend.
 """
 
 from __future__ import annotations
 
 import contextlib
-import signal
-import threading
 import time
 from typing import Callable, Optional
 
 from distributed_sddmm_tpu.autotune.candidates import Candidate
 from distributed_sddmm_tpu.autotune.fingerprint import Problem
+from distributed_sddmm_tpu.resilience.retry import Backoff, CallTimeout, call_with_timeout
 
 
-class MeasureTimeout(Exception):
+class MeasureTimeout(CallTimeout):
     """One measured trial exceeded its wall-clock budget."""
 
 
@@ -86,23 +89,6 @@ def default_trial(
         )
 
 
-def _call_with_timeout(fn: Callable[[], dict], timeout_s: float) -> dict:
-    """Run ``fn`` under a SIGALRM deadline (main thread only)."""
-    if timeout_s <= 0 or threading.current_thread() is not threading.main_thread():
-        return fn()
-
-    def on_alarm(signum, frame):
-        raise MeasureTimeout(f"trial exceeded {timeout_s:.0f}s")
-
-    prev = signal.signal(signal.SIGALRM, on_alarm)
-    signal.setitimer(signal.ITIMER_REAL, timeout_s)
-    try:
-        return fn()
-    finally:
-        signal.setitimer(signal.ITIMER_REAL, 0.0)
-        signal.signal(signal.SIGALRM, prev)
-
-
 def measure_candidates(
     S,
     problem: Problem,
@@ -113,44 +99,56 @@ def measure_candidates(
     timeout_s: float = 120.0,
     retries: int = 1,
     backoff_s: float = 2.0,
+    jitter: float = 0.25,
+    max_elapsed_s: float = 900.0,
     trial_fn: Optional[Callable] = None,
     sleep: Callable[[float], None] = time.sleep,
+    monotonic: Callable[[], float] = time.monotonic,
+    rng=None,
 ) -> list[tuple[Candidate, dict]]:
     """Measure each candidate; return the (candidate, record) pairs that
     produced a number, fastest-first by measured throughput.
 
     Per candidate: up to ``retries + 1`` attempts, each under ``timeout_s``
-    wall-clock, with ``backoff_s * 2**attempt`` sleeps between (a flaky
-    tunnel often recovers within one backoff window; a dead one fails fast
-    instead of serializing 600s hangs across the whole candidate list).
-    Construction errors (divisibility, kernel availability) drop the
-    candidate immediately — retrying a deterministic failure wastes budget.
+    wall-clock, with ``backoff_s * 2**attempt * (1 + U(0, jitter))`` sleeps
+    between (a flaky tunnel often recovers within one backoff window; the
+    jitter keeps a fleet of workers that timed out together from re-arriving
+    together). ``max_elapsed_s`` caps the whole candidate's attempt budget
+    — a dead backend fails fast instead of serializing 600s hangs across
+    the whole candidate list. Construction errors (divisibility, kernel
+    availability) drop the candidate immediately — retrying a deterministic
+    failure wastes budget.
     """
     import sys
 
     run = trial_fn or default_trial
     out = []
     for cand in cands:
+        backoff = Backoff(
+            base_s=backoff_s, jitter=jitter, max_delay_s=float("inf"),
+            max_elapsed_s=max_elapsed_s, rng=rng,
+        )
+        t_start = monotonic()
         last_err = None
         for attempt in range(retries + 1):
             try:
-                rec = _call_with_timeout(
-                    lambda: run(S, problem, cand, trials, warmup), timeout_s
+                rec = call_with_timeout(
+                    lambda: run(S, problem, cand, trials, warmup),
+                    timeout_s, label=f"trial:{cand.algorithm}",
                 )
                 out.append((cand, rec))
                 last_err = None
                 break
-            except (MeasureTimeout, TimeoutError) as e:
-                last_err = e
-                if attempt < retries:
-                    sleep(backoff_s * (2 ** attempt))
             except ValueError as e:
                 last_err = e
                 break  # unconstructible here; enumeration bug or stale seed
             except Exception as e:  # noqa: BLE001 — any failure = drop + note
                 last_err = e
                 if attempt < retries:
-                    sleep(backoff_s * (2 ** attempt))
+                    d = backoff.delay(attempt)
+                    if not backoff.budget_left(monotonic() - t_start, d):
+                        break  # elapsed cap: fail this candidate fast
+                    sleep(d)
         if last_err is not None:
             # The degradation (candidate dropped, possibly down to pure
             # cost-model ranking) must be observable, not silent.

@@ -134,6 +134,8 @@ def get_plan(
     timeout_s: float = 120.0,
     retries: int = 1,
     backoff_s: float = 2.0,
+    jitter: float = 0.25,
+    max_elapsed_s: float = 900.0,
     trial_fn: Optional[Callable] = None,
 ) -> Plan:
     """Select (or recall) the execution plan for a fingerprinted problem.
@@ -190,7 +192,8 @@ def get_plan(
         measured = measure_mod.measure_candidates(
             S, problem, short_list,
             trials=trials, warmup=warmup, timeout_s=timeout_s,
-            retries=retries, backoff_s=backoff_s, trial_fn=trial_fn,
+            retries=retries, backoff_s=backoff_s, jitter=jitter,
+            max_elapsed_s=max_elapsed_s, trial_fn=trial_fn,
         )
 
     if measured:

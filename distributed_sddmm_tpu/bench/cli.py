@@ -129,6 +129,9 @@ def _run_configs(S, alg_names, args, r_values=None):
                             kernel=kernel,
                             breakdown=getattr(args, "breakdown", False),
                             extra_info={"plan": plan.to_dict()} if plan else None,
+                            checkpoint_dir=getattr(args, "checkpoint_dir", None),
+                            checkpoint_every=getattr(args, "checkpoint_every", 1),
+                            resume=getattr(args, "resume", False),
                         )
                 except ValueError as e:
                     # Divisibility constraints differ per algorithm
@@ -170,6 +173,25 @@ def _add_common(p: argparse.ArgumentParser) -> None:
         "backend, e.g. the CPU test mesh)",
     )
     p.add_argument("-o", "--output-file", default=None, help="append JSON records here")
+    p.add_argument(
+        "--faults", default=None, metavar="SPEC",
+        help="activate a fault-injection plan: inline JSON spec list (or "
+        "{'seed','specs'} dict) or @/path/to/plan.json; equivalent to the "
+        "DSDDMM_FAULTS env var (see resilience/faults.py)",
+    )
+    p.add_argument(
+        "--checkpoint-dir", default=None, metavar="DIR",
+        help="persist app state (ALS factors) under DIR atomically",
+    )
+    p.add_argument(
+        "--checkpoint-every", type=int, default=1, metavar="N",
+        help="checkpoint every N alternating steps (with --checkpoint-dir)",
+    )
+    p.add_argument(
+        "--resume", action="store_true",
+        help="resume from the newest valid checkpoint in --checkpoint-dir "
+        "instead of step 0 (corrupt checkpoints scan back; none = fresh)",
+    )
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -246,6 +268,12 @@ def build_parser() -> argparse.ArgumentParser:
 
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
+
+    if getattr(args, "faults", None):
+        from distributed_sddmm_tpu.resilience import FaultPlan, faults
+
+        faults.install(FaultPlan.from_spec(args.faults))
+        print("[faults] plan installed from --faults", file=sys.stderr)
 
     if args.cmd == "er":
         S = HostCOO.rmat(log_m=args.log_m, edge_factor=args.edge_factor, seed=0)

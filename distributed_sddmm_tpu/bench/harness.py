@@ -127,18 +127,38 @@ def _run_gat(alg: DistributedSparse, trials: int, warmup: int, num_layers: int):
     return time.perf_counter() - t0, {"gat_heads": [l.num_heads for l in gat.layers]}
 
 
-def _run_als(alg: DistributedSparse, trials: int, warmup: int, cg_iters: int = 10):
-    als = DistributedALS(alg)
+def _run_als(
+    alg: DistributedSparse,
+    trials: int,
+    warmup: int,
+    cg_iters: int = 10,
+    S: Optional[HostCOO] = None,
+    checkpoint_dir: Optional[str] = None,
+    checkpoint_every: int = 1,
+    resume: bool = False,
+):
+    als = DistributedALS(alg, S_host=S)
     als.initialize_embeddings()
     if warmup:
         als.run_cg(1, cg_iters=cg_iters)  # compiles every program in the loop
         als.initialize_embeddings()
+    store = None
+    if checkpoint_dir:
+        from distributed_sddmm_tpu.resilience import CheckpointStore
+
+        store = CheckpointStore(checkpoint_dir)
     alg.reset_performance_timers()
     t0 = time.perf_counter()
-    als.run_cg(trials, cg_iters=cg_iters)
+    als.run_cg(
+        trials, cg_iters=cg_iters,
+        checkpoint=store, checkpoint_every=checkpoint_every, resume=resume,
+    )
     force_fetch((als.A, als.B))
     elapsed = time.perf_counter() - t0
-    return elapsed, {"als_residual": als.compute_residual(), "cg_iters": cg_iters}
+    stats = {"als_residual": als.compute_residual(), "cg_iters": cg_iters}
+    if als.degraded:
+        stats["als_degraded"] = als.degraded
+    return elapsed, stats
 
 
 def benchmark_algorithm(
@@ -156,6 +176,9 @@ def benchmark_algorithm(
     extra_info: Optional[dict] = None,
     breakdown: bool = False,
     post_build=None,
+    checkpoint_dir: Optional[str] = None,
+    checkpoint_every: int = 1,
+    resume: bool = False,
 ) -> dict:
     """Run one benchmark configuration; append a JSON record to
     ``output_file`` (if given) and return it.
@@ -164,8 +187,15 @@ def benchmark_algorithm(
     reference's ``json_algorithm_info``), ``fused``, ``app``,
     ``overall_throughput`` in GFLOP/s, and per-op ``perf_stats``.
     """
+    from distributed_sddmm_tpu.resilience import faults
+
     if app not in ("vanilla", "gat", "als"):
         raise ValueError(f"unknown app {app!r}; expected vanilla | gat | als")
+    # Snapshot the plan's event cursor: the events list is cumulative and
+    # process-wide, and a sweep emits many records — each must carry only
+    # the faults that fired during ITS run.
+    _fault_plan = faults.active()
+    _events_before = len(_fault_plan.events) if _fault_plan is not None else 0
     if breakdown and (app != "vanilla" or not fused):
         # Fail before any measurement: the attribution times the fusedSpMM
         # op, so injecting it into unfused or gat/als records would mix ops
@@ -186,7 +216,11 @@ def benchmark_algorithm(
     elif app == "gat":
         elapsed, app_stats = _run_gat(alg, trials, warmup, num_layers=3)
     else:
-        elapsed, app_stats = _run_als(alg, trials, warmup)
+        elapsed, app_stats = _run_als(
+            alg, trials, warmup, S=S,
+            checkpoint_dir=checkpoint_dir, checkpoint_every=checkpoint_every,
+            resume=resume,
+        )
 
     # SDDMM+SpMM pair = 2 ops x 2*nnz*R flops each (`benchmark_dist.cpp:147-149`).
     nnz = S.nnz
@@ -223,6 +257,14 @@ def benchmark_algorithm(
         **app_stats,
         **(extra_info or {}),
     }
+    if _fault_plan is not None:
+        # A record produced under fault injection must say so — and which
+        # faults actually fired — or sweep files silently mix poisoned and
+        # clean measurements.
+        record["faults_fired"] = [
+            {"site": s, "kind": k, "call": n}
+            for s, k, n in _fault_plan.events[_events_before:]
+        ]
     if output_file:
         with open(output_file, "a") as f:
             f.write(json.dumps(record) + "\n")

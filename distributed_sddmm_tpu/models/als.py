@@ -19,9 +19,27 @@ programming model.
 The ridge term uses ``lambda=1e-6`` by default rather than the reference's
 1e-13 (`als_conjugate_gradients.cpp:271`), which is below float32 epsilon
 relative to typical Gram-matrix scales; pass ``ridge_lambda`` to override.
+
+Resilience (none of which the reference had — it assumed a healthy MPI
+world and a clean run from step 0):
+
+* **Checkpoint/resume**: ``run_cg(checkpoint=store, checkpoint_every=k,
+  resume=True)`` persists the factor matrices atomically after every k-th
+  alternating step and resumes from the newest loadable checkpoint. The
+  factors round-trip bit-exactly, and each alternating step is a pure
+  deterministic function of (A, B), so a killed-and-resumed run converges
+  to factors bit-identical to an uninterrupted one.
+* **CG divergence ladder** (active when guards are on): a growing or
+  non-finite residual first triggers a damped-λ restart of the half-step
+  (ridge stiffened by ``damp_factor`` from the pre-step factors), and if
+  that diverges too, ALS degrades to the single-node oracle solver
+  (``models/serial_als.py`` — pass ``S_host`` to enable) rather than
+  walking poisoned factors forward.
 """
 
 from __future__ import annotations
+
+import sys
 
 import numpy as np
 
@@ -30,6 +48,14 @@ import jax.numpy as jnp
 
 from distributed_sddmm_tpu.common import KernelMode, MatMode
 from distributed_sddmm_tpu.parallel.base import DistributedSparse
+from distributed_sddmm_tpu.resilience import faults, guards
+from distributed_sddmm_tpu.resilience.guards import CGGuard, NumericalFault
+
+
+class CGDivergence(ArithmeticError):
+    """The batched-CG residual grew (or went non-finite) past the guard's
+    tolerance — the Gram operator is inconsistent or the system is too
+    ill-conditioned for the current ridge."""
 
 
 def _batch_dot(x: jax.Array, y: jax.Array) -> jax.Array:
@@ -91,9 +117,19 @@ class DistributedALS:
         ground_truth_vals: np.ndarray | None = None,
         ground_truth_vals_transpose: np.ndarray | None = None,
         use_programs: str | bool = "auto",
+        S_host=None,
+        guard: str | bool = "auto",
+        damp_factor: float = 1e3,
     ):
         self.d_ops = d_ops
         self.ridge_lambda = ridge_lambda
+        # Resilience knobs: ``guard`` "auto" follows guards.enabled() (on
+        # under an active fault plan or DSDDMM_GUARDS); S_host enables the
+        # final rung of the degradation ladder (serial oracle fallback).
+        self.S_host = S_host
+        self._guard = guard
+        self.damp_factor = damp_factor
+        self.degraded: str | None = None
         if use_programs == "auto":
             self._use_programs = _supports_programs(d_ops)
         else:
@@ -176,39 +212,43 @@ class DistributedALS:
         _, out = d.de_shift(None, out, KernelMode.SPMM_B)
         return out
 
-    def compute_queries(self, A, B, mode: MatMode) -> jax.Array:
+    def compute_queries(
+        self, A, B, mode: MatMode, lam: float | None = None
+    ) -> jax.Array:
         """Apply the Gram operator: ``fusedSpMM + lambda*X``
-        (`als_conjugate_gradients.cpp:265-301`)."""
+        (`als_conjugate_gradients.cpp:265-301`). ``lam`` overrides the
+        ridge for damped restarts; default is the configured lambda."""
+        lam = self.ridge_lambda if lam is None else lam
         d = self.d_ops
         if mode == MatMode.A:
             ones = d.like_s_values(1.0)
             A_s, B_s = d.initial_shift(A, B, KernelMode.SDDMM_A)
             out, _ = d.fused_spmm(A_s, B_s, ones, MatMode.A)
             out, _ = d.de_shift(out, None, KernelMode.SPMM_A)
-            return out + self.ridge_lambda * A
+            return out + lam * A
         ones = d.like_st_values(1.0)
         A_s, B_s = d.initial_shift(A, B, KernelMode.SDDMM_B)
         out, _ = d.fused_spmm(A_s, B_s, ones, MatMode.B)
         _, out = d.de_shift(None, out, KernelMode.SPMM_B)
-        return out + self.ridge_lambda * B
+        return out + lam * B
 
     # ------------------------------------------------------------------ #
     # Batched CG (`als_conjugate_gradients.cpp:38-141`)
     # ------------------------------------------------------------------ #
 
-    def _cg_iter_program(self, mode: MatMode):
+    def _cg_iter_program(self, mode: MatMode, lam: float):
         """ONE jitted program for a full CG iteration: the fused Gram
         operator (via the strategy's raw ``fused_program``) chained with
         every vector update. Same math as the open-coded loop below —
         the difference is dispatch: one compiled call per iteration
-        instead of one per distributed op."""
-        key = (mode, self.d_ops.R)
+        instead of one per distributed op. Keyed by λ too: a damped
+        restart recompiles with the stiffer ridge baked in."""
+        key = (mode, self.d_ops.R, lam)
         if key in self._cg_programs:
             return self._cg_programs[key]
         d = self.d_ops
         ones = d.like_s_values(1.0) if mode == MatMode.A else d.like_st_values(1.0)
         fused = d.fused_program(ones, mode)
-        lam = self.ridge_lambda
         eps = 1e-8
 
         def one_iter(X, other, r, p, rsold):
@@ -223,43 +263,183 @@ class DistributedALS:
         self._cg_programs[key] = prog
         return prog
 
-    def cg_optimizer(self, mode: MatMode, cg_max_iter: int = 10) -> None:
+    def _guard_active(self) -> bool:
+        if self._guard == "auto":
+            return guards.enabled()
+        return bool(self._guard)
+
+    def _cg_run(self, mode: MatMode, cg_max_iter: int, lam: float) -> jax.Array:
+        """One guarded half-step solve from the CURRENT factors; returns
+        the new X without committing it. Raises :class:`CGDivergence` when
+        the residual guard trips (only checked while guarding — the check
+        is one scalar host sync per CG iteration)."""
         eps = 1e-8  # nan_avoidance_constant, cpp:40
+        guarding = self._guard_active()
+        cg_guard = CGGuard() if guarding else None
         X = self.A if mode == MatMode.A else self.B
         rhs = self.compute_rhs(mode)
-        Mx = self.compute_queries(self.A, self.B, mode)
+        # The initial residual and every iteration must see the SAME ridge
+        # — a damped restart that only damped the iterations would solve an
+        # inconsistent system (and the base-λ one would not restart at all).
+        Mx = self.compute_queries(self.A, self.B, mode, lam=lam)
 
         r = rhs - Mx
         p = r
         rsold = _batch_dot(r, r)
 
-        if self._use_programs:
-            prog = self._cg_iter_program(mode)
-            other = self.B if mode == MatMode.A else self.A
-            for _ in range(cg_max_iter):
+        use_programs = self._use_programs
+        prog = self._cg_iter_program(mode, lam) if use_programs else None
+        other = self.B if mode == MatMode.A else self.A
+        for _ in range(cg_max_iter):
+            faults.maybe_raise("als:cg_iter")
+            if use_programs:
                 X, r, p, rsold = self.d_ops._timed(
                     "cgStep", prog, X, other, r, p, rsold
                 )
-        else:
-            for _ in range(cg_max_iter):
+            else:
                 if mode == MatMode.A:
-                    Mp = self.compute_queries(p, self.B, mode)
+                    Mp = self.compute_queries(p, self.B, mode, lam=lam)
                 else:
-                    Mp = self.compute_queries(self.A, p, mode)
+                    Mp = self.compute_queries(self.A, p, mode, lam=lam)
                 X, r, p, rsold = _cg_vector_update(X, r, p, rsold, Mp, eps)
+            if cg_guard is not None and cg_guard.update(float(jnp.sum(rsold))):
+                raise CGDivergence(
+                    f"CG residual diverged in {mode.name} half-step (λ={lam:g})"
+                )
+        return X
 
+    def cg_optimizer(self, mode: MatMode, cg_max_iter: int = 10) -> None:
+        """One half-step through the degradation ladder: solve, and on
+        divergence (or a poisoned op surfacing as :class:`NumericalFault`)
+        retry once from the pre-step factors with a ``damp_factor``-stiffer
+        ridge. A second failure propagates :class:`CGDivergence` — `run_cg`
+        owns the final rung (serial fallback)."""
+        try:
+            X = self._cg_run(mode, cg_max_iter, self.ridge_lambda)
+        except (CGDivergence, NumericalFault) as first:
+            if not self._guard_active():
+                raise
+            damped = self.ridge_lambda * self.damp_factor
+            print(
+                f"[als] {type(first).__name__} in {mode.name} half-step; "
+                f"damped-λ restart (λ={damped:g})", file=sys.stderr,
+            )
+            try:
+                X = self._cg_run(mode, cg_max_iter, damped)
+            except (CGDivergence, NumericalFault) as second:
+                raise CGDivergence(
+                    f"{mode.name} half-step diverged at λ={self.ridge_lambda:g} "
+                    f"and at damped λ={damped:g}: {second}"
+                ) from second
         if mode == MatMode.A:
             self.A = X
         else:
             self.B = X
 
-    def run_cg(self, n_alternating_steps: int, cg_iters: int = 10) -> None:
-        """`als_conjugate_gradients.cpp:235-263`."""
+    # ------------------------------------------------------------------ #
+    # Checkpoint / resume / degradation
+    # ------------------------------------------------------------------ #
+
+    def save_checkpoint(self, store, step: int) -> None:
+        """Atomically persist the factors as alternating-step ``step``.
+        Host copies of the canonical (padded, possibly >2-D) device arrays
+        round-trip bit-exactly through the npz store."""
+        store.save(
+            step,
+            {"A": np.asarray(self.A), "B": np.asarray(self.B)},
+            meta={"kind": "als", "R": self.d_ops.R,
+                  "M": self.d_ops.M, "N": self.d_ops.N},
+        )
+
+    def restore_checkpoint(self, store) -> int:
+        """Load the newest valid checkpoint into the factor matrices;
+        returns the alternating step to resume FROM (0 = fresh start)."""
+        loaded = store.load_latest()
+        if loaded is None:
+            return 0
+        step, arrays, meta = loaded
+        if meta and meta.get("kind") not in (None, "als"):
+            return 0  # foreign store; do not resurrect GAT weights as factors
+        # Shape gate: a checkpoint dir shared across sweep configs (the CLI
+        # passes one --checkpoint-dir to every config) must never restore
+        # another problem's factors as this one's.
+        want_a = tuple(self.d_ops.dense_shape(MatMode.A))
+        want_b = tuple(self.d_ops.dense_shape(MatMode.B))
+        if (
+            "A" not in arrays or "B" not in arrays
+            or tuple(arrays["A"].shape) != want_a
+            or tuple(arrays["B"].shape) != want_b
+        ):
+            print(
+                "[als] ignoring checkpoint with mismatched factor shapes "
+                f"(want {want_a}/{want_b}); fresh start", file=sys.stderr,
+            )
+            return 0
+        self.A = jax.device_put(arrays["A"], self.d_ops.a_sharding())
+        self.B = jax.device_put(arrays["B"], self.d_ops.b_sharding())
+        return step
+
+    def degrade_to_serial(self, n_steps: int, cg_iters: int = 10) -> None:
+        """Final ladder rung: continue the optimization on the single-node
+        oracle solver, seeded from the current factors. Needs ``S_host``."""
+        from distributed_sddmm_tpu.models.serial_als import SerialALS
+
+        if self.S_host is None:
+            raise NumericalFault(
+                "distributed ALS diverged and no S_host was provided for "
+                "the serial fallback; pass S_host=<HostCOO> to DistributedALS"
+            )
+        d = self.d_ops
+        serial = SerialALS(
+            self.S_host, d.R,
+            ridge_lambda=self.ridge_lambda * self.damp_factor,
+            artificial_groundtruth=False,
+            ground_truth_vals=d.gather_s_values(self.ground_truth),
+        )
+        serial.A = d.host_a(self.A).astype(np.float64)
+        serial.B = d.host_b(self.B).astype(np.float64)
+        serial.run_cg(n_steps, cg_iters=cg_iters)
+        self.A = d.put_a(serial.A.astype(np.float32))
+        self.B = d.put_b(serial.B.astype(np.float32))
+        self.degraded = "serial"
+        print(f"[als] degraded to serial oracle solver for {n_steps} "
+              "remaining step(s)", file=sys.stderr)
+
+    def run_cg(
+        self,
+        n_alternating_steps: int,
+        cg_iters: int = 10,
+        *,
+        checkpoint=None,
+        checkpoint_every: int = 1,
+        resume: bool = False,
+    ) -> None:
+        """`als_conjugate_gradients.cpp:235-263`, plus resilience: pass a
+        :class:`~distributed_sddmm_tpu.resilience.CheckpointStore` to
+        persist the factors every ``checkpoint_every`` alternating steps;
+        ``resume=True`` restarts from the newest valid checkpoint instead
+        of step 0 (corrupt checkpoints scan back; none ⇒ fresh start)."""
+        checkpoint_every = max(1, int(checkpoint_every))  # 0 would div-by-zero
+        start = 0
+        if checkpoint is not None and resume:
+            start = self.restore_checkpoint(checkpoint)
         if self.A is None:
             self.initialize_embeddings()
-        for _ in range(n_alternating_steps):
-            self.cg_optimizer(MatMode.A, cg_iters)
-            self.cg_optimizer(MatMode.B, cg_iters)
+        step = start
+        while step < n_alternating_steps:
+            faults.maybe_raise("als:step")
+            try:
+                self.cg_optimizer(MatMode.A, cg_iters)
+                self.cg_optimizer(MatMode.B, cg_iters)
+            except CGDivergence as e:
+                print(f"[als] {e}", file=sys.stderr)
+                self.degrade_to_serial(n_alternating_steps - step, cg_iters)
+                return
+            step += 1
+            if checkpoint is not None and (
+                step % checkpoint_every == 0 or step == n_alternating_steps
+            ):
+                self.save_checkpoint(checkpoint, step)
 
     @classmethod
     def from_plan(
@@ -282,6 +462,7 @@ class DistributedALS:
                 Problem.from_coo(S, R), devices, S=S, mode=plan_mode
             )
         alg = plan.instantiate(S, R=R, devices=devices)
+        kw.setdefault("S_host", S)  # enables the serial-fallback ladder rung
         model = cls(alg, **kw)
         model.plan = plan
         return model

@@ -37,6 +37,7 @@ import jax.numpy as jnp
 
 from distributed_sddmm_tpu.common import KernelMode, MatMode
 from distributed_sddmm_tpu.parallel.base import DistributedSparse
+from distributed_sddmm_tpu.resilience import guards
 
 
 @dataclasses.dataclass
@@ -191,8 +192,13 @@ class GAT:
         if X is None:
             d.set_r_value(self.layers[0].input_features)
             X = d.dummy_initialize(MatMode.A) * (1.0 / (d.M * self.layers[0].input_features))
+        guarding = guards.enabled()
         for i, layer in enumerate(self.layers):
             if self._use_programs:
+                # The whole-layer program dispatches through _timed, whose
+                # resilient path already guards (and repairs) the output —
+                # a second per-layer sentinel here would double the
+                # reduction + host sync on the hot path.
                 prog = self._layer_program(i)
                 d.set_r_value(layer.output_features)
                 X = d._timed("gatLayer", prog, X, *layer.weights)
@@ -202,7 +208,54 @@ class GAT:
                     for j in range(layer.num_heads)
                 ]
                 X = d.concat_heads(heads, MatMode.A)
+                if guarding:
+                    # Per-head path: dense_project/concat_heads dispatch
+                    # outside _timed, so the layer output needs its own
+                    # sentinel — poisoned activations raise (naming the
+                    # layer) or nan_to_num-repair per DSDDMM_GUARD_MODE,
+                    # never silently feed layer i+1.
+                    X = guards.guard_output(f"gat:layer{i}", X)
         return X
+
+    # ------------------------------------------------------------------ #
+    # Parameter checkpoints
+    # ------------------------------------------------------------------ #
+
+    def save_checkpoint(self, store, step: int = 0) -> None:
+        """Persist every head's projection weights atomically."""
+        arrays = {
+            f"w_{i}_{j}": np.asarray(w)
+            for i, layer in enumerate(self.layers)
+            for j, w in enumerate(layer.weights)
+        }
+        store.save(
+            step, arrays,
+            meta={"kind": "gat",
+                  "heads": [layer.num_heads for layer in self.layers]},
+        )
+
+    def load_checkpoint(self, store) -> bool:
+        """Restore weights from the newest valid checkpoint; False when
+        none exists (or the store belongs to another app/shape)."""
+        loaded = store.load_latest()
+        if loaded is None:
+            return False
+        _, arrays, meta = loaded
+        if meta and meta.get("kind") not in (None, "gat"):
+            return False
+        want = {
+            f"w_{i}_{j}"
+            for i, layer in enumerate(self.layers)
+            for j in range(layer.num_heads)
+        }
+        if not want.issubset(arrays):
+            return False
+        for i, layer in enumerate(self.layers):
+            layer.weights = [
+                jnp.asarray(arrays[f"w_{i}_{j}"], dtype=self.d_ops.dtype)
+                for j in range(layer.num_heads)
+            ]
+        return True
 
     @classmethod
     def from_plan(

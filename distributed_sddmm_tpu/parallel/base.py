@@ -392,17 +392,60 @@ class DistributedSparse(abc.ABC):
     # ------------------------------------------------------------------ #
 
     def _timed(self, name: str, fn, *args):
+        from distributed_sddmm_tpu.resilience import faults, guards
         from distributed_sddmm_tpu.utils.platform import force_fetch
 
         t0 = time.perf_counter()
-        out = fn(*args)
-        # Host fetch, not block_until_ready: tunneled backends only run the
-        # queue on a transfer (utils.platform.force_fetch); one scalar per
-        # output leaf is negligible next to any timed op.
-        force_fetch(out)
+        if faults.active() is None and not guards.enabled():
+            out = fn(*args)
+            # Host fetch, not block_until_ready: tunneled backends only run
+            # the queue on a transfer (utils.platform.force_fetch); one
+            # scalar per output leaf is negligible next to any timed op.
+            force_fetch(out)
+        else:
+            out = self._resilient_call(name, fn, *args)
         self.total_time[name] += time.perf_counter() - t0
         self.call_count[name] += 1
         return out
+
+    def _resilient_call(self, name: str, fn, *args):
+        """Every compiled-program dispatch, hardened: synthetic fault hooks
+        fire first (``execute:<op>`` raises, ``output:<op>`` corrupts), the
+        call runs under the shared retry/timeout utility, and — when guards
+        are on — outputs pass a NaN/Inf sentinel before being trusted.
+
+        Transient failures (timeouts, OOMs, tripped sentinels) retry up to
+        ``DSDDMM_EXEC_RETRIES`` times (default 1): an injected one-shot
+        fault heals invisibly, a persistent one surfaces as a clean typed
+        exception after bounded attempts — never a hang, never a silently
+        poisoned array flowing into the next op.
+        """
+        import os
+
+        from distributed_sddmm_tpu.resilience import faults, guards
+        from distributed_sddmm_tpu.resilience.retry import Backoff, retry_call
+        from distributed_sddmm_tpu.utils.platform import force_fetch
+
+        def attempt():
+            faults.maybe_raise(f"execute:{name}")
+            out = fn(*args)
+            out = faults.corrupt_outputs(f"output:{name}", out)
+            force_fetch(out)
+            if guards.enabled():
+                # raise-mode trips the retry below; repair-mode degrades
+                # in place (nan_to_num + stderr warning).
+                out = guards.guard_output(name, out)
+            return out
+
+        return retry_call(
+            attempt,
+            retries=int(os.environ.get("DSDDMM_EXEC_RETRIES", "1")),
+            timeout_s=float(os.environ.get("DSDDMM_EXEC_TIMEOUT", "0")),
+            backoff=Backoff(base_s=0.05, max_delay_s=2.0),
+            retry_on=(TimeoutError, MemoryError, guards.NumericalFault,
+                      faults.FaultError),
+            label=f"execute:{name}",
+        )
 
     def reset_performance_timers(self) -> None:
         self.call_count.clear()

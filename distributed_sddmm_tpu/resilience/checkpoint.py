@@ -1,0 +1,163 @@
+"""Atomic, versioned, corruption-tolerant step checkpoints.
+
+Layout under the store root (default ``artifacts/checkpoints/<name>/``)::
+
+    step_00000002.npz   # the arrays (atomic: temp + os.replace)
+    step_00000003.npz
+    latest.json         # {"schema_version", "step", "file", "digest", "meta"}
+
+``latest.json`` is a pointer, not the source of truth: resume first tries
+the step it names (verifying the recorded SHA-256 digest, so a torn npz
+write cannot resurrect as garbage factors), then falls back to scanning
+``step_*.npz`` newest-first and taking the first file numpy can actually
+load. A checkpoint store therefore degrades one step at a time — a crash
+mid-write costs at most the interrupted step, never the run.
+
+Arrays round-trip bit-exactly (``np.savez`` preserves float bits), which
+is what makes kill-and-resume produce factors identical to an
+uninterrupted run: the resumed process re-executes the remaining steps
+from numerically identical state through the same deterministic programs.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import io
+import json
+import os
+import pathlib
+import re
+import zipfile
+
+import numpy as np
+
+from distributed_sddmm_tpu.utils.atomic import atomic_write_bytes, atomic_write_json
+
+_REPO = pathlib.Path(__file__).resolve().parents[2]
+
+#: Bump on any incompatible change to the stored state layout; older (and
+#: newer — a rolled-back binary must not half-read a future layout) entries
+#: then read as misses.
+SCHEMA_VERSION = 1
+
+DEFAULT_ROOT = _REPO / "artifacts" / "checkpoints"
+
+_STEP_RE = re.compile(r"^step_(\d{8})\.npz$")
+
+
+def default_checkpoint_dir(name: str = "default") -> pathlib.Path:
+    """``DSDDMM_CHECKPOINT_DIR`` env override, else the repo artifact dir."""
+    env = os.environ.get("DSDDMM_CHECKPOINT_DIR")
+    base = pathlib.Path(env) if env else DEFAULT_ROOT
+    return base / name
+
+
+class CheckpointStore:
+    """File-per-step npz store with atomic writes and scan-back recovery."""
+
+    def __init__(self, root: str | os.PathLike, keep_last: int = 3):
+        self.root = pathlib.Path(root)
+        self.keep_last = keep_last
+
+    def _step_path(self, step: int) -> pathlib.Path:
+        return self.root / f"step_{step:08d}.npz"
+
+    # ------------------------------------------------------------------ #
+    # Write path
+    # ------------------------------------------------------------------ #
+
+    def save(self, step: int, arrays: dict, meta: dict | None = None) -> None:
+        """Atomically persist ``arrays`` (name -> ndarray) as ``step``."""
+        buf = io.BytesIO()
+        np.savez(buf, **{k: np.asarray(v) for k, v in arrays.items()})
+        payload = buf.getvalue()
+        path = self._step_path(step)
+        atomic_write_bytes(path, payload)
+        # Digest of what we *intended* to write: a write fault that garbled
+        # the npz on disk then fails digest verification at resume.
+        atomic_write_json(
+            self.root / "latest.json",
+            {
+                "schema_version": SCHEMA_VERSION,
+                "step": int(step),
+                "file": path.name,
+                "digest": hashlib.sha256(payload).hexdigest(),
+                "meta": meta or {},
+            },
+        )
+        self._prune()
+
+    def _prune(self) -> None:
+        steps = self.steps()
+        for s in steps[: max(len(steps) - self.keep_last, 0)]:
+            try:
+                os.unlink(self._step_path(s))
+            except OSError:
+                pass
+
+    # ------------------------------------------------------------------ #
+    # Read path — every failure mode reads as "try the next-older step"
+    # ------------------------------------------------------------------ #
+
+    def steps(self) -> list[int]:
+        """Available step numbers, oldest first."""
+        try:
+            names = os.listdir(self.root)
+        except OSError:
+            return []
+        out = []
+        for n in names:
+            m = _STEP_RE.match(n)
+            if m:
+                out.append(int(m.group(1)))
+        return sorted(out)
+
+    def _read_npz(self, path: pathlib.Path) -> dict | None:
+        try:
+            with np.load(path) as z:
+                return {k: z[k] for k in z.files}
+        except (OSError, ValueError, KeyError, EOFError, zipfile.BadZipFile):
+            return None
+
+    def load(self, step: int) -> dict | None:
+        """The arrays of ``step``, or None if missing/corrupt."""
+        return self._read_npz(self._step_path(step))
+
+    def _latest_pointer(self) -> dict | None:
+        try:
+            rec = json.loads((self.root / "latest.json").read_text())
+        except (OSError, json.JSONDecodeError):
+            return None
+        if not isinstance(rec, dict):
+            return None
+        if rec.get("schema_version") != SCHEMA_VERSION:
+            return None
+        return rec
+
+    def load_latest(self) -> tuple[int, dict, dict] | None:
+        """``(step, arrays, meta)`` of the newest loadable checkpoint.
+
+        Trust ladder: the latest.json pointer with a matching digest, then
+        any ``step_*.npz`` that loads, newest first. None when nothing
+        survives — the caller starts from step 0, the final degradation.
+        """
+        rec = self._latest_pointer()
+        if rec is not None:
+            path = self.root / str(rec.get("file", ""))
+            try:
+                payload = path.read_bytes()
+            except OSError:
+                payload = None
+            if (
+                payload is not None
+                and hashlib.sha256(payload).hexdigest() == rec.get("digest")
+            ):
+                arrays = self._read_npz(path)
+                if arrays is not None:
+                    return int(rec["step"]), arrays, rec.get("meta", {})
+
+        for step in reversed(self.steps()):
+            arrays = self._read_npz(self._step_path(step))
+            if arrays is not None:
+                return step, arrays, {}
+        return None

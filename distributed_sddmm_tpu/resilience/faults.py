@@ -1,0 +1,306 @@
+"""Seeded, deterministic fault-injection plans.
+
+A :class:`FaultPlan` is a list of :class:`FaultSpec` entries, each naming a
+*site* pattern (fnmatch glob over the dotted site strings the framework's
+injection hooks pass in), a fault *kind*, and a firing rule — either an
+explicit list of call indices (``at``) or a per-call probability (``prob``).
+Firing decisions are pure functions of ``(plan seed, spec index, site,
+call count)``, so a plan replays identically across runs and across
+processes: the property that makes a fault-matrix test assert exact
+recovery behavior instead of "something eventually broke".
+
+Supported kinds and the hook that consumes each:
+
+==========  =======================  ========================================
+kind        consuming hook           effect
+==========  =======================  ========================================
+nan / inf   :func:`corrupt_outputs`  overwrite a fraction of elements
+timeout     :func:`maybe_raise`      raise :class:`InjectedTimeout`
+oom         :func:`maybe_raise`      raise :class:`InjectedOOM`
+error       :func:`maybe_raise`      raise :class:`InjectedFault`
+garble      :func:`garble_text`      flip bytes mid-payload before a write
+truncate    :func:`garble_text`      cut the payload (torn / partial write)
+kill        :func:`maybe_kill`       ``os._exit(KILL_EXIT_CODE)``
+==========  =======================  ========================================
+
+Activation: ``install(plan)`` / the :func:`fault_plan` context manager, the
+``--faults`` CLI flag, or the ``DSDDMM_FAULTS`` environment variable (JSON
+spec-list, a ``{"seed": .., "specs": [..]}`` dict, or ``@/path/to/plan.json``)
+— env activation is what reaches subprocess workers. Every hook is a cheap
+no-op when no plan is active, so production paths pay one ``None`` check.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import fnmatch
+import hashlib
+import json
+import os
+import sys
+import threading
+from typing import Optional
+
+#: Exit code used by ``kill`` faults, distinguishable from python crashes.
+KILL_EXIT_CODE = 17
+
+_KINDS = ("nan", "inf", "timeout", "oom", "error", "garble", "truncate", "kill")
+
+
+class FaultError(RuntimeError):
+    """Base class of every injected failure (never raised by real faults —
+    catching it cannot mask a genuine backend error)."""
+
+
+class InjectedFault(FaultError):
+    """A synthetic generic execution failure."""
+
+
+class InjectedTimeout(FaultError, TimeoutError):
+    """A synthetic compile/execute timeout (catches as TimeoutError)."""
+
+
+class InjectedOOM(FaultError, MemoryError):
+    """A synthetic out-of-memory failure (catches as MemoryError)."""
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultSpec:
+    """One fault rule. ``at`` (call indices at the site, 0-based) wins over
+    ``prob``; ``param`` is the kind-specific knob (corrupted-element
+    fraction for nan/inf, cut fraction for garble/truncate)."""
+
+    site: str
+    kind: str
+    at: tuple[int, ...] | None = None
+    prob: float = 0.0
+    param: float = 0.01
+
+    def __post_init__(self):
+        if self.kind not in _KINDS:
+            raise ValueError(f"unknown fault kind {self.kind!r}; one of {_KINDS}")
+        if self.at is not None:
+            object.__setattr__(self, "at", tuple(int(i) for i in self.at))
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "FaultSpec":
+        return cls(
+            site=d["site"], kind=d["kind"],
+            at=tuple(d["at"]) if d.get("at") is not None else None,
+            prob=float(d.get("prob", 0.0)), param=float(d.get("param", 0.01)),
+        )
+
+
+def _unit_hash(*parts) -> float:
+    """Deterministic value in [0, 1) from the given parts (stable across
+    processes and interpreter restarts — no PYTHONHASHSEED dependence)."""
+    h = hashlib.sha256(":".join(str(p) for p in parts).encode()).digest()
+    return int.from_bytes(h[:8], "big") / float(1 << 64)
+
+
+class FaultPlan:
+    """A replayable set of fault rules with per-site call counters."""
+
+    def __init__(self, specs: list[FaultSpec], seed: int = 0):
+        self.specs = list(specs)
+        self.seed = int(seed)
+        self.events: list[tuple[str, str, int]] = []  # (site, kind, call#)
+        self._counters: dict[str, int] = {}
+        self._lock = threading.Lock()
+
+    @classmethod
+    def from_spec(cls, spec) -> "FaultPlan":
+        """Build from a JSON string, ``@path``, list-of-dicts, or
+        ``{"seed": .., "specs": [..]}`` dict."""
+        if isinstance(spec, str):
+            if spec.startswith("@"):
+                import pathlib
+
+                spec = json.loads(pathlib.Path(spec[1:]).read_text())
+            else:
+                spec = json.loads(spec)
+        if isinstance(spec, dict):
+            seed = spec.get("seed", 0)
+            entries = spec.get("specs", [])
+        else:
+            seed, entries = 0, spec
+        return cls([FaultSpec.from_dict(d) for d in entries], seed=seed)
+
+    def fires(self, site: str) -> list[FaultSpec]:
+        """Advance ``site``'s call counter and return the specs that fire
+        on this call (deterministic; thread-safe)."""
+        with self._lock:
+            n = self._counters.get(site, 0)
+            self._counters[site] = n + 1
+        fired = []
+        for i, spec in enumerate(self.specs):
+            if not fnmatch.fnmatch(site, spec.site):
+                continue
+            if spec.at is not None:
+                hit = n in spec.at
+            else:
+                hit = _unit_hash(self.seed, i, site, n) < spec.prob
+            if hit:
+                fired.append(spec)
+                with self._lock:
+                    self.events.append((site, spec.kind, n))
+                print(f"[faults] {spec.kind} fired at {site}#{n}",
+                      file=sys.stderr)
+        return fired
+
+    def call_count(self, site: str) -> int:
+        with self._lock:
+            return self._counters.get(site, 0)
+
+
+# --------------------------------------------------------------------- #
+# Active-plan registry (module-level, env-activatable)
+# --------------------------------------------------------------------- #
+
+_active: Optional[FaultPlan] = None
+_env_checked = False
+_registry_lock = threading.Lock()
+
+
+def install(plan: Optional[FaultPlan]) -> None:
+    """Make ``plan`` the process-wide active plan (None deactivates)."""
+    global _active, _env_checked
+    with _registry_lock:
+        _active = plan
+        _env_checked = True  # an explicit install overrides env activation
+
+
+def clear() -> None:
+    install(None)
+
+
+def active() -> Optional[FaultPlan]:
+    """The active plan, activating from ``DSDDMM_FAULTS`` on first query."""
+    global _active, _env_checked
+    if _env_checked:
+        return _active
+    with _registry_lock:
+        if not _env_checked:
+            env = os.environ.get("DSDDMM_FAULTS")
+            if env:
+                try:
+                    _active = FaultPlan.from_spec(env)
+                except (ValueError, KeyError, OSError) as e:
+                    print(f"[faults] ignoring malformed DSDDMM_FAULTS: {e}",
+                          file=sys.stderr)
+            _env_checked = True
+    return _active
+
+
+class fault_plan:
+    """Context manager: activate ``plan`` inside the block, restore the
+    previous plan (including env-derived) after."""
+
+    def __init__(self, plan: Optional[FaultPlan]):
+        self.plan = plan
+
+    def __enter__(self) -> Optional[FaultPlan]:
+        self._prev = active()
+        install(self.plan)
+        return self.plan
+
+    def __exit__(self, *exc) -> None:
+        install(self._prev)
+
+
+# --------------------------------------------------------------------- #
+# Injection hooks — one per consuming fault family, so each advances its
+# site counter exactly once per framework call.
+# --------------------------------------------------------------------- #
+
+
+def maybe_raise(site: str) -> None:
+    """Raise a synthetic timeout/OOM/error if one fires at ``site``."""
+    plan = active()
+    if plan is None:
+        return
+    for spec in plan.fires(site):
+        if spec.kind == "timeout":
+            raise InjectedTimeout(f"injected timeout at {site}")
+        if spec.kind == "oom":
+            raise InjectedOOM(f"injected OOM at {site}")
+        if spec.kind == "error":
+            raise InjectedFault(f"injected fault at {site}")
+
+
+def maybe_kill(site: str) -> None:
+    """Hard-exit the process if a ``kill`` fault fires at ``site`` —
+    the moral equivalent of a preempted worker."""
+    plan = active()
+    if plan is None:
+        return
+    for spec in plan.fires(site):
+        if spec.kind == "kill":
+            sys.stderr.flush()
+            os._exit(KILL_EXIT_CODE)
+
+
+def _corrupt_leaf(x, kind: str, frac: float, salt: int):
+    """Overwrite ~``frac`` of a floating array's elements with NaN/Inf at
+    deterministic positions, preserving dtype/shape/sharding."""
+    import numpy as np
+
+    val = float("nan") if kind == "nan" else float("inf")
+    size = getattr(x, "size", 0)
+    if size == 0:
+        return x
+    n = max(1, int(size * frac))
+    # Weyl-style deterministic index sequence; dedup keeps it a valid scatter.
+    idx = np.unique((salt + np.arange(n, dtype=np.int64) * 2654435761) % size)
+
+    if isinstance(x, np.ndarray):
+        if not np.issubdtype(x.dtype, np.floating):
+            return x
+        out = x.copy()
+        out.reshape(-1)[idx] = val
+        return out
+
+    import jax
+    import jax.numpy as jnp
+
+    if not isinstance(x, jax.Array) or not jnp.issubdtype(x.dtype, jnp.floating):
+        return x
+    fn = jax.jit(
+        lambda a: a.reshape(-1).at[jnp.asarray(idx)].set(val).reshape(a.shape),
+        out_shardings=x.sharding,
+    )
+    return fn(x)
+
+
+def corrupt_outputs(site: str, tree):
+    """Apply any nan/inf corruption firing at ``site`` to every floating
+    leaf of ``tree`` (jax or numpy); identity when nothing fires."""
+    plan = active()
+    if plan is None:
+        return tree
+    specs = [s for s in plan.fires(site) if s.kind in ("nan", "inf")]
+    if not specs:
+        return tree
+    import jax
+
+    leaves, treedef = jax.tree.flatten(tree)
+    for spec in specs:
+        salt = int(_unit_hash(plan.seed, site, spec.kind) * (1 << 31))
+        leaves = [_corrupt_leaf(l, spec.kind, spec.param, salt) for l in leaves]
+    return jax.tree.unflatten(treedef, leaves)
+
+
+def garble_text(site: str, text: str) -> str:
+    """Apply any garble/truncate fault firing at ``site`` to a payload
+    about to be written — models a torn write / partial flush."""
+    plan = active()
+    if plan is None:
+        return text
+    for spec in plan.fires(site):
+        if spec.kind == "truncate":
+            cut = max(1, int(len(text) * min(max(spec.param, 0.0), 0.95)))
+            text = text[:cut]
+        elif spec.kind == "garble":
+            pos = len(text) // 2
+            text = text[:pos] + "\x00#GARBLED#\x00" + text[pos + 1:]
+    return text

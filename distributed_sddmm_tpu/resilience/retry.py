@@ -1,0 +1,137 @@
+"""Shared retry / timeout / backoff-with-jitter utilities.
+
+Replaces the SIGALRM timeout path PR 1 put in ``autotune/measure.py``:
+``signal.setitimer`` only arms on the main thread, so trials launched from
+worker threads ran unbounded. :func:`call_with_timeout` instead runs the
+callable on a daemon thread and bounds the *join* — usable from any thread,
+on any platform. The abandoned thread keeps running after a timeout (no
+mechanism can interrupt a stuck C++ call; SIGALRM couldn't either — it only
+raised between Python bytecodes), but control returns to the caller, which
+is the property the retry loop needs.
+
+:class:`Backoff` adds the two things the fixed-step exponential backoff
+lacked: **jitter** (fixed steps synchronize retries across workers that
+failed together — the thundering-herd re-collision) and a **max-elapsed
+cap** (exponential growth without a cap turns "retry a few times" into
+minutes of sleeping on a dead backend).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import random
+import threading
+import time
+from typing import Callable, Optional
+
+
+class CallTimeout(TimeoutError):
+    """A callable exceeded its wall-clock budget."""
+
+
+def call_with_timeout(fn: Callable, timeout_s: float, *, label: str = "call"):
+    """Run ``fn()`` under a wall-clock bound; usable from ANY thread.
+
+    ``timeout_s <= 0`` disables the bound (direct call, zero overhead).
+    On expiry raises :class:`CallTimeout`; the worker thread is abandoned
+    (daemonized), exactly the give-up-and-move-on semantics the autotune
+    trial loop wants for a hung backend.
+    """
+    if timeout_s is None or timeout_s <= 0:
+        return fn()
+
+    result: dict = {}
+
+    def runner():
+        try:
+            result["value"] = fn()
+        except BaseException as e:  # noqa: BLE001 — re-raised on the caller
+            result["error"] = e
+
+    t = threading.Thread(target=runner, daemon=True, name=f"timeout:{label}")
+    t.start()
+    t.join(timeout_s)
+    if t.is_alive():
+        raise CallTimeout(f"{label} exceeded {timeout_s:.1f}s")
+    if "error" in result:
+        raise result["error"]
+    return result["value"]
+
+
+@dataclasses.dataclass
+class Backoff:
+    """Exponential backoff with proportional jitter and an elapsed cap.
+
+    ``delay(attempt)`` returns ``min(base * factor**attempt, max_delay) *
+    (1 + U(0, jitter))``. The RNG defaults to a per-process seed (pid ^
+    time) so workers that failed simultaneously desynchronize; pass a
+    seeded ``random.Random`` for reproducible schedules in tests.
+    """
+
+    base_s: float = 2.0
+    factor: float = 2.0
+    jitter: float = 0.25
+    max_delay_s: float = 60.0
+    max_elapsed_s: float = float("inf")
+    rng: Optional[random.Random] = None
+
+    def __post_init__(self):
+        if self.rng is None:
+            self.rng = random.Random(os.getpid() ^ int(time.time() * 1e3))
+
+    def delay(self, attempt: int) -> float:
+        d = min(self.base_s * self.factor ** attempt, self.max_delay_s)
+        if self.jitter > 0:
+            d *= 1.0 + self.rng.uniform(0.0, self.jitter)
+        return d
+
+    def budget_left(self, elapsed_s: float, next_delay_s: float = 0.0) -> bool:
+        """False once sleeping ``next_delay_s`` more would blow the cap —
+        the retry loop then fails fast with the last real error instead of
+        burning wall-clock on a dead backend."""
+        return elapsed_s + next_delay_s <= self.max_elapsed_s
+
+
+def retry_call(
+    fn: Callable,
+    *,
+    retries: int = 1,
+    timeout_s: float = 0.0,
+    backoff: Optional[Backoff] = None,
+    retry_on: tuple = (TimeoutError, MemoryError, OSError),
+    give_up_on: tuple = (),
+    sleep: Callable[[float], None] = time.sleep,
+    clock: Callable[[], float] = time.monotonic,
+    label: str = "call",
+    on_retry: Optional[Callable[[int, BaseException], None]] = None,
+):
+    """Call ``fn`` with up to ``retries`` re-attempts on transient errors.
+
+    ``give_up_on`` wins over ``retry_on`` (deterministic failures —
+    construction errors, bad arguments — must not burn retry budget).
+    Each attempt runs under ``timeout_s`` via :func:`call_with_timeout`;
+    sleeps come from ``backoff`` (default :class:`Backoff`), and the loop
+    stops early when the backoff's elapsed cap would be exceeded. The last
+    error propagates unchanged after exhaustion.
+    """
+    bo = backoff if backoff is not None else Backoff()
+    t_start = clock()
+    last_err: Optional[BaseException] = None
+    for attempt in range(retries + 1):
+        try:
+            return call_with_timeout(fn, timeout_s, label=label)
+        except give_up_on:
+            raise
+        except retry_on as e:
+            last_err = e
+            if attempt >= retries:
+                break
+            d = bo.delay(attempt)
+            if not bo.budget_left(clock() - t_start, d):
+                break
+            if on_retry is not None:
+                on_retry(attempt, e)
+            sleep(d)
+    assert last_err is not None
+    raise last_err

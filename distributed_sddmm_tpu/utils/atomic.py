@@ -1,0 +1,66 @@
+"""Atomic file writes shared by the plan cache and the checkpoint store.
+
+One implementation of the temp-file + ``os.replace`` dance (a reader sees
+the old content or the new content, never a prefix), with the resilience
+layer's write-fault hook threaded through: an active fault plan can garble
+or truncate the payload at site ``write:<filename>``, which lands a corrupt
+*final* file — the observable state a process killed mid-write (or a torn
+page on a full disk) leaves behind. Readers must treat that as a miss;
+the corruption tests drive exactly this path.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import pathlib
+import tempfile
+
+
+def _replace_atomically(path: pathlib.Path, data: bytes) -> None:
+    """The shared core: temp file in the destination dir, ``os.replace``,
+    unlink-on-any-failure (no droppings after a disk-full or a kill)."""
+    path.parent.mkdir(parents=True, exist_ok=True)
+    fd, tmp = tempfile.mkstemp(
+        dir=path.parent, prefix=f".{path.name}.", suffix=".tmp"
+    )
+    try:
+        with os.fdopen(fd, "wb") as f:
+            f.write(data)
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+
+
+def atomic_write_text(path: str | os.PathLike, text: str) -> None:
+    """Write ``text`` to ``path`` atomically (parents created)."""
+    # Imported per call: resilience.checkpoint builds on this module, so a
+    # module-level import would be circular through the package __init__.
+    from distributed_sddmm_tpu.resilience import faults
+
+    path = pathlib.Path(path)
+    text = faults.garble_text(f"write:{path.name}", text)
+    _replace_atomically(path, text.encode())
+
+
+def atomic_write_json(path: str | os.PathLike, obj, **json_kw) -> None:
+    json_kw.setdefault("indent", 1)
+    json_kw.setdefault("sort_keys", True)
+    atomic_write_text(path, json.dumps(obj, **json_kw))
+
+
+def atomic_write_bytes(path: str | os.PathLike, data: bytes) -> None:
+    """Bytes variant (checkpoint .npz payloads). The write-fault hook
+    operates on a latin-1 round-trip so garble/truncate apply bytewise."""
+    from distributed_sddmm_tpu.resilience import faults
+
+    path = pathlib.Path(path)
+    if faults.active() is not None:
+        data = faults.garble_text(
+            f"write:{path.name}", data.decode("latin-1")
+        ).encode("latin-1")
+    _replace_atomically(path, data)

@@ -11,8 +11,69 @@ all-to-all stays on host").
 from __future__ import annotations
 
 import dataclasses
+import sys
 
 import numpy as np
+
+
+def sanitize_coo(
+    rows, cols, vals, M: int, N: int, *, mode: str = "strict"
+) -> tuple["HostCOO", dict]:
+    """Validate raw COO triplets before they can poison a run.
+
+    Detects the three ingest corruptions a real pipeline produces
+    (truncated downloads, 1-based writers, concatenated shards): indices
+    out of ``[0, M) x [0, N)``, duplicate coordinates, and non-finite
+    values. ``mode="strict"`` raises ``ValueError`` naming every issue
+    class with counts; ``mode="repair"`` drops out-of-range and
+    non-finite entries, deduplicates keep-first, warns on stderr, and
+    returns the cleaned matrix. Returns ``(coo, report)`` where the
+    report carries per-issue counts either way (all zero for clean input).
+    """
+    if mode not in ("strict", "repair"):
+        raise ValueError(f"mode must be 'strict' or 'repair', got {mode!r}")
+    rows = np.asarray(rows, dtype=np.int64)
+    cols = np.asarray(cols, dtype=np.int64)
+    vals = np.asarray(vals, dtype=np.float64)
+    if not (rows.shape == cols.shape == vals.shape):
+        raise ValueError("rows/cols/vals must have identical shapes")
+
+    oor = (rows < 0) | (rows >= M) | (cols < 0) | (cols >= N)
+    nonfinite = ~np.isfinite(vals)
+    keep = ~(oor | nonfinite)
+    # Duplicates reported over the RAW coordinates (strict mode must name
+    # them even when one copy also fails another check). Pair-wise unique:
+    # a scalar row*stride+col key is not injective once indices can be
+    # out of range. The repair dedup below runs over the surviving
+    # (in-range, hence scalar-keyable) entries, first occurrence wins.
+    n_unique_raw = (
+        np.unique(np.column_stack([rows, cols]), axis=0).shape[0]
+        if rows.size else 0
+    )
+    dup_count = int(rows.size - n_unique_raw)
+    keys = rows[keep] * max(N, 1) + cols[keep]
+    _, first_idx = np.unique(keys, return_index=True)
+
+    report = {
+        "out_of_range": int(oor.sum()),
+        "non_finite": int(nonfinite.sum()),
+        "duplicates": dup_count,
+        "dropped": 0,
+    }
+    issues = {k: v for k, v in report.items() if k != "dropped" and v}
+    if issues and mode == "strict":
+        raise ValueError(
+            f"corrupt COO ingest ({M}x{N}, nnz={rows.size}): "
+            + ", ".join(f"{v} {k}" for k, v in issues.items())
+            + "; re-ingest with mode='repair' to drop/deduplicate"
+        )
+    if issues:
+        sub = np.flatnonzero(keep)[np.sort(first_idx)]
+        report["dropped"] = int(rows.size - sub.size)
+        print(f"[coo] repaired ingest: dropped {report['dropped']} of "
+              f"{rows.size} entries ({issues})", file=sys.stderr)
+        rows, cols, vals = rows[sub], cols[sub], vals[sub]
+    return HostCOO(rows, cols, vals, M, N), report
 
 
 @dataclasses.dataclass
@@ -47,6 +108,15 @@ class HostCOO:
     @property
     def nnz(self) -> int:
         return int(self.rows.size)
+
+    @classmethod
+    def ingest(
+        cls, rows, cols, vals, M: int, N: int, *, mode: str = "strict"
+    ) -> "HostCOO":
+        """Sanitizing constructor for untrusted triplets (out-of-range /
+        duplicate / non-finite detection; see :func:`sanitize_coo`)."""
+        coo, _ = sanitize_coo(rows, cols, vals, M, N, mode=mode)
+        return coo
 
     # ------------------------------------------------------------------ #
     # Conversions

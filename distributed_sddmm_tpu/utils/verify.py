@@ -79,6 +79,7 @@ def verify_algorithms(
     kernel=None,
     rtol: float = 1e-4,
     verbose: bool = False,
+    S: HostCOO | None = None,
 ) -> bool:
     """Cross-check every named algorithm's fingerprints against the oracle.
 
@@ -87,10 +88,15 @@ def verify_algorithms(
     relative, not absolute, tolerance). Algorithms whose divisibility
     constraints reject the configuration are skipped with a note, mirroring
     the reference where incompatible configs exit early.
+
+    Pass ``S`` to verify against an explicit matrix instead of the default
+    R-mat — the route the edge-case tests use (empty tile blocks,
+    adversarially skewed patterns, sanitized ingests).
     """
     from distributed_sddmm_tpu.bench.harness import ALGORITHM_FACTORIES, make_algorithm
 
-    S = HostCOO.rmat(log_m=log_m, edge_factor=edge_factor, seed=0)
+    if S is None:
+        S = HostCOO.rmat(log_m=log_m, edge_factor=edge_factor, seed=0)
     want = oracle_fingerprints(S, R)
     names = alg_names or sorted(ALGORITHM_FACTORIES)
 

@@ -35,8 +35,17 @@ jax.config.update("jax_platforms", "cpu")
 def main() -> None:
     pid = int(sys.argv[1])
     port = int(sys.argv[2])
+
+    # Fault hooks (env-activated via DSDDMM_FAULTS, e.g. a "kill" spec at
+    # site mp_worker:start) — the resilience fault-matrix test preempts one
+    # worker here and asserts the parent detects it without hanging.
+    from distributed_sddmm_tpu.resilience import faults
+
+    faults.maybe_kill("mp_worker:start")
+
     jax.distributed.initialize(
-        coordinator_address=f"127.0.0.1:{port}", num_processes=2, process_id=pid
+        coordinator_address=f"127.0.0.1:{port}", num_processes=2, process_id=pid,
+        initialization_timeout=int(os.environ.get("DSDDMM_MP_INIT_TIMEOUT", 300)),
     )
     assert jax.device_count() == 4 and jax.local_device_count() == 2
 
@@ -59,6 +68,10 @@ def main() -> None:
     # shards).
     fp_out = float(jnp.sum(out * out))
     fp_mid = float(jnp.sum(mid * mid))
+    # Post-compute preemption point: collectives are done, the result is
+    # about to be reported — a kill here models losing a worker between a
+    # completed step and its checkpoint.
+    faults.maybe_kill("mp_worker:post_compute")
     print(json.dumps({"pid": pid, "fp_out": fp_out, "fp_mid": fp_mid}),
           flush=True)
 

@@ -170,3 +170,81 @@ def test_stored_file_is_valid_json_with_version(tmp_path):
     cache.store("kk", _plan_dict())
     rec = json.loads((tmp_path / "kk.json").read_text())
     assert rec["schema_version"] == cache_mod.SCHEMA_VERSION
+
+
+# --------------------------------------------------------------------- #
+# Mid-write corruption + schema rollback (resilience satellite)
+# --------------------------------------------------------------------- #
+
+
+def test_midwrite_truncation_reads_as_miss_and_recovers(tmp_path):
+    """A fault plan tears the store's write mid-payload (the state a
+    process killed between flush and rename leaves): partial JSON on
+    disk, load = miss, next store recovers."""
+    from distributed_sddmm_tpu.resilience import FaultPlan, FaultSpec, fault_plan
+
+    cache = PlanCache(tmp_path)
+    with fault_plan(FaultPlan(
+        [FaultSpec(site="write:k9.json", kind="truncate", at=(0,), param=0.4)]
+    )):
+        cache.store("k9", _plan_dict())
+    raw = (tmp_path / "k9.json").read_text()
+    import pytest
+    with pytest.raises(json.JSONDecodeError):
+        json.loads(raw)
+    assert cache.load("k9") is None
+    cache.store("k9", _plan_dict())
+    assert cache.load("k9") is not None
+
+
+def test_midwrite_garble_reads_as_miss(tmp_path):
+    from distributed_sddmm_tpu.resilience import FaultPlan, FaultSpec, fault_plan
+
+    cache = PlanCache(tmp_path)
+    with fault_plan(FaultPlan(
+        [FaultSpec(site="write:kg.json", kind="garble", at=(0,))]
+    )):
+        cache.store("kg", _plan_dict())
+    assert cache.load("kg") is None
+
+
+def test_truncated_temp_file_never_lands(tmp_path):
+    """An exception mid-write (disk full, kill between mkstemp and
+    replace) must leave neither a destination file nor .tmp droppings —
+    the atomic writer unlinks its temp on ANY failure."""
+    import pytest
+
+    from distributed_sddmm_tpu.resilience import FaultPlan, FaultSpec, fault_plan
+    from distributed_sddmm_tpu.resilience.faults import InjectedFault
+    from distributed_sddmm_tpu.utils import atomic
+
+    class Boom(Exception):
+        pass
+
+    def exploding_garble(site, text):
+        raise Boom("disk full mid-write")
+
+    from distributed_sddmm_tpu.resilience import faults as faults_mod
+    saved = faults_mod.garble_text
+    faults_mod.garble_text = exploding_garble
+    try:
+        with pytest.raises(Boom):
+            atomic.atomic_write_text(tmp_path / "never.json", "{}")
+    finally:
+        faults_mod.garble_text = saved
+    assert not (tmp_path / "never.json").exists()
+    assert [p.name for p in tmp_path.glob("*.tmp")] == []
+
+
+def test_schema_rollback_future_version_reads_as_miss(tmp_path):
+    """Rollback recovery: a cache written by a NEWER schema generation
+    (deploy rolled back) must read as a miss — not half-parse — and the
+    old binary's store must recover the key."""
+    cache = PlanCache(tmp_path)
+    cache.store("kr", _plan_dict())
+    rec = json.loads((tmp_path / "kr.json").read_text())
+    rec["schema_version"] = cache_mod.SCHEMA_VERSION + 1  # "from the future"
+    (tmp_path / "kr.json").write_text(json.dumps(rec))
+    assert cache.load("kr") is None
+    cache.store("kr", _plan_dict())
+    assert cache.load("kr")["schema_version"] == cache_mod.SCHEMA_VERSION

@@ -180,7 +180,8 @@ def test_kernel_only_seed_does_not_fabricate_algorithm():
 
 
 def test_measure_candidates_retry_backoff_sequence():
-    """Backoff doubles per attempt and stops at success."""
+    """Backoff doubles per attempt and stops at success; jitter=0 keeps
+    the schedule exact."""
     sleeps = []
     calls = {"n": 0}
 
@@ -193,10 +194,48 @@ def test_measure_candidates_retry_backoff_sequence():
     out = measure_candidates(
         None, Problem(M=64, N=64, nnz=256, R=8),
         [Candidate("15d_fusion2", 1)],
-        retries=2, backoff_s=1.5, trial_fn=flaky, sleep=sleeps.append,
+        retries=2, backoff_s=1.5, jitter=0.0, trial_fn=flaky,
+        sleep=sleeps.append,
     )
     assert len(out) == 1
     assert sleeps == [1.5, 3.0]
+
+
+def test_measure_candidates_backoff_jitter_and_elapsed_cap():
+    """Default backoff carries jitter (desynchronizes workers that timed
+    out together: sleeps land in (base, base*(1+j)], never exactly base);
+    the max-elapsed cap stops retrying a dead backend early."""
+    import itertools
+    import random
+
+    sleeps = []
+
+    def always_out(S_, problem, cand, trials, warmup):
+        raise MeasureTimeout("dead backend")
+
+    measure_candidates(
+        None, Problem(M=64, N=64, nnz=256, R=8),
+        [Candidate("15d_fusion2", 1)],
+        retries=3, backoff_s=2.0, jitter=0.5, rng=random.Random(11),
+        trial_fn=always_out, sleep=sleeps.append,
+    )
+    assert len(sleeps) == 3
+    for i, s in enumerate(sleeps):
+        base = 2.0 * 2 ** i
+        assert base < s <= base * 1.5, (i, s)
+
+    # Elapsed cap: a fake clock advancing 100s per attempt blows a 150s
+    # budget after the first retry — the rest of the schedule is skipped.
+    sleeps2 = []
+    clock = itertools.count(0, 100)
+    measure_candidates(
+        None, Problem(M=64, N=64, nnz=256, R=8),
+        [Candidate("15d_fusion2", 1)],
+        retries=5, backoff_s=1.0, jitter=0.0, max_elapsed_s=150.0,
+        trial_fn=always_out, sleep=sleeps2.append,
+        monotonic=lambda: float(next(clock)),
+    )
+    assert len(sleeps2) < 5
 
 
 def test_cli_auto_runs_end_to_end(tmp_path, monkeypatch, capsys):

@@ -68,3 +68,101 @@ def test_random_permuted_preserves_values():
 def test_bounds_check():
     with pytest.raises(ValueError):
         HostCOO(rows=[5], cols=[0], vals=[1.0], M=4, N=4)
+
+
+# --------------------------------------------------------------------- #
+# Ingest sanitization (resilience satellite: strict/repair modes)
+# --------------------------------------------------------------------- #
+
+
+def test_sanitize_strict_names_every_issue_class():
+    from distributed_sddmm_tpu.utils.coo import sanitize_coo
+
+    with pytest.raises(ValueError) as ei:
+        sanitize_coo(
+            rows=[0, 1, 9, 1], cols=[0, 1, 0, 1],
+            vals=[1.0, np.nan, 2.0, 3.0], M=4, N=4, mode="strict",
+        )
+    msg = str(ei.value)
+    assert "out_of_range" in msg and "non_finite" in msg and "duplicates" in msg
+
+
+def test_sanitize_repair_drops_and_dedups_keep_first():
+    from distributed_sddmm_tpu.utils.coo import sanitize_coo
+
+    coo, report = sanitize_coo(
+        rows=[0, 1, 9, 1, 2], cols=[0, 1, 0, 1, -3],
+        vals=[1.0, np.nan, 2.0, 3.0, 4.0], M=4, N=4, mode="repair",
+    )
+    assert report == {
+        "out_of_range": 2, "non_finite": 1, "duplicates": 1, "dropped": 3,
+    }
+    # (1,1) survived once with the FIRST surviving value (the NaN original
+    # was dropped as non-finite, so 3.0 is the first valid occurrence).
+    assert coo.nnz == 2
+    assert coo.rows.tolist() == [0, 1]
+    assert coo.vals.tolist() == [1.0, 3.0]
+
+
+def test_sanitize_repair_dedup_counts_duplicates():
+    from distributed_sddmm_tpu.utils.coo import sanitize_coo
+
+    coo, report = sanitize_coo(
+        rows=[2, 2, 2], cols=[3, 3, 3], vals=[7.0, 8.0, 9.0],
+        M=4, N=4, mode="repair",
+    )
+    assert report["duplicates"] == 2 and coo.nnz == 1
+    assert coo.vals.tolist() == [7.0]  # keep-first
+
+
+def test_sanitize_clean_input_is_identity():
+    from distributed_sddmm_tpu.utils.coo import sanitize_coo
+
+    coo, report = sanitize_coo(
+        rows=[0, 1], cols=[1, 0], vals=[1.0, 2.0], M=2, N=2, mode="strict",
+    )
+    assert coo.nnz == 2
+    assert all(v == 0 for v in report.values())
+
+
+def test_sanitize_zero_nnz_is_valid():
+    from distributed_sddmm_tpu.utils.coo import HostCOO, sanitize_coo
+
+    coo, report = sanitize_coo([], [], [], M=8, N=8, mode="strict")
+    assert coo.nnz == 0 and all(v == 0 for v in report.values())
+    assert HostCOO.ingest([], [], [], 8, 8).nnz == 0
+
+
+def test_ingest_classmethod_strict_default():
+    with pytest.raises(ValueError):
+        HostCOO.ingest([9], [0], [1.0], 4, 4)
+    clean = HostCOO.ingest([0], [0], [1.0], 4, 4)
+    assert clean.nnz == 1
+
+
+def test_verify_empty_tile_blocks_match_oracle():
+    """A pattern confined to one quadrant leaves most device tiles with
+    zero nonzeros; every strategy must still fingerprint-match the oracle
+    through the verify protocol (padding/empty-tile handling is where
+    max_nnz-padded layouts historically go wrong)."""
+    from distributed_sddmm_tpu.utils.verify import verify_algorithms
+
+    rng = np.random.default_rng(0)
+    n = 200
+    S = HostCOO.ingest(
+        rng.integers(0, 16, n), rng.integers(0, 16, n), np.ones(n),
+        64, 64, mode="repair",
+    )
+    assert verify_algorithms(
+        R=16, c=2, alg_names=["15d_fusion2", "15d_sparse"], S=S,
+    )
+
+
+def test_verify_zero_nnz_matrix_matches_oracle():
+    """The degenerate zero-nnz ingest must flow end-to-end (build, SDDMM,
+    SpMM, fused) and agree with the all-zero oracle fingerprints rather
+    than crash on empty tile arrays."""
+    from distributed_sddmm_tpu.utils.verify import verify_algorithms
+
+    S0 = HostCOO.ingest([], [], [], 64, 64)
+    assert verify_algorithms(R=16, c=2, alg_names=["15d_fusion2"], S=S0)

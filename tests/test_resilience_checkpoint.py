@@ -1,0 +1,213 @@
+"""Checkpoint store recovery + the kill-and-resume acceptance test."""
+
+import json
+import pathlib
+
+import numpy as np
+import pytest
+
+from distributed_sddmm_tpu.models.als import DistributedALS
+from distributed_sddmm_tpu.models.gat import GAT, GATLayer
+from distributed_sddmm_tpu.parallel.dense_shift_15d import DenseShift15D
+from distributed_sddmm_tpu.resilience import (
+    CheckpointStore, FaultPlan, FaultSpec, InjectedFault, fault_plan,
+)
+from distributed_sddmm_tpu.resilience import checkpoint as ckpt_mod
+from distributed_sddmm_tpu.utils.coo import HostCOO
+
+
+def _arrays(scale=1.0):
+    rng = np.random.default_rng(0)
+    return {"A": (rng.random((6, 4)) * scale).astype(np.float32),
+            "B": (rng.random((5, 4)) * scale).astype(np.float32)}
+
+
+# --------------------------------------------------------------------- #
+# Store unit behavior
+# --------------------------------------------------------------------- #
+
+
+def test_save_load_roundtrip_bit_exact(tmp_path):
+    store = CheckpointStore(tmp_path)
+    arrs = _arrays()
+    store.save(3, arrs, meta={"kind": "als"})
+    step, got, meta = store.load_latest()
+    assert step == 3 and meta == {"kind": "als"}
+    for k in arrs:
+        assert np.array_equal(got[k], arrs[k])  # bit-exact, not allclose
+
+
+def test_corrupt_latest_npz_scans_back_one_step(tmp_path):
+    store = CheckpointStore(tmp_path)
+    store.save(1, _arrays(1.0))
+    store.save(2, _arrays(2.0))
+    p = store._step_path(2)
+    p.write_bytes(p.read_bytes()[:40])  # torn write
+    step, got, _ = store.load_latest()
+    assert step == 1
+    assert np.array_equal(got["A"], _arrays(1.0)["A"])
+
+
+def test_corrupt_latest_pointer_falls_back_to_scan(tmp_path):
+    store = CheckpointStore(tmp_path)
+    store.save(5, _arrays())
+    (tmp_path / "latest.json").write_text("{torn")
+    step, _, _ = store.load_latest()
+    assert step == 5
+
+
+def test_digest_mismatch_rejects_garbled_npz(tmp_path):
+    """A write fault that garbles the npz between digest and disk must be
+    caught by the digest check, then recovered by scan-back."""
+    store = CheckpointStore(tmp_path)
+    store.save(1, _arrays(1.0))
+    with fault_plan(FaultPlan(
+        [FaultSpec(site="write:step_00000002.npz", kind="garble", at=(0,))]
+    )):
+        store.save(2, _arrays(2.0))
+    step, got, _ = store.load_latest()
+    assert step == 1  # garbled step 2 never serves
+    assert np.array_equal(got["A"], _arrays(1.0)["A"])
+
+
+def test_schema_version_rollback_reads_as_miss(tmp_path, monkeypatch):
+    """A future-schema latest.json (rolled-back binary scenario) must not
+    half-parse: the pointer is ignored, the scan still serves the data
+    files it can actually read."""
+    store = CheckpointStore(tmp_path)
+    store.save(1, _arrays())
+    rec = json.loads((tmp_path / "latest.json").read_text())
+    rec["schema_version"] = ckpt_mod.SCHEMA_VERSION + 1
+    (tmp_path / "latest.json").write_text(json.dumps(rec))
+    step, _, meta = store.load_latest()
+    assert step == 1 and meta == {}  # served via scan, not the foreign pointer
+
+
+def test_empty_store_returns_none(tmp_path):
+    assert CheckpointStore(tmp_path / "nonexistent").load_latest() is None
+
+
+def test_prune_keeps_last_k(tmp_path):
+    store = CheckpointStore(tmp_path, keep_last=2)
+    for s in range(1, 6):
+        store.save(s, _arrays())
+    assert store.steps() == [4, 5]
+    assert store.load_latest()[0] == 5
+
+
+# --------------------------------------------------------------------- #
+# ALS kill-and-resume (acceptance criterion: bit-identical factors)
+# --------------------------------------------------------------------- #
+
+
+def _make_als():
+    S = HostCOO.erdos_renyi(48, 32, 5, seed=0)
+    return DistributedALS(
+        DenseShift15D(S, R=8, c=2, fusion_approach=2), seed=0, S_host=S
+    )
+
+
+def test_als_kill_and_resume_bit_identical(tmp_path):
+    """A fault plan crashes ALS mid-run; resuming from the last checkpoint
+    must converge to factors BIT-identical to an uninterrupted run —
+    checkpointed state is exact and the remaining steps are deterministic
+    functions of it."""
+    als = _make_als()
+    als.run_cg(4, cg_iters=5)
+    want_A, want_B = np.asarray(als.A), np.asarray(als.B)
+
+    store = CheckpointStore(tmp_path)
+    crashed = _make_als()
+    with fault_plan(FaultPlan(
+        [FaultSpec(site="als:step", kind="error", at=(2,))]
+    )):
+        with pytest.raises(InjectedFault):
+            crashed.run_cg(4, cg_iters=5, checkpoint=store, checkpoint_every=1)
+    assert store.load_latest()[0] == 2  # steps 1 and 2 landed before the crash
+
+    resumed = _make_als()
+    resumed.run_cg(4, cg_iters=5, checkpoint=store, checkpoint_every=1,
+                   resume=True)
+    assert np.array_equal(np.asarray(resumed.A), want_A)
+    assert np.array_equal(np.asarray(resumed.B), want_B)
+    assert resumed.compute_residual() < 1e-2
+
+
+def test_als_resume_with_empty_store_is_fresh_start(tmp_path):
+    als = _make_als()
+    als.run_cg(1, cg_iters=3, checkpoint=CheckpointStore(tmp_path),
+               resume=True)
+    assert als.A is not None
+
+
+def test_als_mid_cg_crash_resumes_from_last_step(tmp_path):
+    """Crash INSIDE the CG inner loop (not between steps): the interrupted
+    step never checkpoints, resume re-runs it from the last completed one."""
+    store = CheckpointStore(tmp_path)
+    crashed = _make_als()
+    # Step 0: 2 half-steps x 3 iters = 6 cg_iter calls; crash in step 1's A
+    # half-step, iteration 1 (global call #7).
+    with fault_plan(FaultPlan(
+        [FaultSpec(site="als:cg_iter", kind="error", at=(7,))]
+    )):
+        with pytest.raises(InjectedFault):
+            crashed.run_cg(3, cg_iters=3, checkpoint=store, checkpoint_every=1)
+    assert store.load_latest()[0] == 1
+
+    resumed = _make_als()
+    resumed.run_cg(3, cg_iters=3, checkpoint=store, checkpoint_every=1,
+                   resume=True)
+    want = _make_als()
+    want.run_cg(3, cg_iters=3)
+    assert np.array_equal(np.asarray(resumed.A), np.asarray(want.A))
+    assert np.array_equal(np.asarray(resumed.B), np.asarray(want.B))
+
+
+def test_als_ignores_foreign_store_kind(tmp_path):
+    store = CheckpointStore(tmp_path)
+    store.save(9, {"w_0_0": np.zeros((4, 4), np.float32)}, meta={"kind": "gat"})
+    als = _make_als()
+    assert als.restore_checkpoint(store) == 0  # GAT weights never become factors
+
+
+# --------------------------------------------------------------------- #
+# GAT parameter checkpoints
+# --------------------------------------------------------------------- #
+
+
+def test_gat_weights_roundtrip(tmp_path):
+    S = HostCOO.erdos_renyi(32, 32, 4, seed=1)
+    alg = DenseShift15D(S, R=8, c=1, fusion_approach=2)
+    layers = [GATLayer(input_features=8, features_per_head=8, num_heads=2)]
+    gat = GAT(layers, alg, seed=3)
+    store = CheckpointStore(tmp_path)
+    gat.save_checkpoint(store)
+
+    gat2 = GAT([GATLayer(input_features=8, features_per_head=8, num_heads=2)],
+               alg, seed=99)  # different init
+    assert not np.array_equal(
+        np.asarray(gat.layers[0].weights[0]),
+        np.asarray(gat2.layers[0].weights[0]),
+    )
+    assert gat2.load_checkpoint(store)
+    for j in range(2):
+        assert np.array_equal(
+            np.asarray(gat.layers[0].weights[j]),
+            np.asarray(gat2.layers[0].weights[j]),
+        )
+    # Restored params drive an identical forward pass.
+    out1 = np.asarray(gat.forward())
+    out2 = np.asarray(gat2.forward())
+    assert np.array_equal(out1, out2)
+
+
+def test_gat_rejects_foreign_or_missing_checkpoint(tmp_path):
+    S = HostCOO.erdos_renyi(32, 32, 4, seed=1)
+    alg = DenseShift15D(S, R=8, c=1, fusion_approach=2)
+    gat = GAT([GATLayer(input_features=8, features_per_head=8, num_heads=2)],
+              alg, seed=3)
+    empty = CheckpointStore(tmp_path / "empty")
+    assert not gat.load_checkpoint(empty)
+    als_store = CheckpointStore(tmp_path / "als")
+    als_store.save(1, {"A": np.zeros((4, 4), np.float32)}, meta={"kind": "als"})
+    assert not gat.load_checkpoint(als_store)

@@ -1,0 +1,157 @@
+"""Retry/timeout/backoff utility + guard primitives."""
+
+import random
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from distributed_sddmm_tpu.resilience import (
+    Backoff, CallTimeout, call_with_timeout, retry_call,
+)
+from distributed_sddmm_tpu.resilience.guards import CGGuard, NumericalFault, guard_output
+
+
+def test_call_with_timeout_returns_value_and_propagates_errors():
+    assert call_with_timeout(lambda: 42, 5.0) == 42
+    with pytest.raises(KeyError):
+        call_with_timeout(lambda: {}["x"], 5.0)
+
+
+def test_call_with_timeout_expires():
+    t0 = time.monotonic()
+    with pytest.raises(CallTimeout):
+        call_with_timeout(lambda: time.sleep(10), 0.2, label="hang")
+    assert time.monotonic() - t0 < 5.0
+
+
+def test_call_with_timeout_works_off_main_thread():
+    """The property the SIGALRM path lacked: a bounded call from a worker
+    thread (signal.setitimer only arms on the main thread)."""
+    result = {}
+
+    def worker():
+        try:
+            call_with_timeout(lambda: time.sleep(10), 0.2)
+        except CallTimeout:
+            result["timed_out"] = True
+
+    t = threading.Thread(target=worker)
+    t.start()
+    t.join(timeout=30)
+    assert result.get("timed_out") is True
+
+
+def test_backoff_jitter_bounds_and_determinism():
+    bo = Backoff(base_s=1.0, factor=2.0, jitter=0.5, rng=random.Random(7))
+    delays = [bo.delay(i) for i in range(4)]
+    for i, d in enumerate(delays):
+        assert 1.0 * 2 ** i < d <= 1.5 * 2 ** i
+    bo2 = Backoff(base_s=1.0, factor=2.0, jitter=0.5, rng=random.Random(7))
+    assert delays == [bo2.delay(i) for i in range(4)]
+
+
+def test_backoff_default_rng_desynchronizes():
+    """Two default-constructed backoffs (the fleet case) must not produce
+    identical schedules — that re-collision is the bug jitter fixes."""
+    a = Backoff(base_s=1.0, jitter=0.5)
+    b = Backoff(base_s=1.0, jitter=0.5, rng=random.Random(a.rng.random()))
+    assert [a.delay(i) for i in range(4)] != [b.delay(i) for i in range(4)]
+
+
+def test_retry_call_recovers_then_exhausts():
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise TimeoutError("flaky")
+        return "ok"
+
+    assert retry_call(
+        flaky, retries=3, backoff=Backoff(base_s=0.0, jitter=0.0),
+        sleep=lambda s: None,
+    ) == "ok"
+    assert calls["n"] == 3
+
+    def dead():
+        raise TimeoutError("dead")
+
+    with pytest.raises(TimeoutError):
+        retry_call(dead, retries=2, backoff=Backoff(base_s=0.0, jitter=0.0),
+                   sleep=lambda s: None)
+
+
+def test_retry_call_give_up_on_wins():
+    calls = {"n": 0}
+
+    def bad():
+        calls["n"] += 1
+        raise ValueError("deterministic")
+
+    with pytest.raises(ValueError):
+        retry_call(bad, retries=5, retry_on=(Exception,),
+                   give_up_on=(ValueError,), sleep=lambda s: None)
+    assert calls["n"] == 1  # no retry budget burned on a deterministic error
+
+
+def test_retry_call_elapsed_cap_stops_early():
+    sleeps = []
+    clock = iter(range(0, 10000, 100))
+
+    def dead():
+        raise TimeoutError("dead")
+
+    with pytest.raises(TimeoutError):
+        retry_call(
+            dead, retries=10,
+            backoff=Backoff(base_s=1.0, jitter=0.0, max_elapsed_s=150.0),
+            sleep=sleeps.append, clock=lambda: float(next(clock)),
+        )
+    assert len(sleeps) < 10
+
+
+# --------------------------------------------------------------------- #
+# Guards
+# --------------------------------------------------------------------- #
+
+
+def test_guard_output_raise_and_repair():
+    import jax.numpy as jnp
+
+    clean = jnp.ones((4, 4))
+    assert guard_output("op", clean, mode="raise") is clean
+    poisoned = clean.at[0, 0].set(jnp.nan)
+    with pytest.raises(NumericalFault, match="op"):
+        guard_output("op", poisoned, mode="raise")
+    repaired = guard_output("op", poisoned, mode="repair")
+    assert bool(jnp.isfinite(repaired).all())
+
+
+def test_guard_output_handles_pytrees_and_numpy():
+    x = np.ones(4)
+    y = np.array([1.0, np.inf])
+    with pytest.raises(NumericalFault):
+        guard_output("pair", (x, y), mode="raise")
+    rx, ry = guard_output("pair", (x, y), mode="repair")
+    assert np.isfinite(ry).all() and np.array_equal(rx, x)
+
+
+def test_cg_guard_trips_on_growth_not_noise():
+    g = CGGuard(growth_tol=10.0, patience=2)
+    # Healthy convergence with float noise: never trips.
+    for rs in [100.0, 50.0, 51.0, 20.0, 19.0, 1.0]:
+        assert not g.update(rs)
+    # Sustained explosion: trips after `patience` strikes.
+    g2 = CGGuard(growth_tol=10.0, patience=2)
+    assert not g2.update(10.0)
+    assert not g2.update(500.0)   # strike 1
+    assert g2.update(5000.0)      # strike 2 -> diverged
+
+
+def test_cg_guard_trips_instantly_on_nonfinite():
+    g = CGGuard()
+    assert g.update(float("nan"))
+    g2 = CGGuard()
+    assert g2.update(float("inf"))
