@@ -118,8 +118,14 @@ def measure_candidates(
     the whole candidate list. Construction errors (divisibility, kernel
     availability) drop the candidate immediately — retrying a deterministic
     failure wastes budget.
+
+    Every trial attempt runs under an ``autotune:trial`` trace span
+    (algorithm/c/kernel/attempt, plus the measured throughput or the
+    failure), so a traced run makes plan selection explainable: the
+    report shows which candidates were tried, how long each took, and
+    why losers lost.
     """
-    import sys
+    from distributed_sddmm_tpu.obs import log, metrics, trace
 
     run = trial_fn or default_trial
     out = []
@@ -131,31 +137,45 @@ def measure_candidates(
         t_start = monotonic()
         last_err = None
         for attempt in range(retries + 1):
-            try:
-                rec = call_with_timeout(
-                    lambda: run(S, problem, cand, trials, warmup),
-                    timeout_s, label=f"trial:{cand.algorithm}",
-                )
-                out.append((cand, rec))
-                last_err = None
-                break
-            except ValueError as e:
-                last_err = e
-                break  # unconstructible here; enumeration bug or stale seed
-            except Exception as e:  # noqa: BLE001 — any failure = drop + note
-                last_err = e
-                if attempt < retries:
-                    d = backoff.delay(attempt)
-                    if not backoff.budget_left(monotonic() - t_start, d):
-                        break  # elapsed cap: fail this candidate fast
-                    sleep(d)
+            with trace.span(
+                "autotune:trial", algorithm=cand.algorithm, c=cand.c,
+                kernel=cand.kernel, attempt=attempt,
+            ) as sp:
+                try:
+                    rec = call_with_timeout(
+                        lambda: run(S, problem, cand, trials, warmup),
+                        timeout_s, label=f"trial:{cand.algorithm}",
+                    )
+                    sp.set(gflops=rec.get("overall_throughput"))
+                    out.append((cand, rec))
+                    last_err = None
+                    break
+                except ValueError as e:
+                    sp.set(failed=f"{type(e).__name__}")
+                    last_err = e
+                    break  # unconstructible; enumeration bug or stale seed
+                except Exception as e:  # noqa: BLE001 — failure = drop+note
+                    sp.set(failed=f"{type(e).__name__}")
+                    last_err = e
+            if last_err is not None and attempt < retries:
+                d = backoff.delay(attempt)
+                if not backoff.budget_left(monotonic() - t_start, d):
+                    break  # elapsed cap: fail this candidate fast
+                metrics.GLOBAL.add("autotune_trial_retries")
+                sleep(d)
         if last_err is not None:
             # The degradation (candidate dropped, possibly down to pure
             # cost-model ranking) must be observable, not silent.
-            print(
-                f"[autotune] dropped {cand.algorithm} c={cand.c} "
-                f"kernel={cand.kernel}: {type(last_err).__name__}: {last_err}",
-                file=sys.stderr,
+            metrics.GLOBAL.add("autotune_candidates_dropped")
+            trace.event(
+                "autotune_candidate_dropped", algorithm=cand.algorithm,
+                c=cand.c, kernel=cand.kernel,
+                error=type(last_err).__name__,
+            )
+            log.warn(
+                "autotune",
+                f"dropped {cand.algorithm} c={cand.c} kernel={cand.kernel}",
+                error=f"{type(last_err).__name__}: {last_err}",
             )
     out.sort(
         key=lambda cr: cr[1].get("overall_throughput", 0.0), reverse=True

@@ -153,6 +153,9 @@ def get_plan(
     if mode == "measure" and S is None:
         raise ValueError("mode='measure' needs the sparse matrix S")
 
+    from distributed_sddmm_tpu.obs import metrics as obs_metrics
+    from distributed_sddmm_tpu.obs import trace as obs_trace
+
     p, backend, kernels = machine_signature(devices)
     fp = make_fingerprint(problem, p, backend, kernels)
     cache = cache if cache is not None else PlanCache()
@@ -164,7 +167,14 @@ def get_plan(
         # forever after any model-mode call warmed the key. Measured
         # plans always serve (zero-trial hits are the point).
         if not (mode == "measure" and hit.get("source") != "measured"):
+            obs_metrics.GLOBAL.add("plan_cache_hits")
+            obs_trace.event(
+                "plan_cache_hit", key=fp.key,
+                algorithm=hit.get("algorithm"), c=hit.get("c"),
+                source=hit.get("source"),
+            )
             return Plan.from_dict(hit)
+    obs_metrics.GLOBAL.add("plan_cache_misses")
 
     cands = cand_mod.enumerate_candidates(problem, p, kernels)
     if not cands:
@@ -218,6 +228,11 @@ def get_plan(
             fingerprint_key=fp.key,
         )
 
+    obs_trace.event(
+        "plan_selected", key=fp.key, algorithm=plan.algorithm, c=plan.c,
+        kernel=plan.kernel, source=plan.source,
+        measured=len(measured),
+    )
     cache.store(fp.key, plan.to_dict())
     return plan
 

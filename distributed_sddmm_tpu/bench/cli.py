@@ -192,6 +192,19 @@ def _add_common(p: argparse.ArgumentParser) -> None:
         help="resume from the newest valid checkpoint in --checkpoint-dir "
         "instead of step 0 (corrupt checkpoints scan back; none = fresh)",
     )
+    p.add_argument(
+        "--trace", nargs="?", const="1", default=None, metavar="PATH",
+        help="emit a structured JSONL trace (spans, comm counters, "
+        "resilience events) + run manifest; PATH is a .jsonl file or a "
+        "directory, default artifacts/traces/<run_id>.jsonl "
+        "(equivalent to DSDDMM_TRACE)",
+    )
+    p.add_argument(
+        "--profile", default=None, metavar="LOGDIR",
+        help="capture a jax.profiler trace into LOGDIR "
+        "(TensorBoard-readable) with named annotations per compiled "
+        "program (equivalent to DSDDMM_PROFILE)",
+    )
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -263,11 +276,30 @@ def build_parser() -> argparse.ArgumentParser:
     vf.add_argument("--c", type=int, default=1)
     vf.add_argument("--alg", default="all")
     vf.add_argument("--kernel", default="xla")
+
+    rt = sub.add_parser(
+        "report-trace",
+        help="aggregate a JSONL trace into a per-phase table + comm-volume"
+        " vs cost-model comparison (tools/tracereport.py)",
+    )
+    rt.add_argument("trace", help="path to a <run_id>.jsonl trace")
+    rt.add_argument("--json", action="store_true")
+    rt.add_argument("--no-strict", action="store_true")
     return ap
 
 
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
+
+    if args.cmd == "report-trace":
+        from distributed_sddmm_tpu.tools import tracereport
+
+        sub_argv = [args.trace]
+        if args.json:
+            sub_argv.append("--json")
+        if args.no_strict:
+            sub_argv.append("--no-strict")
+        return tracereport.main(sub_argv)
 
     if getattr(args, "faults", None):
         from distributed_sddmm_tpu.resilience import FaultPlan, faults
@@ -275,6 +307,21 @@ def main(argv=None) -> int:
         faults.install(FaultPlan.from_spec(args.faults))
         print("[faults] plan installed from --faults", file=sys.stderr)
 
+    if getattr(args, "trace", None):
+        from distributed_sddmm_tpu.obs import trace as obs_trace
+
+        tr = obs_trace.enable(None if args.trace == "1" else args.trace)
+        print(f"[trace] writing {tr.path}", file=sys.stderr)
+
+    if getattr(args, "profile", None):
+        from distributed_sddmm_tpu.obs import profiler as obs_profiler
+
+        with obs_profiler.capture(args.profile):
+            return _dispatch(args)
+    return _dispatch(args)
+
+
+def _dispatch(args) -> int:
     if args.cmd == "er":
         S = HostCOO.rmat(log_m=args.log_m, edge_factor=args.edge_factor, seed=0)
         _run_configs(S, _resolve_algs(args.alg), args)

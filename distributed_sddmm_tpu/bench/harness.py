@@ -185,8 +185,13 @@ def benchmark_algorithm(
 
     Record schema follows `benchmark_dist.cpp:151-163`: ``alg_info`` (the
     reference's ``json_algorithm_info``), ``fused``, ``app``,
-    ``overall_throughput`` in GFLOP/s, and per-op ``perf_stats``.
+    ``overall_throughput`` in GFLOP/s, and per-op ``perf_stats`` (kernel
+    seconds). Observability additions: ``metrics`` (the full per-op
+    attribution — kernel vs retry/fault overhead, retries, comm words,
+    FLOPs), and — when tracing is active — ``run_id`` and ``trace_path``
+    tying the record to its trace + manifest.
     """
+    from distributed_sddmm_tpu.obs import trace as obs_trace
     from distributed_sddmm_tpu.resilience import faults
 
     if app not in ("vanilla", "gat", "als"):
@@ -211,16 +216,21 @@ def benchmark_algorithm(
         # runs — e.g. tpu_apps injecting offline-AOT-compiled executables.
         post_build(alg)
 
-    if app == "vanilla":
-        elapsed, app_stats = _run_vanilla(alg, fused, trials, warmup)
-    elif app == "gat":
-        elapsed, app_stats = _run_gat(alg, trials, warmup, num_layers=3)
-    else:
-        elapsed, app_stats = _run_als(
-            alg, trials, warmup, S=S,
-            checkpoint_dir=checkpoint_dir, checkpoint_every=checkpoint_every,
-            resume=resume,
-        )
+    with obs_trace.span(
+        "bench", algorithm=algorithm_name, app=app, R=R, c=c,
+        fused=bool(fused), trials=trials,
+    ):
+        if app == "vanilla":
+            elapsed, app_stats = _run_vanilla(alg, fused, trials, warmup)
+        elif app == "gat":
+            elapsed, app_stats = _run_gat(alg, trials, warmup, num_layers=3)
+        else:
+            elapsed, app_stats = _run_als(
+                alg, trials, warmup, S=S,
+                checkpoint_dir=checkpoint_dir,
+                checkpoint_every=checkpoint_every,
+                resume=resume,
+            )
 
     # SDDMM+SpMM pair = 2 ops x 2*nnz*R flops each (`benchmark_dist.cpp:147-149`).
     nnz = S.nnz
@@ -254,9 +264,20 @@ def benchmark_algorithm(
         "kernel": getattr(alg.kernel, "name", type(alg.kernel).__name__),
         "alg_info": alg.json_algorithm_info(),
         "perf_stats": perf_stats,
+        "metrics": alg.metrics.to_dict(),
         **app_stats,
         **(extra_info or {}),
     }
+    if obs_trace.enabled():
+        record["run_id"] = obs_trace.run_id()
+        record["trace_path"] = obs_trace.trace_path()
+        # Refresh the manifest now that the backend is certainly up —
+        # the copy written at enable() time may predate backend init and
+        # so lack device facts (manifest collection never initializes a
+        # backend itself).
+        from distributed_sddmm_tpu.obs import manifest as obs_manifest
+
+        obs_manifest.write_for_trace(obs_trace.tracer())
     if _fault_plan is not None:
         # A record produced under fault injection must say so — and which
         # faults actually fired — or sweep files silently mix poisoned and

@@ -39,14 +39,14 @@ world and a clean run from step 0):
 
 from __future__ import annotations
 
-import sys
-
 import numpy as np
 
 import jax
 import jax.numpy as jnp
 
 from distributed_sddmm_tpu.common import KernelMode, MatMode
+from distributed_sddmm_tpu.obs import log as obs_log
+from distributed_sddmm_tpu.obs import trace as obs_trace
 from distributed_sddmm_tpu.parallel.base import DistributedSparse
 from distributed_sddmm_tpu.resilience import faults, guards
 from distributed_sddmm_tpu.resilience.guards import CGGuard, NumericalFault
@@ -277,35 +277,45 @@ class DistributedALS:
         guarding = self._guard_active()
         cg_guard = CGGuard() if guarding else None
         X = self.A if mode == MatMode.A else self.B
-        rhs = self.compute_rhs(mode)
-        # The initial residual and every iteration must see the SAME ridge
-        # — a damped restart that only damped the iterations would solve an
-        # inconsistent system (and the base-λ one would not restart at all).
-        Mx = self.compute_queries(self.A, self.B, mode, lam=lam)
+        with obs_trace.span(
+            "als:half_step", mode=mode.name, lam=lam, cg_iters=cg_max_iter,
+        ):
+            rhs = self.compute_rhs(mode)
+            # The initial residual and every iteration must see the SAME
+            # ridge — a damped restart that only damped the iterations would
+            # solve an inconsistent system (and the base-λ one would not
+            # restart at all).
+            Mx = self.compute_queries(self.A, self.B, mode, lam=lam)
 
-        r = rhs - Mx
-        p = r
-        rsold = _batch_dot(r, r)
+            r = rhs - Mx
+            p = r
+            rsold = _batch_dot(r, r)
 
-        use_programs = self._use_programs
-        prog = self._cg_iter_program(mode, lam) if use_programs else None
-        other = self.B if mode == MatMode.A else self.A
-        for _ in range(cg_max_iter):
-            faults.maybe_raise("als:cg_iter")
-            if use_programs:
-                X, r, p, rsold = self.d_ops._timed(
-                    "cgStep", prog, X, other, r, p, rsold
-                )
-            else:
-                if mode == MatMode.A:
-                    Mp = self.compute_queries(p, self.B, mode, lam=lam)
+            use_programs = self._use_programs
+            prog = self._cg_iter_program(mode, lam) if use_programs else None
+            other = self.B if mode == MatMode.A else self.A
+            for _ in range(cg_max_iter):
+                faults.maybe_raise("als:cg_iter")
+                if use_programs:
+                    # B half-steps run the fused pair on the transposed
+                    # tiles; the cost-op alias charges that layout's comm.
+                    X, r, p, rsold = self.d_ops._timed(
+                        "cgStep", prog, X, other, r, p, rsold,
+                        _comm_op="cgStep" if mode == MatMode.A else "cgStepB",
+                    )
                 else:
-                    Mp = self.compute_queries(self.A, p, mode, lam=lam)
-                X, r, p, rsold = _cg_vector_update(X, r, p, rsold, Mp, eps)
-            if cg_guard is not None and cg_guard.update(float(jnp.sum(rsold))):
-                raise CGDivergence(
-                    f"CG residual diverged in {mode.name} half-step (λ={lam:g})"
-                )
+                    if mode == MatMode.A:
+                        Mp = self.compute_queries(p, self.B, mode, lam=lam)
+                    else:
+                        Mp = self.compute_queries(self.A, p, mode, lam=lam)
+                    X, r, p, rsold = _cg_vector_update(X, r, p, rsold, Mp, eps)
+                if cg_guard is not None and cg_guard.update(
+                    float(jnp.sum(rsold))
+                ):
+                    raise CGDivergence(
+                        f"CG residual diverged in {mode.name} half-step "
+                        f"(λ={lam:g})"
+                    )
         return X
 
     def cg_optimizer(self, mode: MatMode, cg_max_iter: int = 10) -> None:
@@ -320,9 +330,13 @@ class DistributedALS:
             if not self._guard_active():
                 raise
             damped = self.ridge_lambda * self.damp_factor
-            print(
-                f"[als] {type(first).__name__} in {mode.name} half-step; "
-                f"damped-λ restart (λ={damped:g})", file=sys.stderr,
+            obs_trace.event(
+                "als_damped_restart", mode=mode.name, lam=damped,
+                cause=type(first).__name__,
+            )
+            obs_log.warn(
+                "als", f"{type(first).__name__} in {mode.name} half-step; "
+                f"damped-λ restart", lam=f"{damped:g}",
             )
             try:
                 X = self._cg_run(mode, cg_max_iter, damped)
@@ -370,9 +384,9 @@ class DistributedALS:
             or tuple(arrays["A"].shape) != want_a
             or tuple(arrays["B"].shape) != want_b
         ):
-            print(
-                "[als] ignoring checkpoint with mismatched factor shapes "
-                f"(want {want_a}/{want_b}); fresh start", file=sys.stderr,
+            obs_log.warn(
+                "als", "ignoring checkpoint with mismatched factor shapes; "
+                "fresh start", want_a=want_a, want_b=want_b,
             )
             return 0
         self.A = jax.device_put(arrays["A"], self.d_ops.a_sharding())
@@ -402,8 +416,9 @@ class DistributedALS:
         self.A = d.put_a(serial.A.astype(np.float32))
         self.B = d.put_b(serial.B.astype(np.float32))
         self.degraded = "serial"
-        print(f"[als] degraded to serial oracle solver for {n_steps} "
-              "remaining step(s)", file=sys.stderr)
+        obs_trace.event("als_degraded", to="serial", remaining_steps=n_steps)
+        obs_log.warn("als", "degraded to serial oracle solver",
+                     remaining_steps=n_steps)
 
     def run_cg(
         self,
@@ -429,10 +444,11 @@ class DistributedALS:
         while step < n_alternating_steps:
             faults.maybe_raise("als:step")
             try:
-                self.cg_optimizer(MatMode.A, cg_iters)
-                self.cg_optimizer(MatMode.B, cg_iters)
+                with obs_trace.span("als:step", step=step):
+                    self.cg_optimizer(MatMode.A, cg_iters)
+                    self.cg_optimizer(MatMode.B, cg_iters)
             except CGDivergence as e:
-                print(f"[als] {e}", file=sys.stderr)
+                obs_log.error("als", str(e))
                 self.degrade_to_serial(n_alternating_steps - step, cg_iters)
                 return
             step += 1

@@ -36,6 +36,7 @@ import jax
 import jax.numpy as jnp
 
 from distributed_sddmm_tpu.common import KernelMode, MatMode
+from distributed_sddmm_tpu.obs import trace as obs_trace
 from distributed_sddmm_tpu.parallel.base import DistributedSparse
 from distributed_sddmm_tpu.resilience import guards
 
@@ -198,16 +199,24 @@ class GAT:
                 # The whole-layer program dispatches through _timed, whose
                 # resilient path already guards (and repairs) the output —
                 # a second per-layer sentinel here would double the
-                # reduction + host sync on the hot path.
+                # reduction + host sync on the hot path. The layer runs
+                # one fused SDDMM+SpMM pair per head; _pairs scales the
+                # comm/FLOP charge accordingly.
                 prog = self._layer_program(i)
                 d.set_r_value(layer.output_features)
-                X = d._timed("gatLayer", prog, X, *layer.weights)
+                X = d._timed(
+                    "gatLayer", prog, X, *layer.weights,
+                    _pairs=float(layer.num_heads),
+                )
             else:
-                heads = [
-                    self.compute_self_attention_head(X, i, j)
-                    for j in range(layer.num_heads)
-                ]
-                X = d.concat_heads(heads, MatMode.A)
+                with obs_trace.span(
+                    "gat:layer", layer=i, heads=layer.num_heads,
+                ):
+                    heads = [
+                        self.compute_self_attention_head(X, i, j)
+                        for j in range(layer.num_heads)
+                    ]
+                    X = d.concat_heads(heads, MatMode.A)
                 if guarding:
                     # Per-head path: dense_project/concat_heads dispatch
                     # outside _timed, so the layer output needs its own

@@ -1,0 +1,33 @@
+"""Observability layer: structured tracing, metrics, logging, profiling.
+
+The paper's whole argument is a communication/computation accounting story
+(1.5D vs 2.5D shift/replication tradeoffs), so the repro needs more than
+wall-clock: this package attributes time to shift steps, collectives,
+local kernels, retries and host transfers, and counts the communication
+volume each strategy's layout math implies — the same per-phase breakdown
+Bharadwaj et al. (IPDPS 2022) use to validate their cost model.
+
+Modules (each importable on its own; none touches a JAX backend at import
+time, so platform pinning still works):
+
+* :mod:`~distributed_sddmm_tpu.obs.trace` — process-wide tracer with
+  nested spans and thread-safe JSONL emission
+  (``DSDDMM_TRACE`` / ``--trace``; near-zero overhead when disabled).
+* :mod:`~distributed_sddmm_tpu.obs.metrics` — thread-safe counters: the
+  per-strategy op registry that replaced the ad-hoc ``total_time`` dict
+  (kernel time separated from retry/fault overhead, comm words and FLOPs
+  from the strategies' layout math), plus a process-wide event counter.
+* :mod:`~distributed_sddmm_tpu.obs.log` — structured stderr logger
+  (level via ``DSDDMM_LOG``) replacing stray ``print`` diagnostics.
+* :mod:`~distributed_sddmm_tpu.obs.profiler` — optional ``jax.profiler``
+  capture + named ``TraceAnnotation``s around compiled programs.
+* :mod:`~distributed_sddmm_tpu.obs.manifest` — one run manifest per
+  traced run (versions, device kind, mesh, git rev, fault config).
+
+The reader/report side lives in ``tools/tracereport.py``
+(``python -m distributed_sddmm_tpu.bench report-trace <trace.jsonl>``).
+"""
+
+from distributed_sddmm_tpu.obs import log, manifest, metrics, profiler, trace
+
+__all__ = ["trace", "metrics", "log", "profiler", "manifest"]

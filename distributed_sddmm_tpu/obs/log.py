@@ -1,0 +1,61 @@
+"""Structured stderr logger replacing stray ``print`` diagnostics.
+
+One line per record: ``[component] message key=value ...`` on stderr —
+the same surface the ad-hoc prints used, so operators lose nothing —
+with a level gate (``DSDDMM_LOG`` = ``debug`` | ``info`` | ``warn`` |
+``error``, default ``info``) and, when tracing is active, a mirrored
+``log`` event in the trace so diagnostics land next to the spans they
+explain.
+
+CLI-facing *output* (bench JSON lines, verify tables, chart paths) is
+NOT logging and stays on ``print``/stdout; the print-lint test
+(``tests/test_obs_lint.py``) enforces the boundary.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+
+from distributed_sddmm_tpu.obs import trace
+
+LEVELS = {"debug": 10, "info": 20, "warn": 30, "warning": 30, "error": 40}
+
+_write_lock = threading.Lock()
+
+
+def threshold() -> int:
+    """Current level gate, read from ``DSDDMM_LOG`` per call (tests and
+    long-lived processes can change it without reimporting)."""
+    name = os.environ.get("DSDDMM_LOG", "info").lower()
+    return LEVELS.get(name, 20)
+
+
+def log(level: str, component: str, msg: str, **fields) -> None:
+    lv = LEVELS.get(level, 20)
+    if lv < threshold():
+        return
+    parts = [f"[{component}] {msg}"]
+    parts += [f"{k}={v}" for k, v in fields.items()]
+    line = " ".join(parts)
+    with _write_lock:
+        sys.stderr.write(line + "\n")
+    if trace.enabled():
+        trace.event("log", level=level, component=component, msg=msg, **fields)
+
+
+def debug(component: str, msg: str, **fields) -> None:
+    log("debug", component, msg, **fields)
+
+
+def info(component: str, msg: str, **fields) -> None:
+    log("info", component, msg, **fields)
+
+
+def warn(component: str, msg: str, **fields) -> None:
+    log("warn", component, msg, **fields)
+
+
+def error(component: str, msg: str, **fields) -> None:
+    log("error", component, msg, **fields)

@@ -1,0 +1,122 @@
+"""Per-run manifest: the environment a trace was captured in.
+
+A trace without its environment is unreproducible noise — the manifest
+records, once per run, everything needed to interpret (and re-run) the
+numbers: jax/jaxlib versions, backend and device kind, device count,
+git revision, the resilience/observability env knobs, and any extras
+the caller supplies (mesh shape, plan fingerprint, bench config).
+
+Written atomically next to the trace file as
+``<run_id>.manifest.json``. Collection is strictly best-effort and
+**never initializes a JAX backend**: device info is only read when a
+backend is already up (platform pinning in scripts/tests must keep
+working), and a missing git binary just leaves ``git_rev`` null.
+"""
+
+from __future__ import annotations
+
+import os
+import pathlib
+import subprocess
+import sys
+import time
+
+from distributed_sddmm_tpu.utils.atomic import atomic_write_json
+
+#: Manifest schema generation (validated by tools/tracereport.py).
+SCHEMA_VERSION = 1
+
+_REPO = pathlib.Path(__file__).resolve().parents[2]
+
+#: Env knobs worth snapshotting — the resilience/obs configuration that
+#: shaped the run's behavior.
+_ENV_KEYS = (
+    "DSDDMM_TRACE", "DSDDMM_LOG", "DSDDMM_PROFILE",
+    "DSDDMM_FAULTS", "DSDDMM_GUARDS", "DSDDMM_GUARD_MODE",
+    "DSDDMM_EXEC_RETRIES", "DSDDMM_EXEC_TIMEOUT",
+    "DSDDMM_PLAN_CACHE", "DSDDMM_CHECKPOINT_DIR",
+    "JAX_PLATFORMS", "XLA_FLAGS",
+)
+
+
+_git_rev_cache: list = []
+
+
+def _git_rev() -> str | None:
+    """HEAD revision, memoized — a traced sweep refreshes the manifest
+    once per bench record and must not fork git each time."""
+    if not _git_rev_cache:
+        try:
+            out = subprocess.run(
+                ["git", "rev-parse", "HEAD"],
+                cwd=_REPO, capture_output=True, text=True, timeout=5,
+            )
+            _git_rev_cache.append(out.stdout.strip() or None)
+        except (OSError, subprocess.SubprocessError):
+            _git_rev_cache.append(None)
+    return _git_rev_cache[0]
+
+
+def _jax_info() -> dict:
+    """Version/device facts, without ever triggering backend init."""
+    info: dict = {}
+    jax = sys.modules.get("jax")
+    if jax is None:
+        return info
+    info["jax_version"] = getattr(jax, "__version__", None)
+    jaxlib = sys.modules.get("jaxlib")
+    if jaxlib is not None:
+        info["jaxlib_version"] = getattr(jaxlib, "version", None) and getattr(
+            jaxlib.version, "__version__", None
+        )
+    try:
+        # Only report devices if a backend already exists; creating one
+        # here could pin the wrong platform before the caller's setup.
+        backends = getattr(jax._src.xla_bridge, "_backends", None)
+        if backends:
+            devs = jax.devices()
+            info["backend"] = jax.default_backend()
+            info["device_count"] = len(devs)
+            info["device_kind"] = devs[0].device_kind if devs else None
+    except Exception:  # noqa: BLE001 — manifest is best-effort
+        pass
+    return info
+
+
+def build(run_id: str, extra: dict | None = None) -> dict:
+    m = {
+        "schema": SCHEMA_VERSION,
+        "run_id": run_id,
+        "created_epoch": time.time(),
+        "python": sys.version.split()[0],
+        "platform": sys.platform,
+        "argv": sys.argv,
+        "git_rev": _git_rev(),
+        "env": {k: os.environ[k] for k in _ENV_KEYS if k in os.environ},
+    }
+    m.update(_jax_info())
+    if extra:
+        m["extra"] = extra
+    return m
+
+
+def manifest_path_for(trace_path: str | os.PathLike) -> pathlib.Path:
+    p = pathlib.Path(trace_path)
+    return p.with_name(p.stem + ".manifest.json")
+
+
+def write_for_trace(tracer, extra: dict | None = None) -> pathlib.Path:
+    """Write (or refresh) the manifest next to ``tracer``'s trace file.
+
+    Refreshes are cheap and idempotent, and once a manifest has been
+    written WITH device facts (i.e. after backend init) further
+    extras-free refreshes are skipped — a traced sweep calls this once
+    per bench record and nothing in it can change anymore."""
+    path = manifest_path_for(tracer.path)
+    if extra is None and getattr(tracer, "_manifest_final", False):
+        return path
+    m = build(tracer.run_id, extra)
+    atomic_write_json(path, m)
+    if "backend" in m:
+        tracer._manifest_final = True
+    return path

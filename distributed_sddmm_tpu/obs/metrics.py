@@ -1,0 +1,186 @@
+"""Thread-safe counters: the per-op metrics registry and global events.
+
+Two registries, two jobs:
+
+* :class:`OpMetrics` — one per strategy instance, replacing the ad-hoc
+  ``total_time``/``call_count`` defaultdicts that ``parallel/base.py``
+  kept around ``_timed``. The old dicts had two defects this class
+  exists to fix: they were mutated without a lock while
+  ``resilience/retry.py`` ran calls on worker threads, and retry
+  attempts double-counted into kernel time (a healed transient fault
+  inflated the op's "kernel" seconds by the whole backoff+retry wall).
+  Every record now carries **kernel_s** (the successful attempt only)
+  and **overhead_s** (everything `_resilient_call` added: failed
+  attempts, backoff sleeps, fault hooks, guard checks) separately,
+  plus per-op retries, communication words and FLOPs.
+* :data:`GLOBAL` — a process-wide :class:`Counters` for cross-cutting
+  events (faults fired, exec retries, guard repairs, plan-cache
+  hits/misses, checkpoints saved/loaded). Cheap enough to bump
+  unconditionally; snapshot lands in bench records and smoke reports.
+
+Communication/FLOP accounting conventions (matching
+``tools/costmodel.py`` so counted volume is directly comparable to the
+analytic predictions):
+
+* ``comm_words`` are **per-device words** — the same unit the cost
+  model's ``pair_words`` predicts (and the notebook's models before it).
+  Only collectives the model counts contribute (``in_model`` entries of
+  the strategy's ``comm_profile``); the SpMM reduce-scatter the notebook
+  folds out of its comparison is tracked separately as
+  ``comm_words_extra``.
+* ``flops`` are **global useful FLOPs**: ``4 * nnz * R`` per fused
+  SDDMM+SpMM pair, ``2 * nnz * R`` per single op — the bench harness's
+  throughput convention (`benchmark_dist.cpp:147-149`).
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+
+
+class Counters:
+    """Named float counters behind one lock (add/get/snapshot/clear)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._vals: dict[str, float] = {}
+
+    def add(self, name: str, value: float = 1.0) -> None:
+        with self._lock:
+            self._vals[name] = self._vals.get(name, 0.0) + value
+
+    def get(self, name: str, default: float = 0.0) -> float:
+        with self._lock:
+            return self._vals.get(name, default)
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return dict(self._vals)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._vals.clear()
+
+
+#: Process-wide event counters (faults_fired, exec_retries,
+#: guard_repairs, plan_cache_hits, checkpoints_saved, ...).
+GLOBAL = Counters()
+
+_FIELDS = (
+    "calls", "kernel_s", "overhead_s", "retries",
+    "comm_words", "comm_words_extra", "flops",
+)
+
+
+class OpMetrics:
+    """Per-op accumulators for one strategy instance (thread-safe)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._ops: dict[str, dict] = {}
+
+    def record(
+        self,
+        op: str,
+        kernel_s: float,
+        overhead_s: float = 0.0,
+        retries: int = 0,
+        comm_words: float = 0.0,
+        comm_words_extra: float = 0.0,
+        flops: float = 0.0,
+        calls: int = 1,
+    ) -> None:
+        with self._lock:
+            rec = self._ops.get(op)
+            if rec is None:
+                rec = self._ops[op] = dict.fromkeys(_FIELDS, 0.0)
+            rec["calls"] += calls
+            rec["kernel_s"] += kernel_s
+            rec["overhead_s"] += overhead_s
+            rec["retries"] += retries
+            rec["comm_words"] += comm_words
+            rec["comm_words_extra"] += comm_words_extra
+            rec["flops"] += flops
+
+    # ------------------------------------------------------------------ #
+    # Views
+    # ------------------------------------------------------------------ #
+
+    def time_view(self):
+        """``{op: kernel seconds}`` — the ``json_perf_statistics`` shape.
+        Retry/fault overhead is deliberately NOT in here; see
+        :meth:`to_dict` for the full attribution."""
+        with self._lock:
+            return collections.defaultdict(
+                float, {k: v["kernel_s"] for k, v in self._ops.items()}
+            )
+
+    def wall_view(self):
+        """``{op: kernel + overhead seconds}`` — the unit the old
+        ``total_time`` dict measured (whole ``_timed`` wall)."""
+        with self._lock:
+            return collections.defaultdict(
+                float,
+                {k: v["kernel_s"] + v["overhead_s"] for k, v in self._ops.items()},
+            )
+
+    def calls_view(self):
+        with self._lock:
+            return collections.defaultdict(
+                int, {k: int(v["calls"]) for k, v in self._ops.items()}
+            )
+
+    def to_dict(self) -> dict:
+        """Full per-op attribution, JSON-ready (sorted, rounded)."""
+        with self._lock:
+            out = {}
+            for op in sorted(self._ops):
+                rec = self._ops[op]
+                out[op] = {
+                    "calls": int(rec["calls"]),
+                    "kernel_s": round(rec["kernel_s"], 9),
+                    "overhead_s": round(rec["overhead_s"], 9),
+                    "retries": int(rec["retries"]),
+                    "comm_words": rec["comm_words"],
+                    "comm_words_extra": rec["comm_words_extra"],
+                    "flops": rec["flops"],
+                }
+            return out
+
+    def clear(self) -> None:
+        with self._lock:
+            self._ops.clear()
+
+
+# --------------------------------------------------------------------- #
+# Op-shape conventions shared by the dispatch choke point and the
+# report tool: how many fused pairs one logical call represents, and
+# the FLOP charge per op family.
+# --------------------------------------------------------------------- #
+
+#: Fraction of a fused SDDMM+SpMM pair each cost-op name represents
+#: (``gatLayer`` is per-head — the caller scales by ``num_heads``).
+#: ``fusedSpMMB``/``cgStepB`` are cost-op aliases: B-mode fused
+#: dispatches keep their public counter name but charge the transposed
+#: layout (``_timed``'s ``_comm_op`` hint).
+OP_PAIRS = {
+    "fusedSpMM": 1.0,
+    "fusedSpMMB": 1.0,
+    "cgStep": 1.0,
+    "cgStepB": 1.0,
+    "gatLayer": 1.0,
+    "sddmmA": 0.5,
+    "sddmmB": 0.5,
+    "spmmA": 0.5,
+    "spmmB": 0.5,
+}
+
+
+def op_flops(op: str, nnz: int, R: int, pairs: float = 1.0) -> float:
+    """Global useful FLOPs for one call: 4*nnz*R per fused pair
+    (2*nnz*R per single op via the 0.5 pair fraction)."""
+    frac = OP_PAIRS.get(op)
+    if frac is None:
+        return 0.0
+    return 4.0 * nnz * R * frac * pairs

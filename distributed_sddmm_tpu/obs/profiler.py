@@ -1,0 +1,85 @@
+"""Optional ``jax.profiler`` integration: capture + named annotations.
+
+Two pieces, both no-ops unless explicitly armed:
+
+* :func:`capture` / :func:`maybe_capture` — a context manager around a
+  whole run that starts a ``jax.profiler`` trace into a log directory
+  (TensorBoard/XProf-readable). Armed by the bench CLI's ``--profile
+  DIR`` flag or the ``DSDDMM_PROFILE=DIR`` env var.
+* :func:`annotate` — a named ``jax.profiler.TraceAnnotation`` wrapped
+  around each compiled-program dispatch (``cgStep``, ``gatLayer``, the
+  sddmm/spmm/fused programs) so device timelines carry the framework's
+  op names. Only constructed while a capture is active
+  (:func:`active`), so the hot path pays one boolean check otherwise.
+
+Everything degrades gracefully: a jax without the profiler API (or a
+backend that refuses to start one) logs a warning and runs untraced —
+profiling must never take down a run.
+"""
+
+from __future__ import annotations
+
+import contextlib
+
+from distributed_sddmm_tpu.obs import log
+
+_capturing = False
+
+
+def active() -> bool:
+    """True while a profiler capture is running (annotations worth it)."""
+    return _capturing
+
+
+def annotate(name: str):
+    """A ``TraceAnnotation(name)`` while capturing, else a null context."""
+    if not _capturing:
+        return contextlib.nullcontext()
+    try:
+        import jax.profiler
+
+        return jax.profiler.TraceAnnotation(name)
+    except Exception:  # noqa: BLE001 — profiling is best-effort
+        return contextlib.nullcontext()
+
+
+@contextlib.contextmanager
+def capture(logdir: str):
+    """Run the block under a ``jax.profiler`` trace into ``logdir``."""
+    global _capturing
+    started = False
+    try:
+        import jax.profiler
+
+        jax.profiler.start_trace(logdir)
+        started = True
+        log.info("profiler", "jax.profiler capture started", logdir=logdir)
+    except Exception as e:  # noqa: BLE001 — run unprofiled, never die
+        log.warn("profiler", "could not start jax.profiler capture",
+                 error=f"{type(e).__name__}: {e}")
+    _capturing = started
+    try:
+        yield
+    finally:
+        _capturing = False
+        if started:
+            try:
+                import jax.profiler
+
+                jax.profiler.stop_trace()
+                log.info("profiler", "jax.profiler capture written",
+                         logdir=logdir)
+            except Exception as e:  # noqa: BLE001
+                log.warn("profiler", "jax.profiler stop_trace failed",
+                         error=f"{type(e).__name__}: {e}")
+
+
+def maybe_capture(logdir: str | None = None):
+    """``capture(logdir)`` when a directory is given (CLI flag) or set in
+    ``DSDDMM_PROFILE``; a null context otherwise."""
+    import os
+
+    target = logdir or os.environ.get("DSDDMM_PROFILE")
+    if not target:
+        return contextlib.nullcontext()
+    return capture(target)

@@ -1,0 +1,300 @@
+"""Process-wide structured tracer: nested spans, thread-safe JSONL.
+
+One tracer per process, activated by the ``DSDDMM_TRACE`` environment
+variable (``1`` → the default ``artifacts/traces/<run_id>.jsonl``; any
+other value is used as the output path, a directory landing the default
+file name inside it), by the bench CLI's ``--trace`` flag, or
+programmatically via :func:`enable`.
+
+Design constraints, in order:
+
+1. **Near-zero overhead when disabled.** :func:`span` and :func:`event`
+   check one module-level boolean and return a shared no-op object —
+   no allocation, no lock, no clock read. Strategy dispatch calls these
+   on every compiled-program call; the disabled path must cost
+   nanoseconds (pinned by a test).
+2. **Thread-safe emission.** Retry workers, autotune trials and the
+   checkpoint writer all emit from non-main threads; records are
+   serialized under one lock and written as complete lines, so a trace
+   is valid JSONL even under concurrency. Span *nesting* is tracked
+   per-thread (thread-local stack) — a worker thread's spans parent to
+   that thread's enclosing span, never to another thread's.
+3. **Monotonic timestamps.** ``t0``/``t1`` are ``time.perf_counter``
+   offsets from the tracer's start; the begin record carries the epoch
+   time of that origin so tools can reconstruct wall-clock.
+
+Record schema (one JSON object per line, ``schema`` = SCHEMA_VERSION):
+
+* ``{"type": "begin", "schema": 1, "run_id": .., "t0_epoch": ..}``
+  — first line of every trace.
+* ``{"type": "span", "name": .., "id": .., "parent": .., "tid": ..,
+  "t0": .., "t1": .., "dur_s": .., "attrs": {..}}`` — emitted when
+  the span *closes* (children therefore appear before their parent;
+  readers reconstruct nesting from ``parent``).
+* ``{"type": "event", "name": .., "id": .., "parent": .., "tid": ..,
+  "t": .., "attrs": {..}}`` — instantaneous (fault fired, retry,
+  guard repair, checkpoint, cache hit, log mirror).
+
+``tools/tracereport.py`` is the schema's reader and validator.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import pathlib
+import threading
+import time
+from typing import Optional
+
+#: Trace record schema generation; readers reject records they cannot
+#: interpret. Bump on any incompatible change.
+SCHEMA_VERSION = 1
+
+_REPO = pathlib.Path(__file__).resolve().parents[2]
+DEFAULT_TRACE_DIR = _REPO / "artifacts" / "traces"
+
+# Module-level fast path: `_active is None` means every hook is a no-op.
+_active: Optional["Tracer"] = None
+_env_checked = False
+_registry_lock = threading.Lock()
+
+
+def _make_run_id() -> str:
+    return (
+        time.strftime("%Y%m%d-%H%M%S")
+        + f"-{os.getpid()}-{int.from_bytes(os.urandom(2), 'big'):04x}"
+    )
+
+
+class _NoopSpan:
+    """Shared do-nothing span: the disabled-tracer return value."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+    def set(self, **attrs) -> None:
+        pass
+
+
+NOOP_SPAN = _NoopSpan()
+
+
+class Span:
+    """A live span; emitted as one JSONL record when it closes."""
+
+    __slots__ = ("tracer", "name", "attrs", "id", "parent", "tid", "_t0")
+
+    def __init__(self, tracer: "Tracer", name: str, attrs: dict):
+        self.tracer = tracer
+        self.name = name
+        self.attrs = attrs
+
+    def set(self, **attrs) -> None:
+        """Attach attributes mid-span (e.g. kernel vs overhead splits
+        known only after the wrapped call returns)."""
+        self.attrs.update(attrs)
+
+    def __enter__(self) -> "Span":
+        tr = self.tracer
+        self.id = tr.next_id()
+        self.tid = threading.get_ident()
+        stack = tr.stack()
+        self.parent = stack[-1] if stack else None
+        stack.append(self.id)
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        t1 = time.perf_counter()
+        tr = self.tracer
+        stack = tr.stack()
+        if stack and stack[-1] == self.id:
+            stack.pop()
+        if exc and exc[0] is not None:
+            self.attrs.setdefault("error", exc[0].__name__)
+        tr.emit({
+            "type": "span",
+            "name": self.name,
+            "id": self.id,
+            "parent": self.parent,
+            "tid": self.tid,
+            "t0": round(self._t0 - tr.t0, 9),
+            "t1": round(t1 - tr.t0, 9),
+            "dur_s": round(t1 - self._t0, 9),
+            "attrs": self.attrs,
+        })
+        return False
+
+
+class Tracer:
+    """JSONL-emitting tracer bound to one output file."""
+
+    def __init__(self, path: pathlib.Path, run_id: str):
+        self.path = path
+        self.run_id = run_id
+        self.t0 = time.perf_counter()
+        self._lock = threading.Lock()
+        self._ids = 0
+        self._local = threading.local()
+        path.parent.mkdir(parents=True, exist_ok=True)
+        # Truncate: one trace per file (re-running with the same explicit
+        # --trace PATH.jsonl must not merge runs — the reader would
+        # double-count). Default/directory specs embed the run_id in the
+        # file name, so concurrent processes never share a file; point
+        # multi-process runs at a directory, not a file.
+        self._fh = open(path, "w", buffering=1)  # line-buffered
+        self.emit({
+            "type": "begin",
+            "schema": SCHEMA_VERSION,
+            "run_id": run_id,
+            "t0_epoch": time.time(),
+            "pid": os.getpid(),
+        })
+
+    def next_id(self) -> int:
+        with self._lock:
+            self._ids += 1
+            return self._ids
+
+    def stack(self) -> list:
+        st = getattr(self._local, "stack", None)
+        if st is None:
+            st = self._local.stack = []
+        return st
+
+    def emit(self, record: dict) -> None:
+        line = json.dumps(record, default=str)
+        with self._lock:
+            if self._fh.closed:
+                return
+            self._fh.write(line + "\n")
+
+    def current_span_id(self) -> Optional[int]:
+        st = self.stack()
+        return st[-1] if st else None
+
+    def close(self) -> None:
+        with self._lock:
+            if not self._fh.closed:
+                self._fh.flush()
+                self._fh.close()
+
+
+# --------------------------------------------------------------------- #
+# Module-level API — what the rest of the framework calls.
+# --------------------------------------------------------------------- #
+
+
+def _env_activate() -> None:
+    global _env_checked
+    with _registry_lock:
+        if _env_checked:
+            return
+        _env_checked = True
+        spec = os.environ.get("DSDDMM_TRACE")
+        if spec:
+            _enable_locked(None if spec in ("1", "on", "true", "yes") else spec)
+
+
+def _resolve_path(spec, run_id: str) -> pathlib.Path:
+    if spec is None:
+        return DEFAULT_TRACE_DIR / f"{run_id}.jsonl"
+    p = pathlib.Path(spec)
+    if p.suffix != ".jsonl":  # treat as a directory
+        return p / f"{run_id}.jsonl"
+    return p
+
+
+def _enable_locked(spec=None, run_id: Optional[str] = None) -> "Tracer":
+    global _active
+    if _active is not None:
+        return _active
+    rid = run_id or _make_run_id()
+    _active = Tracer(_resolve_path(spec, rid), rid)
+    return _active
+
+
+def enable(path=None, run_id: Optional[str] = None) -> "Tracer":
+    """Activate tracing (idempotent — an already-active tracer wins).
+
+    ``path``: explicit ``.jsonl`` file, a directory, or None for
+    ``artifacts/traces/<run_id>.jsonl``. Also writes the run manifest
+    next to the trace (best-effort)."""
+    global _env_checked
+    with _registry_lock:
+        _env_checked = True
+        tr = _enable_locked(path, run_id)
+    from distributed_sddmm_tpu.obs import manifest
+
+    manifest.write_for_trace(tr)
+    return tr
+
+
+def disable() -> None:
+    """Close and deactivate the tracer (tests; end-of-run flush)."""
+    global _active, _env_checked
+    with _registry_lock:
+        if _active is not None:
+            _active.close()
+        _active = None
+        _env_checked = True
+
+
+def tracer() -> Optional["Tracer"]:
+    """The active tracer, activating from ``DSDDMM_TRACE`` on first query."""
+    if not _env_checked:
+        _env_activate()
+    return _active
+
+
+def enabled() -> bool:
+    if not _env_checked:
+        _env_activate()
+    return _active is not None
+
+
+def run_id() -> Optional[str]:
+    tr = tracer()
+    return tr.run_id if tr else None
+
+
+def trace_path() -> Optional[str]:
+    tr = tracer()
+    return str(tr.path) if tr else None
+
+
+def span(name: str, **attrs):
+    """A context manager timing a nested region; no-op when disabled.
+
+    Usage::
+
+        with trace.span("fusedSpMM", alg="15d_fusion2", R=128) as sp:
+            out = run()
+            sp.set(kernel_s=...)   # attrs added before the span closes
+    """
+    tr = tracer()
+    if tr is None:
+        return NOOP_SPAN
+    return Span(tr, name, attrs)
+
+
+def event(name: str, **attrs) -> None:
+    """Emit an instantaneous event under the current thread's span."""
+    tr = tracer()
+    if tr is None:
+        return
+    tr.emit({
+        "type": "event",
+        "name": name,
+        "id": tr.next_id(),
+        "parent": tr.current_span_id(),
+        "tid": threading.get_ident(),
+        "t": round(time.perf_counter() - tr.t0, 9),
+        "attrs": attrs,
+    })

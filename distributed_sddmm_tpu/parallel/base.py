@@ -22,7 +22,6 @@ TPU-native counterpart of the reference's ``Distributed_Sparse``
 from __future__ import annotations
 
 import abc
-import collections
 import time
 from typing import Optional
 
@@ -33,6 +32,10 @@ import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from distributed_sddmm_tpu.common import KernelMode, MatMode
+from distributed_sddmm_tpu.obs import log as obs_log
+from distributed_sddmm_tpu.obs import metrics as obs_metrics
+from distributed_sddmm_tpu.obs import profiler as obs_profiler
+from distributed_sddmm_tpu.obs import trace as obs_trace
 from distributed_sddmm_tpu.ops.kernels import LocalKernel, XlaKernel
 from distributed_sddmm_tpu.parallel.mesh import GridSpec
 from distributed_sddmm_tpu.parallel.sharding import TileSet
@@ -43,6 +46,10 @@ class DistributedSparse(abc.ABC):
 
     algorithm_name: str = ""
     proc_grid_names: tuple = ()
+    #: The ``tools/costmodel.py`` model this strategy's layout realizes
+    #: (None = no analytic model; comm counters then stay zero). Set by
+    #: subclasses; DenseShift15D chooses per fusion approach.
+    cost_model_name: str | None = None
 
     def __init__(
         self,
@@ -60,8 +67,12 @@ class DistributedSparse(abc.ABC):
         self.kernel = kernel if kernel is not None else XlaKernel()
         self.dtype = dtype
         self.r_split = False  # overridden by R-splitting strategies
-        self.call_count: dict = collections.defaultdict(int)
-        self.total_time: dict = collections.defaultdict(float)
+        #: Per-op attribution registry (kernel vs retry/fault overhead,
+        #: comm words, FLOPs). Replaces the unsynchronized total_time /
+        #: call_count dicts; see the compat properties below.
+        self.metrics = obs_metrics.OpMetrics()
+        self._op_cost_cache: dict = {}
+        self._trace_meta_emitted = False
         self._programs: dict = {}
 
         # Subclasses must set these before use:
@@ -246,8 +257,6 @@ class DistributedSparse(abc.ABC):
         per-layer feature widths) — correctness never depends on the
         injection, only compile latency does.
         """
-        import sys
-
         from distributed_sddmm_tpu.parallel.loops import ablation
 
         key = (op, use_st, ablation())
@@ -260,9 +269,12 @@ class DistributedSparse(abc.ABC):
             except Exception as e:  # noqa: BLE001 — any rejection -> jit
                 if not warned:
                     warned.append(1)
-                    print(f"[aot] injected {op}/{use_st} program rejected a "
-                          f"call ({type(e).__name__}: {e}); jit fallback",
-                          file=sys.stderr)
+                    obs_log.warn(
+                        "aot",
+                        f"injected {op}/{use_st} program rejected a call; "
+                        "jit fallback",
+                        error=f"{type(e).__name__}: {e}",
+                    )
                 return fallback(*args)
 
         self._programs[key] = dispatch
@@ -376,7 +388,7 @@ class DistributedSparse(abc.ABC):
         return "\n".join(lines)
 
     def print_nonzero_distribution(self) -> None:
-        print(self.nonzero_distribution_report())
+        print(self.nonzero_distribution_report())  # cli-output
 
     # ------------------------------------------------------------------ #
     # Verification fingerprints (reference `scratch.cpp:26-76`)
@@ -391,21 +403,136 @@ class DistributedSparse(abc.ABC):
     # Performance counters (reference `distributed_sparse.h:205-261`)
     # ------------------------------------------------------------------ #
 
-    def _timed(self, name: str, fn, *args):
+    @property
+    def total_time(self):
+        """Compat view of the old ``total_time`` dict: ``{op: kernel
+        seconds}`` (successful attempts only — retry/fault overhead now
+        lives in ``metrics.to_dict()[op]["overhead_s"]``; MIGRATING.md
+        documents the change). Returns a snapshot, not a live dict."""
+        return self.metrics.time_view()
+
+    @property
+    def call_count(self):
+        """Compat view of the old ``call_count`` dict (snapshot)."""
+        return self.metrics.calls_view()
+
+    def _op_cost(self, op: str, pairs: float) -> tuple:
+        """(model comm words, folded-out comm words, global FLOPs) for one
+        call of ``op`` at the current R — cached, so the per-dispatch cost
+        on the fast path is one dict hit."""
+        key = (op, self.R, pairs)
+        hit = self._op_cost_cache.get(key)
+        if hit is None:
+            profile = self.comm_profile(op, pairs)
+            words = sum(e["words"] for e in profile if e.get("in_model"))
+            extra = sum(e["words"] for e in profile if not e.get("in_model"))
+            nnz = self.S_tiles.nnz if self.S_tiles is not None else 0
+            flops = obs_metrics.op_flops(op, nnz, self.R, pairs)
+            hit = self._op_cost_cache[key] = (words, extra, flops)
+        return hit
+
+    def comm_profile(self, op: str, pairs: float = 1.0) -> list[dict]:
+        """Per-call collective profile: ``[{"collective", "axis", "count",
+        "words", "in_model"}, ...]`` with per-device word volumes.
+
+        The base implementation charges the strategy's analytic model
+        volume (``tools/costmodel.pair_words`` scaled by the op's pair
+        fraction) as one aggregate entry; strategies whose layout math is
+        implemented here override with a genuine per-collective breakdown
+        (see ``DenseShift15D.comm_profile``) — the cross-check between
+        the two is what the trace report's model column surfaces.
+        ``in_model=False`` entries (the SpMM reduce-scatter the notebook
+        folds out of its comparison) are counted separately.
+        """
+        model = self.cost_model_name
+        frac = obs_metrics.OP_PAIRS.get(op)
+        if model is None or frac is None or self.S_tiles is None:
+            return []
+        from distributed_sddmm_tpu.tools import costmodel
+
+        try:
+            w = costmodel.pair_words(
+                model, self.M_pad, self.N_pad, self.R,
+                self.S_tiles.nnz, self.p, self.c,
+            )
+        except ValueError:
+            return []
+        return [{
+            "collective": "modeled", "axis": None, "count": 0,
+            "words": w * frac * pairs, "in_model": True,
+        }]
+
+    def _emit_strategy_meta(self) -> None:
+        """One ``strategy`` trace event per instance: the static layout
+        facts the report tool needs to recompute model predictions."""
+        if self._trace_meta_emitted or not obs_trace.enabled():
+            return
+        self._trace_meta_emitted = True
+        obs_trace.event(
+            "strategy",
+            algorithm=self.algorithm_name,
+            cost_model=self.cost_model_name,
+            M=self.M, N=self.N, M_pad=self.M_pad, N_pad=self.N_pad,
+            R=self.R, nnz=self.S_tiles.nnz if self.S_tiles else 0,
+            p=self.p, c=self.c,
+            kernel=getattr(self.kernel, "name", type(self.kernel).__name__),
+        )
+
+    def _timed(
+        self, name: str, fn, *args, _pairs: float = 1.0,
+        _comm_op: str | None = None,
+    ):
+        """Dispatch one compiled program with full attribution: kernel
+        time (the successful attempt) separate from retry/fault overhead,
+        comm words + FLOPs from the layout model, a trace span when
+        tracing, a profiler annotation when capturing. ``_pairs`` scales
+        the comm/FLOP charge for multi-pair programs (GAT layers dispatch
+        one fused pair per head); ``_comm_op`` overrides the cost-op name
+        when the counter name does not determine the layout (B-mode fused
+        dispatches charge ``fusedSpMMB``/``cgStepB`` while still counting
+        under the public op name)."""
         from distributed_sddmm_tpu.resilience import faults, guards
         from distributed_sddmm_tpu.utils.platform import force_fetch
 
-        t0 = time.perf_counter()
-        if faults.active() is None and not guards.enabled():
+        cost_op = _comm_op or name
+        resilient = faults.active() is not None or guards.enabled()
+        if not (resilient or obs_trace.enabled() or obs_profiler.active()):
+            # Hot path: two clock reads + one locked counter update.
+            t0 = time.perf_counter()
             out = fn(*args)
             # Host fetch, not block_until_ready: tunneled backends only run
             # the queue on a transfer (utils.platform.force_fetch); one
             # scalar per output leaf is negligible next to any timed op.
             force_fetch(out)
-        else:
-            out = self._resilient_call(name, fn, *args)
-        self.total_time[name] += time.perf_counter() - t0
-        self.call_count[name] += 1
+            kernel_s = time.perf_counter() - t0
+            words, extra, flops = self._op_cost(cost_op, _pairs)
+            self.metrics.record(
+                name, kernel_s, comm_words=words, comm_words_extra=extra,
+                flops=flops,
+            )
+            return out
+
+        self._emit_strategy_meta()
+        words, extra, flops = self._op_cost(cost_op, _pairs)
+        with obs_trace.span(name, R=self.R, pairs=_pairs) as sp:
+            t0 = time.perf_counter()
+            if resilient:
+                out, kernel_s, attempts = self._resilient_call(name, fn, *args)
+            else:
+                with obs_profiler.annotate(name):
+                    out = fn(*args)
+                    force_fetch(out)
+                kernel_s = time.perf_counter() - t0
+                attempts = 1
+            overhead_s = max(time.perf_counter() - t0 - kernel_s, 0.0)
+            self.metrics.record(
+                name, kernel_s, overhead_s=overhead_s, retries=attempts - 1,
+                comm_words=words, comm_words_extra=extra, flops=flops,
+            )
+            sp.set(
+                kernel_s=round(kernel_s, 9), overhead_s=round(overhead_s, 9),
+                retries=attempts - 1, comm_words=words, flops=flops,
+            )
         return out
 
     def _resilient_call(self, name: str, fn, *args):
@@ -419,6 +546,11 @@ class DistributedSparse(abc.ABC):
         fault heals invisibly, a persistent one surfaces as a clean typed
         exception after bounded attempts — never a hang, never a silently
         poisoned array flowing into the next op.
+
+        Returns ``(out, kernel_s, attempts)``: ``kernel_s`` times the
+        attempt that actually succeeded, so failed attempts and backoff
+        sleeps land in the caller's overhead bucket instead of inflating
+        kernel time (the double-count the old ``total_time`` dict had).
         """
         import os
 
@@ -426,18 +558,29 @@ class DistributedSparse(abc.ABC):
         from distributed_sddmm_tpu.resilience.retry import Backoff, retry_call
         from distributed_sddmm_tpu.utils.platform import force_fetch
 
+        attempts = [0]
+
         def attempt():
+            attempts[0] += 1
+            t0 = time.perf_counter()
             faults.maybe_raise(f"execute:{name}")
-            out = fn(*args)
-            out = faults.corrupt_outputs(f"output:{name}", out)
-            force_fetch(out)
+            with obs_profiler.annotate(name):
+                out = fn(*args)
+                out = faults.corrupt_outputs(f"output:{name}", out)
+                force_fetch(out)
             if guards.enabled():
                 # raise-mode trips the retry below; repair-mode degrades
-                # in place (nan_to_num + stderr warning).
+                # in place (nan_to_num + a structured warning).
                 out = guards.guard_output(name, out)
-            return out
+            return out, time.perf_counter() - t0
 
-        return retry_call(
+        def on_retry(i: int, err: BaseException) -> None:
+            obs_metrics.GLOBAL.add("exec_retries")
+            obs_trace.event(
+                "retry", op=name, attempt=i, error=type(err).__name__,
+            )
+
+        out, kernel_s = retry_call(
             attempt,
             retries=int(os.environ.get("DSDDMM_EXEC_RETRIES", "1")),
             timeout_s=float(os.environ.get("DSDDMM_EXEC_TIMEOUT", "0")),
@@ -445,11 +588,13 @@ class DistributedSparse(abc.ABC):
             retry_on=(TimeoutError, MemoryError, guards.NumericalFault,
                       faults.FaultError),
             label=f"execute:{name}",
+            on_retry=on_retry,
         )
+        return out, kernel_s, attempts[0]
 
     def reset_performance_timers(self) -> None:
-        self.call_count.clear()
-        self.total_time.clear()
+        self.metrics.clear()
+        self._op_cost_cache.clear()
 
     def measure_breakdown(
         self,
@@ -517,7 +662,11 @@ class DistributedSparse(abc.ABC):
         }
 
     def json_perf_statistics(self) -> dict:
-        return {k: self.total_time[k] for k in sorted(self.total_time)}
+        """Per-op kernel seconds (sorted). Retry/fault overhead is NOT in
+        these numbers — bench records carry the full split under
+        ``metrics`` (see :class:`obs.metrics.OpMetrics`)."""
+        view = self.metrics.time_view()
+        return {k: view[k] for k in sorted(view)}
 
     def json_algorithm_info(self) -> dict:
         """Same record schema as the reference (`distributed_sparse.h:131-179`)."""

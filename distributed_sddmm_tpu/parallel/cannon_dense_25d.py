@@ -62,6 +62,7 @@ _A_MODES = (KernelMode.SDDMM_A, KernelMode.SPMM_A)
 
 class CannonDense25D(DistributedSparse):
     algorithm_name = "2.5D Cannon's Algorithm Replicating Dense Matrices"
+    cost_model_name = "25d_dense"
     proc_grid_names = ("# Rows", "# Cols", "# Layers")
 
     def __init__(

@@ -64,6 +64,7 @@ _A_MODES = (KernelMode.SDDMM_A, KernelMode.SPMM_A)
 
 class CannonSparse25D(DistributedSparse):
     algorithm_name = "2.5D Cannon's Algorithm Replicating Sparse Matrix"
+    cost_model_name = "25d_sparse"
     proc_grid_names = ("# Rows", "# Cols", "# Layers")
 
     def __init__(

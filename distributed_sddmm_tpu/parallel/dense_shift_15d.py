@@ -80,6 +80,9 @@ class DenseShift15D(DistributedSparse):
         grid = make_grid(p // c, c, 1, adjacency=adjacency, devices=devices)
         super().__init__(grid, S.M, S.N, R, c, kernel=kernel, dtype=dtype)
         self.fusion_approach = fusion_approach
+        self.cost_model_name = (
+            "15d_fusion2" if fusion_approach == 2 else "15d_fusion1"
+        )
         self.unroll = unroll
         self.nr = p // c
 
@@ -110,6 +113,54 @@ class DenseShift15D(DistributedSparse):
         """Change the inner dimension (reference ``setRValue``,
         `15D_dense_shift.hpp:128-140`). Programs retrace per distinct shape."""
         self.R = R
+
+    def comm_profile(self, op: str, pairs: float = 1.0) -> list[dict]:
+        """Per-collective word volumes from THIS strategy's layout math
+        (not the cost model): the stationary operand's per-device block is
+        ``localArows x R`` (all-gathered over the c-wide ``cols`` axis),
+        the moving operand's is ``localBrows x R`` (ppermuted around the
+        ``(p/c)``-long ``rows`` ring), and SpMM partials psum_scatter back
+        over ``cols``. The in-model sum equals
+        ``costmodel.pair_words(cost_model_name, M_pad, N_pad, ...)``
+        exactly — the agreement the trace report (and a test) checks; the
+        reduce-scatter is ``in_model=False`` because the notebook's
+        models fold it out of the comparison.
+        """
+        R, c, nr = self.R, self.c, self.nr
+        n_pass = 1 if self.fusion_approach == 2 else 2
+        # B-output ops run on the transposed tiles: stationary/output rows
+        # come from the N side, the A blocks ride the ring.
+        stat_rows, mov_rows = self.localArows, self.localBrows
+        if op.endswith("B"):
+            stat_rows, mov_rows = mov_rows, stat_rows
+        repl = {
+            "collective": "all_gather", "axis": "cols",
+            "count": (1 if c > 1 else 0) * pairs,
+            "words": (c - 1) * stat_rows * R * pairs,
+            "in_model": True,
+        }
+        reduce_ = {
+            "collective": "psum_scatter", "axis": "cols",
+            "count": (1 if c > 1 else 0) * pairs,
+            "words": (c - 1) * stat_rows * R * pairs,
+            "in_model": False,
+        }
+
+        def ring(passes):
+            return {
+                "collective": "ppermute", "axis": "rows",
+                "count": (nr - 1) * passes * pairs,
+                "words": (nr - 1) * mov_rows * R * passes * pairs,
+                "in_model": True,
+            }
+
+        if op in ("fusedSpMM", "cgStep", "gatLayer", "fusedSpMMB", "cgStepB"):
+            return [repl, ring(n_pass), reduce_]
+        if op in ("sddmmA", "sddmmB"):
+            return [repl, ring(1)]
+        if op in ("spmmA", "spmmB"):
+            return [ring(1), reduce_]
+        return []
 
     # ------------------------------------------------------------------ #
     # shard_map programs
@@ -535,6 +586,7 @@ class DenseShift15D(DistributedSparse):
             return out, mid
         prog = self._program(op, use_st=True)
         out, mid = self._timed(
-            "fusedSpMM", prog, B, A, *self._tile_args(self.ST_tiles, s_vals)
+            "fusedSpMM", prog, B, A, *self._tile_args(self.ST_tiles, s_vals),
+            _comm_op="fusedSpMMB",
         )
         return out, mid

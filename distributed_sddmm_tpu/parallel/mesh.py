@@ -161,8 +161,13 @@ class GridSpec:
                     if got != want:
                         ok = False
                     if verbose:
-                        flag = "OK " if got == want else "FAIL"
-                        print(f"{flag} (i={i}, j={j}, k={k}) got={got} want={want}")
+                        from distributed_sddmm_tpu.obs import log
+
+                        flag = "OK" if got == want else "FAIL"
+                        log.info(
+                            "mesh", f"self_test {flag}",
+                            coord=(i, j, k), got=got, want=want,
+                        )
         return ok
 
 

@@ -52,6 +52,7 @@ _TILE_SPEC = P("rows", "cols", None, None, None)
 
 class SparseShift15D(DistributedSparse):
     algorithm_name = "1.5D Sparse Shifting Dense Replicating Algorithm"
+    cost_model_name = "15d_sparse"
     proc_grid_names = ("# Rows", "# Layers")
 
     def __init__(

@@ -68,11 +68,18 @@ class CheckpointStore:
 
     def save(self, step: int, arrays: dict, meta: dict | None = None) -> None:
         """Atomically persist ``arrays`` (name -> ndarray) as ``step``."""
+        from distributed_sddmm_tpu.obs import metrics, trace
+
         buf = io.BytesIO()
         np.savez(buf, **{k: np.asarray(v) for k, v in arrays.items()})
         payload = buf.getvalue()
         path = self._step_path(step)
         atomic_write_bytes(path, payload)
+        metrics.GLOBAL.add("checkpoints_saved")
+        trace.event(
+            "checkpoint_save", step=int(step), file=path.name,
+            bytes=len(payload),
+        )
         # Digest of what we *intended* to write: a write fault that garbled
         # the npz on disk then fails digest verification at resume.
         atomic_write_json(
@@ -141,6 +148,8 @@ class CheckpointStore:
         any ``step_*.npz`` that loads, newest first. None when nothing
         survives — the caller starts from step 0, the final degradation.
         """
+        from distributed_sddmm_tpu.obs import metrics, trace
+
         rec = self._latest_pointer()
         if rec is not None:
             path = self.root / str(rec.get("file", ""))
@@ -154,10 +163,20 @@ class CheckpointStore:
             ):
                 arrays = self._read_npz(path)
                 if arrays is not None:
+                    metrics.GLOBAL.add("checkpoints_loaded")
+                    trace.event(
+                        "checkpoint_load", step=int(rec["step"]),
+                        file=path.name, source="pointer",
+                    )
                     return int(rec["step"]), arrays, rec.get("meta", {})
 
         for step in reversed(self.steps()):
             arrays = self._read_npz(self._step_path(step))
             if arrays is not None:
+                metrics.GLOBAL.add("checkpoints_loaded")
+                trace.event(
+                    "checkpoint_load", step=step,
+                    file=self._step_path(step).name, source="scan_back",
+                )
                 return step, arrays, {}
         return None

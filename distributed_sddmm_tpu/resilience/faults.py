@@ -128,7 +128,12 @@ class FaultPlan:
 
     def fires(self, site: str) -> list[FaultSpec]:
         """Advance ``site``'s call counter and return the specs that fire
-        on this call (deterministic; thread-safe)."""
+        on this call (deterministic; thread-safe). Every firing is
+        observable three ways: the plan's own ``events`` list (bench
+        records), the structured log, and — when tracing — a
+        ``fault_fired`` trace event plus a global counter."""
+        from distributed_sddmm_tpu.obs import log, metrics, trace
+
         with self._lock:
             n = self._counters.get(site, 0)
             self._counters[site] = n + 1
@@ -144,8 +149,9 @@ class FaultPlan:
                 fired.append(spec)
                 with self._lock:
                     self.events.append((site, spec.kind, n))
-                print(f"[faults] {spec.kind} fired at {site}#{n}",
-                      file=sys.stderr)
+                metrics.GLOBAL.add("faults_fired")
+                trace.event("fault_fired", site=site, kind=spec.kind, call=n)
+                log.warn("faults", f"{spec.kind} fired", site=site, call=n)
         return fired
 
     def call_count(self, site: str) -> int:
@@ -186,8 +192,10 @@ def active() -> Optional[FaultPlan]:
                 try:
                     _active = FaultPlan.from_spec(env)
                 except (ValueError, KeyError, OSError) as e:
-                    print(f"[faults] ignoring malformed DSDDMM_FAULTS: {e}",
-                          file=sys.stderr)
+                    from distributed_sddmm_tpu.obs import log
+
+                    log.warn("faults", "ignoring malformed DSDDMM_FAULTS",
+                             error=str(e))
             _env_checked = True
     return _active
 

@@ -17,7 +17,6 @@ a damped one).
 from __future__ import annotations
 
 import os
-import sys
 
 from distributed_sddmm_tpu.resilience import faults
 
@@ -88,7 +87,11 @@ def guard_output(name: str, tree, mode: str | None = None):
 
         return np.nan_to_num(leaf)
 
-    print(f"[guards] repaired non-finite output of {name}", file=sys.stderr)
+    from distributed_sddmm_tpu.obs import log, metrics, trace
+
+    metrics.GLOBAL.add("guard_repairs")
+    trace.event("guard_repair", op=name)
+    log.warn("guards", "repaired non-finite output", op=name)
     return jax.tree.map(repair_leaf, tree)
 
 

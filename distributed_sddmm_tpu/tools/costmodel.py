@@ -167,6 +167,36 @@ def _cannon_sparse_words(M, N, R, nnz, p, c):
     return ring + reduce_out
 
 
+def pair_words(
+    alg: str, M: int, N: int, R: int, nnz: int, p: int, c: int,
+) -> float:
+    """Modeled per-device communication words for one fused SDDMM+SpMM
+    pair — the volume term of :func:`pair_time`, exposed on its own so
+    the observability layer's counted comm volume (strategy layout math,
+    ``obs/metrics.py``) can be checked against the analytic prediction.
+    Same conventions as the notebook models: the SpMM reduce-scatter is
+    folded out. Raises ValueError exactly as :func:`pair_time` does."""
+    return _pair_words_hops(alg, M, N, R, nnz, p, c)[0]
+
+
+def _pair_words_hops(alg, M, N, R, nnz, p, c) -> tuple[float, float]:
+    if c < 1 or p % c:
+        raise ValueError(f"c={c} must divide p={p}")
+    if alg == "15d_fusion2":
+        return _dense_shift_words(M, N, R, p, c, n_pass=1, n_repl=1), p / c - 1
+    if alg == "15d_fusion1":
+        return _dense_shift_words(M, N, R, p, c, n_pass=2, n_repl=1), 2 * (p / c - 1)
+    if alg == "15d_unfused":
+        return _dense_shift_words(M, N, R, p, c, n_pass=2, n_repl=2), 2 * (p / c - 1)
+    if alg == "15d_sparse":
+        return _sparse_shift_words(M, N, R, nnz, p, c, n_pass=1), p / c - 1
+    if alg == "25d_dense":
+        return _cannon_dense_words(M, N, R, p, c), max(_sqrtpc(p, c) // c, 1)
+    if alg == "25d_sparse":
+        return _cannon_sparse_words(M, N, R, nnz, p, c), max(_sqrtpc(p, c) // c, 1)
+    raise ValueError(f"unknown model {alg!r}")
+
+
 def pair_time(
     alg: str, M: int, N: int, R: int, nnz: int, p: int, c: int,
     machine: Machine = Machine(),
@@ -177,28 +207,7 @@ def pair_time(
     combinations the named algorithm cannot run (non-divisor c, non-square
     p/c) — callers enumerating c filter on that, exactly as the strategy
     constructors do."""
-    if c < 1 or p % c:
-        raise ValueError(f"c={c} must divide p={p}")
-    if alg == "15d_fusion2":
-        words = _dense_shift_words(M, N, R, p, c, n_pass=1, n_repl=1)
-        hops = p / c - 1
-    elif alg == "15d_fusion1":
-        words = _dense_shift_words(M, N, R, p, c, n_pass=2, n_repl=1)
-        hops = 2 * (p / c - 1)
-    elif alg == "15d_unfused":
-        words = _dense_shift_words(M, N, R, p, c, n_pass=2, n_repl=2)
-        hops = 2 * (p / c - 1)
-    elif alg == "15d_sparse":
-        words = _sparse_shift_words(M, N, R, nnz, p, c, n_pass=1)
-        hops = p / c - 1
-    elif alg == "25d_dense":
-        words = _cannon_dense_words(M, N, R, p, c)
-        hops = max(_sqrtpc(p, c) // c, 1)
-    elif alg == "25d_sparse":
-        words = _cannon_sparse_words(M, N, R, nnz, p, c)
-        hops = max(_sqrtpc(p, c) // c, 1)
-    else:
-        raise ValueError(f"unknown model {alg!r}")
+    words, hops = _pair_words_hops(alg, M, N, R, nnz, p, c)
     compute = 4.0 * nnz * R / p / machine.flops_rate
     return words / machine.ici_words_per_s + hops * machine.alpha_s + compute
 

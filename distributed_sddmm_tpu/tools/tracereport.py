@@ -1,0 +1,263 @@
+"""Trace reader, schema validator, and per-phase report.
+
+Consumes the JSONL traces ``obs/trace.py`` emits (plus the sibling
+``<run_id>.manifest.json``) and produces:
+
+* a **per-phase table** — for every span name: calls, total seconds,
+  kernel seconds vs retry/fault overhead seconds (the split
+  ``parallel/base.py::_timed`` attributes), retries, counted comm words
+  and FLOPs;
+* a **comm-volume vs cost-model comparison** — counted per-device words
+  (the strategy's own layout math, accumulated per call) against the
+  analytic prediction recomputed here from the trace's ``strategy``
+  event through ``tools/costmodel.pair_words``. Agreement is the same
+  check the source paper runs between measured and modeled volume; a
+  mismatch means either the layout math or the model drifted;
+* an **events summary** — faults fired (by kind), retries, guard
+  repairs, checkpoints, autotune trials/cache hits.
+
+CLI::
+
+    python -m distributed_sddmm_tpu.tools.tracereport TRACE.jsonl [--json]
+    python -m distributed_sddmm_tpu.bench report-trace TRACE.jsonl
+
+Validation is strict on structure (unknown ``type``, missing required
+fields, non-monotonic span bounds are errors) and lenient on content
+(unknown attrs pass through) — the contract tests and the obs smoke
+drive :func:`validate_record` over every line.
+"""
+
+from __future__ import annotations
+
+import argparse
+import collections
+import json
+import pathlib
+import sys
+
+#: Required fields per record type (schema v1, obs/trace.py).
+_REQUIRED = {
+    "begin": ("schema", "run_id", "t0_epoch"),
+    "span": ("name", "id", "tid", "t0", "t1", "dur_s", "attrs"),
+    "event": ("name", "id", "tid", "t", "attrs"),
+}
+
+SUPPORTED_SCHEMA = 1
+
+
+def validate_record(rec) -> list[str]:
+    """Structural errors in one parsed record ([] = valid)."""
+    errors = []
+    if not isinstance(rec, dict):
+        return ["record is not an object"]
+    kind = rec.get("type")
+    if kind not in _REQUIRED:
+        return [f"unknown record type {kind!r}"]
+    for field in _REQUIRED[kind]:
+        if field not in rec:
+            errors.append(f"{kind} record missing {field!r}")
+    if kind == "begin" and rec.get("schema") not in (None, SUPPORTED_SCHEMA):
+        errors.append(f"unsupported schema {rec.get('schema')!r}")
+    if kind == "span" and not errors:
+        if not isinstance(rec["attrs"], dict):
+            errors.append("span attrs is not an object")
+        if rec["t1"] < rec["t0"] or rec["dur_s"] < 0:
+            errors.append("span bounds not monotonic")
+    if kind == "event" and not isinstance(rec.get("attrs"), dict):
+        errors.append("event attrs is not an object")
+    return errors
+
+
+def load_trace(path, strict: bool = True) -> dict:
+    """Parse + validate a trace file.
+
+    Returns ``{"begin", "spans", "events", "errors"}``; raises
+    ``ValueError`` on any schema error when ``strict``.
+    """
+    begin = None
+    spans, events, errors = [], [], []
+    text = pathlib.Path(path).read_text()
+    for ln, line in enumerate(text.splitlines(), 1):
+        if not line.strip():
+            continue
+        try:
+            rec = json.loads(line)
+        except json.JSONDecodeError as e:
+            errors.append(f"line {ln}: not JSON ({e})")
+            continue
+        errs = validate_record(rec)
+        if errs:
+            errors.extend(f"line {ln}: {e}" for e in errs)
+            continue
+        if rec["type"] == "begin":
+            if begin is None:
+                begin = rec
+        elif rec["type"] == "span":
+            spans.append(rec)
+        else:
+            events.append(rec)
+    if begin is None:
+        errors.append("no begin record")
+    if strict and errors:
+        raise ValueError("invalid trace: " + "; ".join(errors[:5]))
+    return {"begin": begin, "spans": spans, "events": events, "errors": errors}
+
+
+def load_manifest(trace_path) -> dict | None:
+    """The manifest written next to ``trace_path``, or None."""
+    p = pathlib.Path(trace_path)
+    mpath = p.with_name(p.stem + ".manifest.json")
+    try:
+        rec = json.loads(mpath.read_text())
+    except (OSError, json.JSONDecodeError):
+        return None
+    return rec if isinstance(rec, dict) else None
+
+
+# --------------------------------------------------------------------- #
+# Aggregation
+# --------------------------------------------------------------------- #
+
+
+def _strategy_meta(events: list) -> dict | None:
+    """The last ``strategy`` event: the layout facts for the model
+    comparison (a trace of one bench run has exactly one)."""
+    metas = [e["attrs"] for e in events if e["name"] == "strategy"]
+    return metas[-1] if metas else None
+
+
+def _model_words_per_pair(meta: dict) -> float | None:
+    from distributed_sddmm_tpu.tools import costmodel
+
+    model = meta.get("cost_model")
+    if not model:
+        return None
+    try:
+        return costmodel.pair_words(
+            model, meta["M_pad"], meta["N_pad"], meta["R"],
+            meta["nnz"], meta["p"], meta["c"],
+        )
+    except (KeyError, ValueError):
+        return None
+
+
+def aggregate(trace: dict) -> dict:
+    """Per-phase table + model comparison + events summary, JSON-ready."""
+    from distributed_sddmm_tpu.obs import metrics as obs_metrics
+
+    phases: dict[str, dict] = {}
+    for sp in trace["spans"]:
+        a = sp["attrs"]
+        ph = phases.setdefault(sp["name"], {
+            "calls": 0, "total_s": 0.0, "kernel_s": 0.0, "overhead_s": 0.0,
+            "retries": 0, "comm_words": 0.0, "flops": 0.0, "pairs": 0.0,
+        })
+        ph["calls"] += 1
+        ph["total_s"] += sp["dur_s"]
+        ph["kernel_s"] += a.get("kernel_s", sp["dur_s"])
+        ph["overhead_s"] += a.get("overhead_s", 0.0)
+        ph["retries"] += a.get("retries", 0)
+        ph["comm_words"] += a.get("comm_words", 0.0)
+        ph["flops"] += a.get("flops", 0.0)
+        ph["pairs"] += a.get("pairs", 0.0) * (
+            obs_metrics.OP_PAIRS.get(sp["name"], 0.0)
+        )
+
+    meta = _strategy_meta(trace["events"])
+    model_pair = _model_words_per_pair(meta) if meta else None
+    for name, ph in phases.items():
+        # Model column only where the op maps onto whole fused pairs at
+        # the strategy's fingerprinted R (GAT's per-layer R drift and
+        # non-op spans get no prediction rather than a wrong one).
+        if (
+            model_pair is not None
+            and name in ("fusedSpMM", "cgStep")
+            and ph["pairs"] > 0
+        ):
+            ph["model_words"] = model_pair * ph["pairs"]
+            ph["model_ratio"] = (
+                ph["comm_words"] / ph["model_words"]
+                if ph["model_words"] else None
+            )
+
+    ev_counts = collections.Counter(e["name"] for e in trace["events"])
+    fault_kinds = collections.Counter(
+        e["attrs"].get("kind", "?")
+        for e in trace["events"] if e["name"] == "fault_fired"
+    )
+    summary = {
+        "run_id": (trace["begin"] or {}).get("run_id"),
+        "strategy": meta,
+        "phases": {k: phases[k] for k in sorted(phases)},
+        "events": dict(sorted(ev_counts.items())),
+        "faults_by_kind": dict(sorted(fault_kinds.items())),
+    }
+    return summary
+
+
+def render(report: dict) -> str:
+    """The human table: per-phase rows + events + model comparison."""
+    lines = [f"trace run_id: {report.get('run_id')}"]
+    meta = report.get("strategy")
+    if meta:
+        lines.append(
+            f"strategy: {meta.get('algorithm')} "
+            f"(model {meta.get('cost_model')}) "
+            f"M={meta.get('M')} N={meta.get('N')} R={meta.get('R')} "
+            f"nnz={meta.get('nnz')} p={meta.get('p')} c={meta.get('c')}"
+        )
+    header = (
+        f"{'phase':<18} {'calls':>6} {'total_s':>9} {'kernel_s':>9} "
+        f"{'ovh_s':>8} {'retry':>5} {'Mwords':>9} {'model':>9} {'GFLOP':>8}"
+    )
+    lines += [header, "-" * len(header)]
+    for name, ph in report["phases"].items():
+        model = ph.get("model_words")
+        lines.append(
+            f"{name:<18} {ph['calls']:>6} {ph['total_s']:>9.4f} "
+            f"{ph['kernel_s']:>9.4f} {ph['overhead_s']:>8.4f} "
+            f"{ph['retries']:>5} {ph['comm_words'] / 1e6:>9.3f} "
+            f"{(model / 1e6 if model is not None else float('nan')):>9.3f} "
+            f"{ph['flops'] / 1e9:>8.3f}"
+        )
+    if report["events"]:
+        lines.append("events: " + ", ".join(
+            f"{k}={v}" for k, v in report["events"].items()
+        ))
+    if report["faults_by_kind"]:
+        lines.append("faults by kind: " + ", ".join(
+            f"{k}={v}" for k, v in report["faults_by_kind"].items()
+        ))
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("trace", help="path to a <run_id>.jsonl trace")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the aggregate as JSON instead of a table")
+    ap.add_argument("--no-strict", action="store_true",
+                    help="tolerate (and report) malformed lines")
+    args = ap.parse_args(argv)
+
+    trace = load_trace(args.trace, strict=not args.no_strict)
+    report = aggregate(trace)
+    manifest = load_manifest(args.trace)
+    if manifest:
+        report["manifest"] = {
+            k: manifest.get(k)
+            for k in ("jax_version", "backend", "device_count",
+                      "device_kind", "git_rev")
+        }
+    if args.json:
+        print(json.dumps(report, indent=1))
+    else:
+        print(render(report))
+        if trace["errors"]:
+            print(f"({len(trace['errors'])} malformed line(s) skipped)",
+                  file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

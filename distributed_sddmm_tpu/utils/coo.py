@@ -11,7 +11,6 @@ all-to-all stays on host").
 from __future__ import annotations
 
 import dataclasses
-import sys
 
 import numpy as np
 
@@ -68,10 +67,14 @@ def sanitize_coo(
             + "; re-ingest with mode='repair' to drop/deduplicate"
         )
     if issues:
+        from distributed_sddmm_tpu.obs import log
+
         sub = np.flatnonzero(keep)[np.sort(first_idx)]
         report["dropped"] = int(rows.size - sub.size)
-        print(f"[coo] repaired ingest: dropped {report['dropped']} of "
-              f"{rows.size} entries ({issues})", file=sys.stderr)
+        log.warn(
+            "coo", "repaired ingest",
+            dropped=report["dropped"], total=int(rows.size), issues=issues,
+        )
         rows, cols, vals = rows[sub], cols[sub], vals[sub]
     return HostCOO(rows, cols, vals, M, N), report
 

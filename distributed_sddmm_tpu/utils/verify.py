@@ -14,6 +14,7 @@ from __future__ import annotations
 import numpy as np
 
 from distributed_sddmm_tpu.common import KernelMode, MatMode
+from distributed_sddmm_tpu.obs import log
 from distributed_sddmm_tpu.utils import oracle
 from distributed_sddmm_tpu.utils.coo import HostCOO
 
@@ -105,8 +106,8 @@ def verify_algorithms(
         try:
             alg = make_algorithm(name, S, R, c, kernel=kernel)
         except ValueError as e:
-            if verbose:
-                print(f"skip {name}: {e}")
+            # Diagnostic, not table output — goes to the structured log.
+            log.info("verify", f"skip {name}", reason=str(e))
             continue
         got = fingerprint_algorithm(alg, S)
         for op, v in want.items():
@@ -114,5 +115,5 @@ def verify_algorithms(
             all_ok &= bool(ok)
             if verbose:
                 flag = "OK " if ok else "FAIL"
-                print(f"{flag} {name:22s} {op:14s} got={got[op]:.6e} want={v:.6e}")
+                print(f"{flag} {name:22s} {op:14s} got={got[op]:.6e} want={v:.6e}")  # cli-output
     return all_ok

@@ -1,0 +1,55 @@
+"""Lint: no bare ``print(`` in library code.
+
+Diagnostics go through ``obs.log`` (structured, level-gated, mirrored
+into traces); only allowlisted CLI modules — whose *product* is stdout
+text — and lines explicitly tagged ``# cli-output`` may print. This is
+what keeps the structured-logging satellite from regressing one stray
+debug print at a time.
+"""
+
+import pathlib
+import re
+
+PKG = pathlib.Path(__file__).resolve().parents[1] / "distributed_sddmm_tpu"
+
+#: Modules whose stdout IS the product (argparse CLIs, table printers).
+ALLOWLIST = {
+    "bench/cli.py",        # bench subcommands print JSON records
+    "bench/kernels.py",    # kernel-sweep table printer
+    "tools/costmodel.py",  # cost-model CLI
+    "tools/charts.py",     # chart CLI
+    "tools/tracereport.py",  # trace-report CLI
+}
+
+#: A real print call: not someone_print(, not .print(, not "print(" in a
+#: string... (line-based, so a docstring mention with leading prose is
+#: fine; code examples in docstrings should use ``print`` without parens
+#: or sit in allowlisted modules).
+_PRINT_RE = re.compile(r"(?<![\w.\"'`])print\(")
+
+
+def test_no_bare_print_outside_cli_modules():
+    violations = []
+    for path in sorted(PKG.rglob("*.py")):
+        rel = path.relative_to(PKG).as_posix()
+        if rel in ALLOWLIST:
+            continue
+        in_doc = False
+        for ln, line in enumerate(path.read_text().splitlines(), 1):
+            stripped = line.strip()
+            # Cheap docstring tracking: toggle on triple quotes so prose
+            # mentioning print( does not count.
+            if stripped.count('"""') % 2 == 1:
+                in_doc = not in_doc
+                continue
+            if in_doc or stripped.startswith("#"):
+                continue
+            if "# cli-output" in line:
+                continue
+            if _PRINT_RE.search(line):
+                violations.append(f"{rel}:{ln}: {stripped[:70]}")
+    assert not violations, (
+        "bare print( in library code — use distributed_sddmm_tpu.obs.log "
+        "(or tag deliberate CLI output with '# cli-output'):\n"
+        + "\n".join(violations)
+    )

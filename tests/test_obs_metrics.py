@@ -1,0 +1,158 @@
+"""Metrics registry contract: thread safety, attribution, compat views.
+
+The satellite fix this pins: the old ``total_time`` defaultdict was
+mutated without a lock while ``resilience/retry.py`` ran calls on worker
+threads, and retry attempts double-counted into kernel time. The
+registry must (a) survive concurrent recording without losing updates,
+(b) attribute retry/backoff wall-clock to ``overhead_s`` — never
+``kernel_s`` — and (c) keep the old ``total_time`` / ``call_count`` /
+``json_perf_statistics`` read surfaces working.
+"""
+
+import threading
+
+import pytest
+
+from distributed_sddmm_tpu.obs.metrics import GLOBAL, Counters, OpMetrics, op_flops
+
+
+class TestCounters:
+    def test_add_get_snapshot_clear(self):
+        c = Counters()
+        c.add("x")
+        c.add("x", 2.5)
+        assert c.get("x") == 3.5
+        assert c.get("missing") == 0.0
+        assert c.snapshot() == {"x": 3.5}
+        c.clear()
+        assert c.snapshot() == {}
+
+    def test_concurrent_adds_lose_nothing(self):
+        c = Counters()
+        n, threads = 2000, 8
+
+        def worker():
+            for _ in range(n):
+                c.add("hits")
+
+        ts = [threading.Thread(target=worker) for _ in range(threads)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        assert c.get("hits") == n * threads
+
+
+class TestOpMetrics:
+    def test_concurrent_records_lose_nothing(self):
+        m = OpMetrics()
+        n, threads = 1000, 8
+
+        def worker():
+            for _ in range(n):
+                m.record("op", kernel_s=0.001, overhead_s=0.0005, retries=1)
+
+        ts = [threading.Thread(target=worker) for _ in range(threads)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        rec = m.to_dict()["op"]
+        assert rec["calls"] == n * threads
+        assert rec["retries"] == n * threads
+        assert rec["kernel_s"] == pytest.approx(0.001 * n * threads)
+        assert rec["overhead_s"] == pytest.approx(0.0005 * n * threads)
+
+    def test_views_default_to_zero(self):
+        m = OpMetrics()
+        m.record("a", kernel_s=1.0, overhead_s=0.5)
+        assert m.time_view()["a"] == 1.0
+        assert m.time_view()["missing"] == 0.0  # defaultdict compat
+        assert m.wall_view()["a"] == 1.5
+        assert m.calls_view()["a"] == 1
+        assert m.calls_view()["missing"] == 0
+        m.clear()
+        assert m.to_dict() == {}
+
+    def test_op_flops_convention(self):
+        assert op_flops("fusedSpMM", nnz=100, R=8) == 4.0 * 100 * 8
+        assert op_flops("sddmmA", nnz=100, R=8) == 2.0 * 100 * 8
+        assert op_flops("gatLayer", nnz=100, R=8, pairs=4) == 4.0 * 100 * 8 * 4
+        assert op_flops("unknown_op", nnz=100, R=8) == 0.0
+
+
+class TestDispatchAttribution:
+    """The _timed/_resilient_call rework, pinned through a real strategy."""
+
+    @pytest.fixture
+    def alg(self):
+        from distributed_sddmm_tpu.parallel.dense_shift_15d import DenseShift15D
+        from distributed_sddmm_tpu.utils.coo import HostCOO
+
+        S = HostCOO.rmat(log_m=6, edge_factor=8, seed=0)
+        return DenseShift15D(S, R=8, c=2)
+
+    def test_retry_overhead_not_in_kernel_time(self, alg, monkeypatch):
+        """An injected first-attempt timeout forces one retry with a
+        >=50ms backoff sleep; kernel_s must exclude it, overhead_s must
+        contain it — the double-count the old dict had."""
+        from distributed_sddmm_tpu.common import MatMode
+        from distributed_sddmm_tpu.resilience import (
+            FaultPlan, FaultSpec, fault_plan,
+        )
+
+        A = alg.dummy_initialize(MatMode.A)
+        B = alg.dummy_initialize(MatMode.B)
+        vals = alg.like_s_values(1.0)
+        # Clean timing first (also compiles the program).
+        alg.fused_spmm(A, B, vals, MatMode.A)
+        clean = alg.metrics.to_dict()["fusedSpMM"]["kernel_s"]
+        alg.reset_performance_timers()
+
+        plan = FaultPlan([
+            FaultSpec(site="execute:fusedSpMM", kind="timeout", at=(0,)),
+        ])
+        with fault_plan(plan):
+            alg.fused_spmm(A, B, vals, MatMode.A)
+        rec = alg.metrics.to_dict()["fusedSpMM"]
+        assert rec["retries"] == 1
+        # The backoff sleep (>=50ms base) lands in overhead, and kernel
+        # time stays in the same ballpark as a clean dispatch instead of
+        # absorbing the failed attempt + sleep.
+        assert rec["overhead_s"] >= 0.04
+        assert rec["kernel_s"] < clean * 20 + 1.0
+        assert rec["kernel_s"] > 0
+
+    def test_compat_surfaces(self, alg):
+        from distributed_sddmm_tpu.common import MatMode
+
+        A = alg.dummy_initialize(MatMode.A)
+        B = alg.dummy_initialize(MatMode.B)
+        alg.spmm_a(A, B, alg.like_s_values(1.0))
+        # Old read surfaces still answer.
+        assert alg.total_time["spmmA"] > 0
+        assert alg.total_time["never_ran"] == 0.0
+        assert alg.call_count["spmmA"] == 1
+        stats = alg.json_perf_statistics()
+        assert stats["spmmA"] == alg.total_time["spmmA"]
+        assert list(stats) == sorted(stats)
+        alg.reset_performance_timers()
+        assert alg.json_perf_statistics() == {}
+
+    def test_global_counters_on_retry(self, alg):
+        from distributed_sddmm_tpu.common import MatMode
+        from distributed_sddmm_tpu.resilience import (
+            FaultPlan, FaultSpec, fault_plan,
+        )
+
+        before = GLOBAL.get("exec_retries")
+        faults_before = GLOBAL.get("faults_fired")
+        plan = FaultPlan([
+            FaultSpec(site="execute:spmmA", kind="error", at=(0,)),
+        ])
+        A = alg.dummy_initialize(MatMode.A)
+        B = alg.dummy_initialize(MatMode.B)
+        with fault_plan(plan):
+            alg.spmm_a(A, B, alg.like_s_values(1.0))
+        assert GLOBAL.get("exec_retries") == before + 1
+        assert GLOBAL.get("faults_fired") == faults_before + 1
