@@ -74,8 +74,12 @@ def default_trial(
     """One short measured run through the bench harness timing path.
     Returns the harness record (``overall_throughput`` in GFLOP/s)."""
     from distributed_sddmm_tpu.bench.harness import benchmark_algorithm
+    from distributed_sddmm_tpu.obs import store as obs_store
 
-    with block_knobs(cand):
+    # A candidate trial is a probe, not a run: keep it out of the run
+    # store (it would share the real run's fingerprint key AND config
+    # axes, silently skewing the regression gate's rolling baseline).
+    with obs_store.suppressed(), block_knobs(cand):
         return benchmark_algorithm(
             S,
             cand.algorithm,

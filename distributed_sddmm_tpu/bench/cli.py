@@ -19,12 +19,30 @@ subcommands:
   (`/root/reference/test_async_strategies.cpp:14-103`)
 * ``baseline`` — external-competitor host SpMM baseline
   (`/root/reference/petsc_baseline/spmm_test.cpp:111-157`)
+
+Cross-run observability subcommands (no reference analog — the obs
+layer's store/regress/report half):
+
+* ``history``     — list the persistent run store (``obs/store.py``)
+* ``compare``     — per-phase delta table between two stored runs
+* ``gate``        — CI regression gate vs a rolling baseline
+  (exit 0 pass / 2 regression / 3 insufficient data)
+* ``backfill``    — ingest the committed round 1–5 BENCH/MULTICHIP
+  records into the store
+* ``report-html`` — self-contained HTML dashboard (``obs/report.py``)
+* ``report-trace``— per-phase aggregate of one trace file
+
+Benchmark-producing subcommands (``er``/``file``/``heatmap``) persist
+every record into the run store automatically (``--no-runstore`` opts
+out) and accept ``--watchdog warn|strict`` for in-run anomaly
+monitoring (``obs/watchdog.py``).
 """
 
 from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 
 from distributed_sddmm_tpu.bench.harness import (
@@ -205,6 +223,19 @@ def _add_common(p: argparse.ArgumentParser) -> None:
         "(TensorBoard-readable) with named annotations per compiled "
         "program (equivalent to DSDDMM_PROFILE)",
     )
+    p.add_argument(
+        "--watchdog", default=None, choices=["warn", "strict"],
+        help="in-run anomaly monitor: EWMA step-time spikes/drift, "
+        "repair storms, comm-vs-costmodel mismatch; 'warn' reports "
+        "(anomaly trace events + an 'anomalies' record field), 'strict' "
+        "escalates through the resilience ladder (equivalent to "
+        "DSDDMM_WATCHDOG)",
+    )
+    p.add_argument(
+        "--no-runstore", action="store_true",
+        help="do not persist this run into the run store "
+        "(artifacts/runstore); DSDDMM_RUNSTORE relocates or disables it",
+    )
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -280,12 +311,174 @@ def build_parser() -> argparse.ArgumentParser:
     rt = sub.add_parser(
         "report-trace",
         help="aggregate a JSONL trace into a per-phase table + comm-volume"
-        " vs cost-model comparison (tools/tracereport.py)",
+        " vs cost-model comparison (tools/tracereport.py); exits nonzero "
+        "on schema violations unless --no-strict",
     )
     rt.add_argument("trace", help="path to a <run_id>.jsonl trace")
     rt.add_argument("--json", action="store_true")
     rt.add_argument("--no-strict", action="store_true")
+
+    def _store_arg(p):
+        p.add_argument(
+            "--store", default=None, metavar="DIR",
+            help="run-store root (default artifacts/runstore, or "
+            "DSDDMM_RUNSTORE)",
+        )
+
+    hi = sub.add_parser("history", help="list the persistent run store")
+    _store_arg(hi)
+    hi.add_argument("--key", default=None,
+                    help="filter to one fingerprint key")
+    hi.add_argument("--backend", default=None)
+    hi.add_argument("--limit", type=int, default=None, metavar="N",
+                    help="newest N runs")
+    hi.add_argument("--json", action="store_true")
+
+    cp = sub.add_parser(
+        "compare",
+        help="per-phase delta table between two stored runs "
+        "(run ids, unique prefixes, or latest / latest~N)",
+    )
+    _store_arg(cp)
+    cp.add_argument("run_a")
+    cp.add_argument("run_b")
+    cp.add_argument("--threshold", type=float, default=0.15,
+                    help="relative noise band (default 0.15)")
+    cp.add_argument("--json", action="store_true")
+
+    ga = sub.add_parser(
+        "gate",
+        help="CI regression gate: compare a run against a rolling "
+        "baseline of the last K matching runs; exit 0 pass, 2 "
+        "regression, 3 insufficient baseline data",
+    )
+    _store_arg(ga)
+    ga.add_argument("run", help="run id / prefix / latest[~N] to judge")
+    ga.add_argument("--against", default=None, metavar="RUN",
+                    help="explicit baseline run instead of the rolling "
+                    "baseline")
+    ga.add_argument("--last", type=int, default=5, metavar="K",
+                    help="rolling-baseline population (default 5)")
+    ga.add_argument("--min-runs", type=int, default=1,
+                    help="fewer matching baseline runs than this exits 3")
+    ga.add_argument("--threshold", type=float, default=0.15)
+    ga.add_argument("--json", action="store_true")
+
+    bf = sub.add_parser(
+        "backfill",
+        help="ingest the committed historical records (BENCH_r0*.json, "
+        "MULTICHIP_r0*.json, artifacts/bench_midround) into the run store",
+    )
+    _store_arg(bf)
+    bf.add_argument("--root", default=None, metavar="DIR",
+                    help="repo root to scan (default: this checkout)")
+
+    rh = sub.add_parser(
+        "report-html",
+        help="self-contained HTML dashboard: run history, per-phase "
+        "trends, latest compare",
+    )
+    _store_arg(rh)
+    rh.add_argument("-o", "--output-file", default=None,
+                    help="default <store>/report.html")
+    rh.add_argument("--limit", type=int, default=100)
+    rh.add_argument("--key", default=None,
+                    help="focus fingerprint key for trends/compare "
+                    "(default: the newest run's)")
+    rh.add_argument("--threshold", type=float, default=0.15)
     return ap
+
+
+def _run_store(args):
+    from distributed_sddmm_tpu.obs import store as obs_store
+
+    if getattr(args, "store", None):
+        return obs_store.RunStore(args.store)
+    return obs_store.active() or obs_store.RunStore()
+
+
+def _resolve_run(store, spec: str):
+    try:
+        doc = store.resolve(spec)
+    except ValueError as e:  # ambiguous prefix — say so, with candidates
+        raise SystemExit(str(e))
+    if doc is None:
+        raise SystemExit(
+            f"no stored run matches {spec!r} (try 'history'; specs are "
+            "run ids, unique prefixes, or latest / latest~N)"
+        )
+    return doc
+
+
+def _dispatch_store(args) -> int:
+    """The run-store subcommands (no benchmark execution, no backend)."""
+    from distributed_sddmm_tpu.obs import regress
+
+    store = _run_store(args)
+
+    if args.cmd == "history":
+        rows = store.history(
+            key=args.key, backend=args.backend, limit=args.limit
+        )
+        if args.json:
+            print(json.dumps(rows, indent=1))
+        else:
+            print(regress.render_history(rows))  # cli-output
+        return 0
+
+    if args.cmd == "compare":
+        a = _resolve_run(store, args.run_a)
+        b = _resolve_run(store, args.run_b)
+        report = regress.compare(b, doc_a=a, threshold=args.threshold)
+        if args.json:
+            print(json.dumps(report, indent=1))
+        else:
+            print(regress.render_compare(report))  # cli-output
+        return 0
+
+    if args.cmd == "gate":
+        doc = _resolve_run(store, args.run)
+        baseline = (
+            _resolve_run(store, args.against) if args.against else None
+        )
+        code, report = regress.gate(
+            store, doc, k=args.last, threshold=args.threshold,
+            min_runs=args.min_runs, baseline_doc=baseline,
+        )
+        if args.json:
+            print(json.dumps(report, indent=1))
+        else:
+            if report.get("phases"):
+                print(regress.render_compare(report))  # cli-output
+            print(f"gate: {report['verdict']} (exit {code})")  # cli-output
+        return code
+
+    if args.cmd == "backfill":
+        from distributed_sddmm_tpu.obs.store import backfill_historical
+
+        docs = backfill_historical(store, root=args.root)
+        print(  # cli-output
+            f"backfilled {len(docs)} historical record(s) into {store.root}"
+        )
+        for d in docs:
+            print(f"  {d['run_id']:<32} <- {d.get('source')}")  # cli-output
+        return 0
+
+    if args.cmd == "report-html":
+        from distributed_sddmm_tpu.obs import report as obs_report
+
+        path = obs_report.build_html(
+            store, out_path=args.output_file, limit=args.limit,
+            key=args.key, threshold=args.threshold,
+        )
+        print(f"wrote {path}")  # cli-output
+        return 0
+
+    raise AssertionError(args.cmd)
+
+
+#: Subcommands that execute benchmarks and therefore feed the run store.
+_BENCH_CMDS = ("er", "file", "heatmap")
 
 
 def main(argv=None) -> int:
@@ -300,6 +493,33 @@ def main(argv=None) -> int:
         if args.no_strict:
             sub_argv.append("--no-strict")
         return tracereport.main(sub_argv)
+
+    if args.cmd in ("history", "compare", "gate", "backfill", "report-html"):
+        return _dispatch_store(args)
+
+    if getattr(args, "watchdog", None):
+        from distributed_sddmm_tpu.obs import watchdog as obs_watchdog
+
+        obs_watchdog.enable(args.watchdog)
+        print(f"[watchdog] {args.watchdog} mode", file=sys.stderr)
+
+    if args.cmd in _BENCH_CMDS:
+        from distributed_sddmm_tpu.obs import store as obs_store
+
+        if getattr(args, "no_runstore", False):
+            # Explicit opt-out must beat the env var: the harness's
+            # store.active() would otherwise self-activate from a
+            # non-empty DSDDMM_RUNSTORE despite the flag.
+            obs_store.disable()
+        else:
+            # Records persist into the store automatically;
+            # DSDDMM_RUNSTORE can relocate (a path) or veto (0/off)
+            # this default — one grammar, shared with store.active().
+            enabled, root = obs_store.parse_env_spec(
+                os.environ.get("DSDDMM_RUNSTORE")
+            )
+            if enabled:
+                obs_store.enable(root)
 
     if getattr(args, "faults", None):
         from distributed_sddmm_tpu.resilience import FaultPlan, faults

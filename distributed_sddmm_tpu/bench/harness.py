@@ -192,6 +192,7 @@ def benchmark_algorithm(
     tying the record to its trace + manifest.
     """
     from distributed_sddmm_tpu.obs import trace as obs_trace
+    from distributed_sddmm_tpu.obs import watchdog as obs_watchdog
     from distributed_sddmm_tpu.resilience import faults
 
     if app not in ("vanilla", "gat", "als"):
@@ -201,6 +202,9 @@ def benchmark_algorithm(
     # the faults that fired during ITS run.
     _fault_plan = faults.active()
     _events_before = len(_fault_plan.events) if _fault_plan is not None else 0
+    # Same cursor discipline for the anomaly watchdog.
+    _watchdog = obs_watchdog.active()
+    _anomalies_before = len(_watchdog.events) if _watchdog is not None else 0
     if breakdown and (app != "vanilla" or not fused):
         # Fail before any measurement: the attribution times the fusedSpMM
         # op, so injecting it into unfused or gat/als records would mix ops
@@ -286,7 +290,26 @@ def benchmark_algorithm(
             {"site": s, "kind": k, "call": n}
             for s, k, n in _fault_plan.events[_events_before:]
         ]
+    if _watchdog is not None:
+        # End-of-run anomaly summary — present (possibly empty) whenever
+        # the watchdog ran, so a clean record under monitoring is
+        # distinguishable from an unmonitored one.
+        record["anomalies"] = _watchdog.summary(since=_anomalies_before)
     if output_file:
         with open(output_file, "a") as f:
             f.write(json.dumps(record) + "\n")
+
+    from distributed_sddmm_tpu.obs import store as obs_store
+
+    run_store = obs_store.active()
+    if run_store is not None:
+        # Cross-run persistence is best-effort: a full disk or torn
+        # index must cost the history entry, never the benchmark.
+        try:
+            run_store.ingest_record(record)
+        except Exception as e:  # noqa: BLE001
+            from distributed_sddmm_tpu.obs import log as obs_log
+
+            obs_log.warn("store", "run-store ingest failed",
+                         error=f"{type(e).__name__}: {e}")
     return record

@@ -44,9 +44,12 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+import time
+
 from distributed_sddmm_tpu.common import KernelMode, MatMode
 from distributed_sddmm_tpu.obs import log as obs_log
 from distributed_sddmm_tpu.obs import trace as obs_trace
+from distributed_sddmm_tpu.obs import watchdog as obs_watchdog
 from distributed_sddmm_tpu.parallel.base import DistributedSparse
 from distributed_sddmm_tpu.resilience import faults, guards
 from distributed_sddmm_tpu.resilience.guards import CGGuard, NumericalFault
@@ -441,12 +444,28 @@ class DistributedALS:
         if self.A is None:
             self.initialize_embeddings()
         step = start
+        wd = obs_watchdog.active()
         while step < n_alternating_steps:
             faults.maybe_raise("als:step")
             try:
+                t_step = time.perf_counter()
                 with obs_trace.span("als:step", step=step):
                     self.cg_optimizer(MatMode.A, cg_iters)
                     self.cg_optimizer(MatMode.B, cg_iters)
+                if wd is not None:
+                    # Whole-step cadence on top of the per-dispatch hook:
+                    # creep across alternating steps (the long-run drift
+                    # the watchdog exists for) shows here even when each
+                    # individual cgStep stays under its own spike bar.
+                    try:
+                        wd.observe("als:step", time.perf_counter() - t_step)
+                    except obs_watchdog.WatchdogAlarm as alarm:
+                        # Strict mode: a step-cadence anomaly enters the
+                        # ladder at the divergence rung (degrade, don't
+                        # abort) — per-dispatch alarms are already
+                        # laddered inside cg_optimizer, and this hook
+                        # must not be the one path that escapes.
+                        raise CGDivergence(str(alarm)) from alarm
             except CGDivergence as e:
                 obs_log.error("als", str(e))
                 self.degrade_to_serial(n_alternating_steps - step, cg_iters)

@@ -29,6 +29,7 @@ from __future__ import annotations
 
 import dataclasses
 import math
+import time
 
 import numpy as np
 
@@ -37,6 +38,7 @@ import jax.numpy as jnp
 
 from distributed_sddmm_tpu.common import KernelMode, MatMode
 from distributed_sddmm_tpu.obs import trace as obs_trace
+from distributed_sddmm_tpu.obs import watchdog as obs_watchdog
 from distributed_sddmm_tpu.parallel.base import DistributedSparse
 from distributed_sddmm_tpu.resilience import guards
 
@@ -194,7 +196,9 @@ class GAT:
             d.set_r_value(self.layers[0].input_features)
             X = d.dummy_initialize(MatMode.A) * (1.0 / (d.M * self.layers[0].input_features))
         guarding = guards.enabled()
+        wd = obs_watchdog.active()
         for i, layer in enumerate(self.layers):
+            t_layer = time.perf_counter()
             if self._use_programs:
                 # The whole-layer program dispatches through _timed, whose
                 # resilient path already guards (and repairs) the output —
@@ -224,6 +228,20 @@ class GAT:
                     # layer) or nan_to_num-repair per DSDDMM_GUARD_MODE,
                     # never silently feed layer i+1.
                     X = guards.guard_output(f"gat:layer{i}", X)
+            if wd is not None:
+                # Whole-layer cadence: per-head dispatches are watched
+                # individually in _timed, but a layer whose heads each
+                # slow a little only crosses the spike bar in aggregate.
+                # Keyed per layer index (like the guard sentinel): layer
+                # costs are legitimately heterogeneous (width/head-count
+                # differ), and one shared EWMA would flag the expensive
+                # layer of a healthy network on every forward pass.
+                # Strict-mode alarms propagate out of forward() by
+                # design: unlike ALS (damped restart, serial oracle),
+                # GAT inference has no cheaper rung to degrade to, so
+                # the ladder's last rung — a loud typed NumericalFault —
+                # is the correct response.
+                wd.observe(f"gat:layer{i}", time.perf_counter() - t_layer)
         return X
 
     # ------------------------------------------------------------------ #

@@ -24,10 +24,31 @@ time, so platform pinning still works):
 * :mod:`~distributed_sddmm_tpu.obs.manifest` — one run manifest per
   traced run (versions, device kind, mesh, git rev, fault config).
 
-The reader/report side lives in ``tools/tracereport.py``
+The cross-run half (PR 4) closes the loop:
+
+* :mod:`~distributed_sddmm_tpu.obs.store` — persistent run store under
+  ``artifacts/runstore/`` (one doc per run, indexed by problem
+  fingerprint + code hash + backend; the bench CLI writes it
+  automatically, ``DSDDMM_RUNSTORE`` for programmatic use).
+* :mod:`~distributed_sddmm_tpu.obs.regress` — per-phase deltas between
+  runs / rolling baselines, noise-aware verdicts, the CI ``bench gate``
+  exit-code contract, roofline + comm-model attribution columns.
+* :mod:`~distributed_sddmm_tpu.obs.watchdog` — in-run anomaly monitor
+  (EWMA step-time spikes/drift, repair storms, comm-vs-costmodel
+  mismatch) via ``DSDDMM_WATCHDOG=warn|strict``; anomalies land as
+  trace events and an ``anomalies`` summary in the bench record.
+* :mod:`~distributed_sddmm_tpu.obs.report` — self-contained HTML
+  dashboard (``bench report-html``): history, trends, latest compare.
+
+The trace reader/report side lives in ``tools/tracereport.py``
 (``python -m distributed_sddmm_tpu.bench report-trace <trace.jsonl>``).
 """
 
-from distributed_sddmm_tpu.obs import log, manifest, metrics, profiler, trace
+from distributed_sddmm_tpu.obs import (
+    log, manifest, metrics, profiler, regress, report, store, trace, watchdog,
+)
 
-__all__ = ["trace", "metrics", "log", "profiler", "manifest"]
+__all__ = [
+    "trace", "metrics", "log", "profiler", "manifest",
+    "store", "regress", "watchdog", "report",
+]

@@ -10,7 +10,10 @@ Written atomically next to the trace file as
 ``<run_id>.manifest.json``. Collection is strictly best-effort and
 **never initializes a JAX backend**: device info is only read when a
 backend is already up (platform pinning in scripts/tests must keep
-working), and a missing git binary just leaves ``git_rev`` null.
+working). Git provenance tolerates detached HEADs and non-git
+checkouts: ``git_rev`` records ``"unknown"`` (never raises) when no
+revision is resolvable, and ``git_dirty`` flags uncommitted changes
+(None when unknowable).
 """
 
 from __future__ import annotations
@@ -35,26 +38,46 @@ _ENV_KEYS = (
     "DSDDMM_FAULTS", "DSDDMM_GUARDS", "DSDDMM_GUARD_MODE",
     "DSDDMM_EXEC_RETRIES", "DSDDMM_EXEC_TIMEOUT",
     "DSDDMM_PLAN_CACHE", "DSDDMM_CHECKPOINT_DIR",
+    "DSDDMM_WATCHDOG", "DSDDMM_RUNSTORE",
     "JAX_PLATFORMS", "XLA_FLAGS",
 )
 
 
-_git_rev_cache: list = []
+_git_info_cache: dict = {}
 
 
-def _git_rev() -> str | None:
-    """HEAD revision, memoized — a traced sweep refreshes the manifest
-    once per bench record and must not fork git each time."""
-    if not _git_rev_cache:
+def _git_info(cwd=None) -> dict:
+    """``{"git_rev", "git_dirty"}``, memoized per directory — a traced
+    sweep refreshes the manifest once per bench record and must not
+    fork git each time.
+
+    Never raises: a detached HEAD still resolves through ``rev-parse
+    HEAD``; a non-git checkout (tarball export, bind-mounted subdir) or
+    a missing git binary records ``git_rev: "unknown"`` with
+    ``git_dirty: None`` — an explicit "provenance unavailable" marker a
+    run-store consumer can filter on, instead of a crash or a silent
+    null that reads like a bug."""
+    cwd = pathlib.Path(cwd) if cwd is not None else _REPO
+    key = str(cwd)
+    if key not in _git_info_cache:
+        rev, dirty = "unknown", None
         try:
             out = subprocess.run(
                 ["git", "rev-parse", "HEAD"],
-                cwd=_REPO, capture_output=True, text=True, timeout=5,
+                cwd=cwd, capture_output=True, text=True, timeout=5,
             )
-            _git_rev_cache.append(out.stdout.strip() or None)
+            if out.returncode == 0 and out.stdout.strip():
+                rev = out.stdout.strip()
+                st = subprocess.run(
+                    ["git", "status", "--porcelain"],
+                    cwd=cwd, capture_output=True, text=True, timeout=5,
+                )
+                if st.returncode == 0:
+                    dirty = bool(st.stdout.strip())
         except (OSError, subprocess.SubprocessError):
-            _git_rev_cache.append(None)
-    return _git_rev_cache[0]
+            pass
+        _git_info_cache[key] = {"git_rev": rev, "git_dirty": dirty}
+    return _git_info_cache[key]
 
 
 def _jax_info() -> dict:
@@ -91,7 +114,7 @@ def build(run_id: str, extra: dict | None = None) -> dict:
         "python": sys.version.split()[0],
         "platform": sys.platform,
         "argv": sys.argv,
-        "git_rev": _git_rev(),
+        **_git_info(),
         "env": {k: os.environ[k] for k in _ENV_KEYS if k in os.environ},
     }
     m.update(_jax_info())

@@ -1,0 +1,360 @@
+"""Cross-run regression analysis: per-phase deltas, verdicts, gate.
+
+Consumes run documents from :mod:`obs.store` and answers the question
+every PR needs answered mechanically: *did this change make a phase
+slower, and if so, is it compute, comm, or overhead?*
+
+Design points:
+
+* **Per-phase, not per-run.** A run's headline GFLOP/s can hide a 2x
+  cgStep regression behind a faster warmup; the unit of comparison is
+  the phase table (trace aggregate when the run was traced, per-op
+  ``metrics`` otherwise — both normalize to the same row shape).
+* **Noise-aware verdicts.** Single-shot diffs flag noise as regression
+  and absorb regressions into noise. The comparison metric is seconds
+  per call; against a rolling baseline of the last K matching runs the
+  band is ``median * (1 ± threshold)`` widened by a robust spread
+  estimate (1.4826·MAD ≈ σ), so a machine with jittery timings widens
+  its own bands instead of tripping the gate.
+* **Roofline context.** Each row carries achieved GFLOP/s
+  (counted FLOPs / kernel seconds) and counted-vs-modeled comm words
+  (``tools/costmodel.pair_words`` through the trace aggregate), so a
+  regression is *attributed*: overhead growth (retries/faults), comm
+  drift, or compute slowdown — the first-order split the 1.5D/2.5D
+  cost-model argument needs.
+* **Machine-readable gate.** :func:`gate` returns a stable exit code —
+  0 pass, 2 regression, 3 insufficient data — and a JSON-able report;
+  CI fails on nonzero, exactly like a test.
+
+Comparability is enforced by the caller handing in documents with the
+same store index key (problem fingerprint + code hash + backend);
+:func:`compare` itself only warns when keys differ — cross-key diffs
+are legitimate for "what did this code change cost" questions.
+"""
+
+from __future__ import annotations
+
+import statistics
+
+#: Gate exit codes (stable contract for CI).
+GATE_PASS = 0
+GATE_REGRESSION = 2
+GATE_NO_DATA = 3
+
+#: Phases that exist for bookkeeping, not performance (the bench span
+#: wraps the whole run; comparing it double-counts its children).
+_SKIP_PHASES = ("bench",)
+
+
+def phase_stats(doc: dict) -> dict[str, dict]:
+    """Normalize one run document to ``{phase: row}``.
+
+    The row NAMESPACE is the bench record's per-op ``metrics`` — every
+    run (traced or not, first-of-sweep or not) carries it, so two docs
+    always compare over the same phase set; a verdict of "missing" then
+    means work actually vanished, never that one doc happened to have a
+    trace aggregate attached and the other did not. The trace aggregate
+    (``doc["phases"]``), when present, only ENRICHES matching ops with
+    the cost-model column (app-level spans like ``als:step`` stay out
+    of the comparison). Docs with no record metrics at all (synthetic /
+    trace-only) fall back to the trace aggregate wholesale. Row shape::
+
+        {calls, total_s, kernel_s, overhead_s, retries,
+         comm_words, flops, t_call, gflops, model_words?, model_ratio?}
+    """
+    trace_phases = doc.get("phases") or {}
+    metrics = (doc.get("record") or {}).get("metrics") or {}
+    if metrics:
+        phases = {}
+        for op, m in metrics.items():
+            row = {
+                "calls": m.get("calls", 0),
+                "total_s": m.get("kernel_s", 0.0) + m.get("overhead_s", 0.0),
+                "kernel_s": m.get("kernel_s", 0.0),
+                "overhead_s": m.get("overhead_s", 0.0),
+                "retries": m.get("retries", 0),
+                "comm_words": m.get("comm_words", 0.0),
+                "flops": m.get("flops", 0.0),
+            }
+            tp = trace_phases.get(op)
+            if tp and tp.get("model_words") is not None:
+                row["model_words"] = tp["model_words"]
+            phases[op] = row
+    else:
+        phases = trace_phases
+    out = {}
+    for name, ph in phases.items():
+        if name in _SKIP_PHASES:
+            continue
+        calls = ph.get("calls", 0)
+        if not calls:
+            continue
+        kernel_s = ph.get("kernel_s", 0.0)
+        row = {
+            "calls": int(calls),
+            "total_s": ph.get("total_s", kernel_s + ph.get("overhead_s", 0.0)),
+            "kernel_s": kernel_s,
+            "overhead_s": ph.get("overhead_s", 0.0),
+            "retries": int(ph.get("retries", 0)),
+            "comm_words": ph.get("comm_words", 0.0),
+            "flops": ph.get("flops", 0.0),
+        }
+        row["t_call"] = row["total_s"] / calls
+        row["gflops"] = (
+            row["flops"] / kernel_s / 1e9 if kernel_s > 0 else None
+        )
+        if ph.get("model_words") is not None:
+            row["model_words"] = ph["model_words"]
+            row["model_ratio"] = (
+                ph.get("model_ratio")
+                if ph.get("model_ratio") is not None
+                else (row["comm_words"] / ph["model_words"]
+                      if ph["model_words"] else None)
+            )
+        out[name] = row
+    return out
+
+
+def _band(t_calls: list[float], threshold: float) -> tuple[float, float, float]:
+    """(median, lo, hi) noise band for a phase's baseline seconds/call.
+
+    The relative threshold sets the floor; with >= 3 baseline runs a
+    robust spread estimate (1.4826·MAD) widens it — a noisy machine's
+    own history is the best available noise model."""
+    med = statistics.median(t_calls)
+    slack = threshold * med
+    if len(t_calls) >= 3:
+        mad = statistics.median(abs(t - med) for t in t_calls)
+        slack = max(slack, 3.0 * 1.4826 * mad)
+    return med, med - slack, med + slack
+
+
+def _attribute(base: dict, new: dict) -> str:
+    """First-order blame for a slower phase: overhead (retry/fault wall),
+    comm (counted volume or model agreement moved), or compute (the
+    kernel itself). Same altitude as the cost model — a hint for where
+    to look, not a proof."""
+    d_total = new["t_call"] - base["t_call"]
+    d_overhead = (
+        new["overhead_s"] / new["calls"] - base["overhead_s"] / base["calls"]
+    )
+    if d_total > 0 and d_overhead >= 0.5 * d_total:
+        return "overhead"
+    base_w = base["comm_words"] / base["calls"] if base["calls"] else 0.0
+    new_w = new["comm_words"] / new["calls"] if new["calls"] else 0.0
+    if base_w > 0 and abs(new_w - base_w) > 0.1 * base_w:
+        return "comm"
+    r_a, r_b = base.get("model_ratio"), new.get("model_ratio")
+    if r_a is not None and r_b is not None and abs(r_b - r_a) > 0.1:
+        return "comm"
+    return "compute"
+
+
+def compare(
+    doc_b: dict,
+    doc_a: dict | None = None,
+    baseline_docs: list[dict] | None = None,
+    threshold: float = 0.15,
+) -> dict:
+    """Per-phase comparison of run ``doc_b`` against run ``doc_a`` and/or
+    a rolling baseline.
+
+    ``baseline_docs`` (defaulting to ``[doc_a]``) supplies the
+    seconds-per-call population the noise band is computed from;
+    ``doc_a`` (defaulting to the newest baseline doc) supplies the
+    reference row shown in the delta columns. Returns a JSON-able report
+    with per-phase verdicts in {regression, improvement, ok, missing,
+    new} and an overall verdict.
+    """
+    if baseline_docs is None:
+        baseline_docs = [doc_a] if doc_a is not None else []
+    if doc_a is None:
+        if not baseline_docs:
+            raise ValueError("compare needs doc_a and/or baseline_docs")
+        doc_a = baseline_docs[-1]
+
+    stats_a = phase_stats(doc_a)
+    stats_b = phase_stats(doc_b)
+    baseline_stats = [phase_stats(d) for d in baseline_docs] or [stats_a]
+
+    phases: dict[str, dict] = {}
+    regressions, improvements, missing, new_phases = [], [], [], []
+    for name in sorted(set(stats_a) | set(stats_b)):
+        a, b = stats_a.get(name), stats_b.get(name)
+        if b is None:
+            missing.append(name)
+            phases[name] = {"a": a, "b": None, "verdict": "missing"}
+            continue
+        if a is None:
+            new_phases.append(name)
+            phases[name] = {"a": None, "b": b, "verdict": "new"}
+            continue
+        t_calls = [s[name]["t_call"] for s in baseline_stats if name in s]
+        med, lo, hi = _band(t_calls or [a["t_call"]], threshold)
+        if b["t_call"] > hi:
+            verdict = "regression"
+            regressions.append(name)
+        elif b["t_call"] < lo:
+            verdict = "improvement"
+            improvements.append(name)
+        else:
+            verdict = "ok"
+        row = {
+            "a": a,
+            "b": b,
+            "baseline_median_t_call": med,
+            "band": [lo, hi],
+            "baseline_n": len(t_calls),
+            "delta_pct": (
+                (b["t_call"] - med) / med * 100.0 if med > 0 else None
+            ),
+            "verdict": verdict,
+        }
+        if verdict == "regression":
+            base_row = dict(a)
+            base_row["t_call"] = med
+            row["attribution"] = _attribute(base_row, b)
+        phases[name] = row
+
+    overall = "ok"
+    if regressions or missing:
+        overall = "regression"
+    elif improvements:
+        overall = "improvement"
+    return {
+        "run_a": doc_a.get("run_id"),
+        "run_b": doc_b.get("run_id"),
+        "key_a": doc_a.get("key"),
+        "key_b": doc_b.get("key"),
+        "comparable": doc_a.get("key") == doc_b.get("key"),
+        "baseline_n": len(baseline_docs),
+        "threshold": threshold,
+        "phases": phases,
+        "regressions": regressions,
+        "improvements": improvements,
+        "missing": missing,
+        "new": new_phases,
+        "verdict": overall,
+    }
+
+
+def gate(
+    store,
+    doc: dict,
+    k: int = 5,
+    threshold: float = 0.15,
+    min_runs: int = 1,
+    baseline_doc: dict | None = None,
+) -> tuple[int, dict]:
+    """CI gate: compare ``doc`` against an explicit baseline run or the
+    rolling baseline of the last ``k`` store runs matching its index key
+    (same problem fingerprint, code hash, backend).
+
+    Returns ``(exit_code, report)``: 0 pass (improvements pass too),
+    2 on any phase regression or vanished phase, 3 when fewer than
+    ``min_runs`` comparable baseline runs exist (CI treats that as
+    "cannot judge", distinct from "judged bad").
+    """
+    if baseline_doc is not None:
+        baseline = [baseline_doc]
+    else:
+        baseline = store.matching(doc, limit=k)
+    if len(baseline) < max(min_runs, 1):
+        return GATE_NO_DATA, {
+            "verdict": "no_data",
+            "run_b": doc.get("run_id"),
+            "key_b": doc.get("key"),
+            "baseline_n": len(baseline),
+            "min_runs": min_runs,
+            "exit_code": GATE_NO_DATA,
+        }
+    report = compare(doc, baseline_docs=baseline, threshold=threshold)
+    code = GATE_REGRESSION if report["verdict"] == "regression" else GATE_PASS
+    report["exit_code"] = code
+    return code, report
+
+
+# --------------------------------------------------------------------- #
+# Rendering (the human half of `bench compare` / `bench gate`)
+# --------------------------------------------------------------------- #
+
+
+def _num(v, spec: str, width: int) -> str:
+    """Right-aligned number or a '-' placeholder; ``spec`` is a full
+    format spec (sign/precision/type), padded to ``width``."""
+    if v is None:
+        return " " * (width - 1) + "-"
+    return f"{format(v, spec):>{width}}"
+
+
+def render_compare(report: dict) -> str:
+    """Fixed-width per-phase delta table with comm/FLOP attribution."""
+    lines = [
+        f"compare {report.get('run_a')} -> {report.get('run_b')} "
+        f"(baseline n={report.get('baseline_n')}, "
+        f"threshold ±{report.get('threshold', 0) * 100:.0f}%)",
+    ]
+    if not report.get("comparable", True):
+        lines.append(
+            "NOTE: runs have different fingerprint keys (problem, code or "
+            "backend changed) — deltas mix causes"
+        )
+    header = (
+        f"{'phase':<16} {'calls':>5} {'t/call A':>10} {'t/call B':>10} "
+        f"{'Δ%':>7} {'GF/s A':>8} {'GF/s B':>8} {'Mw/call':>9} "
+        f"{'words/model':>11} {'verdict':>11} {'blame':>9}"
+    )
+    lines += [header, "-" * len(header)]
+    for name, row in report["phases"].items():
+        a, b = row.get("a"), row.get("b")
+        if row["verdict"] in ("missing", "new"):
+            src = a if b is None else b
+            dash = " ".join(
+                "-".rjust(w) for w in (10, 10, 7, 8, 8, 9, 11)
+            )
+            lines.append(
+                f"{name:<16} {src['calls']:>5} {dash} "
+                f"{row['verdict']:>11}"
+            )
+            continue
+        med = row.get("baseline_median_t_call")
+        mwords = b["comm_words"] / b["calls"] / 1e6 if b["calls"] else 0.0
+        lines.append(
+            f"{name:<16} {b['calls']:>5} "
+            f"{_num(med, '.6f', 10)} {_num(b['t_call'], '.6f', 10)} "
+            f"{_num(row.get('delta_pct'), '+.1f', 7)} "
+            f"{_num(a.get('gflops'), '.3f', 8)} "
+            f"{_num(b.get('gflops'), '.3f', 8)} "
+            f"{mwords:>9.3f} "
+            f"{_num(b.get('model_ratio'), '.3f', 11)} "
+            f"{row['verdict']:>11} {row.get('attribution', ''):>9}"
+        )
+    lines.append(f"verdict: {report['verdict']}")
+    if report.get("regressions"):
+        lines.append("regressions: " + ", ".join(report["regressions"]))
+    if report.get("missing"):
+        lines.append("missing phases: " + ", ".join(report["missing"]))
+    if report.get("improvements"):
+        lines.append("improvements: " + ", ".join(report["improvements"]))
+    return "\n".join(lines)
+
+
+def render_history(rows: list[dict]) -> str:
+    """``bench history`` table: one line per stored run, oldest first."""
+    header = (
+        f"{'run_id':<28} {'source':<9} {'alg':<20} {'app':<7} {'R':>5} "
+        f"{'backend':<8} {'elapsed':>9} {'GFLOP/s':>9} {'anom':>4}  key"
+    )
+    lines = [header, "-" * len(header)]
+    for r in rows:
+        lines.append(
+            f"{str(r.get('run_id'))[:28]:<28} {str(r.get('source', ''))[:9]:<9} "
+            f"{str(r.get('algorithm', '') or '-')[:20]:<20} "
+            f"{str(r.get('app', '') or '-')[:7]:<7} "
+            f"{str(r.get('R', '') or '-'):>5} "
+            f"{str(r.get('backend', '') or '-')[:8]:<8} "
+            f"{_num(r.get('elapsed'), '.3f', 9)} "
+            f"{_num(r.get('overall_throughput'), '.3f', 9)} "
+            f"{r.get('anomaly_count', 0):>4}  {str(r.get('key') or '-')[:16]}"
+        )
+    return "\n".join(lines)

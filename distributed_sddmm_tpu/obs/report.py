@@ -1,0 +1,229 @@
+"""Static HTML dashboard over the run store.
+
+``bench report-html`` renders one **self-contained** HTML file — no
+external assets, charts embedded as base64 PNGs — so it can be attached
+to a CI artifact, mailed, or opened from a scp'd checkout without a
+server. Sections:
+
+* **Run history** — every stored run (backfilled rounds included),
+  newest last, with backend, headline throughput, and anomaly counts.
+* **Per-phase trends** — seconds/call per phase and headline GFLOP/s
+  across the runs sharing the dashboard's focus fingerprint key (the
+  most recent key by default): the "did PR N bend this curve" figure.
+* **Latest compare** — the most recent run against its rolling
+  baseline, straight from :func:`obs.regress.compare`, with verdict
+  coloring and the comm/FLOP attribution columns.
+
+Chart rendering reuses ``tools/charts.py`` (matplotlib). When
+matplotlib is unavailable the dashboard degrades to tables only — the
+numbers, not the pictures, are the contract.
+"""
+
+from __future__ import annotations
+
+import base64
+import html
+import io
+import pathlib
+import time
+
+from distributed_sddmm_tpu.obs import regress
+
+_CSS = """
+body { font-family: -apple-system, 'Segoe UI', Roboto, sans-serif;
+       margin: 2em auto; max-width: 72em; color: #222; }
+h1, h2 { font-weight: 600; }
+h1 { font-size: 1.4em; } h2 { font-size: 1.1em; margin-top: 2em; }
+table { border-collapse: collapse; font-size: 0.82em; width: 100%; }
+th, td { padding: 3px 8px; text-align: right; border-bottom: 1px solid #eee; }
+th { background: #f6f6f6; position: sticky; top: 0; }
+td.l, th.l { text-align: left; font-family: ui-monospace, monospace; }
+tr.regression td { background: #fdecea; }
+tr.improvement td { background: #eaf7ed; }
+tr.missing td, tr.new td { background: #fff8e1; }
+.meta { color: #777; font-size: 0.8em; }
+.verdict-ok { color: #1a7f37; font-weight: 600; }
+.verdict-regression { color: #c0392b; font-weight: 600; }
+.verdict-improvement { color: #1a7f37; font-weight: 600; }
+.verdict-no_data { color: #b8860b; font-weight: 600; }
+img { max-width: 100%; }
+"""
+
+
+def _esc(v) -> str:
+    return html.escape("-" if v is None else str(v))
+
+
+def _fmt(v, nd: int = 3) -> str:
+    if v is None:
+        return "-"
+    if isinstance(v, float):
+        return f"{v:.{nd}f}"
+    return str(v)
+
+
+def _chart_png(draw) -> str | None:
+    """Run ``draw(ax)`` on a fresh figure, return a data-URI PNG (None
+    when matplotlib is absent or nothing was drawn)."""
+    try:
+        import matplotlib
+
+        matplotlib.use("Agg")
+        import matplotlib.pyplot as plt
+    except ImportError:
+        return None
+    fig, ax = plt.subplots(figsize=(9.5, 4.0))
+    try:
+        if draw(ax) is False:
+            return None
+        fig.tight_layout()
+        buf = io.BytesIO()
+        fig.savefig(buf, format="png", dpi=120)
+    finally:
+        plt.close(fig)
+    return "data:image/png;base64," + base64.b64encode(buf.getvalue()).decode()
+
+
+def _history_table(rows: list[dict]) -> str:
+    cells = [
+        "<table><tr><th class=l>run_id</th><th class=l>source</th>"
+        "<th class=l>algorithm</th><th>app</th><th>R</th><th>c</th>"
+        "<th>backend</th><th>elapsed&nbsp;s</th><th>GFLOP/s</th>"
+        "<th>anomalies</th><th class=l>key</th></tr>"
+    ]
+    for r in rows:
+        anom = r.get("anomaly_count", 0)
+        style = ' class="regression"' if anom else ""
+        cells.append(
+            f"<tr{style}><td class=l>{_esc(r.get('run_id'))}</td>"
+            f"<td class=l>{_esc(r.get('source'))}</td>"
+            f"<td class=l>{_esc(r.get('algorithm'))}</td>"
+            f"<td>{_esc(r.get('app'))}</td><td>{_esc(r.get('R'))}</td>"
+            f"<td>{_esc(r.get('c'))}</td><td>{_esc(r.get('backend'))}</td>"
+            f"<td>{_fmt(r.get('elapsed'))}</td>"
+            f"<td>{_fmt(r.get('overall_throughput'))}</td>"
+            f"<td>{anom or ''}</td>"
+            f"<td class=l>{_esc((r.get('key') or '')[:16])}</td></tr>"
+        )
+    cells.append("</table>")
+    return "".join(cells)
+
+
+def _compare_table(report: dict) -> str:
+    cells = [
+        "<table><tr><th class=l>phase</th><th>calls</th>"
+        "<th>t/call base</th><th>t/call new</th><th>Δ%</th>"
+        "<th>GF/s base</th><th>GF/s new</th><th>Mwords/call</th>"
+        "<th>words/model</th><th>verdict</th><th>blame</th></tr>"
+    ]
+    for name, row in report["phases"].items():
+        v = row["verdict"]
+        a, b = row.get("a"), row.get("b")
+        if v in ("missing", "new"):
+            cells.append(
+                f'<tr class="{v}"><td class=l>{_esc(name)}</td>'
+                + "<td>-</td>" * 8
+                + f"<td>{v}</td><td></td></tr>"
+            )
+            continue
+        mwords = b["comm_words"] / b["calls"] / 1e6 if b["calls"] else 0.0
+        cells.append(
+            f'<tr class="{v if v != "ok" else ""}">'
+            f"<td class=l>{_esc(name)}</td><td>{b['calls']}</td>"
+            f"<td>{_fmt(row.get('baseline_median_t_call'), 6)}</td>"
+            f"<td>{_fmt(b['t_call'], 6)}</td>"
+            f"<td>{_fmt(row.get('delta_pct'), 1)}</td>"
+            f"<td>{_fmt(a.get('gflops'))}</td><td>{_fmt(b.get('gflops'))}</td>"
+            f"<td>{_fmt(mwords)}</td>"
+            f"<td>{_fmt(b.get('model_ratio'))}</td>"
+            f"<td>{v}</td><td>{_esc(row.get('attribution', ''))}</td></tr>"
+        )
+    cells.append("</table>")
+    return "".join(cells)
+
+
+def _trend_series(store, rows: list[dict]) -> tuple[dict, dict]:
+    """(per-phase t/call series, headline series) across ``rows``."""
+    per_phase: dict[str, list] = {}
+    headline: dict[str, list] = {"GFLOP/s": []}
+    for x, r in enumerate(rows):
+        if r.get("overall_throughput"):
+            headline["GFLOP/s"].append((x, r["overall_throughput"]))
+        doc = store.get(r["run_id"])
+        if not doc:
+            continue
+        for name, ph in regress.phase_stats(doc).items():
+            per_phase.setdefault(name, []).append((x, ph["t_call"]))
+    return per_phase, headline
+
+
+def build_html(
+    store,
+    out_path: str | pathlib.Path | None = None,
+    limit: int = 100,
+    key: str | None = None,
+    threshold: float = 0.15,
+) -> pathlib.Path:
+    """Render the dashboard; returns the written path (default
+    ``<store root>/report.html``)."""
+    from distributed_sddmm_tpu.tools import charts
+
+    out_path = pathlib.Path(out_path) if out_path else store.root / "report.html"
+    all_rows = store.history(limit=limit)
+    # Focus key for trends/compare: the most recent run's key unless
+    # pinned — trends across different problems would be meaningless.
+    if key is None:
+        for r in reversed(all_rows):
+            if r.get("key"):
+                key = r["key"]
+                break
+    focus_rows = [r for r in all_rows if key and r.get("key") == key]
+
+    sections = [
+        "<h1>distributed_sddmm_tpu run history</h1>",
+        f'<p class=meta>store: {_esc(store.root)} · generated '
+        f'{time.strftime("%Y-%m-%d %H:%M:%S")} · {len(all_rows)} runs shown'
+        f" · focus key: {_esc((key or '')[:16])}</p>",
+        "<h2>Runs</h2>", _history_table(all_rows),
+    ]
+
+    per_phase, headline = _trend_series(store, focus_rows)
+    png = _chart_png(lambda ax: charts.trend_chart(ax, per_phase))
+    if png:
+        sections += ["<h2>Per-phase seconds/call (focus key)</h2>",
+                     f'<img src="{png}" alt="per-phase trend">']
+    png = _chart_png(
+        lambda ax: charts.trend_chart(
+            ax, headline, ylabel="GFLOP/s", logy=False)
+    )
+    if png:
+        sections += ["<h2>Headline throughput (focus key)</h2>",
+                     f'<img src="{png}" alt="throughput trend">']
+
+    if len(focus_rows) >= 2:
+        newest = store.get(focus_rows[-1]["run_id"])
+        baseline = store.matching(newest, limit=5) if newest else []
+        if newest and baseline:
+            rep = regress.compare(
+                newest, baseline_docs=baseline, threshold=threshold
+            )
+            sections += [
+                f"<h2>Latest compare — verdict "
+                f'<span class="verdict-{rep["verdict"]}">'
+                f'{rep["verdict"]}</span></h2>',
+                f"<p class=meta>{_esc(rep['run_a'])} → "
+                f"{_esc(rep['run_b'])} (baseline n={rep['baseline_n']}, "
+                f"threshold ±{threshold * 100:.0f}%)</p>",
+                _compare_table(rep),
+            ]
+
+    doc = (
+        "<!doctype html><html><head><meta charset='utf-8'>"
+        "<title>distributed_sddmm_tpu runs</title>"
+        f"<style>{_CSS}</style></head><body>"
+        + "".join(sections)
+        + "</body></html>"
+    )
+    out_path.parent.mkdir(parents=True, exist_ok=True)
+    out_path.write_text(doc)
+    return out_path

@@ -1,0 +1,365 @@
+"""In-run anomaly watchdog: drift, storms, and comm-model disagreement.
+
+The store/regress half of the obs layer compares *finished* runs; this
+module watches a run **while it executes**. Three anomaly families, each
+chosen because it has bitten this repo's own rounds:
+
+* **step_time_spike / step_time_drift** — an EWMA per op at the
+  ``parallel/base.py::_timed`` choke point (and per ALS alternating
+  step / GAT layer via the app hooks). A single dispatch far above the
+  moving average is a spike (preempted chip, paging, a retry storm
+  upstream); a moving average that creeps above its own early baseline
+  is drift (the round-5 ALS dispatch-gap failure mode — each step a
+  little slower, invisible until the run ends). Mid-run jit recompiles
+  surface as spikes *by design*: on a dispatch-dominated backend a
+  retrace storm is precisely the anomaly worth catching early.
+* **repair_storm** — guard repairs + exec retries per dispatch window.
+  Individually each repair is a healed transient; a *rate* of them is a
+  persistently sick backend that retry is merely hiding.
+* **comm_mismatch** — the strategy's counted per-device comm words
+  against ``tools/costmodel.pair_words`` for its declared model (the
+  1.5D/2.5D volumes of Bharadwaj et al., arXiv:2203.07673). Layout math
+  and analytic model are maintained independently; disagreement beyond
+  tolerance means one of them drifted, and the run's accounting — the
+  paper's whole argument — can no longer be trusted.
+
+Every anomaly is recorded on the watchdog (for the end-of-run
+``anomalies`` summary the bench record carries), emitted as an
+``anomaly`` trace event when tracing, and counted in the global
+metrics. Modes (``DSDDMM_WATCHDOG`` or :func:`enable`):
+
+* ``warn`` (also ``1``/``on``) — observe and report only; numerical
+  results are untouched by construction (the watchdog only ever reads
+  timings and counters).
+* ``strict`` — additionally raise :class:`WatchdogAlarm` (a
+  :class:`~distributed_sddmm_tpu.resilience.guards.NumericalFault`)
+  after recording, which hands the anomaly to the resilience ladder:
+  ALS answers with a damped restart and ultimately the serial
+  fallback, exactly as it would a tripped output guard.
+
+Disabled (the default) every hook is one module-level ``None`` check —
+the same budget discipline as the tracer.
+"""
+
+from __future__ import annotations
+
+import os
+import statistics
+import threading
+from typing import Optional
+
+from distributed_sddmm_tpu.obs import log as obs_log
+from distributed_sddmm_tpu.obs import metrics as obs_metrics
+from distributed_sddmm_tpu.obs import trace as obs_trace
+from distributed_sddmm_tpu.resilience.guards import NumericalFault
+
+
+class WatchdogAlarm(NumericalFault):
+    """An in-run anomaly escalated under ``DSDDMM_WATCHDOG=strict`` —
+    typed as a NumericalFault so the existing degradation ladder
+    (retry / damped restart / serial fallback) owns the response."""
+
+
+class Watchdog:
+    """Anomaly state for one process-wide monitoring session."""
+
+    def __init__(
+        self,
+        mode: str = "warn",
+        spike_factor: float = 3.0,
+        min_abs_s: float = 5e-3,
+        drift_factor: float = 2.0,
+        min_samples: int = 5,
+        ewma_alpha: float = 0.2,
+        storm_window: int = 20,
+        storm_rate: float = 0.25,
+        comm_rtol: float = 0.25,
+    ):
+        if mode not in ("warn", "strict"):
+            raise ValueError(f"watchdog mode {mode!r}; expected warn|strict")
+        self.mode = mode
+        self.spike_factor = spike_factor
+        self.min_abs_s = min_abs_s
+        self.drift_factor = drift_factor
+        self.min_samples = min_samples
+        self.ewma_alpha = ewma_alpha
+        self.storm_window = storm_window
+        self.storm_rate = storm_rate
+        self.comm_rtol = comm_rtol
+
+        #: Every anomaly, in firing order (the bench harness slices this
+        #: by cursor, the same pattern as FaultPlan.events).
+        self.events: list[dict] = []
+        self._lock = threading.Lock()
+        # {op: {"count", "ewma", "warmup" (first-window samples),
+        #  "baseline" (their MEDIAN — robust: the first dispatch of a
+        #  jitted program is a compile, and a mean would fold that
+        #  outlier into "normal", blinding the detector for the rest of
+        #  a short run)}}
+        self._ops: dict[str, dict] = {}
+        self._drift_flagged: set[str] = set()
+        self._comm_checked: dict[tuple, bool] = {}
+        self._dispatches = 0
+        self._storm_mark: dict[str, float] = {}
+
+    # ------------------------------------------------------------------ #
+    # Anomaly plumbing
+    # ------------------------------------------------------------------ #
+
+    def _anomaly(self, kind: str, op: str, **attrs) -> str:
+        """Record + emit one anomaly; returns its description. Never
+        raises — strict-mode escalation happens in :meth:`_escalate`
+        AFTER every co-detected anomaly of the observation has been
+        emitted (a raise mid-emission would permanently swallow a drift
+        or storm detected on the same dispatch as a spike)."""
+        ev = {"kind": kind, "op": op, **attrs}
+        with self._lock:
+            self.events.append(ev)
+        obs_metrics.GLOBAL.add("watchdog_anomalies")
+        obs_trace.event("anomaly", kind=kind, op=op, **attrs)
+        obs_log.warn("watchdog", f"{kind} on {op}",
+                     **{k: _fmt(v) for k, v in attrs.items()})
+        return f"{kind} on {op} ({attrs})"
+
+    def _escalate(self, descriptions: list[str]) -> None:
+        if descriptions and self.mode == "strict":
+            raise WatchdogAlarm("watchdog: " + "; ".join(descriptions))
+
+    # ------------------------------------------------------------------ #
+    # Step-time EWMA (dispatch choke point + app loops)
+    # ------------------------------------------------------------------ #
+
+    def observe(self, op: str, dur_s: float) -> None:
+        """Feed one timed region (a ``_timed`` dispatch, an ALS
+        alternating step, a GAT layer). Spike/drift checks run against
+        the op's own history — cross-op scales never mix."""
+        spike = drift = None
+        with self._lock:
+            # Storm accounting first, unconditionally: it is op-
+            # independent, and skipping it on warmup dispatches would
+            # let a window boundary slide — the next boundary would
+            # then divide a multi-window repair delta by one window.
+            self._dispatches += 1
+            storm = self._storm_check_locked()
+            st = self._ops.get(op)
+            if st is None:
+                st = self._ops[op] = {
+                    "count": 0, "ewma": 0.0, "warmup": [], "baseline": 0.0,
+                }
+            if st["count"] < self.min_samples:
+                # Warmup: no spike/drift verdicts; the first window's
+                # MEDIAN defines normal (robust to the compile-on-
+                # first-dispatch outlier).
+                st["count"] += 1
+                st["warmup"].append(dur_s)
+                if st["count"] == self.min_samples:
+                    st["baseline"] = st["ewma"] = statistics.median(
+                        st["warmup"]
+                    )
+            else:
+                ewma = st["ewma"]
+                if (
+                    dur_s > self.spike_factor * ewma
+                    and dur_s - ewma > self.min_abs_s
+                ):
+                    spike = (dur_s, ewma)
+                st["ewma"] = ewma = (
+                    (1 - self.ewma_alpha) * ewma + self.ewma_alpha * dur_s
+                )
+                st["count"] += 1
+                baseline = st["baseline"]
+                if (
+                    op not in self._drift_flagged
+                    and st["count"] > 2 * self.min_samples
+                    and ewma > self.drift_factor * baseline
+                    and ewma - baseline > self.min_abs_s
+                ):
+                    self._drift_flagged.add(op)
+                    drift = (ewma, baseline)
+        # Anomaly emission (and strict-mode raising) happens outside the
+        # state lock — trace/log hooks must never run under it.
+        fired = []
+        if spike:
+            fired.append(self._anomaly(
+                "step_time_spike", op,
+                dur_s=round(spike[0], 6), ewma_s=round(spike[1], 6),
+                factor=round(spike[0] / max(spike[1], 1e-12), 2),
+            ))
+        if drift:
+            fired.append(self._anomaly(
+                "step_time_drift", op,
+                ewma_s=round(drift[0], 6), baseline_s=round(drift[1], 6),
+                factor=round(drift[0] / max(drift[1], 1e-12), 2),
+            ))
+        if storm:
+            fired.append(self._anomaly("repair_storm", "*", **storm))
+        self._escalate(fired)
+
+    def _storm_check_locked(self) -> dict | None:
+        """Every ``storm_window`` dispatches, compare the global repair/
+        retry counters against the previous mark; a rate above
+        ``storm_rate`` per dispatch is a storm."""
+        if self._dispatches % self.storm_window:
+            return None
+        snap = obs_metrics.GLOBAL.snapshot()
+        repairs = snap.get("guard_repairs", 0.0) + snap.get("exec_retries", 0.0)
+        prev = self._storm_mark.get("repairs", None)
+        self._storm_mark["repairs"] = repairs
+        if prev is None:
+            return None
+        rate = (repairs - prev) / self.storm_window
+        if rate > self.storm_rate:
+            return {
+                "repairs_in_window": repairs - prev,
+                "window": self.storm_window,
+                "rate": round(rate, 3),
+            }
+        return None
+
+    # ------------------------------------------------------------------ #
+    # Comm-volume vs cost model
+    # ------------------------------------------------------------------ #
+
+    #: Ops the analytic model predicts exactly: whole fused SDDMM+SpMM
+    #: pairs (incl. the B-mode cost aliases). Single ops (sddmmA, ...)
+    #: still pay full replication for half the flops, and GAT layers run
+    #: at per-layer R — the model column would be wrong, not the layout
+    #: math, so those are excluded here exactly as in tools/tracereport.
+    _COMM_CHECK_OPS = ("fusedSpMM", "fusedSpMMB", "cgStep", "cgStepB")
+
+    def check_comm(
+        self, strategy, op: str, counted_words: float, pairs: float = 1.0,
+    ) -> None:
+        """Counted per-device words for one call of ``op`` against the
+        analytic prediction for the strategy's declared cost model.
+        Static per (strategy geometry, op, R, pairs) — checked once per
+        key, so the per-dispatch cost after the first call is one dict
+        hit."""
+        if op not in self._COMM_CHECK_OPS:
+            return
+        model_name = getattr(strategy, "cost_model_name", None)
+        frac = obs_metrics.OP_PAIRS.get(op)
+        if model_name is None or frac is None or strategy.S_tiles is None:
+            return
+        # The full geometry belongs in the memo key: model_words depends
+        # on (M_pad, N_pad, p, c), and a c-sweep instantiates the same
+        # algorithm_name at several geometries in one process.
+        key = (
+            strategy.algorithm_name, model_name, op,
+            strategy.M_pad, strategy.N_pad, strategy.p, strategy.c,
+            strategy.R, pairs,
+        )
+        with self._lock:
+            if key in self._comm_checked:
+                return
+            self._comm_checked[key] = True
+        from distributed_sddmm_tpu.tools import costmodel
+
+        try:
+            model_words = costmodel.pair_words(
+                model_name, strategy.M_pad, strategy.N_pad, strategy.R,
+                strategy.S_tiles.nnz, strategy.p, strategy.c,
+            ) * frac * pairs
+        except ValueError:
+            return
+        if model_words <= 0:
+            if counted_words > 0:
+                self._escalate([self._anomaly(
+                    "comm_mismatch", op, counted_words=counted_words,
+                    model_words=0.0, ratio=None,
+                )])
+            return
+        ratio = counted_words / model_words
+        if abs(ratio - 1.0) > self.comm_rtol:
+            self._escalate([self._anomaly(
+                "comm_mismatch", op,
+                counted_words=counted_words,
+                model_words=model_words,
+                ratio=round(ratio, 4),
+                model=model_name,
+            )])
+
+    def observe_dispatch(
+        self, strategy, op: str, dur_s: float,
+        counted_words: float = 0.0, pairs: float = 1.0,
+        cost_op: str | None = None,
+    ) -> None:
+        """The ``_timed`` hook: step-time EWMA plus the one-time comm
+        check, in one call."""
+        self.check_comm(strategy, cost_op or op, counted_words, pairs)
+        self.observe(op, dur_s)
+
+    # ------------------------------------------------------------------ #
+    # End-of-run summary
+    # ------------------------------------------------------------------ #
+
+    def summary(self, since: int = 0) -> dict:
+        """Aggregate anomalies recorded after cursor ``since`` (the bench
+        harness snapshots ``len(events)`` per record): grouped by
+        (kind, op) with a count and the first occurrence's detail."""
+        with self._lock:
+            events = list(self.events[since:])
+        grouped: dict[tuple, dict] = {}
+        for ev in events:
+            k = (ev["kind"], ev["op"])
+            g = grouped.get(k)
+            if g is None:
+                g = grouped[k] = {
+                    "kind": ev["kind"], "op": ev["op"], "count": 0,
+                    "first": {a: v for a, v in ev.items()
+                              if a not in ("kind", "op")},
+                }
+            g["count"] += 1
+        return {
+            "mode": self.mode,
+            "total": len(events),
+            "anomalies": [grouped[k] for k in sorted(grouped)],
+        }
+
+
+def _fmt(v):
+    return round(v, 6) if isinstance(v, float) else v
+
+
+# --------------------------------------------------------------------- #
+# Module-level activation (env + CLI), tracer-style
+# --------------------------------------------------------------------- #
+
+_active: Optional[Watchdog] = None
+_env_checked = False
+_registry_lock = threading.Lock()
+
+
+def enable(mode: str = "warn", **knobs) -> Watchdog:
+    """Activate a process-wide watchdog (replaces any previous one —
+    monitoring state is per-session, not cumulative across enables)."""
+    global _active, _env_checked
+    with _registry_lock:
+        _env_checked = True
+        _active = Watchdog(mode=mode, **knobs)
+        return _active
+
+
+def disable() -> None:
+    global _active, _env_checked
+    with _registry_lock:
+        _active = None
+        _env_checked = True
+
+
+def active() -> Optional[Watchdog]:
+    """The active watchdog, activating from ``DSDDMM_WATCHDOG`` on first
+    query (``warn``/``1``/``on`` → warn, ``strict`` → strict, other /
+    unset → disabled)."""
+    global _active, _env_checked
+    if _env_checked:
+        return _active
+    with _registry_lock:
+        if not _env_checked:
+            _env_checked = True
+            spec = os.environ.get("DSDDMM_WATCHDOG", "").lower()
+            if spec in ("warn", "1", "on", "true", "yes"):
+                _active = Watchdog(mode="warn")
+            elif spec == "strict":
+                _active = Watchdog(mode="strict")
+    return _active

@@ -36,6 +36,7 @@ from distributed_sddmm_tpu.obs import log as obs_log
 from distributed_sddmm_tpu.obs import metrics as obs_metrics
 from distributed_sddmm_tpu.obs import profiler as obs_profiler
 from distributed_sddmm_tpu.obs import trace as obs_trace
+from distributed_sddmm_tpu.obs import watchdog as obs_watchdog
 from distributed_sddmm_tpu.ops.kernels import LocalKernel, XlaKernel
 from distributed_sddmm_tpu.parallel.mesh import GridSpec
 from distributed_sddmm_tpu.parallel.sharding import TileSet
@@ -423,9 +424,19 @@ class DistributedSparse(abc.ABC):
         key = (op, self.R, pairs)
         hit = self._op_cost_cache.get(key)
         if hit is None:
+            from distributed_sddmm_tpu.resilience import faults
+
             profile = self.comm_profile(op, pairs)
             words = sum(e["words"] for e in profile if e.get("in_model"))
             extra = sum(e["words"] for e in profile if not e.get("in_model"))
+            # Fault hook for comm-accounting drift: a `skew` spec at
+            # comm:<op> scales the counted words. Applied on the cache
+            # miss, so a firing sticks until the cost cache is next
+            # cleared (reset_performance_timers) — the shape of a real
+            # layout-math regression (the watchdog's comm-vs-costmodel
+            # check is what must notice). The site counter advances once
+            # per cache computation, not per dispatch.
+            words = faults.scale_value(f"comm:{op}", words)
             nnz = self.S_tiles.nnz if self.S_tiles is not None else 0
             flops = obs_metrics.op_flops(op, nnz, self.R, pairs)
             hit = self._op_cost_cache[key] = (words, extra, flops)
@@ -496,6 +507,7 @@ class DistributedSparse(abc.ABC):
 
         cost_op = _comm_op or name
         resilient = faults.active() is not None or guards.enabled()
+        wd = obs_watchdog.active()
         if not (resilient or obs_trace.enabled() or obs_profiler.active()):
             # Hot path: two clock reads + one locked counter update.
             t0 = time.perf_counter()
@@ -510,6 +522,13 @@ class DistributedSparse(abc.ABC):
                 name, kernel_s, comm_words=words, comm_words_extra=extra,
                 flops=flops,
             )
+            if wd is not None:
+                # After metrics.record: a strict-mode alarm must not lose
+                # the observation that raised it.
+                wd.observe_dispatch(
+                    self, name, kernel_s, counted_words=words,
+                    pairs=_pairs, cost_op=cost_op,
+                )
             return out
 
         self._emit_strategy_meta()
@@ -532,6 +551,14 @@ class DistributedSparse(abc.ABC):
             sp.set(
                 kernel_s=round(kernel_s, 9), overhead_s=round(overhead_s, 9),
                 retries=attempts - 1, comm_words=words, flops=flops,
+            )
+        if wd is not None:
+            # Outside the span so a strict-mode WatchdogAlarm cannot leave
+            # the span unclosed; the anomaly event still references the
+            # enclosing (app-level) span as its parent.
+            wd.observe_dispatch(
+                self, name, kernel_s, counted_words=words,
+                pairs=_pairs, cost_op=cost_op,
             )
         return out
 

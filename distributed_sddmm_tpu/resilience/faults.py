@@ -18,9 +18,17 @@ nan / inf   :func:`corrupt_outputs`  overwrite a fraction of elements
 timeout     :func:`maybe_raise`      raise :class:`InjectedTimeout`
 oom         :func:`maybe_raise`      raise :class:`InjectedOOM`
 error       :func:`maybe_raise`      raise :class:`InjectedFault`
+delay       :func:`maybe_raise`      sleep ``param`` seconds, then proceed
+            (a straggler dispatch — the obs watchdog's step-time-spike
+            quarry; shares maybe_raise so the execute-site call counter
+            still advances exactly once per dispatch)
 garble      :func:`garble_text`      flip bytes mid-payload before a write
 truncate    :func:`garble_text`      cut the payload (torn / partial write)
 kill        :func:`maybe_kill`       ``os._exit(KILL_EXIT_CODE)``
+skew        :func:`scale_value`      multiply a counted quantity by
+            ``param`` (models comm-accounting / layout-math drift at the
+            ``comm:<op>`` sites; detected by the watchdog's
+            comm-vs-costmodel check)
 ==========  =======================  ========================================
 
 Activation: ``install(plan)`` / the :func:`fault_plan` context manager, the
@@ -44,7 +52,8 @@ from typing import Optional
 #: Exit code used by ``kill`` faults, distinguishable from python crashes.
 KILL_EXIT_CODE = 17
 
-_KINDS = ("nan", "inf", "timeout", "oom", "error", "garble", "truncate", "kill")
+_KINDS = ("nan", "inf", "timeout", "oom", "error", "delay", "garble",
+          "truncate", "kill", "skew")
 
 
 class FaultError(RuntimeError):
@@ -223,7 +232,10 @@ class fault_plan:
 
 
 def maybe_raise(site: str) -> None:
-    """Raise a synthetic timeout/OOM/error if one fires at ``site``."""
+    """Raise a synthetic timeout/OOM/error — or sleep through a
+    ``delay`` straggler — if one fires at ``site``. The delay kind lives
+    here (not in its own hook) so execute-site call counters advance
+    exactly once per dispatch."""
     plan = active()
     if plan is None:
         return
@@ -234,6 +246,10 @@ def maybe_raise(site: str) -> None:
             raise InjectedOOM(f"injected OOM at {site}")
         if spec.kind == "error":
             raise InjectedFault(f"injected fault at {site}")
+        if spec.kind == "delay":
+            import time
+
+            time.sleep(max(float(spec.param), 0.0))
 
 
 def maybe_kill(site: str) -> None:
@@ -296,6 +312,20 @@ def corrupt_outputs(site: str, tree):
         salt = int(_unit_hash(plan.seed, site, spec.kind) * (1 << 31))
         leaves = [_corrupt_leaf(l, spec.kind, spec.param, salt) for l in leaves]
     return jax.tree.unflatten(treedef, leaves)
+
+
+def scale_value(site: str, value: float) -> float:
+    """Multiply ``value`` by any ``skew`` fault firing at ``site`` —
+    models the comm-accounting drift (layout math disagreeing with the
+    analytic model) the observability watchdog exists to catch. Sites
+    use the ``comm:<op>`` namespace; identity when nothing fires."""
+    plan = active()
+    if plan is None:
+        return value
+    for spec in plan.fires(site):
+        if spec.kind == "skew":
+            value = value * float(spec.param)
+    return value
 
 
 def garble_text(site: str, text: str) -> str:

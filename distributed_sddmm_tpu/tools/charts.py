@@ -205,6 +205,33 @@ def kernels_chart(records, ax, points=None) -> bool:
     return True
 
 
+def trend_chart(ax, series: dict, ylabel: str = "s/call",
+                logy: bool = True) -> bool:
+    """Per-phase trend lines over a run sequence (the run-store
+    dashboard's history figure). ``series`` maps label -> list of
+    (x, y) points; x is the run's position in history. Returns False
+    when nothing plottable was passed (axis is blanked)."""
+    plotted = False
+    for label in sorted(series):
+        pts = [(x, y) for x, y in series[label] if y is not None and y > 0]
+        if len(pts) < 2:
+            continue
+        ax.plot([p[0] for p in pts], [p[1] for p in pts],
+                marker="o", markersize=3, linewidth=1.2, label=label)
+        plotted = True
+    if not plotted:
+        ax.set_axis_off()
+        return False
+    if logy:
+        ax.set_yscale("log")
+    ax.set_xlabel("run (oldest → newest)")
+    ax.set_ylabel(ylabel)
+    ax.grid(color="#dddddd", linewidth=0.6, zorder=0)
+    ax.spines[["top", "right"]].set_visible(False)
+    ax.legend(fontsize=7, frameon=False)
+    return True
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("results", help="JSON-lines results file from the harness")

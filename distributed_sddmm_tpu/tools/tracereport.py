@@ -240,7 +240,17 @@ def main(argv=None) -> int:
                     help="tolerate (and report) malformed lines")
     args = ap.parse_args(argv)
 
-    trace = load_trace(args.trace, strict=not args.no_strict)
+    try:
+        trace = load_trace(args.trace, strict=not args.no_strict)
+    except OSError as e:
+        print(f"cannot read trace: {e}", file=sys.stderr)
+        return 2
+    except ValueError as e:
+        # Validation is a CONTRACT: a trace that fails the schema must
+        # fail the invoking pipeline, not scroll past as prose. Exit 2
+        # distinguishes "invalid trace" from argparse's usage exit.
+        print(f"invalid trace: {e}", file=sys.stderr)
+        return 2
     report = aggregate(trace)
     manifest = load_manifest(args.trace)
     if manifest:

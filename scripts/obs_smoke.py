@@ -16,7 +16,12 @@ this as a subprocess):
    strategy (the paper's measured-vs-modeled volume check).
 4. **disabled overhead** — with tracing off, the per-dispatch hook cost
    (span() + metrics bookkeeping) stays in the microsecond range, far
-   under the <2% bench budget.
+   under the <2% bench budget (best-of-N so a loaded CI machine's
+   scheduling noise cannot trip it).
+5. **regression gate** — the `bench gate` CLI judges two synthetic runs
+   in a throwaway store: a within-noise rerun passes (exit 0) and a 2x
+   slowdown fails (exit 2) — the cross-run half of the obs layer, CPU
+   only, no benchmark execution.
 
 Usage::
 
@@ -148,24 +153,93 @@ def check_comm_agreement(trace_path: str) -> dict:
     }
 
 
-def check_disabled_overhead() -> dict:
-    """The disabled-tracer hook cost per dispatch, measured directly."""
+def check_disabled_overhead(reps: int = 5) -> dict:
+    """The disabled-tracer hook cost per dispatch, measured directly.
+
+    Best-of-``reps``: the hook cost is a *capability* bound (can the
+    disabled path run this fast), so the minimum over several repeats is
+    the right statistic — a single-shot mean conflates the hooks with
+    whatever else a loaded CI machine scheduled mid-loop, which made
+    this check flaky."""
     from distributed_sddmm_tpu.obs import metrics, trace
 
     assert not trace.enabled()
     n = 20000
     om = metrics.OpMetrics()
-    t0 = time.perf_counter()
-    for _ in range(n):
-        sp = trace.span("x")  # the per-dispatch disabled-path hooks
-        om.record("x", 1e-6, comm_words=1.0, flops=1.0)
-    per_call_us = (time.perf_counter() - t0) / n * 1e6
+    samples_us = []
+    for _ in range(max(reps, 1)):
+        t0 = time.perf_counter()
+        for _ in range(n):
+            sp = trace.span("x")  # the per-dispatch disabled-path hooks
+            om.record("x", 1e-6, comm_words=1.0, flops=1.0)
+        samples_us.append((time.perf_counter() - t0) / n * 1e6)
+    per_call_us = min(samples_us)
     return {
         "name": "disabled_overhead",
         # Generous CI bound: the real budget is <2% of a bench whose
         # dispatches cost milliseconds; 50us/call would still pass that.
         "ok": bool(sp is trace.NOOP_SPAN and per_call_us < 50.0),
         "per_call_us": round(per_call_us, 3),
+        "samples_us": [round(s, 3) for s in samples_us],
+    }
+
+
+def _synth_run_doc(run_id: str, scale: float) -> dict:
+    """A minimal comparable run document: one fused-pair phase whose
+    seconds scale by ``scale`` (1.0 = baseline speed)."""
+    kernel_s = 0.100 * scale
+    return {
+        "run_id": run_id,
+        "key": "smoke-synthetic-key",
+        "backend": "cpu",
+        "code_hash": "smoke",
+        "source": "obs_smoke",
+        "record": {
+            "algorithm": "15d_fusion2", "app": "vanilla",
+            "R": 128, "c": 1, "fused": True,
+            "elapsed": kernel_s, "overall_throughput": 4.0 / kernel_s,
+            "metrics": {
+                "fusedSpMM": {
+                    "calls": 10, "kernel_s": kernel_s, "overhead_s": 0.0,
+                    "retries": 0, "comm_words": 1.0e6,
+                    "comm_words_extra": 0.0, "flops": 4.0e9,
+                },
+            },
+        },
+    }
+
+
+def check_regression_gate(tmp: str) -> dict:
+    """Drive the real `bench gate` subcommand over a throwaway store."""
+    import contextlib
+    import io
+
+    from distributed_sddmm_tpu.bench import cli
+    from distributed_sddmm_tpu.obs.store import RunStore
+
+    def gate(run_id: str, root: str) -> int:
+        # Capture the CLI's human tables: this script's own stdout is a
+        # single JSON report and must stay machine-parseable. SystemExit
+        # (unknown run) maps to its code rather than killing the smoke.
+        with contextlib.redirect_stdout(io.StringIO()):
+            try:
+                return cli.main(["gate", run_id, "--store", root])
+            except SystemExit as e:
+                return int(e.code) if isinstance(e.code, int) else 1
+
+    root = str(pathlib.Path(tmp) / "runstore")
+    store = RunStore(root)
+    store.put(_synth_run_doc("base-1", 1.00))
+    store.put(_synth_run_doc("base-2", 0.99))
+    store.put(_synth_run_doc("rerun-ok", 1.03))     # within the ±15% band
+    rc_ok = gate("rerun-ok", root)
+    store.put(_synth_run_doc("rerun-slow", 2.00))   # a 2x slowdown
+    rc_slow = gate("rerun-slow", root)
+    return {
+        "name": "regression_gate",
+        "ok": bool(rc_ok == 0 and rc_slow == 2),
+        "within_noise_exit": rc_ok,
+        "slowdown_exit": rc_slow,
     }
 
 
@@ -198,6 +272,13 @@ def main(argv=None) -> int:
         except Exception as e:  # noqa: BLE001
             checks.append({
                 "name": "disabled_overhead", "ok": False,
+                "error": f"{type(e).__name__}: {e}",
+            })
+        try:
+            checks.append(check_regression_gate(tmp))
+        except Exception as e:  # noqa: BLE001
+            checks.append({
+                "name": "regression_gate", "ok": False,
                 "error": f"{type(e).__name__}: {e}",
             })
 

@@ -10,6 +10,14 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+# Tests drive the bench CLI in-process; the run store's CLI default
+# (persist every record under artifacts/runstore) must not silt the
+# checkout — or a developer's DSDDMM_RUNSTORE-exported real store —
+# during CI, so the veto is unconditional. Tests that exercise the
+# store pass an explicit --store/root (or monkeypatch the env), which
+# bypasses it.
+os.environ["DSDDMM_RUNSTORE"] = "0"
+
 from distributed_sddmm_tpu.utils.platform import force_cpu_platform  # noqa: E402
 
 force_cpu_platform(n_devices=8, replace=True)

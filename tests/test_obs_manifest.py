@@ -1,0 +1,82 @@
+"""Manifest git-provenance contract: detached HEADs and non-git
+checkouts degrade to explicit markers, never exceptions.
+
+A manifest is written on every traced run, possibly from a tarball
+export or a CI sandbox with no ``.git`` (or no git binary at all) — the
+bench must keep running and the manifest must say *why* provenance is
+absent (``git_rev: "unknown"``) rather than crash or emit an ambiguous
+null.
+"""
+
+import subprocess
+
+from distributed_sddmm_tpu.obs import manifest
+
+
+def _fresh(monkeypatch):
+    """Clear the per-directory memo so each test measures a real probe."""
+    monkeypatch.setattr(manifest, "_git_info_cache", {})
+
+
+class TestGitInfo:
+    def test_real_checkout_resolves_rev_and_dirty_flag(self, monkeypatch):
+        _fresh(monkeypatch)
+        info = manifest._git_info()
+        assert len(info["git_rev"]) == 40  # a real sha, this repo is git
+        assert info["git_dirty"] in (True, False)
+
+    def test_non_git_directory_records_unknown(self, monkeypatch, tmp_path):
+        _fresh(monkeypatch)
+        info = manifest._git_info(cwd=tmp_path)
+        assert info == {"git_rev": "unknown", "git_dirty": None}
+
+    def test_detached_head_still_resolves(self, monkeypatch, tmp_path):
+        """rev-parse HEAD works on a detached HEAD; the manifest must
+        record the sha, not 'unknown'."""
+        _fresh(monkeypatch)
+        for cmd in (
+            ["git", "init", "-q"],
+            ["git", "-c", "user.email=t@t", "-c", "user.name=t",
+             "commit", "-q", "--allow-empty", "-m", "one"],
+            ["git", "-c", "user.email=t@t", "-c", "user.name=t",
+             "commit", "-q", "--allow-empty", "-m", "two"],
+            ["git", "checkout", "-q", "--detach", "HEAD~1"],
+        ):
+            subprocess.run(cmd, cwd=tmp_path, check=True,
+                           capture_output=True)
+        info = manifest._git_info(cwd=tmp_path)
+        assert len(info["git_rev"]) == 40
+        assert info["git_dirty"] is False
+
+    def test_missing_git_binary_never_raises(self, monkeypatch):
+        _fresh(monkeypatch)
+
+        def boom(*a, **kw):
+            raise FileNotFoundError("git not on PATH")
+
+        monkeypatch.setattr(manifest.subprocess, "run", boom)
+        info = manifest._git_info()
+        assert info == {"git_rev": "unknown", "git_dirty": None}
+
+    def test_build_carries_both_fields_and_never_raises(self, monkeypatch):
+        _fresh(monkeypatch)
+        monkeypatch.setattr(
+            manifest, "_REPO", manifest._REPO / "no-such-subdir"
+        )
+        m = manifest.build("run-x")
+        assert m["git_rev"] == "unknown"
+        assert m["git_dirty"] is None
+        assert m["run_id"] == "run-x"
+
+    def test_memoized_per_directory(self, monkeypatch, tmp_path):
+        _fresh(monkeypatch)
+        manifest._git_info(cwd=tmp_path)
+        calls = []
+        monkeypatch.setattr(
+            manifest.subprocess, "run",
+            lambda *a, **kw: calls.append(a) or (_ for _ in ()).throw(
+                AssertionError("should be memoized")
+            ),
+        )
+        assert manifest._git_info(cwd=tmp_path)["git_rev"] == "unknown"
+        assert not calls

@@ -1,0 +1,268 @@
+"""Regression verdict logic + the compare/gate/report CLI contract.
+
+Synthetic run pairs pin the four verdict regimes (clear regression,
+within-noise, improvement, missing phase), the gate's exit-code
+contract (0/2/3 — the interface CI scripts key on), the rendered
+compare table's comm/FLOP attribution columns, the report-trace
+validator's nonzero exit on schema violations, and the HTML dashboard.
+All CPU-only and free of benchmark execution: documents are built
+directly, exactly what the store would hold.
+"""
+
+import json
+
+import pytest
+
+from distributed_sddmm_tpu.bench import cli
+from distributed_sddmm_tpu.obs import regress
+from distributed_sddmm_tpu.obs.store import RunStore
+
+
+def make_doc(run_id, scale=1.0, key="key-a", phases=("fusedSpMM",),
+             overhead_s=0.0, comm_words=1000.0):
+    """One synthetic run doc; ``scale`` multiplies every phase's time."""
+    metrics = {}
+    for ph in phases:
+        metrics[ph] = {
+            "calls": 10, "kernel_s": 0.050 * scale, "overhead_s": overhead_s,
+            "retries": 0, "comm_words": comm_words,
+            "comm_words_extra": 0.0, "flops": 2.0e8,
+        }
+    return {
+        "run_id": run_id, "key": key, "backend": "cpu", "code_hash": "c0de",
+        "record": {
+            "algorithm": "15d_fusion2", "app": "vanilla", "R": 64, "c": 2,
+            "fused": True, "elapsed": 0.05 * scale,
+            "overall_throughput": 4.0 / scale, "metrics": metrics,
+        },
+    }
+
+
+class TestVerdicts:
+    def test_clear_regression(self):
+        rep = regress.compare(make_doc("b", 2.0), doc_a=make_doc("a", 1.0))
+        assert rep["verdict"] == "regression"
+        assert rep["regressions"] == ["fusedSpMM"]
+        row = rep["phases"]["fusedSpMM"]
+        assert row["verdict"] == "regression"
+        assert row["delta_pct"] == pytest.approx(100.0)
+        assert row["attribution"] == "compute"
+
+    def test_within_noise(self):
+        rep = regress.compare(make_doc("b", 1.05), doc_a=make_doc("a", 1.0))
+        assert rep["verdict"] == "ok"
+        assert rep["phases"]["fusedSpMM"]["verdict"] == "ok"
+        assert not rep["regressions"]
+
+    def test_improvement(self):
+        rep = regress.compare(make_doc("b", 0.5), doc_a=make_doc("a", 1.0))
+        assert rep["verdict"] == "improvement"
+        assert rep["improvements"] == ["fusedSpMM"]
+
+    def test_missing_phase_is_a_regression_verdict(self):
+        a = make_doc("a", 1.0, phases=("fusedSpMM", "cgStep"))
+        b = make_doc("b", 1.0, phases=("fusedSpMM",))
+        rep = regress.compare(b, doc_a=a)
+        assert rep["missing"] == ["cgStep"]
+        assert rep["verdict"] == "regression"  # a vanished phase gates
+
+    def test_new_phase_is_not_a_regression(self):
+        a = make_doc("a", 1.0, phases=("fusedSpMM",))
+        b = make_doc("b", 1.0, phases=("fusedSpMM", "cgStep"))
+        rep = regress.compare(b, doc_a=a)
+        assert rep["new"] == ["cgStep"]
+        assert rep["verdict"] == "ok"
+
+    def test_overhead_attribution(self):
+        """A slowdown living in retry/fault overhead blames overhead,
+        not compute."""
+        a = make_doc("a", 1.0)
+        b = make_doc("b", 1.0, overhead_s=1.0)  # kernel unchanged
+        rep = regress.compare(b, doc_a=a)
+        row = rep["phases"]["fusedSpMM"]
+        assert row["verdict"] == "regression"
+        assert row["attribution"] == "overhead"
+
+    def test_comm_attribution(self):
+        """Kernel slower AND counted volume moved → blame comm."""
+        a = make_doc("a", 1.0, comm_words=1000.0)
+        b = make_doc("b", 2.0, comm_words=2000.0)
+        rep = regress.compare(b, doc_a=a)
+        assert rep["phases"]["fusedSpMM"]["attribution"] == "comm"
+
+    def test_rolling_baseline_median_absorbs_one_outlier(self):
+        """One slow baseline run must not drag the band: median-of-reps,
+        not last-run diffing."""
+        baseline = [make_doc(f"b{i}", s)
+                    for i, s in enumerate([1.0, 1.02, 5.0, 0.98, 1.01])]
+        rep = regress.compare(make_doc("new", 1.04), baseline_docs=baseline)
+        assert rep["verdict"] == "ok"
+        rep = regress.compare(make_doc("new", 2.0), baseline_docs=baseline)
+        assert rep["verdict"] == "regression"
+
+    def test_key_mismatch_flagged_not_fatal(self):
+        rep = regress.compare(
+            make_doc("b", 1.0, key="key-b"), doc_a=make_doc("a", 1.0)
+        )
+        assert rep["comparable"] is False
+
+    def test_phase_stats_metrics_namespace_with_trace_enrichment(self):
+        """Rows come from record metrics (the namespace every run has);
+        the trace aggregate only donates the model column — so traced
+        and untraced runs never disagree on which phases exist."""
+        doc = make_doc("a", 1.0)
+        doc["phases"] = {
+            "fusedSpMM": {"calls": 4, "total_s": 2.0, "kernel_s": 1.8,
+                          "overhead_s": 0.2, "retries": 1,
+                          "comm_words": 50.0, "flops": 1e6, "pairs": 4.0,
+                          "model_words": 500.0},
+            "als:step": {"calls": 2, "total_s": 1.0, "kernel_s": 1.0,
+                         "overhead_s": 0.0, "retries": 0,
+                         "comm_words": 0.0, "flops": 0.0},
+        }
+        st = regress.phase_stats(doc)
+        assert "als:step" not in st  # app spans stay out of the verdict set
+        row = st["fusedSpMM"]
+        assert row["calls"] == 10          # metrics, not the trace's 4
+        assert row["t_call"] == pytest.approx(0.005)
+        # counted words from metrics vs modeled words from the trace
+        assert row["model_ratio"] == pytest.approx(1000.0 / 500.0)
+
+    def test_traced_vs_untraced_docs_compare_cleanly(self):
+        """A doc with a trace aggregate judged against one without must
+        not produce spurious 'missing' phases (verdict-source skew)."""
+        a = make_doc("a", 1.0)
+        a["phases"] = {
+            "als:step": {"calls": 2, "total_s": 1.0, "kernel_s": 1.0,
+                         "overhead_s": 0.0, "retries": 0,
+                         "comm_words": 0.0, "flops": 0.0},
+        }
+        rep = regress.compare(make_doc("b", 1.0), doc_a=a)
+        assert rep["verdict"] == "ok"
+        assert not rep["missing"] and not rep["new"]
+
+
+class TestGate:
+    def _store(self, tmp_path, scales):
+        store = RunStore(tmp_path)
+        for i, s in enumerate(scales):
+            store.put(make_doc(f"run-{i}", s))
+        return store
+
+    def test_gate_passes_within_noise(self, tmp_path):
+        store = self._store(tmp_path, [1.0, 1.01, 0.99])
+        store.put(make_doc("new", 1.05))
+        code, rep = regress.gate(store, store.get("new"))
+        assert code == regress.GATE_PASS == 0
+        assert rep["exit_code"] == 0
+
+    def test_gate_fails_on_2x_slowdown(self, tmp_path):
+        store = self._store(tmp_path, [1.0, 1.01, 0.99])
+        store.put(make_doc("new", 2.0))
+        code, rep = regress.gate(store, store.get("new"))
+        assert code == regress.GATE_REGRESSION == 2
+        assert rep["regressions"] == ["fusedSpMM"]
+
+    def test_gate_no_baseline_exits_3(self, tmp_path):
+        store = RunStore(tmp_path)
+        store.put(make_doc("only", 1.0))
+        code, rep = regress.gate(store, store.get("only"))
+        assert code == regress.GATE_NO_DATA == 3
+        assert rep["verdict"] == "no_data"
+
+    def test_gate_ignores_other_keys(self, tmp_path):
+        store = self._store(tmp_path, [1.0])
+        store.put(make_doc("foreign", 0.1, key="key-z"))
+        store.put(make_doc("new", 1.02))
+        code, _ = regress.gate(store, store.get("new"))
+        assert code == 0
+
+
+class TestCli:
+    def _seed(self, tmp_path):
+        store = RunStore(tmp_path)
+        store.put(make_doc("run-base", 1.0))
+        store.put(make_doc("run-new", 2.0))
+        return str(tmp_path)
+
+    def test_compare_prints_delta_table(self, tmp_path, capsys):
+        root = self._seed(tmp_path)
+        assert cli.main(["compare", "run-base", "run-new",
+                         "--store", root]) == 0
+        out = capsys.readouterr().out
+        # per-phase row with delta, throughput and comm columns
+        assert "fusedSpMM" in out
+        assert "+100.0" in out
+        assert "GF/s" in out and "Mw/call" in out
+        assert "regression" in out
+
+    def test_compare_json(self, tmp_path, capsys):
+        root = self._seed(tmp_path)
+        assert cli.main(["compare", "latest~1", "latest", "--store", root,
+                         "--json"]) == 0
+        rep = json.loads(capsys.readouterr().out)
+        assert rep["verdict"] == "regression"
+
+    def test_gate_exit_codes_through_cli(self, tmp_path, capsys):
+        root = self._seed(tmp_path)
+        assert cli.main(["gate", "run-new", "--store", root]) == 2
+        assert cli.main(["gate", "run-new", "--store", root,
+                         "--threshold", "2.0"]) == 0
+        capsys.readouterr()
+
+    def test_gate_unknown_run_is_usage_error(self, tmp_path):
+        with pytest.raises(SystemExit):
+            cli.main(["gate", "nope", "--store", str(tmp_path)])
+
+    def test_history_lists_runs(self, tmp_path, capsys):
+        root = self._seed(tmp_path)
+        assert cli.main(["history", "--store", root]) == 0
+        out = capsys.readouterr().out
+        assert "run-base" in out and "run-new" in out
+
+    def test_report_html_selfcontained(self, tmp_path, capsys):
+        root = self._seed(tmp_path)
+        out_file = tmp_path / "dash.html"
+        assert cli.main(["report-html", "--store", root,
+                         "-o", str(out_file)]) == 0
+        html = out_file.read_text()
+        capsys.readouterr()
+        assert html.startswith("<!doctype html>")
+        assert "run-new" in html
+        assert "fusedSpMM" in html
+        # self-contained: no external references
+        assert "http://" not in html and "https://" not in html
+        assert 'src="data:image/png;base64,' in html  # embedded chart
+
+
+class TestReportTraceExit:
+    """Satellite: the trace validator's exit code is the contract."""
+
+    def test_valid_trace_exits_zero(self, tmp_path, capsys):
+        p = tmp_path / "good.jsonl"
+        p.write_text(json.dumps({
+            "type": "begin", "schema": 1, "run_id": "r", "t0_epoch": 0.0,
+        }) + "\n")
+        assert cli.main(["report-trace", str(p)]) == 0
+        capsys.readouterr()
+
+    def test_schema_violation_exits_nonzero(self, tmp_path, capsys):
+        p = tmp_path / "bad.jsonl"
+        p.write_text('{"type": "span", "name": "x"}\n')  # missing fields
+        rc = cli.main(["report-trace", str(p)])
+        assert rc == 2
+        assert "invalid trace" in capsys.readouterr().err
+
+    def test_missing_file_exits_nonzero(self, tmp_path, capsys):
+        assert cli.main(["report-trace", str(tmp_path / "absent.jsonl")]) == 2
+        capsys.readouterr()
+
+    def test_no_strict_tolerates(self, tmp_path, capsys):
+        p = tmp_path / "mixed.jsonl"
+        p.write_text(
+            json.dumps({"type": "begin", "schema": 1, "run_id": "r",
+                        "t0_epoch": 0.0}) + "\n"
+            + "not json at all\n"
+        )
+        assert cli.main(["report-trace", str(p), "--no-strict"]) == 0
+        capsys.readouterr()

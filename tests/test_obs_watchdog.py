@@ -1,0 +1,303 @@
+"""Watchdog contract: injected anomalies are detected, warn mode never
+changes results, strict mode escalates through the resilience ladder.
+
+The injections come from the fault-plan machinery (deterministic,
+replayable), exercising the same sites production faults use:
+
+* a ``delay`` fault at an execute site makes one dispatch a straggler →
+  ``step_time_spike`` anomaly (trace event + record summary), with the
+  run's numerical output bit-identical to a clean run under
+  ``warn`` — the watchdog only reads clocks and counters;
+* a ``skew`` fault at a ``comm:`` site drifts the counted comm words
+  away from the strategy's analytic model → ``comm_mismatch``;
+* drift / repair-storm detection is pinned on the Watchdog class
+  directly with synthetic observations (no sleeps, no backend).
+"""
+
+import json
+
+import pytest
+
+from distributed_sddmm_tpu.common import MatMode
+from distributed_sddmm_tpu.obs import metrics as obs_metrics
+from distributed_sddmm_tpu.obs import trace as obs_trace
+from distributed_sddmm_tpu.obs import watchdog as obs_watchdog
+from distributed_sddmm_tpu.obs.watchdog import Watchdog, WatchdogAlarm
+from distributed_sddmm_tpu.parallel.dense_shift_15d import DenseShift15D
+from distributed_sddmm_tpu.resilience import FaultPlan, FaultSpec, fault_plan
+from distributed_sddmm_tpu.resilience.guards import NumericalFault
+from distributed_sddmm_tpu.utils.coo import HostCOO
+
+
+@pytest.fixture(autouse=True)
+def _clean_watchdog(monkeypatch):
+    monkeypatch.delenv("DSDDMM_WATCHDOG", raising=False)
+    obs_watchdog.disable()
+    yield
+    obs_watchdog.disable()
+    obs_trace.disable()
+
+
+def _problem():
+    return HostCOO.erdos_renyi(48, 32, 5, seed=0)
+
+
+def _alg(S):
+    return DenseShift15D(S, R=8, c=2, fusion_approach=2)
+
+
+def _run_fused(alg, n):
+    A = alg.dummy_initialize(MatMode.A)
+    B = alg.dummy_initialize(MatMode.B)
+    vals = alg.like_s_values(1.0)
+    out = mid = None
+    for _ in range(n):
+        out, mid = alg.fused_spmm(A, B, vals, MatMode.A)
+    return alg.fingerprint(out), alg.fingerprint(mid)
+
+
+class TestUnitDetection:
+    """Detector logic on synthetic observations — no jax, no sleeps."""
+
+    def test_spike_fires_after_warmup(self):
+        wd = Watchdog(mode="warn", min_samples=5, min_abs_s=1e-3)
+        for _ in range(5):
+            wd.observe("op", 0.010)
+        wd.observe("op", 0.100)  # 10x the moving average
+        kinds = [e["kind"] for e in wd.events]
+        assert kinds == ["step_time_spike"]
+        assert wd.events[0]["op"] == "op"
+        assert wd.events[0]["factor"] > 3
+
+    def test_no_spike_during_warmup(self):
+        wd = Watchdog(mode="warn", min_samples=5)
+        for d in (0.01, 0.5, 0.01, 0.4, 0.01):  # chaos inside warmup
+            wd.observe("op", d)
+        assert wd.events == []
+
+    def test_small_absolute_jitter_ignored(self):
+        """A 10x spike on a microsecond op is scheduler noise, not an
+        anomaly — the absolute floor gates it."""
+        wd = Watchdog(mode="warn", min_samples=5, min_abs_s=5e-3)
+        for _ in range(5):
+            wd.observe("op", 1e-5)
+        wd.observe("op", 1e-4)
+        assert wd.events == []
+
+    def test_drift_fires_once_on_creep(self):
+        wd = Watchdog(mode="warn", min_samples=5, min_abs_s=1e-3,
+                      drift_factor=2.0)
+        for _ in range(5):
+            wd.observe("op", 0.010)
+        # each step under the 3x spike bar, but the EWMA creeps past 2x
+        for _ in range(30):
+            wd.observe("op", 0.025)
+        kinds = [e["kind"] for e in wd.events]
+        assert kinds.count("step_time_drift") == 1
+        assert "step_time_spike" not in kinds
+
+    def test_ops_do_not_share_baselines(self):
+        wd = Watchdog(mode="warn", min_samples=5, min_abs_s=1e-3)
+        for _ in range(5):
+            wd.observe("fast", 0.001)
+            wd.observe("slow", 0.5)
+        wd.observe("slow", 0.5)  # normal for slow; 500x fast's scale
+        assert wd.events == []
+
+    def test_repair_storm_rate(self):
+        wd = Watchdog(mode="warn", storm_window=10, storm_rate=0.25)
+        for _ in range(10):
+            wd.observe("op", 0.01)  # first window sets the mark
+        obs_metrics.GLOBAL.add("exec_retries", 8.0)
+        for _ in range(10):
+            wd.observe("op", 0.01)
+        assert [e["kind"] for e in wd.events].count("repair_storm") == 1
+
+    def test_storm_window_boundary_inside_warmup_not_skipped(self):
+        """A window boundary landing on a warmup dispatch must still
+        advance the mark — otherwise the next boundary divides a two-
+        window repair delta by one window and a sub-threshold rate
+        false-fires."""
+        wd = Watchdog(mode="warn", storm_window=10, storm_rate=0.25,
+                      min_samples=100)  # every observation is warmup
+        obs_metrics.GLOBAL.clear()
+        for _ in range(10):
+            wd.observe("op", 0.01)  # boundary at 10: mark set in warmup
+        obs_metrics.GLOBAL.add("exec_retries", 6.0)
+        for _ in range(30):
+            wd.observe("op", 0.01)
+        # All 6 repairs land in the second window (rate 0.6 > 0.25):
+        # exactly one storm — under the old warmup-skip, zero windows
+        # were ever evaluated and nothing fired at all.
+        assert [e["kind"] for e in wd.events].count("repair_storm") == 1
+
+    def test_storm_subthreshold_rate_not_flagged_across_warmup(self):
+        """0.2 repairs/dispatch (under the 0.25 bar) must stay quiet
+        even when every boundary falls inside warmup."""
+        wd = Watchdog(mode="warn", storm_window=10, storm_rate=0.25,
+                      min_samples=100)
+        obs_metrics.GLOBAL.clear()
+        for _ in range(10):
+            wd.observe("op", 0.01)
+        for _ in range(3):  # 2 repairs per 10-dispatch window
+            obs_metrics.GLOBAL.add("exec_retries", 2.0)
+            for _ in range(10):
+                wd.observe("op", 0.01)
+        assert not [e for e in wd.events if e["kind"] == "repair_storm"]
+
+    def test_summary_groups_and_cursors(self):
+        wd = Watchdog(mode="warn", min_samples=2, min_abs_s=1e-3)
+        for _ in range(2):
+            wd.observe("op", 0.01)
+        wd.observe("op", 0.2)
+        cursor = len(wd.events)
+        wd.observe("op", 0.2)  # ewma still ~0.01-ish after one spike
+        s_all = wd.summary()
+        s_new = wd.summary(since=cursor)
+        assert s_all["total"] >= s_new["total"] >= 1
+        (g,) = [a for a in s_all["anomalies"]
+                if a["kind"] == "step_time_spike"]
+        assert g["count"] == s_all["total"]
+        assert "dur_s" in g["first"]
+
+    def test_env_activation(self, monkeypatch):
+        monkeypatch.setenv("DSDDMM_WATCHDOG", "strict")
+        monkeypatch.setattr(obs_watchdog, "_env_checked", False)
+        monkeypatch.setattr(obs_watchdog, "_active", None)
+        wd = obs_watchdog.active()
+        assert wd is not None and wd.mode == "strict"
+
+
+class TestInjectedSpike:
+    def test_delay_fault_detected_and_results_identical(self, tmp_path):
+        """The acceptance pin: an injected straggler dispatch produces a
+        step_time_spike anomaly (trace event + summary) under warn mode,
+        and the run's numerical output equals a clean run's."""
+        S = _problem()
+        want = _run_fused(_alg(S), 8)
+
+        tr = obs_trace.enable(tmp_path / "t.jsonl")
+        wd = obs_watchdog.enable("warn", min_abs_s=1e-3)
+        plan = FaultPlan([
+            FaultSpec(site="execute:fusedSpMM", kind="delay", at=(6,),
+                      param=0.3),
+        ])
+        with fault_plan(plan):
+            got = _run_fused(_alg(S), 8)
+        obs_trace.disable()
+
+        assert plan.events, "the delay fault never fired"
+        assert got == want, "warn-mode watchdog changed numerical results"
+        spikes = [e for e in wd.events if e["kind"] == "step_time_spike"]
+        assert spikes and spikes[0]["op"] == "fusedSpMM"
+        # and the anomaly reached the trace as a structured event
+        lines = [json.loads(l) for l in tr.path.read_text().splitlines()]
+        anomalies = [r for r in lines
+                     if r["type"] == "event" and r["name"] == "anomaly"]
+        assert any(a["attrs"]["kind"] == "step_time_spike"
+                   for a in anomalies)
+
+    def test_strict_mode_escalates_as_numerical_fault(self):
+        """Strict mode hands the anomaly to the resilience ladder: the
+        alarm is a NumericalFault, raised from the dispatch that
+        spiked."""
+        S = _problem()
+        obs_watchdog.enable("strict", min_abs_s=1e-3)
+        plan = FaultPlan([
+            FaultSpec(site="execute:fusedSpMM", kind="delay", at=(6,),
+                      param=0.3),
+        ])
+        with fault_plan(plan):
+            with pytest.raises(WatchdogAlarm) as exc:
+                _run_fused(_alg(S), 8)
+        assert isinstance(exc.value, NumericalFault)
+        assert "step_time_spike" in str(exc.value)
+
+    def test_strict_step_alarm_degrades_als_not_aborts(self, monkeypatch):
+        """A strict-mode alarm from the whole-step als:step hook must
+        enter the resilience ladder (degrade to the serial oracle) —
+        not escape run_cg as an unhandled exception."""
+        from distributed_sddmm_tpu.models.als import DistributedALS
+
+        S = _problem()
+        als = DistributedALS(_alg(S), S_host=S)
+        wd = obs_watchdog.enable("strict")
+
+        def step_alarm(op, dur_s):
+            if op == "als:step":
+                raise WatchdogAlarm("step_time_drift on als:step")
+
+        monkeypatch.setattr(wd, "observe", step_alarm)
+        monkeypatch.setattr(wd, "observe_dispatch", lambda *a, **k: None)
+        als.run_cg(2, cg_iters=2)  # must not raise
+        assert als.degraded == "serial"
+
+
+class TestInjectedCommMismatch:
+    def test_skew_fault_detected(self, tmp_path):
+        """A skewed comm counter (layout-math drift) disagrees with the
+        cost model and is flagged, with the measured ratio attached."""
+        S = _problem()
+        tr = obs_trace.enable(tmp_path / "t.jsonl")
+        wd = obs_watchdog.enable("warn")
+        plan = FaultPlan([
+            FaultSpec(site="comm:fusedSpMM", kind="skew", at=(0,),
+                      param=2.0),
+        ])
+        with fault_plan(plan):
+            _run_fused(_alg(S), 2)
+        obs_trace.disable()
+
+        assert plan.events, "the skew fault never fired"
+        mism = [e for e in wd.events if e["kind"] == "comm_mismatch"]
+        assert mism and mism[0]["op"] == "fusedSpMM"
+        assert mism[0]["ratio"] == pytest.approx(2.0, rel=1e-3)
+        lines = [json.loads(l) for l in tr.path.read_text().splitlines()]
+        assert any(
+            r["type"] == "event" and r["name"] == "anomaly"
+            and r["attrs"]["kind"] == "comm_mismatch" for r in lines
+        )
+
+    def test_clean_run_has_no_comm_mismatch(self):
+        """The genuine DenseShift15D layout math agrees with the model —
+        no anomaly without an injection (the check that makes the
+        injected-mismatch test meaningful)."""
+        S = _problem()
+        wd = obs_watchdog.enable("warn")
+        _run_fused(_alg(S), 2)
+        assert not [e for e in wd.events if e["kind"] == "comm_mismatch"]
+
+
+class TestBenchRecordAnomalies:
+    def test_record_carries_anomalies_summary(self):
+        """End-of-run summary lands in the bench record (scoped to this
+        record's window), empty-but-present on a clean monitored run."""
+        from distributed_sddmm_tpu.bench.harness import benchmark_algorithm
+
+        S = _problem()
+        obs_watchdog.enable("warn", min_abs_s=1e-3)
+        plan = FaultPlan([
+            FaultSpec(site="execute:fusedSpMM", kind="delay", at=(6,),
+                      param=0.3),
+        ])
+        with fault_plan(plan):
+            record = benchmark_algorithm(
+                S, "15d_fusion2", None, fused=True, R=8, c=2,
+                trials=8, warmup=0,
+            )
+        anomalies = record["anomalies"]
+        assert anomalies["mode"] == "warn"
+        kinds = {a["kind"] for a in anomalies["anomalies"]}
+        assert "step_time_spike" in kinds
+        # record remains JSON-serializable with the new field
+        json.dumps(record)
+
+    def test_unmonitored_record_has_no_anomalies_field(self):
+        from distributed_sddmm_tpu.bench.harness import benchmark_algorithm
+
+        S = _problem()
+        record = benchmark_algorithm(
+            S, "15d_fusion2", None, fused=True, R=8, c=2,
+            trials=1, warmup=0,
+        )
+        assert "anomalies" not in record
