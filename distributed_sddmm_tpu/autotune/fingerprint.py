@@ -85,6 +85,31 @@ def code_hash() -> str:
     return h.hexdigest()[:12]
 
 
+@functools.lru_cache(maxsize=1)
+def serve_code_hash() -> str:
+    """The serving analog of :func:`code_hash`: warm serving programs
+    (fold-in solve, node scoring) are shaped by ``serve/workloads.py``,
+    not by ops/ or parallel/, so the serving-program cache keys on the
+    ``serve/`` sources instead."""
+    h = hashlib.sha256()
+    for f in sorted((_PKG / "serve").glob("*.py")):
+        h.update(f.name.encode())
+        h.update(f.read_bytes())
+    return h.hexdigest()[:12]
+
+
+def serve_program_key(
+    workload: str, batch_bucket: int, inner_bucket: int, r, backend: str,
+) -> str:
+    """Cache key for one serving bucket cell — same discipline as the
+    plan-cache fingerprints (problem shape + machine + code generation),
+    owned here so the key grammar lives next to the other fingerprints."""
+    return (
+        f"serve:{workload}:b{int(batch_bucket)}:i{int(inner_bucket)}"
+        f":r{r}:{backend}:{serve_code_hash()}"
+    )
+
+
 @dataclasses.dataclass(frozen=True)
 class Fingerprint:
     """Canonical signature + stable key. ``fields`` is the exact dict the

@@ -99,11 +99,11 @@ def compile_chain_pair(step_fn, state, trials: int, device,
 def load_executable(out_dir: str | pathlib.Path, name: str, n: int, device):
     """Deserialize one saved executable onto ``device``. Raises on any
     failure — callers fall back to the jitted path."""
-    from jax.experimental import serialize_executable as se
+    from distributed_sddmm_tpu import compat
 
     serialized, in_tree, out_tree = pickle.loads(
         (pathlib.Path(out_dir) / f"{name}_{n}.pkl").read_bytes())
-    return se.deserialize_and_load(
+    return compat.deserialize_and_load(
         serialized, in_tree, out_tree, backend=device.client,
         execution_devices=[device])
 

@@ -19,6 +19,9 @@ subcommands:
   (`/root/reference/test_async_strategies.cpp:14-103`)
 * ``baseline`` — external-competitor host SpMM baseline
   (`/root/reference/petsc_baseline/spmm_test.cpp:111-157`)
+* ``serve``   — online serving load test (no reference analog): warm
+  engine + dynamic micro-batching + open-loop Poisson arrivals with
+  SLO-gated latency (``distributed_sddmm_tpu/serve/``)
 
 Cross-run observability subcommands (no reference analog — the obs
 layer's store/regress/report half):
@@ -300,6 +303,54 @@ def build_parser() -> argparse.ArgumentParser:
     bl.add_argument("--iters", type=int, default=10)
     bl.add_argument("-o", "--output-file", default=None)
 
+    sv = sub.add_parser(
+        "serve",
+        help="online serving load test: warm engine (autotune-planned "
+        "strategy), dynamic micro-batching, open-loop Poisson arrivals, "
+        "SLO-gated latency report (serve/); the record persists to the "
+        "run store so `bench gate` regresses p99/shed-rate",
+    )
+    sv.add_argument("--app", default="als", choices=["als", "gat"])
+    sv.add_argument("--log-m", type=int, default=8, help="log2 matrix side")
+    sv.add_argument("--edge-factor", type=int, default=8)
+    sv.add_argument("--R", type=int, default=16)
+    sv.add_argument("--duration", type=float, default=10.0,
+                    metavar="SECONDS", help="load-generation window")
+    sv.add_argument("--rate", type=float, default=30.0, metavar="HZ",
+                    help="offered Poisson arrival rate (requests/s)")
+    sv.add_argument("--max-batch", type=int, default=8)
+    sv.add_argument("--max-depth", type=int, default=64,
+                    help="admission bound; beyond it requests shed")
+    sv.add_argument("--max-wait-ms", type=float, default=5.0,
+                    help="micro-batch linger after the first arrival")
+    sv.add_argument("--k", type=int, default=10, help="ALS top-k size")
+    sv.add_argument("--train-steps", type=int, default=2,
+                    help="ALS warm-model alternating steps before serving")
+    sv.add_argument("--seed", type=int, default=0)
+    sv.add_argument("--oracle-every", type=int, default=8,
+                    help="oracle-check every Nth request (0 disables)")
+    sv.add_argument(
+        "--slo", default=None, metavar="SPEC",
+        help="SLO spec 'p99_ms=250,err_rate=0.01' (default DSDDMM_SLO); "
+        "violations exit 2",
+    )
+    sv.add_argument(
+        "--plan-mode", default="model", choices=["model", "auto", "measure"],
+    )
+    sv.add_argument("-o", "--output-file", default=None,
+                    help="append the JSON record here")
+    sv.add_argument(
+        "--faults", default=None, metavar="SPEC",
+        help="fault plan: JSON spec, @path, or the comma shorthand "
+        "('delay,nan' expands to probabilistic faults at the execute/"
+        "output sites); the engine must shed/degrade, never crash",
+    )
+    sv.add_argument("--trace", nargs="?", const="1", default=None,
+                    metavar="PATH")
+    sv.add_argument("--profile", default=None, metavar="LOGDIR")
+    sv.add_argument("--watchdog", default=None, choices=["warn", "strict"])
+    sv.add_argument("--no-runstore", action="store_true")
+
     vf = sub.add_parser("verify", help="fingerprint cross-check of algorithms")
     vf.add_argument("--log-m", type=int, default=8)
     vf.add_argument("--edge-factor", type=int, default=8)
@@ -478,7 +529,7 @@ def _dispatch_store(args) -> int:
 
 
 #: Subcommands that execute benchmarks and therefore feed the run store.
-_BENCH_CMDS = ("er", "file", "heatmap")
+_BENCH_CMDS = ("er", "file", "heatmap", "serve")
 
 
 def main(argv=None) -> int:
@@ -541,7 +592,131 @@ def main(argv=None) -> int:
     return _dispatch(args)
 
 
+def _dispatch_serve(args) -> int:
+    """``bench serve``: build a warm engine, drive it open-loop, report
+    + persist the serving record. Exit 0 on a clean run, 1 on any
+    incorrect (oracle-mismatched) reply, 2 on SLO violation — faults
+    and shedding are expected operating conditions, not failures."""
+    from distributed_sddmm_tpu.obs import trace as obs_trace
+    from distributed_sddmm_tpu.obs import watchdog as obs_watchdog
+    from distributed_sddmm_tpu.resilience import faults
+    from distributed_sddmm_tpu.serve import (
+        SLOSpec, build_als_engine, build_gat_engine, run_load,
+    )
+
+    S = HostCOO.rmat(log_m=args.log_m, edge_factor=args.edge_factor, seed=0)
+    slo = SLOSpec.parse(args.slo) if args.slo else SLOSpec.from_env()
+    engine_kw = dict(
+        max_batch=args.max_batch, max_depth=args.max_depth,
+        max_wait_ms=args.max_wait_ms,
+    )
+    print(f"[serve] building warm {args.app} engine "
+          f"(2^{args.log_m} matrix, R={args.R})", file=sys.stderr)
+    if args.app == "als":
+        eng = build_als_engine(
+            S, R=args.R, train_steps=args.train_steps, k=args.k,
+            plan_mode=args.plan_mode, **engine_kw,
+        )
+    else:
+        eng = build_gat_engine(
+            S, R=args.R, plan_mode=args.plan_mode, **engine_kw,
+        )
+    model = eng.workload.model
+    d_ops = model.d_ops
+    plan = getattr(model, "plan", None)
+
+    # Same cursor discipline as benchmark_algorithm: the record carries
+    # only the faults/anomalies of the SERVING window, not warmup's.
+    _fault_plan = faults.active()
+    _events_before = len(_fault_plan.events) if _fault_plan else 0
+    _watchdog = obs_watchdog.active()
+    _anomalies_before = len(_watchdog.events) if _watchdog else 0
+    d_ops.reset_performance_timers()
+
+    eng.start()  # compile-ahead warmup of the whole bucket ladder
+    try:
+        summary = run_load(
+            eng, duration_s=args.duration, rate_hz=args.rate,
+            seed=args.seed, oracle_every=args.oracle_every, slo=slo,
+        )
+    finally:
+        eng.stop()
+
+    record = {
+        "app": f"serve-{args.app}",
+        "algorithm": plan.algorithm if plan else d_ops.algorithm_name,
+        "R": args.R,
+        "c": plan.c if plan else d_ops.c,
+        "fused": True,
+        "kernel": getattr(d_ops.kernel, "name", type(d_ops.kernel).__name__),
+        "num_trials": summary["completed"],
+        "elapsed": summary["duration_s"],
+        "overall_throughput": None,
+        "alg_info": d_ops.json_algorithm_info(),
+        "metrics": d_ops.metrics.to_dict(),
+        "engine": eng.stats(),
+        "serve_config": {
+            "rate_hz": args.rate, "duration_s": args.duration,
+            "max_batch": args.max_batch, "max_depth": args.max_depth,
+            "max_wait_ms": args.max_wait_ms,
+            "batch_buckets": list(eng.batch_buckets),
+            "inner_buckets": list(eng.workload.inner_buckets),
+        },
+        **summary,
+    }
+    if plan is not None:
+        record["plan"] = plan.to_dict()
+    if obs_trace.enabled():
+        record["run_id"] = obs_trace.run_id()
+        record["trace_path"] = obs_trace.trace_path()
+        from distributed_sddmm_tpu.obs import manifest as obs_manifest
+
+        obs_manifest.write_for_trace(obs_trace.tracer())
+    if _fault_plan is not None:
+        record["faults_fired"] = [
+            {"site": s, "kind": k, "call": n}
+            for s, k, n in _fault_plan.events[_events_before:]
+        ]
+    if _watchdog is not None:
+        record["anomalies"] = _watchdog.summary(since=_anomalies_before)
+
+    print(json.dumps({
+        "app": record["app"], "algorithm": record["algorithm"],
+        "requests": summary["requests"], "completed": summary["completed"],
+        "throughput_rps": summary["throughput_rps"],
+        "latency_ms": summary["latency_ms"],
+        "batch_occupancy": summary.get("batch_occupancy"),
+        "shed_count": summary["shed_count"],
+        "degraded_count": summary["degraded_count"],
+        "oracle_checked": summary["oracle_checked"],
+        "oracle_failures": summary["oracle_failures"],
+        "slo_violations": summary["slo_violations"],
+    }))
+    if args.output_file:
+        with open(args.output_file, "a") as f:
+            f.write(json.dumps(record) + "\n")
+
+    from distributed_sddmm_tpu.obs import store as obs_store
+
+    run_store = obs_store.active()
+    if run_store is not None:
+        try:
+            doc = run_store.ingest_record(record, source="serve")
+            print(f"[serve] runstore doc {doc['run_id']}", file=sys.stderr)
+        except Exception as e:  # noqa: BLE001 — never fail the run
+            print(f"[serve] runstore ingest failed: {e}", file=sys.stderr)
+
+    if summary["oracle_failures"]:
+        return 1
+    if summary["slo_violations"]:
+        return 2
+    return 0
+
+
 def _dispatch(args) -> int:
+    if args.cmd == "serve":
+        return _dispatch_serve(args)
+
     if args.cmd == "er":
         S = HostCOO.rmat(log_m=args.log_m, edge_factor=args.edge_factor, seed=0)
         _run_configs(S, _resolve_algs(args.alg), args)

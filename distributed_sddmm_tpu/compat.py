@@ -60,3 +60,38 @@ else:
 
     def pvary(x, axes):
         return x
+
+
+def pallas_tpu_compiler_params(**kwargs):
+    """Mosaic compiler-params struct across jax generations: modern jax
+    exports ``pallas.tpu.CompilerParams``, 0.4.x calls the same struct
+    ``TPUCompilerParams`` (and very old generations take a plain dict).
+    """
+    from jax.experimental.pallas import tpu as pltpu
+
+    cls = getattr(pltpu, "CompilerParams", None) or getattr(
+        pltpu, "TPUCompilerParams", None
+    )
+    if cls is None:
+        return dict(kwargs)
+    return cls(**kwargs)
+
+
+def deserialize_and_load(serialized, in_tree, out_tree, *, backend=None,
+                         execution_devices=None):
+    """``jax.experimental.serialize_executable.deserialize_and_load``
+    across jax generations: modern jax takes ``execution_devices``;
+    0.4.x only ``backend`` (the executable's baked-in device assignment
+    applies, which is the single-device case the AOT load path uses)."""
+    import inspect
+
+    from jax.experimental import serialize_executable as se
+
+    kwargs = {"backend": backend}
+    if (
+        execution_devices is not None
+        and "execution_devices"
+        in inspect.signature(se.deserialize_and_load).parameters
+    ):
+        kwargs["execution_devices"] = execution_devices
+    return se.deserialize_and_load(serialized, in_tree, out_tree, **kwargs)

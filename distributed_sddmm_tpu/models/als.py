@@ -502,6 +502,17 @@ class DistributedALS:
         model.plan = plan
         return model
 
+    def item_factors(self) -> np.ndarray:
+        """The warm item-factor matrix (N, R) in global row order on the
+        host — what the serving fold-in endpoint scores new users
+        against (``serve/workloads.py::ALSFoldInTopK``)."""
+        if self.B is None:
+            raise ValueError(
+                "no factors yet: run initialize_embeddings()/run_cg() "
+                "or restore a checkpoint first"
+            )
+        return self.d_ops.host_b(self.B)
+
     def compute_residual(self) -> float:
         """||sddmm(A, B) - ground_truth||_2 (`als_conjugate_gradients.cpp:207-219`)."""
         d = self.d_ops

@@ -244,6 +244,19 @@ class GAT:
                 wd.observe(f"gat:layer{i}", time.perf_counter() - t_layer)
         return X
 
+    def node_embeddings(self, X: jax.Array | None = None) -> np.ndarray:
+        """Run the forward pass and return the final-layer embeddings
+        (M, output_features) in global row AND column order on the host
+        — the serving gather source (``serve/workloads.py::GATNodeScore``
+        caches this once per weight refresh). The canonical device
+        layout may be column-skewed on the dense-shift strategies; this
+        is the one place that unskews it for host consumers."""
+        d = self.d_ops
+        out = self.forward(X)
+        d.set_r_value(self.layers[-1].output_features)
+        out = d._unskew_cols(out, MatMode.A)
+        return d.host_a(out)
+
     # ------------------------------------------------------------------ #
     # Parameter checkpoints
     # ------------------------------------------------------------------ #

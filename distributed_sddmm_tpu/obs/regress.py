@@ -112,7 +112,44 @@ def phase_stats(doc: dict) -> dict[str, dict]:
                       if ph["model_words"] else None)
             )
         out[name] = row
+    out.update(_serving_rows(doc))
     return out
+
+
+def _pseudo_row(calls: int, value: float) -> dict:
+    """A phase row carrying one scalar-per-request quantity in its
+    ``t_call`` slot (seconds for latency axes, a plain rate for
+    shed_rate) — the band/verdict machinery then applies unchanged."""
+    return {
+        "calls": int(calls), "total_s": value * calls,
+        "kernel_s": value * calls, "overhead_s": 0.0, "retries": 0,
+        "comm_words": 0.0, "flops": 0.0, "t_call": value, "gflops": None,
+    }
+
+
+def _serving_rows(doc: dict) -> dict[str, dict]:
+    """The serving verdict axes (``bench serve`` records): tail latency
+    percentiles as pseudo-phases (``t_call`` = the percentile in
+    seconds) plus the shed rate. Offline records have none of these
+    fields and contribute no rows, so serving and kernel docs never
+    produce spurious "missing" verdicts against each other only when
+    the config axes differ — which the store's ``app=serve-*`` axis
+    already guarantees."""
+    rec = doc.get("record") or {}
+    lat = rec.get("latency_ms") or {}
+    requests = rec.get("requests") or 0
+    if not (requests and lat):
+        return {}
+    rows = {}
+    for pct in (50, 99):
+        v = lat.get(f"p{pct}")
+        if v is not None:
+            rows[f"serve:latency_p{pct}"] = _pseudo_row(requests, v / 1e3)
+    if rec.get("shed_rate") is not None:
+        rows["serve:shed_rate"] = _pseudo_row(
+            requests, float(rec["shed_rate"])
+        )
+    return rows
 
 
 def _band(t_calls: list[float], threshold: float) -> tuple[float, float, float]:
@@ -211,9 +248,14 @@ def compare(
             "verdict": verdict,
         }
         if verdict == "regression":
-            base_row = dict(a)
-            base_row["t_call"] = med
-            row["attribution"] = _attribute(base_row, b)
+            if name.startswith("serve:"):
+                # Serving axes carry no comm/overhead split to blame;
+                # the axis itself names what went bad.
+                row["attribution"] = "serving"
+            else:
+                base_row = dict(a)
+                base_row["t_call"] = med
+                row["attribution"] = _attribute(base_row, b)
         phases[name] = row
 
     overall = "ok"

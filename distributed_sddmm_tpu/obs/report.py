@@ -142,6 +142,26 @@ def _compare_table(report: dict) -> str:
     return "".join(cells)
 
 
+def _latency_series(store, rows: list[dict]) -> dict:
+    """Serving latency trend (ms) across every stored ``bench serve``
+    run in ``rows`` — p50/p99 plus the shed count scaled into view via
+    its own series label. Rows without ``latency_ms`` (offline runs)
+    contribute nothing, so the panel only renders when serving history
+    exists."""
+    series: dict[str, list] = {}
+    for x, r in enumerate(rows):
+        if r.get("latency_p99_ms") is None:
+            continue
+        doc = store.get(r["run_id"])
+        lat = ((doc or {}).get("record") or {}).get("latency_ms") or {}
+        for pct in ("p50", "p99"):
+            if lat.get(pct) is not None:
+                series.setdefault(f"latency {pct} (ms)", []).append(
+                    (x, lat[pct])
+                )
+    return series
+
+
 def _trend_series(store, rows: list[dict]) -> tuple[dict, dict]:
     """(per-phase t/call series, headline series) across ``rows``."""
     per_phase: dict[str, list] = {}
@@ -199,6 +219,15 @@ def build_html(
     if png:
         sections += ["<h2>Headline throughput (focus key)</h2>",
                      f'<img src="{png}" alt="throughput trend">']
+
+    lat_series = _latency_series(store, all_rows)
+    png = _chart_png(
+        lambda ax: charts.trend_chart(
+            ax, lat_series, ylabel="latency (ms)", logy=False)
+    )
+    if png:
+        sections += ["<h2>Serving latency trend (all serve runs)</h2>",
+                     f'<img src="{png}" alt="serving latency trend">']
 
     if len(focus_rows) >= 2:
         newest = store.get(focus_rows[-1]["run_id"])

@@ -53,6 +53,8 @@ _INDEX_FIELDS = (
     "run_id", "created_epoch", "key", "backend", "code_hash",
     "algorithm", "app", "R", "c", "fused", "kernel", "elapsed",
     "overall_throughput", "source", "anomaly_count",
+    # Serving records (`bench serve`) only; None elsewhere.
+    "latency_p99_ms", "shed_count",
 )
 
 #: Configuration axes (beyond the fingerprint key) two runs must share
@@ -302,6 +304,8 @@ def _index_row(doc: dict) -> dict:
         "overall_throughput": rec.get("overall_throughput"),
         "source": doc.get("source"),
         "anomaly_count": sum(a.get("count", 1) for a in anomalies),
+        "latency_p99_ms": (rec.get("latency_ms") or {}).get("p99"),
+        "shed_count": rec.get("shed_count"),
     }
     return {k: row[k] for k in _INDEX_FIELDS}
 

@@ -74,6 +74,8 @@ class Watchdog:
         storm_window: int = 20,
         storm_rate: float = 0.25,
         comm_rtol: float = 0.25,
+        queue_frac: float = 0.75,
+        queue_patience: int = 5,
     ):
         if mode not in ("warn", "strict"):
             raise ValueError(f"watchdog mode {mode!r}; expected warn|strict")
@@ -86,6 +88,10 @@ class Watchdog:
         self.storm_window = storm_window
         self.storm_rate = storm_rate
         self.comm_rtol = comm_rtol
+        self.queue_frac = queue_frac
+        self.queue_patience = queue_patience
+        self._queue_streak = 0
+        self._queue_flagged = False
 
         #: Every anomaly, in firing order (the bench harness slices this
         #: by cursor, the same pattern as FaultPlan.events).
@@ -215,6 +221,41 @@ class Watchdog:
                 "rate": round(rate, 3),
             }
         return None
+
+    # ------------------------------------------------------------------ #
+    # Queue-depth runaway (the serving layer's anomaly)
+    # ------------------------------------------------------------------ #
+
+    def observe_queue(self, depth: int, capacity: int) -> None:
+        """Feed one serving-queue depth sample (``serve/engine.py`` calls
+        this per admission). A depth that sits at or above
+        ``queue_frac * capacity`` for ``queue_patience`` consecutive
+        samples is a **queue_runaway**: arrivals persistently outpace
+        drain, so latency is already unbounded-trending and shedding is
+        imminent — the open-loop failure mode a single spike check
+        misses. One anomaly per runaway episode: the streak re-arms only
+        after depth falls back below the line."""
+        fired = None
+        with self._lock:
+            if capacity <= 0:
+                return
+            if depth >= self.queue_frac * capacity:
+                self._queue_streak += 1
+                if (
+                    not self._queue_flagged
+                    and self._queue_streak >= self.queue_patience
+                ):
+                    self._queue_flagged = True
+                    fired = (depth, self._queue_streak)
+            else:
+                self._queue_streak = 0
+                self._queue_flagged = False
+        if fired:
+            self._escalate([self._anomaly(
+                "queue_runaway", "serve",
+                depth=fired[0], capacity=capacity,
+                frac=round(fired[0] / capacity, 3), streak=fired[1],
+            )])
 
     # ------------------------------------------------------------------ #
     # Comm-volume vs cost model

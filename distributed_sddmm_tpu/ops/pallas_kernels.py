@@ -43,6 +43,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from distributed_sddmm_tpu import compat
+
 from distributed_sddmm_tpu.ops import blocked
 from distributed_sddmm_tpu.ops.blocked import (
     CHUNK, _GC_SHIFT, _GR_SHIFT, MAX_BLOCKS, unpack_meta,
@@ -389,7 +391,7 @@ def _tile_call(
         body,
         grid_spec=grid_spec,
         out_shape=out_shapes,
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=compat.pallas_tpu_compiler_params(
             dimension_semantics=("arbitrary",)
         ),
         interpret=interpret,

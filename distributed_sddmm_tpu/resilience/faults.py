@@ -55,6 +55,20 @@ KILL_EXIT_CODE = 17
 _KINDS = ("nan", "inf", "timeout", "oom", "error", "delay", "garble",
           "truncate", "kill", "skew")
 
+#: Comma-shorthand expansion (``FaultPlan.from_spec("delay,nan")``): each
+#: kind's natural site family. ``execute:*``/``output:*`` cover both the
+#: offline dispatch hooks (``parallel/base._resilient_call``) and the
+#: serving engine's ``execute:serveBatch``/``output:serveBatch`` sites.
+SHORTHAND_SITES = {
+    "nan": "output:*", "inf": "output:*",
+    "timeout": "execute:*", "oom": "execute:*", "error": "execute:*",
+    "delay": "execute:*",
+    "garble": "write:*", "truncate": "write:*",
+    "kill": "worker:*", "skew": "comm:*",
+}
+SHORTHAND_PROB = 0.1
+SHORTHAND_PARAM = {"delay": 0.05, "nan": 0.05, "inf": 0.05}
+
 
 class FaultError(RuntimeError):
     """Base class of every injected failure (never raised by real faults —
@@ -119,15 +133,27 @@ class FaultPlan:
 
     @classmethod
     def from_spec(cls, spec) -> "FaultPlan":
-        """Build from a JSON string, ``@path``, list-of-dicts, or
-        ``{"seed": .., "specs": [..]}`` dict."""
+        """Build from a JSON string, ``@path``, list-of-dicts,
+        ``{"seed": .., "specs": [..]}`` dict, or the comma shorthand
+        (``"delay,nan"``): bare kind names expand to probabilistic specs
+        at each kind's natural site family (:data:`SHORTHAND_SITES`) —
+        the one-flag chaos knob ``--faults delay,nan`` promises."""
         if isinstance(spec, str):
             if spec.startswith("@"):
                 import pathlib
 
                 spec = json.loads(pathlib.Path(spec[1:]).read_text())
             else:
-                spec = json.loads(spec)
+                words = [w.strip() for w in spec.split(",") if w.strip()]
+                if words and all(w in _KINDS for w in words):
+                    spec = [
+                        {"site": SHORTHAND_SITES[w], "kind": w,
+                         "prob": SHORTHAND_PROB,
+                         "param": SHORTHAND_PARAM.get(w, 0.01)}
+                        for w in words
+                    ]
+                else:
+                    spec = json.loads(spec)
         if isinstance(spec, dict):
             seed = spec.get("seed", 0)
             entries = spec.get("specs", [])

@@ -1,0 +1,99 @@
+"""Online serving layer: request queue, micro-batching, warm engines.
+
+Turns the offline apps into request/response services:
+
+* :mod:`~distributed_sddmm_tpu.serve.queue` — bounded admission +
+  dynamic micro-batching + backpressure (:class:`ShedError`).
+* :mod:`~distributed_sddmm_tpu.serve.engine` — warm-model execution
+  over a bucketed, compile-ahead program cache with the resilience
+  ladder (retry → degrade-to-serial) around every dispatch.
+* :mod:`~distributed_sddmm_tpu.serve.workloads` — the two paper apps as
+  endpoints: ALS user fold-in + top-k recommendation, GAT node scoring.
+* :mod:`~distributed_sddmm_tpu.serve.slo` — SLO specs (``DSDDMM_SLO``),
+  latency/occupancy recording, and the open-loop Poisson load generator
+  behind ``bench serve``.
+
+The :func:`build_als_engine` / :func:`build_gat_engine` helpers are the
+"zero to serving" path the CLI and smoke script use: autotune-plan the
+strategy, warm the model, wrap it in an engine.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from distributed_sddmm_tpu.serve.engine import ServingEngine
+from distributed_sddmm_tpu.serve.queue import (
+    Request, RequestError, RequestQueue, ShedError,
+)
+from distributed_sddmm_tpu.serve.slo import (
+    LatencyRecorder, SLOSpec, percentile, run_load,
+)
+from distributed_sddmm_tpu.serve.workloads import (
+    ALSFoldInTopK, GATNodeScore, ServingWorkload, bucket_for,
+)
+
+__all__ = [
+    "ALSFoldInTopK", "GATNodeScore", "LatencyRecorder", "Request",
+    "RequestError", "RequestQueue", "ServingEngine", "ServingWorkload",
+    "ShedError", "SLOSpec", "bucket_for", "build_als_engine",
+    "build_gat_engine", "percentile", "run_load",
+]
+
+
+def build_als_engine(
+    S,
+    R: int = 16,
+    train_steps: int = 2,
+    cg_iters: int = 5,
+    k: int = 10,
+    plan_mode: str = "model",
+    devices=None,
+    item_buckets=None,
+    **engine_kw,
+) -> ServingEngine:
+    """Plan, train, and wrap a warm ALS fold-in endpoint.
+
+    ``train_steps`` alternating steps warm the factor matrices (real
+    deployments would restore a checkpoint instead; pass
+    ``train_steps=0`` and load factors onto ``model`` yourself).
+    """
+    from distributed_sddmm_tpu.models.als import DistributedALS
+
+    model = DistributedALS.from_plan(
+        S, R, devices=devices, plan_mode=plan_mode
+    )
+    if train_steps:
+        model.run_cg(train_steps, cg_iters=cg_iters)
+    elif model.A is None:
+        model.initialize_embeddings()
+    kw = {"k": k}
+    if item_buckets is not None:
+        kw["item_buckets"] = tuple(item_buckets)
+    workload = ALSFoldInTopK(model, **kw)
+    return ServingEngine(workload, **engine_kw)
+
+
+def build_gat_engine(
+    S,
+    R: int = 16,
+    num_layers: int = 2,
+    plan_mode: str = "model",
+    devices=None,
+    node_buckets=None,
+    **engine_kw,
+) -> ServingEngine:
+    """Plan, build, and wrap a warm GAT node-scoring endpoint (the
+    forward pass runs once at workload construction; ``refresh()`` it
+    after weight updates)."""
+    from distributed_sddmm_tpu.bench.harness import _gat_layers
+    from distributed_sddmm_tpu.models.gat import GAT
+
+    model = GAT.from_plan(
+        S, _gat_layers(R, num_layers), devices=devices, plan_mode=plan_mode
+    )
+    kw = {}
+    if node_buckets is not None:
+        kw["node_buckets"] = tuple(node_buckets)
+    workload = GATNodeScore(model, **kw)
+    return ServingEngine(workload, **engine_kw)

@@ -1,0 +1,445 @@
+"""The serving engine: warm model, bucketed program cache, runner thread.
+
+One engine serves one workload (`serve/workloads.py`) from one warm
+model. The execution contract:
+
+* **Bucket ladder.** Every micro-batch is padded to a ``(batch_bucket,
+  inner_bucket)`` cell from two small power-of-two ladders, so every
+  request dispatches into an **already-jitted** program — the serving
+  analog of the offline jit-chained ``cgStep``/``gatLayer`` paths: on a
+  dispatch-dominated backend, a retrace on the hot path is the latency
+  SLO's worst enemy. :meth:`warmup` compiles the whole ladder ahead of
+  the first request (compile-ahead), and the program cache is keyed the
+  way autotune fingerprints are (workload, bucket cell, R, backend,
+  code hash) so a stale program can never serve a new code generation.
+* **Determinism across batching.** A micro-batch is split into groups
+  per inner bucket, each group padded with zero-masked rows; every
+  program computes request rows independently. A request's reply is
+  therefore a function of its payload alone — not of arrival order,
+  micro-batch composition, or padding (pinned by ``tests/test_serve.py``).
+* **Resilience ladder** (the same rungs ``parallel/base._resilient_call``
+  gives offline dispatch): fault hooks fire at ``execute:serveBatch`` /
+  ``output:serveBatch``, the call runs under a per-batch timeout with
+  bounded retries, guarded outputs retry on NaN/Inf, and a persistently
+  failing batch **degrades to the workload's host-serial fallback** per
+  request — the engine sheds or degrades, it does not die.
+* **Observability**: ``serve:batch`` spans + per-request reply events
+  through ``obs.trace``, queue-depth/occupancy into the
+  :class:`~distributed_sddmm_tpu.serve.slo.LatencyRecorder`, and the
+  watchdog's ``queue_runaway`` hook on every admission.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Optional
+
+from distributed_sddmm_tpu.obs import log as obs_log
+from distributed_sddmm_tpu.obs import metrics as obs_metrics
+from distributed_sddmm_tpu.obs import trace as obs_trace
+from distributed_sddmm_tpu.obs import watchdog as obs_watchdog
+from distributed_sddmm_tpu.resilience import faults
+from distributed_sddmm_tpu.resilience.guards import NumericalFault
+from distributed_sddmm_tpu.serve.queue import Request, RequestError, RequestQueue
+from distributed_sddmm_tpu.serve.slo import LatencyRecorder
+from distributed_sddmm_tpu.serve.workloads import ServingWorkload, bucket_for
+
+
+def _default_batch_buckets(max_batch: int) -> tuple[int, ...]:
+    out, b = [], 1
+    while b < max_batch:
+        out.append(b)
+        b *= 2
+    out.append(max_batch)
+    return tuple(out)
+
+
+class ServingEngine:
+    """Request/response execution over a warm model.
+
+    ``exec_timeout_s``/``exec_retries`` bound one micro-batch dispatch
+    (defaults from ``DSDDMM_SERVE_TIMEOUT`` / ``DSDDMM_SERVE_RETRIES``);
+    after the retry budget the batch degrades to the workload's serial
+    fallback instead of failing the requests.
+    """
+
+    #: Fault-injection site names (shared ``execute:*`` / ``output:*``
+    #: namespaces with offline dispatch, so one fault spec covers both).
+    OP = "serveBatch"
+
+    def __init__(
+        self,
+        workload: ServingWorkload,
+        max_batch: int = 8,
+        max_depth: int = 64,
+        max_wait_ms: float = 5.0,
+        batch_buckets: Optional[tuple[int, ...]] = None,
+        exec_timeout_s: Optional[float] = None,
+        exec_retries: Optional[int] = None,
+        recorder: Optional[LatencyRecorder] = None,
+    ):
+        self.workload = workload
+        self.queue = RequestQueue(
+            max_depth=max_depth, max_batch=max_batch, max_wait_ms=max_wait_ms
+        )
+        self.batch_buckets = tuple(
+            sorted(batch_buckets or _default_batch_buckets(max_batch))
+        )
+        self.exec_timeout_s = (
+            float(os.environ.get("DSDDMM_SERVE_TIMEOUT", "0"))
+            if exec_timeout_s is None else float(exec_timeout_s)
+        )
+        self.exec_retries = (
+            int(os.environ.get("DSDDMM_SERVE_RETRIES", "1"))
+            if exec_retries is None else int(exec_retries)
+        )
+        self.recorder = recorder if recorder is not None else LatencyRecorder()
+
+        self._programs: dict[str, object] = {}
+        #: Fast path: (batch_bucket, inner_bucket) -> resolved program.
+        #: The fingerprint-style key exists to pin the cache to a code
+        #: generation at CONSTRUCTION; backend and serve_code_hash cannot
+        #: change mid-process, so dispatch looks up by cell only.
+        self._cell_programs: dict[tuple[int, int], object] = {}
+        self._cache_lock = threading.Lock()
+        self.cache_hits = 0
+        self.cache_misses = 0
+        self.served = 0
+        self.degraded_batches = 0
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+
+    # ------------------------------------------------------------------ #
+    # Warm program cache (autotune-fingerprint-style keys)
+    # ------------------------------------------------------------------ #
+
+    def program_key(self, batch_bucket: int, inner_bucket: int) -> str:
+        from distributed_sddmm_tpu.autotune import fingerprint as fp
+
+        backend = "unknown"
+        try:
+            import jax
+
+            backend = jax.default_backend()
+        except Exception:  # noqa: BLE001 — key quality, not correctness
+            pass
+        r = getattr(self.workload, "R", getattr(self.workload, "_F", 0))
+        return fp.serve_program_key(
+            self.workload.name, batch_bucket, inner_bucket, r, backend
+        )
+
+    def _program(self, batch_bucket: int, inner_bucket: int):
+        cell = (batch_bucket, inner_bucket)
+        with self._cache_lock:
+            prog = self._cell_programs.get(cell)
+            if prog is not None:
+                self.cache_hits += 1
+                return prog
+            self.cache_misses += 1
+        key = self.program_key(batch_bucket, inner_bucket)
+        prog = self.workload.build_program(batch_bucket, inner_bucket)
+        with self._cache_lock:
+            prog = self._programs.setdefault(key, prog)
+            self._cell_programs[cell] = prog
+        return prog
+
+    def warmup(self) -> int:
+        """Compile-ahead: build and execute every ladder cell once (with
+        an all-padding batch), so no live request ever pays a compile.
+        Returns the number of programs warmed."""
+        from distributed_sddmm_tpu.utils.platform import force_fetch
+
+        n = 0
+        with obs_trace.span(
+            "serve:warmup", workload=self.workload.name,
+            cells=len(self.batch_buckets) * len(self.workload.inner_buckets),
+        ):
+            for bb in self.batch_buckets:
+                for ib in self.workload.inner_buckets:
+                    prog = self._program(bb, ib)
+                    args = self.workload.pad_batch([], bb, ib)
+                    force_fetch(prog(*args))
+                    n += 1
+        obs_log.info(
+            "serve", "warmup complete", programs=n,
+            batch_buckets=list(self.batch_buckets),
+            inner_buckets=list(self.workload.inner_buckets),
+        )
+        return n
+
+    # ------------------------------------------------------------------ #
+    # Lifecycle
+    # ------------------------------------------------------------------ #
+
+    def start(self, warmup: bool = True) -> "ServingEngine":
+        if self._thread is not None:
+            raise RuntimeError("engine already started")
+        if warmup:
+            self.warmup()
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name=f"serve-{self.workload.name}"
+        )
+        self._thread.start()
+        return self
+
+    def stop(self, drain: bool = True, timeout_s: float = 30.0) -> None:
+        """Close admission; optionally drain queued requests, then stop
+        the runner."""
+        self.queue.close()
+        if not drain:
+            self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout_s)
+            self._thread = None
+
+    def __enter__(self) -> "ServingEngine":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    # ------------------------------------------------------------------ #
+    # Client surface
+    # ------------------------------------------------------------------ #
+
+    def submit(self, payload: dict) -> Request:
+        """Admit one request (sheds with
+        :class:`~distributed_sddmm_tpu.serve.queue.ShedError` when the
+        queue is at depth)."""
+        from distributed_sddmm_tpu.serve.queue import ShedError
+
+        wd = obs_watchdog.active()
+        if wd is not None:
+            # BEFORE admission: a strict-mode runaway alarm must shed
+            # this request while it is still reject-able — admitting
+            # first would execute (and ingest) a request whose client
+            # was told it never got in.
+            try:
+                wd.observe_queue(self.queue.depth(), self.queue.max_depth)
+            except NumericalFault:
+                self.recorder.record_shed()
+                obs_metrics.GLOBAL.add("serve_shed")
+                raise ShedError(
+                    "queue runaway (watchdog strict)",
+                    retry_after_s=self.queue.max_wait_ms / 1e3,
+                ) from None
+        try:
+            return self.queue.submit(self.workload.clamp(payload))
+        except ShedError:
+            self.recorder.record_shed()
+            obs_metrics.GLOBAL.add("serve_shed")
+            raise
+
+    def serve_one(self, payload: dict, timeout_s: float = 30.0) -> dict:
+        """Submit + wait (the synchronous convenience path)."""
+        return self.submit(payload).result(timeout_s=timeout_s)
+
+    def execute_now(self, payloads: list[dict]) -> list[dict]:
+        """Synchronously execute payloads through the SAME pad/program
+        path as the runner (no queue, no recorder) — the reference the
+        batching-determinism tests compare batched replies against."""
+        payloads = [self.workload.clamp(p) for p in payloads]
+        replies: dict[int, dict] = {}
+        for ib, idxs in self._group_by_inner(payloads).items():
+            group = [payloads[i] for i in idxs]
+            bb = bucket_for(len(group), self.batch_buckets)
+            out = self._dispatch(group, bb, ib)
+            for i, reply in zip(idxs, out):
+                replies[i] = reply
+        return [replies[i] for i in range(len(payloads))]
+
+    # ------------------------------------------------------------------ #
+    # Runner
+    # ------------------------------------------------------------------ #
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            batch = self.queue.next_batch(timeout_s=0.25)
+            if not batch:
+                if self.queue.closed and self.queue.depth() == 0:
+                    return
+                continue
+            try:
+                self._serve_batch(batch)
+            except Exception as e:  # noqa: BLE001 — the loop must survive
+                obs_log.error(
+                    "serve", "batch failed past every rung",
+                    error=f"{type(e).__name__}: {e}",
+                )
+                for req in batch:
+                    if not req.done():
+                        req.set_error(RequestError(str(e)))
+
+    def _group_by_inner(self, payloads: list[dict]) -> dict[int, list[int]]:
+        """Indices grouped by inner bucket. Grouping (rather than padding
+        the whole micro-batch to the largest member's bucket) is what
+        makes a request's inner shape a function of its own payload —
+        the determinism contract."""
+        groups: dict[int, list[int]] = {}
+        for i, p in enumerate(payloads):
+            ib = bucket_for(
+                self.workload.inner_size(p), self.workload.inner_buckets
+            )
+            groups.setdefault(ib, []).append(i)
+        return groups
+
+    def _serve_batch(self, batch: list[Request]) -> None:
+        t_batch = time.perf_counter()
+        depth_now = self.queue.depth()
+        payloads = [req.payload for req in batch]
+        answered_idx: list[int] = []
+        wd = obs_watchdog.active()
+
+        for ib, idxs in self._group_by_inner(payloads).items():
+            group = [payloads[i] for i in idxs]
+            reqs = [batch[i] for i in idxs]
+            bb = bucket_for(len(group), self.batch_buckets)
+            self.recorder.record_batch(len(group), bb, depth_now)
+            t0 = time.perf_counter()
+            for req in reqs:
+                # Per GROUP, not per batch: groups dispatch sequentially,
+                # and a later group's execute_s must not absorb an
+                # earlier group's (possibly retried/degraded) dispatch.
+                req.t_execute = t0
+            with obs_trace.span(
+                "serve:batch", workload=self.workload.name,
+                batch=len(group), batch_bucket=bb, inner_bucket=ib,
+                depth=depth_now,
+            ) as sp:
+                try:
+                    replies = self._dispatch(group, bb, ib)
+                    degraded = False
+                except Exception as e:  # noqa: BLE001 — degrade rung
+                    replies = self._degrade(group, e)
+                    degraded = True
+                    sp.set(degraded=True)
+            for i, req, reply in zip(idxs, reqs, replies):
+                if reply is None:  # serial fallback failed too
+                    req.set_error(RequestError(
+                        "no reply: compiled dispatch and serial fallback "
+                        "both failed"
+                    ))
+                    continue
+                req.degraded = degraded
+                req.set_result(reply)
+                answered_idx.append(i)
+                if obs_trace.enabled():
+                    obs_trace.event(
+                        "serve:reply", req=req.req_id, degraded=degraded,
+                        **{k: round(v, 6)
+                           for k, v in req.stage_latencies_s().items()},
+                    )
+            self.served += len(group)
+            if wd is not None:
+                try:
+                    wd.observe(
+                        f"serve:{self.workload.name}",
+                        time.perf_counter() - t0,
+                    )
+                except NumericalFault as alarm:
+                    # Strict-mode spike/drift: the anomaly is recorded;
+                    # serving's ladder response is shed/degrade upstream,
+                    # not runner death.
+                    obs_log.warn("serve", "watchdog alarm in runner",
+                                 error=str(alarm))
+
+        # Ingest + drain-rate hint, after replies are out the door.
+        # ANSWERED payloads only, in admission (FIFO) order regardless of
+        # group dispatch order: a request whose every rung failed got a
+        # RequestError — training on traffic the client never received
+        # an answer for would break the "served users appended" contract.
+        if answered_idx:
+            try:
+                self.workload.ingest(
+                    [payloads[i] for i in sorted(answered_idx)]
+                )
+            except Exception as e:  # noqa: BLE001 — ingest is best-effort
+                obs_log.warn("serve", "online ingest failed",
+                             error=f"{type(e).__name__}: {e}")
+        dt = time.perf_counter() - t_batch
+        if dt > 0:
+            inst = len(batch) / dt
+            self.queue.drain_rate_hint = (
+                0.8 * self.queue.drain_rate_hint + 0.2 * inst
+                if self.queue.drain_rate_hint else inst
+            )
+
+    # ------------------------------------------------------------------ #
+    # The resilience ladder around one compiled dispatch
+    # ------------------------------------------------------------------ #
+
+    def _dispatch(
+        self, group: list[dict], batch_bucket: int, inner_bucket: int
+    ) -> list[dict]:
+        from distributed_sddmm_tpu.resilience import guards
+        from distributed_sddmm_tpu.resilience.retry import Backoff, retry_call
+        from distributed_sddmm_tpu.utils.platform import force_fetch
+
+        prog = self._program(batch_bucket, inner_bucket)
+        args = self.workload.pad_batch(group, batch_bucket, inner_bucket)
+
+        def attempt():
+            faults.maybe_raise(f"execute:{self.OP}")
+            out = prog(*args)
+            out = faults.corrupt_outputs(f"output:{self.OP}", out)
+            force_fetch(out)
+            if guards.enabled():
+                # raise-mode trips the retry; repair-mode nan_to_nums.
+                out = guards.guard_output(self.OP, out)
+            return out
+
+        def on_retry(i: int, err: BaseException) -> None:
+            obs_metrics.GLOBAL.add("exec_retries")
+            obs_trace.event("retry", op=self.OP, attempt=i,
+                            error=type(err).__name__)
+
+        out = retry_call(
+            attempt,
+            retries=self.exec_retries,
+            timeout_s=self.exec_timeout_s,
+            backoff=Backoff(base_s=0.02, max_delay_s=0.5),
+            retry_on=(TimeoutError, MemoryError, NumericalFault,
+                      faults.FaultError),
+            label=f"execute:{self.OP}",
+            on_retry=on_retry,
+        )
+        return self.workload.unpad(out, group)
+
+    def _degrade(self, group: list[dict], cause: BaseException) -> list:
+        """Final rung: per-request host-serial fallback. Requests whose
+        fallback ALSO fails get a typed error (reply slot None here)."""
+        self.degraded_batches += 1
+        obs_metrics.GLOBAL.add("serve_degraded_batches")
+        obs_trace.event(
+            "serve_degraded", workload=self.workload.name,
+            cause=type(cause).__name__, batch=len(group),
+        )
+        obs_log.warn(
+            "serve", "batch degraded to serial fallback",
+            cause=f"{type(cause).__name__}: {cause}", batch=len(group),
+        )
+        replies = []
+        for payload in group:
+            try:
+                replies.append(self.workload.serial(payload))
+            except Exception as e:  # noqa: BLE001 — per-request error
+                replies.append(None)
+                obs_log.error("serve", "serial fallback failed",
+                              error=f"{type(e).__name__}: {e}")
+        return replies
+
+    # ------------------------------------------------------------------ #
+
+    def stats(self) -> dict:
+        with self._cache_lock:
+            return {
+                "programs": len(self._programs),
+                "cache_hits": self.cache_hits,
+                "cache_misses": self.cache_misses,
+                "served": self.served,
+                "degraded_batches": self.degraded_batches,
+                "queue_shed": self.queue.shed_count,
+            }

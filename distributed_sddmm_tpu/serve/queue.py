@@ -1,0 +1,242 @@
+"""Thread-safe request queue with dynamic micro-batching + backpressure.
+
+The front door of the serving layer (`serve/engine.py` is the back):
+client threads :meth:`~RequestQueue.submit` individual requests, the
+engine's runner thread pulls *micro-batches* — up to ``max_batch``
+requests, or whatever has arrived when ``max_wait_ms`` expires after the
+first request of the batch, whichever comes first. That is the dynamic
+batching bargain from the serving literature (and the same
+amortize-setup-over-many-steps insight the offline kernels already
+exploit via step-batching): one compiled-program dispatch serves many
+requests, with a bounded latency tax on the first arrival.
+
+Admission control is a hard depth bound: a full queue **sheds** new
+requests with :class:`ShedError` carrying a ``retry_after_s`` hint
+instead of growing without bound — queueing-theory 101 says an open-loop
+arrival process above capacity turns an unbounded queue into unbounded
+latency; shedding converts that into an explicit, client-visible signal
+while requests already admitted still meet their latency target.
+
+Every request carries its timeline (enqueue → admit → execute → reply
+perf-counter stamps); ``serve/slo.py`` turns those into the percentile
+histograms the SLO gate judges.
+"""
+
+from __future__ import annotations
+
+import collections
+import itertools
+import threading
+import time
+from typing import Any, Optional
+
+
+class ShedError(RuntimeError):
+    """Request rejected by admission control (queue at ``max_depth``).
+
+    ``retry_after_s`` is the server's drain-time estimate for the current
+    backlog — the value an HTTP front end would surface as a 429
+    ``Retry-After`` header.
+    """
+
+    def __init__(self, msg: str, retry_after_s: float = 0.0):
+        super().__init__(msg)
+        self.retry_after_s = retry_after_s
+
+
+class RequestError(RuntimeError):
+    """The engine failed to produce a reply for this request (persistent
+    fault after retries AND the serial fallback failed)."""
+
+
+class Request:
+    """One in-flight request: payload + reply slot + timeline stamps.
+
+    The reply slot is a one-shot event; :meth:`result` blocks the caller
+    until the engine delivers (or raises what the engine recorded).
+    Timeline stamps are ``time.perf_counter`` values filled in by the
+    queue (``t_enqueue``), the batcher (``t_admit``), and the engine
+    (``t_execute``, ``t_reply``).
+    """
+
+    __slots__ = (
+        "req_id", "payload", "t_enqueue", "t_admit", "t_execute", "t_reply",
+        "degraded", "_done", "_value", "_error",
+    )
+
+    def __init__(self, req_id: int, payload: Any):
+        self.req_id = req_id
+        self.payload = payload
+        self.t_enqueue: float = 0.0
+        self.t_admit: Optional[float] = None
+        self.t_execute: Optional[float] = None
+        self.t_reply: Optional[float] = None
+        #: Set by the engine when this reply came off the serial fallback
+        #: rung instead of the compiled program.
+        self.degraded: bool = False
+        self._done = threading.Event()
+        self._value: Any = None
+        self._error: Optional[BaseException] = None
+
+    # -- engine side --------------------------------------------------- #
+
+    def set_result(self, value: Any) -> None:
+        self.t_reply = time.perf_counter()
+        self._value = value
+        self._done.set()
+
+    def set_error(self, err: BaseException) -> None:
+        self.t_reply = time.perf_counter()
+        self._error = err
+        self._done.set()
+
+    # -- client side --------------------------------------------------- #
+
+    def done(self) -> bool:
+        return self._done.is_set()
+
+    def result(self, timeout_s: Optional[float] = None) -> Any:
+        """Block until the reply lands; raises the engine's recorded
+        error, or ``TimeoutError`` if no reply arrives in time."""
+        if not self._done.wait(timeout_s):
+            raise TimeoutError(
+                f"request {self.req_id} unanswered after {timeout_s}s"
+            )
+        if self._error is not None:
+            raise self._error
+        return self._value
+
+    # -- timeline ------------------------------------------------------ #
+
+    def stage_latencies_s(self) -> dict:
+        """{queue, execute, total} wall seconds (None-safe: requests that
+        were shed or errored mid-flight report what they have)."""
+        out = {}
+        if self.t_admit is not None:
+            out["queue_s"] = self.t_admit - self.t_enqueue
+        if self.t_execute is not None and self.t_reply is not None:
+            out["execute_s"] = self.t_reply - self.t_execute
+        if self.t_reply is not None:
+            out["total_s"] = self.t_reply - self.t_enqueue
+        return out
+
+
+class RequestQueue:
+    """Bounded FIFO with micro-batch extraction.
+
+    ``max_depth`` bounds admission (excess submissions shed);
+    ``max_batch``/``max_wait_ms`` shape the micro-batches
+    :meth:`next_batch` hands the engine. ``drain_rate_hint`` (requests/s,
+    updated by the engine from observed throughput) feeds the
+    ``retry_after_s`` hint on shed.
+    """
+
+    def __init__(
+        self,
+        max_depth: int = 256,
+        max_batch: int = 16,
+        max_wait_ms: float = 5.0,
+    ):
+        if max_depth < 1 or max_batch < 1:
+            raise ValueError("max_depth and max_batch must be >= 1")
+        self.max_depth = int(max_depth)
+        self.max_batch = int(max_batch)
+        self.max_wait_ms = float(max_wait_ms)
+        self._q: collections.deque[Request] = collections.deque()
+        self._lock = threading.Lock()
+        self._not_empty = threading.Condition(self._lock)
+        self._ids = itertools.count()
+        self._closed = False
+        self.shed_count = 0
+        self.submitted_count = 0
+        #: Engine-maintained throughput estimate for retry_after hints.
+        self.drain_rate_hint: float = 0.0
+
+    # ------------------------------------------------------------------ #
+    # Client side
+    # ------------------------------------------------------------------ #
+
+    def submit(self, payload: Any) -> Request:
+        """Admit one request (raises :class:`ShedError` when full, or
+        ``RuntimeError`` after :meth:`close`)."""
+        with self._lock:
+            if self._closed:
+                raise RuntimeError("queue is closed")
+            if len(self._q) >= self.max_depth:
+                self.shed_count += 1
+                rate = self.drain_rate_hint
+                retry_after = (
+                    len(self._q) / rate if rate > 0
+                    else self.max_wait_ms / 1e3 * len(self._q) / self.max_batch
+                )
+                raise ShedError(
+                    f"queue full ({len(self._q)}/{self.max_depth}); "
+                    f"retry after ~{retry_after:.3f}s",
+                    retry_after_s=retry_after,
+                )
+            req = Request(next(self._ids), payload)
+            req.t_enqueue = time.perf_counter()
+            self._q.append(req)
+            self.submitted_count += 1
+            self._not_empty.notify()
+            return req
+
+    def depth(self) -> int:
+        with self._lock:
+            return len(self._q)
+
+    # ------------------------------------------------------------------ #
+    # Engine side
+    # ------------------------------------------------------------------ #
+
+    def next_batch(self, timeout_s: Optional[float] = None) -> list[Request]:
+        """Block for the next micro-batch.
+
+        Returns as soon as ``max_batch`` requests are waiting, or
+        ``max_wait_ms`` after the FIRST request of the batch arrived —
+        the arrival of request #1 starts the clock, so a lone request
+        pays at most ``max_wait_ms`` of batching latency. Returns ``[]``
+        on ``timeout_s`` with nothing queued, or when closed and empty.
+        """
+        deadline = (
+            time.perf_counter() + timeout_s if timeout_s is not None else None
+        )
+        with self._not_empty:
+            while not self._q:
+                if self._closed:
+                    return []
+                remaining = None
+                if deadline is not None:
+                    remaining = deadline - time.perf_counter()
+                    if remaining <= 0:
+                        return []
+                self._not_empty.wait(remaining)
+            # First arrival in hand: linger up to max_wait_ms for peers.
+            batch_deadline = (
+                self._q[0].t_enqueue + self.max_wait_ms / 1e3
+            )
+            while (
+                len(self._q) < self.max_batch
+                and not self._closed
+            ):
+                linger = batch_deadline - time.perf_counter()
+                if linger <= 0:
+                    break
+                self._not_empty.wait(linger)
+            n = min(len(self._q), self.max_batch)
+            batch = [self._q.popleft() for _ in range(n)]
+        t_admit = time.perf_counter()
+        for req in batch:
+            req.t_admit = t_admit
+        return batch
+
+    def close(self) -> None:
+        """Stop admitting; wake any blocked :meth:`next_batch`. Requests
+        already queued remain drainable."""
+        with self._lock:
+            self._closed = True
+            self._not_empty.notify_all()
+
+    @property
+    def closed(self) -> bool:
+        return self._closed

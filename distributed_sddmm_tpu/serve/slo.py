@@ -1,0 +1,312 @@
+"""SLO specs, per-request latency recording, and the open-loop load gen.
+
+Three pieces, one contract:
+
+* :class:`SLOSpec` — the target (``DSDDMM_SLO="p99_ms=250,err_rate=0.01"``
+  or the ``--slo`` flag): latency percentiles in milliseconds plus an
+  error-rate bound. :meth:`SLOSpec.check` turns an observed summary into
+  a (possibly empty) list of violations.
+* :class:`LatencyRecorder` — the measurement half: per-request stage
+  latencies (enqueue→admit→execute→reply, straight off the
+  :class:`~distributed_sddmm_tpu.serve.queue.Request` timeline), queue
+  depth and batch occupancy samples, shed/error/degraded counts.
+  Percentiles use the nearest-rank convention (p99 of 100 samples is the
+  99th largest — no interpolation invents latencies nobody observed).
+* :func:`run_load` — an **open-loop Poisson** load generator: arrival
+  times are drawn ahead of time from a seeded exponential process and
+  submissions happen at those instants regardless of completions (a
+  closed loop self-throttles and hides capacity cliffs; open-loop is the
+  honest way to ask "does this engine sustain λ req/s"). Every Nth reply
+  is checked against the workload's float64 oracle.
+
+The summary :func:`run_load` returns is the serving half of a bench
+record: ``latency_ms`` percentiles, ``shed_count``, occupancy — the
+fields ``bench serve`` persists to the run store and ``bench gate``
+regresses on (``obs/regress.py`` serving axes).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+import os
+import threading
+import time
+from typing import Optional
+
+import numpy as np
+
+from distributed_sddmm_tpu.obs import log as obs_log
+from distributed_sddmm_tpu.serve.queue import ShedError
+
+_PCTS = (50, 95, 99)
+
+
+def percentile(samples: list[float], pct: float) -> float | None:
+    """Nearest-rank percentile (None on empty input)."""
+    if not samples:
+        return None
+    ordered = sorted(samples)
+    rank = max(1, math.ceil(pct / 100.0 * len(ordered)))
+    return ordered[rank - 1]
+
+
+@dataclasses.dataclass(frozen=True)
+class SLOSpec:
+    """Latency/error targets. Unset fields (None) are unconstrained."""
+
+    p50_ms: float | None = None
+    p95_ms: float | None = None
+    p99_ms: float | None = None
+    err_rate: float | None = None
+    shed_rate: float | None = None
+
+    _FIELDS = ("p50_ms", "p95_ms", "p99_ms", "err_rate", "shed_rate")
+
+    @classmethod
+    def parse(cls, spec: str | None) -> "SLOSpec":
+        """``"p99_ms=250,err_rate=0.01"`` → SLOSpec. Unknown keys raise —
+        a typo'd SLO that silently constrains nothing would make every
+        run green."""
+        if not spec:
+            return cls()
+        kw = {}
+        for part in spec.split(","):
+            part = part.strip()
+            if not part:
+                continue
+            if "=" not in part:
+                raise ValueError(f"SLO entry {part!r} is not key=value")
+            k, v = part.split("=", 1)
+            k = k.strip()
+            if k not in cls._FIELDS:
+                raise ValueError(
+                    f"unknown SLO key {k!r}; expected one of {cls._FIELDS}"
+                )
+            kw[k] = float(v)
+        return cls(**kw)
+
+    @classmethod
+    def from_env(cls) -> "SLOSpec":
+        return cls.parse(os.environ.get("DSDDMM_SLO"))
+
+    def to_dict(self) -> dict:
+        return {
+            k: v for k, v in dataclasses.asdict(self).items() if v is not None
+        }
+
+    def check(self, summary: dict) -> list[dict]:
+        """Violations of this spec in a :meth:`LatencyRecorder.summary`
+        (empty list = SLO met; unmeasured axes are not violations)."""
+        out = []
+        lat = summary.get("latency_ms") or {}
+        for pct in _PCTS:
+            want = getattr(self, f"p{pct}_ms")
+            got = lat.get(f"p{pct}")
+            if want is not None and got is not None and got > want:
+                out.append({"axis": f"p{pct}_ms", "limit": want,
+                            "observed": round(got, 3)})
+        for axis in ("err_rate", "shed_rate"):
+            want = getattr(self, axis)
+            got = summary.get(axis)
+            if want is not None and got is not None and got > want:
+                out.append({"axis": axis, "limit": want,
+                            "observed": round(got, 6)})
+        return out
+
+
+class LatencyRecorder:
+    """Thread-safe accumulator for one serving session's observations."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._total_s: list[float] = []
+        self._queue_s: list[float] = []
+        self._execute_s: list[float] = []
+        self._depth: list[int] = []
+        self._occupancy: list[float] = []
+        self.completed = 0
+        self.errors = 0
+        self.degraded = 0
+        self.shed = 0
+
+    # -- feeding ------------------------------------------------------- #
+
+    def record_reply(self, req) -> None:
+        stages = req.stage_latencies_s()
+        with self._lock:
+            self.completed += 1
+            if req.degraded:
+                self.degraded += 1
+            if "total_s" in stages:
+                self._total_s.append(stages["total_s"])
+            if "queue_s" in stages:
+                self._queue_s.append(stages["queue_s"])
+            if "execute_s" in stages:
+                self._execute_s.append(stages["execute_s"])
+
+    def record_error(self) -> None:
+        with self._lock:
+            self.errors += 1
+
+    def record_shed(self) -> None:
+        with self._lock:
+            self.shed += 1
+
+    def record_batch(self, batch_size: int, bucket: int, depth: int) -> None:
+        with self._lock:
+            self._depth.append(depth)
+            self._occupancy.append(batch_size / bucket if bucket else 0.0)
+
+    # -- reporting ----------------------------------------------------- #
+
+    @staticmethod
+    def _pct_ms(samples: list[float]) -> dict:
+        out = {}
+        for pct in _PCTS:
+            v = percentile(samples, pct)
+            if v is not None:
+                out[f"p{pct}"] = round(v * 1e3, 3)
+        if samples:
+            out["mean"] = round(sum(samples) / len(samples) * 1e3, 3)
+            out["max"] = round(max(samples) * 1e3, 3)
+        return out
+
+    def summary(self) -> dict:
+        with self._lock:
+            total = list(self._total_s)
+            queue = list(self._queue_s)
+            execute = list(self._execute_s)
+            depth = list(self._depth)
+            occ = list(self._occupancy)
+            completed, errors = self.completed, self.errors
+            shed, degraded = self.shed, self.degraded
+        requests = completed + errors + shed
+        out = {
+            "requests": requests,
+            "completed": completed,
+            "errors": errors,
+            "shed_count": shed,
+            "degraded_count": degraded,
+            "err_rate": errors / requests if requests else 0.0,
+            "shed_rate": shed / requests if requests else 0.0,
+            "latency_ms": self._pct_ms(total),
+            "queue_ms": self._pct_ms(queue),
+            "execute_ms": self._pct_ms(execute),
+        }
+        if occ:
+            out["batch_occupancy"] = {
+                "mean": round(sum(occ) / len(occ), 4),
+                "p50": round(percentile(occ, 50), 4),
+                "batches": len(occ),
+            }
+        if depth:
+            out["queue_depth"] = {
+                "mean": round(sum(depth) / len(depth), 2),
+                "p95": percentile(depth, 95),
+                "max": max(depth),
+            }
+        return out
+
+
+# --------------------------------------------------------------------- #
+# Open-loop Poisson load generator
+# --------------------------------------------------------------------- #
+
+
+def run_load(
+    engine,
+    duration_s: float,
+    rate_hz: float,
+    seed: int = 0,
+    oracle_every: int = 8,
+    reply_timeout_s: float = 30.0,
+    slo: Optional[SLOSpec] = None,
+) -> dict:
+    """Drive ``engine`` with Poisson arrivals for ``duration_s`` seconds.
+
+    Arrivals are precomputed (seeded exponential inter-arrival gaps at
+    ``rate_hz``), submitted open-loop from this thread; each reply is
+    collected on its own short-lived waiter thread (pruned as they
+    finish) so a slow reply never delays the next arrival — arrival
+    instants are absolute offsets from the run start, so thread-spawn
+    cost cannot accumulate into schedule drift. Every
+    ``oracle_every``-th completed request
+    is checked against ``engine.workload.oracle`` (float64 reference);
+    mismatches are counted and logged, never raised — the load gen's job
+    is to measure, the caller's to judge.
+
+    Returns the recorder summary extended with throughput, oracle-check
+    results, and SLO violations (``slo`` defaults to the env spec).
+    """
+    slo = slo if slo is not None else SLOSpec.from_env()
+    rec = engine.recorder
+    rng = np.random.default_rng(seed)
+    workload = engine.workload
+
+    n_expect = max(1, int(duration_s * rate_hz * 2))
+    gaps = rng.exponential(1.0 / max(rate_hz, 1e-9), size=n_expect)
+    arrivals = np.cumsum(gaps)
+    arrivals = arrivals[arrivals < duration_s]
+
+    oracle_checked = [0]
+    oracle_failures = [0]
+    waiters: list[threading.Thread] = []
+    submitted = 0
+
+    def wait_reply(req, check: bool):
+        try:
+            reply = req.result(timeout_s=reply_timeout_s)
+        except ShedError:
+            return  # already counted at submit
+        except Exception as e:  # noqa: BLE001 — recorded, run continues
+            rec.record_error()
+            obs_log.warn("serve", "request failed", req=req.req_id,
+                         error=f"{type(e).__name__}: {e}")
+            return
+        rec.record_reply(req)
+        if check:
+            oracle_checked[0] += 1
+            if not workload.check_reply(req.payload, reply):
+                oracle_failures[0] += 1
+                obs_log.error("serve", "oracle mismatch", req=req.req_id)
+
+    t0 = time.perf_counter()
+    for i, t_arr in enumerate(arrivals):
+        delay = t0 + float(t_arr) - time.perf_counter()
+        if delay > 0:
+            time.sleep(delay)
+        payload = workload.sample_payload(rng)
+        try:
+            req = engine.submit(payload)
+        except ShedError:
+            continue  # the engine's submit path recorded the shed
+        submitted += 1
+        w = threading.Thread(
+            target=wait_reply,
+            args=(req, oracle_every > 0 and i % oracle_every == 0),
+            daemon=True, name=f"serve-wait-{req.req_id}",
+        )
+        w.start()
+        waiters.append(w)
+        if len(waiters) >= 256:  # prune finished waiters, bound the list
+            waiters = [t for t in waiters if t.is_alive()]
+
+    for w in waiters:
+        w.join(reply_timeout_s)
+    elapsed = time.perf_counter() - t0
+
+    summary = rec.summary()
+    summary.update({
+        "duration_s": round(elapsed, 3),
+        "offered_rate_hz": rate_hz,
+        "offered": int(len(arrivals)),
+        "submitted": submitted,
+        "throughput_rps": round(summary["completed"] / elapsed, 3)
+        if elapsed > 0 else 0.0,
+        "oracle_checked": oracle_checked[0],
+        "oracle_failures": oracle_failures[0],
+    })
+    summary["slo"] = slo.to_dict()
+    summary["slo_violations"] = slo.check(summary)
+    return summary

@@ -1,0 +1,480 @@
+"""Concrete serving endpoints: ALS fold-in top-k and GAT node scoring.
+
+Both follow the same contract the engine batches against
+(:class:`ServingWorkload`): a request **payload** is a small dict, the
+per-request *inner size* (rated-item count, node count) is bucketed
+independently of the batch dimension, and the compiled program for a
+``(batch_bucket, inner_bucket)`` cell computes every request row
+independently — the property the batching-determinism tests pin:
+a request's reply must not depend on which other requests shared its
+micro-batch, only on its own payload.
+
+**ALS fold-in + top-k** (the paper's collaborative-filtering app, served):
+a new user arrives with a handful of (item, rating) observations. Rather
+than re-running distributed ALS, the user's factor vector is *folded in*
+against the warm item factors B — solve the one-user ridge normal
+equation ``(Bᵀ_obs B_obs + λI) x = Bᵀ_obs r`` (an R×R solve, the same
+normal-equation structure the offline half-steps solve for all rows at
+once) — then scored against every item and the top-k unseen items
+returned. The served user's ratings row is appended to the live host
+matrix via :meth:`HostCOO.append_rows` so the next offline retrain sees
+the online traffic.
+
+**GAT node scoring** (the paper's GNN app, served): the warm model's
+forward pass is the expensive, whole-graph part; it runs once at engine
+warmup and is refreshed out-of-band. A request asks for scores of a
+node batch: gather the requested rows of the cached embeddings and
+project them through a fixed scoring head — the gather/project half is
+what latency-sensitive serving actually dispatches per request.
+"""
+
+from __future__ import annotations
+
+import abc
+import threading
+from typing import Optional
+
+import numpy as np
+
+from distributed_sddmm_tpu.utils.coo import HostCOO
+
+#: Default inner-size bucket ladders (powers of two keep the compiled
+#: program count logarithmic in the supported range).
+ALS_ITEM_BUCKETS = (8, 16, 32, 64)
+GAT_NODE_BUCKETS = (1, 4, 16, 64)
+
+
+def bucket_for(n: int, ladder: tuple[int, ...]) -> int:
+    """Smallest ladder rung >= n (the largest rung for oversize n —
+    callers clamp payloads to it at admission)."""
+    for b in ladder:
+        if n <= b:
+            return b
+    return ladder[-1]
+
+
+def _chol_solve(gram, rhs):
+    """Batched SPD solve via a hand-unrolled Cholesky (``gram`` is
+    ``(b, R, R)``, ``rhs`` ``(b, R)``).
+
+    Exists for bitwise batch-invariance, not speed: XLA:CPU lowers
+    ``jnp.linalg.solve`` (and plain ``x @ B.T``) to LAPACK/Eigen calls
+    whose accumulation order DEPENDS ON THE BATCH DIMENSION, so the same
+    request solved in a batch of 1 vs 4 returns different last bits —
+    exactly what the serving determinism contract forbids. This
+    formulation uses only elementwise/broadcast ops and fixed-size
+    last-axis reductions, which are batch-invariant (pinned by
+    ``tests/test_serve.py``). Unrolls O(R) ops at trace time — fine for
+    serving-scale R (tens), not for R in the thousands."""
+    import jax.numpy as jnp
+
+    R = gram.shape[-1]
+    L = jnp.zeros_like(gram)
+    for j in range(R):
+        d = jnp.sqrt(
+            gram[:, j, j] - jnp.sum(L[:, j, :j] * L[:, j, :j], axis=-1)
+        )
+        L = L.at[:, j, j].set(d)
+        if j + 1 < R:
+            off = (
+                gram[:, j + 1:, j]
+                - jnp.sum(
+                    L[:, j + 1:, :j] * L[:, j, :j][:, None, :], axis=-1
+                )
+            ) / d[:, None]
+            L = L.at[:, j + 1:, j].set(off)
+    y = jnp.zeros_like(rhs)
+    for j in range(R):
+        y = y.at[:, j].set(
+            (rhs[:, j] - jnp.sum(L[:, j, :j] * y[:, :j], axis=-1))
+            / L[:, j, j]
+        )
+    x = jnp.zeros_like(rhs)
+    for j in reversed(range(R)):
+        x = x.at[:, j].set(
+            (y[:, j] - jnp.sum(L[:, j + 1:, j] * x[:, j + 1:], axis=-1))
+            / L[:, j, j]
+        )
+    return x
+
+
+class ServingWorkload(abc.ABC):
+    """What the engine needs from an endpoint. All array math that runs
+    per-dispatch lives in :meth:`build_program`'s jitted closure; payload
+    padding and reply slicing are host-side numpy."""
+
+    #: Endpoint name (bench record ``app`` = ``serve-<name>``).
+    name: str = "?"
+    #: Inner-size ladder (rated items / requested nodes).
+    inner_buckets: tuple[int, ...] = (1,)
+
+    @abc.abstractmethod
+    def inner_size(self, payload: dict) -> int:
+        """The payload's inner dimension, pre-bucketing."""
+
+    @abc.abstractmethod
+    def clamp(self, payload: dict) -> dict:
+        """Payload admitted for execution (oversize payloads truncated to
+        the largest inner bucket — admission must never grow the ladder)."""
+
+    @abc.abstractmethod
+    def build_program(self, batch_bucket: int, inner_bucket: int):
+        """A jitted callable ``prog(*padded_args) -> outputs`` for one
+        bucket cell. Called once per cell (the engine caches)."""
+
+    @abc.abstractmethod
+    def pad_batch(
+        self, payloads: list[dict], batch_bucket: int, inner_bucket: int
+    ) -> tuple:
+        """Padded device-ready args for ``prog``; rows past
+        ``len(payloads)`` are zero-masked."""
+
+    @abc.abstractmethod
+    def unpad(self, outputs, payloads: list[dict]) -> list[dict]:
+        """Slice program outputs back into one reply per payload
+        (host numpy)."""
+
+    @abc.abstractmethod
+    def serial(self, payload: dict) -> dict:
+        """Single-request host-numpy fallback (the degrade rung: must
+        not touch the accelerator)."""
+
+    @abc.abstractmethod
+    def oracle(self, payload: dict) -> dict:
+        """Float64 reference reply for correctness checking."""
+
+    @abc.abstractmethod
+    def check_reply(self, payload: dict, reply: dict) -> bool:
+        """True when ``reply`` is consistent with :meth:`oracle`."""
+
+    @abc.abstractmethod
+    def sample_payload(self, rng: np.random.Generator) -> dict:
+        """A synthetic request (load generator + compile-ahead warmup)."""
+
+    def ingest(self, payloads: list[dict]) -> None:
+        """Optional online-ingest hook, called after a batch is served."""
+
+
+# --------------------------------------------------------------------- #
+# ALS: user fold-in + top-k recommendation
+# --------------------------------------------------------------------- #
+
+
+class ALSFoldInTopK(ServingWorkload):
+    """Serve top-k recommendations for unseen users against warm item
+    factors.
+
+    ``model`` is a trained/warm
+    :class:`~distributed_sddmm_tpu.models.als.DistributedALS`; its item
+    factors are fetched once (global row order) and kept as the scoring
+    matrix. ``S_live`` (defaults to the model's ``S_host``) receives
+    each served user's ratings row via ``append_rows`` — the online
+    half of the ingest story.
+
+    Payload: ``{"items": int array, "ratings": float array}``.
+    Reply:   ``{"items": int[k] (top-k unseen item ids, best first),
+    "scores": float[k]}``.
+    """
+
+    name = "als"
+
+    def __init__(
+        self,
+        model,
+        k: int = 10,
+        item_buckets: tuple[int, ...] = ALS_ITEM_BUCKETS,
+        S_live: Optional[HostCOO] = None,
+        ingest_rows: bool = True,
+        ridge: float = 0.1,
+    ):
+        import jax.numpy as jnp
+
+        if model.B is None:
+            raise ValueError(
+                "ALSFoldInTopK needs a warm model (run initialize_embeddings"
+                "/run_cg first, or use ServingEngine warmup)"
+            )
+        self.model = model
+        self.k = int(k)
+        self.inner_buckets = tuple(sorted(int(b) for b in item_buckets))
+        d = model.d_ops
+        self.N = d.N
+        self.R = d.R
+        # Deliberately STIFFER than the training ridge: a fold-in user
+        # has fewer observations than factors (rank-deficient Gram), and
+        # the training-scale 1e-6 leaves the f32 solve meaningless. The
+        # floor keeps the one-user system conditioned; the training
+        # ridge wins only if someone configured it even stiffer.
+        self.ridge_lambda = max(float(model.ridge_lambda), float(ridge))
+        # One host fetch; the serving programs take the factor matrix as
+        # a plain argument so a refreshed B never invalidates the cache.
+        self._B_host = np.ascontiguousarray(
+            model.item_factors(), dtype=np.float32
+        )
+        self._B_dev = jnp.asarray(self._B_host)
+        self.S_live = S_live if S_live is not None else model.S_host
+        self.ingest_rows = bool(ingest_rows and self.S_live is not None)
+        self._ingest_lock = threading.Lock()
+        if self.k > self.N:
+            raise ValueError(f"k={k} exceeds item count N={self.N}")
+
+    # -- payload shaping ----------------------------------------------- #
+
+    def inner_size(self, payload: dict) -> int:
+        return int(len(payload["items"]))
+
+    def clamp(self, payload: dict) -> dict:
+        cap = self.inner_buckets[-1]
+        if len(payload["items"]) <= cap:
+            return payload
+        return {
+            "items": np.asarray(payload["items"])[:cap],
+            "ratings": np.asarray(payload["ratings"])[:cap],
+        }
+
+    def sample_payload(self, rng: np.random.Generator) -> dict:
+        n = int(min(1 + rng.poisson(4), self.inner_buckets[-1]))
+        items = rng.choice(self.N, size=n, replace=False).astype(np.int64)
+        return {
+            "items": items,
+            "ratings": rng.standard_normal(n).astype(np.float64),
+        }
+
+    # -- device program ------------------------------------------------ #
+
+    def build_program(self, batch_bucket: int, inner_bucket: int):
+        import jax
+        import jax.numpy as jnp
+
+        lam = self.ridge_lambda
+        k = self.k
+
+        def fold_in_topk(B, idx, ratings, mask):
+            # Per-row ridge normal equations against the observed item
+            # factors (masked gather keeps padded slots inert). Every op
+            # here is batch-dim-invariant by construction — see
+            # _chol_solve for why lapack solve / plain gemm are not.
+            rows = B[idx] * mask[..., None]                  # (b, L, R)
+            gram = jnp.einsum("blr,bls->brs", rows, rows)
+            gram = gram + lam * jnp.eye(B.shape[1], dtype=B.dtype)
+            rhs = jnp.einsum("blr,bl->br", rows, ratings * mask)
+            x = _chol_solve(gram, rhs)                       # (b, R)
+            # Broadcast-sum, not x @ B.T: gemm accumulation order varies
+            # with the batch dimension on XLA:CPU.
+            scores = jnp.sum(x[:, None, :] * B[None, :, :], axis=-1)
+            # Mask already-rated items out of the recommendation set.
+            b = idx.shape[0]
+            rated = jnp.zeros(scores.shape, dtype=mask.dtype)
+            rated = rated.at[jnp.arange(b)[:, None], idx].max(mask)
+            scores = jnp.where(rated > 0, -jnp.inf, scores)
+            vals, ids = jax.lax.top_k(scores, k)
+            return vals, ids
+
+        return jax.jit(fold_in_topk)
+
+    def pad_batch(
+        self, payloads: list[dict], batch_bucket: int, inner_bucket: int
+    ) -> tuple:
+        b, L = batch_bucket, inner_bucket
+        idx = np.zeros((b, L), dtype=np.int32)
+        ratings = np.zeros((b, L), dtype=np.float32)
+        mask = np.zeros((b, L), dtype=np.float32)
+        for i, p in enumerate(payloads):
+            n = len(p["items"])
+            idx[i, :n] = p["items"]
+            ratings[i, :n] = p["ratings"]
+            mask[i, :n] = 1.0
+        return (self._B_dev, idx, ratings, mask)
+
+    def unpad(self, outputs, payloads: list[dict]) -> list[dict]:
+        n = len(payloads)
+        vals, ids = outputs
+        vals = np.asarray(vals)[:n]
+        ids = np.asarray(ids)[:n]
+        return [
+            {"items": ids[i].astype(np.int64), "scores": vals[i]}
+            for i in range(n)
+        ]
+
+    # -- host paths ---------------------------------------------------- #
+
+    def _scores_host(self, payload: dict, B: np.ndarray) -> np.ndarray:
+        items = np.asarray(payload["items"], dtype=np.int64)
+        ratings = np.asarray(payload["ratings"], dtype=B.dtype)
+        rows = B[items]
+        gram = rows.T @ rows + self.ridge_lambda * np.eye(
+            B.shape[1], dtype=B.dtype
+        )
+        rhs = rows.T @ ratings
+        x = np.linalg.solve(gram, rhs)
+        scores = B @ x
+        scores[items] = -np.inf
+        return scores
+
+    def serial(self, payload: dict) -> dict:
+        """Degrade rung: same math, numpy float32, no accelerator."""
+        scores = self._scores_host(payload, self._B_host)
+        order = np.argsort(-scores, kind="stable")[: self.k]
+        return {"items": order.astype(np.int64),
+                "scores": scores[order].astype(np.float32)}
+
+    def oracle(self, payload: dict) -> dict:
+        scores = self._scores_host(
+            payload, self._B_host.astype(np.float64)
+        )
+        order = np.argsort(-scores, kind="stable")[: self.k]
+        return {"items": order.astype(np.int64), "scores": scores[order]}
+
+    def check_reply(self, payload: dict, reply: dict) -> bool:
+        """Reply is correct when every returned item scores (per the
+        float64 oracle) at least as high as the oracle's k-th best minus
+        float32 slack, and the returned scores agree with the oracle's
+        scores for those same items. Rank-order between near-ties is NOT
+        pinned — f32 vs f64 legitimately swaps ties."""
+        oracle_scores = self._scores_host(
+            payload, self._B_host.astype(np.float64)
+        )
+        scale = float(np.max(np.abs(oracle_scores[np.isfinite(oracle_scores)])))
+        tol = 1e-3 * max(scale, 1.0)
+        ids = np.asarray(reply["items"])
+        got = np.asarray(reply["scores"], dtype=np.float64)
+        kth = np.partition(oracle_scores, -self.k)[-self.k]
+        if np.any(oracle_scores[ids] < kth - tol):
+            return False
+        return bool(np.all(np.abs(got - oracle_scores[ids]) <= tol))
+
+    def ingest(self, payloads: list[dict]) -> None:
+        """Fold the served users into the live ratings matrix: one new
+        row per request (repair-mode sanitize — online traffic is
+        untrusted by definition)."""
+        if not self.ingest_rows:
+            return
+        with self._ingest_lock:
+            self.S_live.append_rows(
+                [np.asarray(p["items"], dtype=np.int64) for p in payloads],
+                [np.asarray(p["ratings"], dtype=np.float64) for p in payloads],
+                mode="repair",
+            )
+
+
+# --------------------------------------------------------------------- #
+# GAT: node scoring over cached forward embeddings
+# --------------------------------------------------------------------- #
+
+
+class GATNodeScore(ServingWorkload):
+    """Score requested nodes against the warm model's cached embeddings.
+
+    ``refresh()`` runs the (whole-graph) forward pass and caches the
+    final-layer embeddings in global row order; per-request serving is a
+    gather + a fixed linear scoring head (seeded at construction so
+    replies are reproducible across processes).
+
+    Payload: ``{"nodes": int array}``.
+    Reply:   ``{"nodes": int array, "scores": float array}`` (one scalar
+    per requested node).
+    """
+
+    name = "gat"
+
+    def __init__(
+        self,
+        model,
+        node_buckets: tuple[int, ...] = GAT_NODE_BUCKETS,
+        head_seed: int = 0,
+    ):
+        self.model = model
+        self.inner_buckets = tuple(sorted(int(b) for b in node_buckets))
+        self.M = model.d_ops.M
+        self._F = model.layers[-1].output_features
+        # Fixed scoring head: embeddings -> scalar logit.
+        rng = np.random.default_rng(head_seed)
+        self._w_host = (
+            rng.standard_normal(self._F) / np.sqrt(self._F)
+        ).astype(np.float32)
+        self._X_host: Optional[np.ndarray] = None
+        self._X_dev = None
+        self._w_dev = None
+        self.refresh()
+
+    def refresh(self) -> None:
+        """Run the warm forward pass and cache the embeddings (call
+        after a weight update; serving reads a consistent snapshot)."""
+        import jax.numpy as jnp
+
+        self._X_host = np.ascontiguousarray(
+            self.model.node_embeddings(), dtype=np.float32
+        )
+        self._X_dev = jnp.asarray(self._X_host)
+        self._w_dev = jnp.asarray(self._w_host)
+
+    # -- payload shaping ----------------------------------------------- #
+
+    def inner_size(self, payload: dict) -> int:
+        return int(len(payload["nodes"]))
+
+    def clamp(self, payload: dict) -> dict:
+        cap = self.inner_buckets[-1]
+        if len(payload["nodes"]) <= cap:
+            return payload
+        return {"nodes": np.asarray(payload["nodes"])[:cap]}
+
+    def sample_payload(self, rng: np.random.Generator) -> dict:
+        n = int(min(1 + rng.poisson(2), self.inner_buckets[-1]))
+        return {
+            "nodes": rng.choice(self.M, size=n, replace=False).astype(np.int64)
+        }
+
+    # -- device program ------------------------------------------------ #
+
+    def build_program(self, batch_bucket: int, inner_bucket: int):
+        import jax
+
+        def score(X, w, nodes, mask):
+            emb = X[nodes]                        # (b, L, F)
+            return (emb @ w) * mask               # (b, L)
+
+        return jax.jit(score)
+
+    def pad_batch(
+        self, payloads: list[dict], batch_bucket: int, inner_bucket: int
+    ) -> tuple:
+        b, L = batch_bucket, inner_bucket
+        nodes = np.zeros((b, L), dtype=np.int32)
+        mask = np.zeros((b, L), dtype=np.float32)
+        for i, p in enumerate(payloads):
+            n = len(p["nodes"])
+            nodes[i, :n] = p["nodes"]
+            mask[i, :n] = 1.0
+        return (self._X_dev, self._w_dev, nodes, mask)
+
+    def unpad(self, outputs, payloads: list[dict]) -> list[dict]:
+        scores = np.asarray(outputs)[: len(payloads)]
+        return [
+            {
+                "nodes": np.asarray(p["nodes"], dtype=np.int64),
+                "scores": scores[i][: len(p["nodes"])],
+            }
+            for i, p in enumerate(payloads)
+        ]
+
+    # -- host paths ---------------------------------------------------- #
+
+    def serial(self, payload: dict) -> dict:
+        nodes = np.asarray(payload["nodes"], dtype=np.int64)
+        scores = self._X_host[nodes] @ self._w_host
+        return {"nodes": nodes, "scores": scores.astype(np.float32)}
+
+    def oracle(self, payload: dict) -> dict:
+        nodes = np.asarray(payload["nodes"], dtype=np.int64)
+        scores = (
+            self._X_host[nodes].astype(np.float64)
+            @ self._w_host.astype(np.float64)
+        )
+        return {"nodes": nodes, "scores": scores}
+
+    def check_reply(self, payload: dict, reply: dict) -> bool:
+        want = self.oracle(payload)["scores"]
+        got = np.asarray(reply["scores"], dtype=np.float64)[: len(want)]
+        scale = max(float(np.max(np.abs(want))) if want.size else 0.0, 1.0)
+        return bool(np.all(np.abs(got - want) <= 1e-3 * scale))

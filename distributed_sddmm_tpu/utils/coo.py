@@ -121,6 +121,56 @@ class HostCOO:
         coo, _ = sanitize_coo(rows, cols, vals, M, N, mode=mode)
         return coo
 
+    def append_rows(
+        self, cols_per_row, vals_per_row, *, mode: str = "strict"
+    ) -> tuple[int, dict]:
+        """Incrementally append new rows in place (online fold-in ingest).
+
+        ``cols_per_row[i]`` / ``vals_per_row[i]`` hold the column indices
+        and values of new row ``M + i``; the matrix grows by
+        ``len(cols_per_row)`` rows with no rebuild of the existing
+        triplets (one concatenate). The appended block passes
+        :func:`sanitize_coo` first (``mode="strict"`` rejects a corrupt
+        block before the matrix is touched — an in-place ingest must be
+        all-or-nothing; ``mode="repair"`` drops/dedups bad entries within
+        the block, the right setting for untrusted online traffic). New
+        rows cannot collide with existing entries by construction, so
+        sanitize only sees the block.
+
+        Returns ``(first_new_row_index, report)`` where the report is the
+        sanitize report for the appended block. Appending zero rows is a
+        no-op.
+        """
+        if len(cols_per_row) != len(vals_per_row):
+            raise ValueError("cols_per_row and vals_per_row length mismatch")
+        k = len(cols_per_row)
+        first = self.M
+        if k == 0:
+            return first, {"out_of_range": 0, "non_finite": 0,
+                           "duplicates": 0, "dropped": 0}
+        counts = [len(c) for c in cols_per_row]
+        rows = np.repeat(
+            np.arange(first, first + k, dtype=np.int64), counts
+        )
+        cols = (
+            np.concatenate([np.asarray(c, dtype=np.int64)
+                            for c in cols_per_row])
+            if sum(counts) else np.empty(0, dtype=np.int64)
+        )
+        vals = (
+            np.concatenate([np.asarray(v, dtype=np.float64)
+                            for v in vals_per_row])
+            if sum(counts) else np.empty(0, dtype=np.float64)
+        )
+        block, report = sanitize_coo(
+            rows, cols, vals, first + k, self.N, mode=mode
+        )
+        self.rows = np.concatenate([self.rows, block.rows])
+        self.cols = np.concatenate([self.cols, block.cols])
+        self.vals = np.concatenate([self.vals, block.vals])
+        self.M = first + k
+        return first, report
+
     # ------------------------------------------------------------------ #
     # Conversions
     # ------------------------------------------------------------------ #

@@ -1,0 +1,210 @@
+"""CPU-mesh serving smoke: the online layer end to end.
+
+Four checks on the same virtual 8-device CPU mesh the test suite uses
+(fast enough for CI; a tier-1 test runs this as a subprocess):
+
+1. **determinism** — one warm ALS fold-in engine; replies are
+   bit-identical across batch compositions and match the float64
+   oracle; the whole bucket ladder is compiled at warmup and live
+   requests only ever hit the cache.
+2. **backpressure** — with no runner draining, submissions beyond
+   ``max_depth`` shed with a retry-after hint and the queue stays
+   bounded.
+3. **faulted load** — an open-loop Poisson run under an injected
+   ``delay,nan`` storm: every request is answered or shed, zero
+   incorrect replies, the engine never dies.
+4. **slo** — the same summary judged against a tight SLO (must
+   violate) and a loose one (must pass): the gate axis works.
+
+Usage::
+
+    python scripts/serve_smoke.py [-o out.json]
+
+Prints one JSON summary; exits nonzero if any check fails.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import pathlib
+import sys
+import time
+
+REPO = pathlib.Path(__file__).resolve().parents[1]
+sys.path.insert(0, str(REPO))
+
+
+def _build_serving(seed: int = 0):
+    import numpy as np
+
+    from distributed_sddmm_tpu.models.als import DistributedALS
+    from distributed_sddmm_tpu.parallel.dense_shift_15d import DenseShift15D
+    from distributed_sddmm_tpu.serve import ALSFoldInTopK, ServingEngine
+    from distributed_sddmm_tpu.utils.coo import HostCOO
+
+    S = HostCOO.erdos_renyi(64, 48, 6, seed=seed, values="normal")
+    alg = DenseShift15D(S, R=8, c=1, fusion_approach=2)
+    model = DistributedALS(alg, S_host=S)
+    model.run_cg(2, cg_iters=4)
+    workload = ALSFoldInTopK(model, k=5, item_buckets=(4, 8))
+    engine = ServingEngine(
+        workload, max_batch=4, max_depth=16, max_wait_ms=4.0
+    )
+    rng = np.random.default_rng(seed + 1)
+    payloads = [workload.sample_payload(rng) for _ in range(6)]
+    return workload, engine, payloads
+
+
+def check_determinism(workload, engine, payloads) -> dict:
+    import numpy as np
+
+    warmed = engine.warmup()
+    stats0 = engine.stats()
+    batched = engine.execute_now(payloads)
+    solos = [engine.execute_now([p])[0] for p in payloads]
+    bit_identical = all(
+        np.array_equal(a["items"], b["items"])
+        and np.array_equal(a["scores"], b["scores"])
+        for a, b in zip(batched, solos)
+    )
+    oracle_ok = all(
+        workload.check_reply(p, r) for p, r in zip(payloads, batched)
+    )
+    stats = engine.stats()
+    return {
+        "name": "determinism",
+        "ok": bool(
+            bit_identical and oracle_ok
+            and warmed == stats0["cache_misses"]
+            and stats["cache_misses"] == stats0["cache_misses"]
+        ),
+        "bit_identical": bit_identical,
+        "oracle_ok": oracle_ok,
+        "programs": stats["programs"],
+        "live_compiles": stats["cache_misses"] - stats0["cache_misses"],
+    }
+
+
+def check_backpressure(workload) -> dict:
+    import numpy as np
+
+    from distributed_sddmm_tpu.serve import ServingEngine, ShedError
+
+    engine = ServingEngine(
+        workload, max_batch=2, max_depth=4, max_wait_ms=1.0
+    )
+    rng = np.random.default_rng(9)
+    shed = 0
+    retry_after_sane = True
+    for _ in range(10):
+        try:
+            engine.submit(workload.sample_payload(rng))
+        except ShedError as e:
+            shed += 1
+            retry_after_sane &= e.retry_after_s >= 0.0
+    depth = engine.queue.depth()
+    engine.queue.close()
+    return {
+        "name": "backpressure",
+        "ok": bool(shed == 6 and depth == 4 and retry_after_sane),
+        "shed": shed,
+        "depth": depth,
+    }
+
+
+def check_faulted_load(workload) -> dict:
+    from distributed_sddmm_tpu.resilience import FaultPlan, fault_plan
+    from distributed_sddmm_tpu.serve import ServingEngine, run_load
+
+    engine = ServingEngine(
+        workload, max_batch=4, max_depth=8, max_wait_ms=2.0
+    )
+    plan = FaultPlan.from_spec("delay,nan")
+    engine.start(warmup=False)
+    try:
+        with fault_plan(plan):
+            summary = run_load(
+                engine, duration_s=1.5, rate_hz=40, seed=3, oracle_every=3
+            )
+    finally:
+        engine.stop()
+    accounted = (
+        summary["completed"] + summary["shed_count"] == summary["requests"]
+    )
+    return {
+        "name": "faulted_load",
+        "ok": bool(
+            accounted
+            and summary["errors"] == 0
+            and summary["oracle_failures"] == 0
+            and len(plan.events) > 0
+        ),
+        "requests": summary["requests"],
+        "completed": summary["completed"],
+        "shed": summary["shed_count"],
+        "degraded": summary["degraded_count"],
+        "faults_fired": len(plan.events),
+        "oracle_failures": summary["oracle_failures"],
+        "p99_ms": summary["latency_ms"].get("p99"),
+    }
+
+
+def check_slo(workload) -> dict:
+    from distributed_sddmm_tpu.serve import ServingEngine, SLOSpec, run_load
+
+    engine = ServingEngine(
+        workload, max_batch=4, max_depth=16, max_wait_ms=2.0
+    )
+    engine.start(warmup=False)
+    try:
+        summary = run_load(
+            engine, duration_s=1.0, rate_hz=30, seed=4, oracle_every=0,
+            slo=SLOSpec.parse("p99_ms=0.001"),  # impossibly tight
+        )
+    finally:
+        engine.stop()
+    tight_violates = bool(summary["slo_violations"])
+    loose_passes = not SLOSpec.parse("p99_ms=60000,err_rate=0.5").check(
+        summary
+    )
+    return {
+        "name": "slo",
+        "ok": bool(tight_violates and loose_passes and summary["completed"]),
+        "tight_violations": summary["slo_violations"],
+        "completed": summary["completed"],
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("-o", "--output-file", default=None)
+    args = ap.parse_args(argv)
+
+    from distributed_sddmm_tpu.utils.platform import force_cpu_platform
+
+    force_cpu_platform(n_devices=8, replace=True)
+
+    t0 = time.perf_counter()
+    workload, engine, payloads = _build_serving()
+    checks = [check_determinism(workload, engine, payloads)]
+    # The remaining checks build their own engines over the same warm
+    # workload (programs recompile per engine; the matrices are tiny).
+    checks.append(check_backpressure(workload))
+    checks.append(check_faulted_load(workload))
+    checks.append(check_slo(workload))
+
+    report = {
+        "ok": all(c["ok"] for c in checks),
+        "elapsed_s": round(time.perf_counter() - t0, 2),
+        "checks": checks,
+    }
+    text = json.dumps(report, indent=1)
+    print(text)
+    if args.output_file:
+        pathlib.Path(args.output_file).write_text(text)
+    return 0 if report["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

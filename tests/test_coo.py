@@ -166,3 +166,89 @@ def test_verify_zero_nnz_matrix_matches_oracle():
 
     S0 = HostCOO.ingest([], [], [], 64, 64)
     assert verify_algorithms(R=16, c=2, alg_names=["15d_fusion2"], S=S0)
+
+
+# --------------------------------------------------------------------- #
+# append_rows: incremental fold-in ingest
+# --------------------------------------------------------------------- #
+
+
+def test_append_rows_matches_from_scratch_oracle():
+    """Appending rows incrementally must equal building the grown matrix
+    from scratch (dense compare via scipy)."""
+    S = HostCOO.erdos_renyi(16, 12, 3, seed=0, values="normal")
+    rows0 = S.rows.copy()
+    cols0, vals0 = S.cols.copy(), S.vals.copy()
+    new_cols = [np.array([0, 5, 11]), np.array([2])]
+    new_vals = [np.array([1.0, -2.0, 0.5]), np.array([3.0])]
+    first, report = S.append_rows(new_cols, new_vals)
+    assert first == 16
+    assert S.M == 18 and S.N == 12
+    assert report["dropped"] == 0
+    want = HostCOO(
+        np.concatenate([rows0, [16, 16, 16, 17]]),
+        np.concatenate([cols0, [0, 5, 11, 2]]),
+        np.concatenate([vals0, [1.0, -2.0, 0.5, 3.0]]),
+        18, 12,
+    )
+    assert (S.to_scipy() != want.to_scipy()).nnz == 0
+
+
+def test_append_rows_empty_is_noop():
+    S = HostCOO.erdos_renyi(8, 8, 2, seed=1)
+    m, nnz = S.M, S.nnz
+    first, report = S.append_rows([], [])
+    assert (first, S.M, S.nnz) == (m, m, nnz)
+    assert report["dropped"] == 0
+
+
+def test_append_rows_strict_rejects_without_mutating():
+    """A corrupt block in strict mode must leave the matrix untouched
+    (all-or-nothing: in-place ingest cannot half-apply)."""
+    S = HostCOO.erdos_renyi(8, 8, 2, seed=2)
+    m, nnz = S.M, S.nnz
+    with pytest.raises(ValueError, match="out_of_range|corrupt"):
+        S.append_rows([np.array([0, 99])], [np.array([1.0, 2.0])])
+    with pytest.raises(ValueError, match="non_finite|corrupt"):
+        S.append_rows([np.array([0, 1])], [np.array([1.0, np.nan])])
+    assert (S.M, S.nnz) == (m, nnz)
+
+
+def test_append_rows_repair_drops_and_dedups():
+    S = HostCOO.erdos_renyi(8, 8, 2, seed=3)
+    nnz = S.nnz
+    first, report = S.append_rows(
+        [np.array([0, 99, 3, 3]), np.array([1, 2])],
+        [np.array([1.0, 5.0, 2.0, 9.0]), np.array([np.inf, 4.0])],
+        mode="repair",
+    )
+    assert first == 8 and S.M == 10
+    # kept: (8,0)=1.0, (8,3)=2.0 first-wins, (9,2)=4.0
+    assert S.nnz == nnz + 3
+    assert report["dropped"] == 3
+    tail = {(int(r), int(c)): v
+            for r, c, v in zip(S.rows[nnz:], S.cols[nnz:], S.vals[nnz:])}
+    assert tail == {(8, 0): 1.0, (8, 3): 2.0, (9, 2): 4.0}
+
+
+def test_append_rows_mismatched_lengths_raise():
+    S = HostCOO.erdos_renyi(8, 8, 2, seed=4)
+    with pytest.raises(ValueError):
+        S.append_rows([np.array([0])], [])
+    with pytest.raises(ValueError):
+        S.append_rows([np.array([0, 1])], [np.array([1.0])])
+
+
+def test_append_rows_then_algorithms_still_verify():
+    """A grown matrix must flow through the distributed strategies and
+    match the oracle — appended rows are first-class entries."""
+    from distributed_sddmm_tpu.utils.verify import verify_algorithms
+
+    S = HostCOO.erdos_renyi(60, 64, 4, seed=5)
+    rng = np.random.default_rng(6)
+    S.append_rows(
+        [rng.choice(64, size=5, replace=False) for _ in range(4)],
+        [np.ones(5) for _ in range(4)],
+    )
+    assert S.M == 64
+    assert verify_algorithms(R=16, c=2, alg_names=["15d_fusion2"], S=S)

@@ -1,0 +1,559 @@
+"""Serving-layer contract tests: batching determinism, backpressure,
+fault survival, bucket padding, SLO machinery, gate integration.
+
+The load-bearing property throughout: a request's reply is a function of
+its payload alone — not of arrival order, micro-batch composition,
+batch bucket, or padding. Everything else (shedding, degradation) exists
+so the engine keeps honoring that property under pressure.
+"""
+
+import json
+import pathlib
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from distributed_sddmm_tpu.models.als import DistributedALS
+from distributed_sddmm_tpu.obs import watchdog as obs_watchdog
+from distributed_sddmm_tpu.parallel.dense_shift_15d import DenseShift15D
+from distributed_sddmm_tpu.resilience import FaultPlan, FaultSpec, fault_plan
+from distributed_sddmm_tpu.serve import (
+    ALSFoldInTopK, GATNodeScore, RequestQueue, ServingEngine, ShedError,
+    SLOSpec, bucket_for, percentile, run_load,
+)
+from distributed_sddmm_tpu.utils.coo import HostCOO
+
+
+def _reply_equal(a: dict, b: dict) -> bool:
+    return set(a) == set(b) and all(
+        np.array_equal(np.asarray(a[k]), np.asarray(b[k])) for k in a
+    )
+
+
+@pytest.fixture(scope="module")
+def als_serving():
+    """One warm ALS fold-in workload + engine for the module (model
+    training dominates setup; every test reuses it read-only)."""
+    S = HostCOO.erdos_renyi(64, 48, 6, seed=0, values="normal")
+    alg = DenseShift15D(S, R=8, c=1, fusion_approach=2)
+    model = DistributedALS(alg, S_host=S)
+    model.run_cg(2, cg_iters=4)
+    workload = ALSFoldInTopK(model, k=5, item_buckets=(4, 8),
+                             ingest_rows=False)
+    engine = ServingEngine(
+        workload, max_batch=4, max_depth=16, max_wait_ms=4.0
+    )
+    engine.warmup()
+    return workload, engine
+
+
+@pytest.fixture(scope="module")
+def als_payloads(als_serving):
+    workload, _ = als_serving
+    rng = np.random.default_rng(7)
+    return [workload.sample_payload(rng) for _ in range(6)]
+
+
+# --------------------------------------------------------------------- #
+# Queue semantics
+# --------------------------------------------------------------------- #
+
+
+class TestQueue:
+    def test_fifo_and_batch_cap(self):
+        q = RequestQueue(max_depth=8, max_batch=3, max_wait_ms=1.0)
+        reqs = [q.submit(i) for i in range(5)]
+        batch = q.next_batch(timeout_s=1.0)
+        assert [r.req_id for r in batch] == [r.req_id for r in reqs[:3]]
+        assert [r.payload for r in q.next_batch(timeout_s=1.0)] == [3, 4]
+
+    def test_first_arrival_starts_the_clock(self):
+        q = RequestQueue(max_depth=8, max_batch=4, max_wait_ms=60.0)
+        t0 = time.perf_counter()
+        q.submit("a")
+        batch = q.next_batch(timeout_s=5.0)
+        waited = time.perf_counter() - t0
+        assert [r.payload for r in batch] == ["a"]
+        # A lone request pays ~max_wait_ms, not the full poll timeout.
+        assert waited < 2.0
+
+    def test_admission_bound_sheds_with_retry_after(self):
+        q = RequestQueue(max_depth=2, max_batch=2, max_wait_ms=1.0)
+        q.submit("a")
+        q.submit("b")
+        with pytest.raises(ShedError) as ei:
+            q.submit("c")
+        assert ei.value.retry_after_s >= 0.0
+        assert q.shed_count == 1
+        assert q.depth() == 2  # the shed request never entered
+
+    def test_close_drains_then_returns_empty(self):
+        q = RequestQueue(max_depth=4, max_batch=4, max_wait_ms=1.0)
+        q.submit("a")
+        q.close()
+        with pytest.raises(RuntimeError):
+            q.submit("b")
+        assert [r.payload for r in q.next_batch(timeout_s=1.0)] == ["a"]
+        assert q.next_batch(timeout_s=0.2) == []
+
+    def test_timeline_stamps(self):
+        q = RequestQueue(max_depth=4, max_batch=1, max_wait_ms=0.0)
+        req = q.submit("a")
+        (got,) = q.next_batch(timeout_s=1.0)
+        got.t_execute = time.perf_counter()
+        got.set_result("ok")
+        lat = req.stage_latencies_s()
+        assert set(lat) == {"queue_s", "execute_s", "total_s"}
+        assert lat["total_s"] >= lat["queue_s"] >= 0.0
+
+
+# --------------------------------------------------------------------- #
+# Batching determinism + bucket padding (the core serving contract)
+# --------------------------------------------------------------------- #
+
+
+class TestDeterminism:
+    def test_bucket_for(self):
+        assert bucket_for(1, (4, 8)) == 4
+        assert bucket_for(5, (4, 8)) == 8
+        assert bucket_for(99, (4, 8)) == 8  # clamp rung
+
+    def test_any_arrival_order_bit_identical(self, als_serving, als_payloads):
+        _, engine = als_serving
+        base = engine.execute_now(als_payloads)
+        for perm in ([3, 1, 5, 0, 2, 4], [5, 4, 3, 2, 1, 0]):
+            permuted = engine.execute_now([als_payloads[i] for i in perm])
+            for where, i in enumerate(perm):
+                assert _reply_equal(permuted[where], base[i])
+
+    def test_bucket_padding_never_changes_results(
+        self, als_serving, als_payloads
+    ):
+        """Batch of 1 (smallest bucket, all padding) vs full batch
+        (bigger bucket, other requests as neighbors): bit-identical."""
+        _, engine = als_serving
+        base = engine.execute_now(als_payloads)
+        for i, p in enumerate(als_payloads):
+            solo = engine.execute_now([p])[0]
+            assert _reply_equal(solo, base[i])
+
+    def test_replies_match_float64_oracle(self, als_serving, als_payloads):
+        workload, engine = als_serving
+        for p, r in zip(als_payloads, engine.execute_now(als_payloads)):
+            assert workload.check_reply(p, r)
+
+    def test_queued_path_matches_direct(self, als_serving, als_payloads):
+        workload, _ = als_serving
+        engine = ServingEngine(
+            workload, max_batch=4, max_depth=16, max_wait_ms=10.0
+        )
+        base = engine.execute_now(als_payloads)
+        engine.start(warmup=False)
+        try:
+            reqs = [engine.submit(p) for p in als_payloads]
+            replies = [r.result(timeout_s=30.0) for r in reqs]
+        finally:
+            engine.stop()
+        for got, want in zip(replies, base):
+            assert _reply_equal(got, want)
+
+    def test_gat_workload_determinism_and_oracle(self):
+        from distributed_sddmm_tpu.models.gat import GAT, GATLayer
+
+        S = HostCOO.erdos_renyi(64, 64, 5, seed=1)
+        alg = DenseShift15D(S, R=8, c=1, fusion_approach=2)
+        workload = GATNodeScore(
+            GAT([GATLayer(8, 8, 2)], alg), node_buckets=(2, 4)
+        )
+        engine = ServingEngine(workload, max_batch=4, max_depth=16)
+        engine.warmup()
+        rng = np.random.default_rng(3)
+        payloads = [workload.sample_payload(rng) for _ in range(5)]
+        batched = engine.execute_now(payloads)
+        for i, p in enumerate(payloads):
+            assert _reply_equal(engine.execute_now([p])[0], batched[i])
+            assert workload.check_reply(p, batched[i])
+
+
+# --------------------------------------------------------------------- #
+# Warm program cache
+# --------------------------------------------------------------------- #
+
+
+class TestProgramCache:
+    def test_warmup_compiles_whole_ladder_then_only_hits(
+        self, als_serving, als_payloads
+    ):
+        workload, _ = als_serving
+        engine = ServingEngine(
+            workload, max_batch=4, max_depth=16, max_wait_ms=2.0
+        )
+        warmed = engine.warmup()
+        stats = engine.stats()
+        assert warmed == stats["programs"] == stats["cache_misses"] == 6
+        engine.execute_now(als_payloads)
+        stats = engine.stats()
+        assert stats["cache_misses"] == 6  # no live-request compiles
+        assert stats["cache_hits"] > 0
+
+    def test_cache_keyed_like_autotune_fingerprints(self, als_serving):
+        from distributed_sddmm_tpu.autotune import fingerprint as fp
+
+        _, engine = als_serving
+        key = engine.program_key(4, 8)
+        assert key.startswith("serve:als:b4:i8")
+        # keyed on the serving code generation: serve/ sources shape
+        # these programs the way ops/+parallel/ shape offline plans
+        assert fp.serve_code_hash() in key
+
+
+# --------------------------------------------------------------------- #
+# Resilience: transient heal, persistent degrade, engine never dies
+# --------------------------------------------------------------------- #
+
+
+class TestFaultedEngine:
+    def test_transient_faults_heal_bit_identical(
+        self, als_serving, als_payloads
+    ):
+        workload, engine = als_serving
+        want = engine.execute_now(als_payloads[:2])
+        plan = FaultPlan([
+            FaultSpec(site="execute:serveBatch", kind="timeout", at=(0,)),
+            FaultSpec(site="output:serveBatch", kind="nan", at=(1,),
+                      param=0.2),
+        ])
+        with fault_plan(plan):
+            got = engine.execute_now(als_payloads[:2])
+        assert {k for _, k, _ in plan.events} == {"timeout", "nan"}
+        for a, b in zip(got, want):
+            assert _reply_equal(a, b)
+
+    def test_persistent_fault_degrades_to_serial(
+        self, als_serving, als_payloads
+    ):
+        workload, _ = als_serving
+        engine = ServingEngine(
+            workload, max_batch=4, max_depth=16, max_wait_ms=2.0,
+            exec_retries=1,
+        )
+        plan = FaultPlan([
+            FaultSpec(site="execute:serveBatch", kind="error", prob=1.0),
+        ])
+        engine.start(warmup=False)
+        try:
+            with fault_plan(plan):
+                req = engine.submit(als_payloads[0])
+                reply = req.result(timeout_s=30.0)
+        finally:
+            engine.stop()
+        assert req.degraded is True
+        assert engine.degraded_batches >= 1
+        # The degraded reply is the serial fallback's answer — still a
+        # correct recommendation per the float64 oracle.
+        assert _reply_equal(reply, workload.serial(als_payloads[0]))
+        assert workload.check_reply(als_payloads[0], reply)
+
+    def test_faulted_load_run_stays_up(self, als_serving):
+        """A probabilistic delay+nan storm: every offered request is
+        answered or shed, none crash the runner."""
+        workload, _ = als_serving
+        engine = ServingEngine(
+            workload, max_batch=4, max_depth=8, max_wait_ms=2.0
+        )
+        plan = FaultPlan.from_spec("delay,nan")
+        engine.start(warmup=False)
+        try:
+            with fault_plan(plan):
+                summary = run_load(
+                    engine, duration_s=1.2, rate_hz=40, seed=5,
+                    oracle_every=3,
+                )
+        finally:
+            engine.stop()
+        assert summary["errors"] == 0
+        assert summary["oracle_failures"] == 0
+        assert (
+            summary["completed"] + summary["shed_count"]
+            == summary["requests"]
+        )
+        assert len(plan.events) > 0  # the storm actually fired
+
+
+# --------------------------------------------------------------------- #
+# Watchdog: queue-depth runaway
+# --------------------------------------------------------------------- #
+
+
+class TestQueueRunaway:
+    def test_sustained_depth_fires_once_and_rearms(self):
+        wd = obs_watchdog.Watchdog(
+            mode="warn", queue_frac=0.5, queue_patience=3
+        )
+        for _ in range(5):
+            wd.observe_queue(6, 10)
+        kinds = [e["kind"] for e in wd.events]
+        assert kinds.count("queue_runaway") == 1  # one per episode
+        wd.observe_queue(1, 10)  # drains -> re-arms
+        for _ in range(3):
+            wd.observe_queue(9, 10)
+        kinds = [e["kind"] for e in wd.events]
+        assert kinds.count("queue_runaway") == 2
+
+    def test_brief_spike_does_not_fire(self):
+        wd = obs_watchdog.Watchdog(
+            mode="warn", queue_frac=0.5, queue_patience=3
+        )
+        for _ in range(10):
+            wd.observe_queue(6, 10)
+            wd.observe_queue(0, 10)
+        assert not wd.events
+
+    def test_strict_mode_escalates(self):
+        wd = obs_watchdog.Watchdog(
+            mode="strict", queue_frac=0.5, queue_patience=2
+        )
+        wd.observe_queue(8, 10)
+        with pytest.raises(obs_watchdog.WatchdogAlarm):
+            wd.observe_queue(8, 10)
+        assert wd.summary()["anomalies"][0]["kind"] == "queue_runaway"
+
+    def test_engine_submit_feeds_the_watchdog(self, als_serving):
+        workload, _ = als_serving
+        engine = ServingEngine(workload, max_batch=2, max_depth=8)
+        obs_watchdog.enable("warn", queue_frac=0.25, queue_patience=2)
+        try:
+            rng = np.random.default_rng(0)
+            for _ in range(6):  # runner not started: depth only grows
+                engine.submit(workload.sample_payload(rng))
+            wd = obs_watchdog.active()
+            assert any(e["kind"] == "queue_runaway" for e in wd.events)
+        finally:
+            obs_watchdog.disable()
+            engine.queue.close()
+
+
+# --------------------------------------------------------------------- #
+# SLO machinery
+# --------------------------------------------------------------------- #
+
+
+class TestSLO:
+    def test_percentile_nearest_rank(self):
+        xs = [float(i) for i in range(1, 101)]
+        assert percentile(xs, 50) == 50.0
+        assert percentile(xs, 99) == 99.0
+        assert percentile([], 50) is None
+
+    def test_parse_and_check(self):
+        spec = SLOSpec.parse("p99_ms=10, err_rate=0.01")
+        assert spec.p99_ms == 10.0 and spec.err_rate == 0.01
+        viol = spec.check({
+            "latency_ms": {"p99": 12.0}, "err_rate": 0.0, "shed_rate": 0.5,
+        })
+        assert [v["axis"] for v in viol] == ["p99_ms"]
+        assert spec.check({"latency_ms": {"p99": 9.0}, "err_rate": 0.0}) == []
+
+    def test_parse_rejects_unknown_keys(self):
+        with pytest.raises(ValueError):
+            SLOSpec.parse("p98_ms=10")
+        with pytest.raises(ValueError):
+            SLOSpec.parse("p99_ms")
+
+    def test_env_spec(self, monkeypatch):
+        monkeypatch.setenv("DSDDMM_SLO", "p50_ms=5,shed_rate=0.1")
+        spec = SLOSpec.from_env()
+        assert spec.p50_ms == 5.0 and spec.shed_rate == 0.1
+
+
+# --------------------------------------------------------------------- #
+# Backpressure through the full engine
+# --------------------------------------------------------------------- #
+
+
+class TestBackpressure:
+    def test_overload_sheds_instead_of_queueing_forever(self, als_serving):
+        workload, _ = als_serving
+        engine = ServingEngine(
+            workload, max_batch=2, max_depth=4, max_wait_ms=1.0
+        )
+        rng = np.random.default_rng(1)
+        shed = 0
+        for _ in range(12):  # runner not running: only shed relieves
+            try:
+                engine.submit(workload.sample_payload(rng))
+            except ShedError as e:
+                shed += 1
+                assert e.retry_after_s >= 0.0
+        assert shed == 8  # exactly the overflow beyond max_depth
+        assert engine.recorder.shed == 8
+        assert engine.queue.depth() == 4
+        engine.queue.close()
+
+
+# --------------------------------------------------------------------- #
+# Gate integration: serving verdict axes
+# --------------------------------------------------------------------- #
+
+
+def _serve_doc(run_id: str, p99_ms: float, shed_rate: float = 0.0,
+               key: str = "sk1") -> dict:
+    return {
+        "run_id": run_id, "key": key, "backend": "cpu", "code_hash": "c1",
+        "record": {
+            "app": "serve-als", "algorithm": "15d_fusion2", "R": 16,
+            "c": 1, "fused": True, "kernel": "xla",
+            "requests": 100, "shed_rate": shed_rate,
+            "shed_count": int(shed_rate * 100),
+            "latency_ms": {"p50": p99_ms / 2, "p99": p99_ms},
+            "metrics": {},
+        },
+    }
+
+
+class TestServingGate:
+    def test_phase_stats_exposes_serving_axes(self):
+        from distributed_sddmm_tpu.obs import regress
+
+        rows = regress.phase_stats(_serve_doc("a", 10.0, 0.05))
+        assert rows["serve:latency_p99"]["t_call"] == pytest.approx(0.010)
+        assert rows["serve:latency_p50"]["t_call"] == pytest.approx(0.005)
+        assert rows["serve:shed_rate"]["t_call"] == pytest.approx(0.05)
+
+    def test_latency_regression_gates(self, tmp_path):
+        from distributed_sddmm_tpu.obs import regress
+        from distributed_sddmm_tpu.obs.store import RunStore
+
+        store = RunStore(tmp_path)
+        for i in range(3):
+            store.put(_serve_doc(f"base-{i}", 10.0))
+        bad = _serve_doc("new", 25.0)
+        store.put(bad)
+        code, report = regress.gate(store, bad, k=3)
+        assert code == regress.GATE_REGRESSION
+        assert "serve:latency_p99" in report["regressions"]
+        assert (
+            report["phases"]["serve:latency_p99"]["attribution"] == "serving"
+        )
+
+    def test_shed_storm_gates(self, tmp_path):
+        from distributed_sddmm_tpu.obs import regress
+        from distributed_sddmm_tpu.obs.store import RunStore
+
+        store = RunStore(tmp_path)
+        for i in range(3):
+            store.put(_serve_doc(f"base-{i}", 10.0, shed_rate=0.0))
+        bad = _serve_doc("new", 10.0, shed_rate=0.3)
+        store.put(bad)
+        code, report = regress.gate(store, bad, k=3)
+        assert code == regress.GATE_REGRESSION
+        assert "serve:shed_rate" in report["regressions"]
+
+    def test_steady_serving_passes(self, tmp_path):
+        from distributed_sddmm_tpu.obs import regress
+        from distributed_sddmm_tpu.obs.store import RunStore
+
+        store = RunStore(tmp_path)
+        for i in range(3):
+            store.put(_serve_doc(f"base-{i}", 10.0))
+        ok = _serve_doc("new", 10.5)
+        store.put(ok)
+        code, report = regress.gate(store, ok, k=3)
+        assert code == regress.GATE_PASS
+
+    def test_index_rows_carry_serving_fields(self, tmp_path):
+        from distributed_sddmm_tpu.obs.store import RunStore
+
+        store = RunStore(tmp_path)
+        store.put(_serve_doc("a", 12.5, shed_rate=0.02))
+        (row,) = store.index()
+        assert row["latency_p99_ms"] == 12.5
+        assert row["shed_count"] == 2
+
+
+# --------------------------------------------------------------------- #
+# Fault shorthand
+# --------------------------------------------------------------------- #
+
+
+class TestFaultShorthand:
+    def test_kind_list_expands(self):
+        plan = FaultPlan.from_spec("delay,nan")
+        kinds = {(s.site, s.kind) for s in plan.specs}
+        assert kinds == {("execute:*", "delay"), ("output:*", "nan")}
+        assert all(s.prob > 0 for s in plan.specs)
+
+    def test_json_specs_still_parse(self):
+        plan = FaultPlan.from_spec(
+            '[{"site": "execute:*", "kind": "timeout", "at": [0]}]'
+        )
+        assert plan.specs[0].kind == "timeout"
+
+    def test_unknown_word_falls_through_to_json_error(self):
+        with pytest.raises(ValueError):
+            FaultPlan.from_spec("delay,frobnicate")
+
+
+# --------------------------------------------------------------------- #
+# Online ingest: append_rows wired into the serving path
+# --------------------------------------------------------------------- #
+
+
+def test_served_users_are_folded_into_live_matrix():
+    S = HostCOO.erdos_renyi(48, 32, 5, seed=2, values="normal")
+    alg = DenseShift15D(S, R=8, c=1, fusion_approach=2)
+    model = DistributedALS(alg, S_host=S)
+    model.initialize_embeddings()
+    workload = ALSFoldInTopK(model, k=3, item_buckets=(4, 8),
+                             ingest_rows=True)
+    engine = ServingEngine(workload, max_batch=4, max_depth=8,
+                           max_wait_ms=2.0)
+    rng = np.random.default_rng(4)
+    payloads = [workload.sample_payload(rng) for _ in range(3)]
+    M0, nnz0 = S.M, S.nnz
+    engine.start(warmup=False)
+    try:
+        reqs = [engine.submit(p) for p in payloads]
+        for r in reqs:
+            r.result(timeout_s=30.0)
+    finally:
+        engine.stop()
+    assert S.M == M0 + 3
+    assert S.nnz == nnz0 + sum(len(p["items"]) for p in payloads)
+    # the appended rows are exactly the served ratings
+    got = {(int(r), int(c)): v
+           for r, c, v in zip(S.rows[nnz0:], S.cols[nnz0:], S.vals[nnz0:])}
+    want = {}
+    for i, p in enumerate(payloads):
+        for c, v in zip(p["items"], p["ratings"]):
+            want[(M0 + i, int(c))] = float(v)
+    assert got == pytest.approx(want)
+
+
+# --------------------------------------------------------------------- #
+# The tier-1 smoke script, end to end in a clean subprocess
+# --------------------------------------------------------------------- #
+
+
+def test_serve_smoke_script(tmp_path):
+    repo = pathlib.Path(__file__).resolve().parents[1]
+    out_file = tmp_path / "smoke.json"
+    proc = subprocess.run(
+        [sys.executable, str(repo / "scripts" / "serve_smoke.py"),
+         "-o", str(out_file)],
+        capture_output=True, text=True, timeout=540,
+        env={"PATH": "/usr/bin:/bin:/usr/local/bin", "HOME": "/tmp"},
+    )
+    assert proc.returncode == 0, proc.stdout[-2000:] + proc.stderr[-2000:]
+    rep = json.loads(out_file.read_text())
+    assert rep["ok"] is True
+    by_name = {c["name"]: c for c in rep["checks"]}
+    assert set(by_name) == {
+        "determinism", "backpressure", "faulted_load", "slo",
+    }
+    assert by_name["determinism"]["live_compiles"] == 0
+    assert by_name["faulted_load"]["faults_fired"] > 0
+    assert by_name["faulted_load"]["oracle_failures"] == 0
