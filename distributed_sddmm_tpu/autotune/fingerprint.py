@@ -86,6 +86,21 @@ def code_hash() -> str:
 
 
 @functools.lru_cache(maxsize=1)
+def models_code_hash() -> str:
+    """Hash of the ``models/`` sources. The jit-chained app programs
+    (``cgStep``, ``gatLayer``) bake the CG vector algebra / layer math
+    into the executable on top of the strategy programs, so their store
+    entries must be invalidated by a ``models/`` edit even though
+    :func:`code_hash` (ops/ + parallel/ only, the plan-validity scope)
+    deliberately is not."""
+    h = hashlib.sha256()
+    for f in sorted((_PKG / "models").glob("*.py")):
+        h.update(f.name.encode())
+        h.update(f.read_bytes())
+    return h.hexdigest()[:12]
+
+
+@functools.lru_cache(maxsize=1)
 def serve_code_hash() -> str:
     """The serving analog of :func:`code_hash`: warm serving programs
     (fold-in solve, node scoring) are shaped by ``serve/workloads.py``,
@@ -101,12 +116,15 @@ def serve_code_hash() -> str:
 def serve_program_key(
     workload: str, batch_bucket: int, inner_bucket: int, r, backend: str,
 ) -> str:
-    """Cache key for one serving bucket cell — same discipline as the
-    plan-cache fingerprints (problem shape + machine + code generation),
-    owned here so the key grammar lives next to the other fingerprints."""
-    return (
-        f"serve:{workload}:b{int(batch_bucket)}:i{int(inner_bucket)}"
-        f":r{r}:{backend}:{serve_code_hash()}"
+    """Cache key for one serving bucket cell. The grammar now lives in
+    ``programs/keys.py`` beside every other compiled-program key (PR 6
+    unified the three look-alike builders); this compat re-export keeps
+    the historical import path working."""
+    from distributed_sddmm_tpu.programs import keys as program_keys
+
+    return program_keys.serve_program_key(
+        workload, batch_bucket, inner_bucket, r, backend,
+        code=serve_code_hash(),
     )
 
 

@@ -81,18 +81,34 @@ class Plan:
     def make_kernel(self):
         return measure_mod._build_kernel(self.candidate())
 
-    def instantiate(self, S, R: int, devices=None, **kw):
+    def instantiate(self, S, R: int, devices=None, program_store=None, **kw):
         """Build the planned strategy for a concrete sparse matrix through
         the harness factory (same five magic strings). ``R`` is passed
         explicitly — plans are selected per problem and do not carry the
-        problem with them."""
+        problem with them.
+
+        When the persistent program store is active (``programs/``;
+        ``program_store`` overrides, ``DSDDMM_PROGRAMS=0`` vetoes), the
+        strategy is bound to it under this plan's fingerprint key: every
+        shard_map program the strategy compiles is then recalled from
+        ``artifacts/programs/`` when a previous process already built it,
+        and persisted when not — the zero-live-compile warm start the
+        plan cache gives selection, extended to compilation."""
         from distributed_sddmm_tpu.bench.harness import make_algorithm
 
         with measure_mod.block_knobs(self.candidate()):
-            return make_algorithm(
+            alg = make_algorithm(
                 self.algorithm, S, R=R, c=self.c,
                 kernel=self.make_kernel(), devices=devices, **kw
             )
+        if self.fingerprint_key:
+            from distributed_sddmm_tpu import programs
+
+            programs.bind_strategy(
+                alg, self.fingerprint_key, store=program_store,
+                content_key=programs.matrix_content_key(S),
+            )
+        return alg
 
 
 def _seed_candidate(

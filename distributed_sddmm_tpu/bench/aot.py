@@ -62,17 +62,48 @@ def trip_counts(trials: int) -> tuple[int, int]:
     return (1, 1 + trials)
 
 
-def save_executable(compiled, out_dir: str | pathlib.Path, name: str,
-                    n: int) -> None:
-    """Single owner of the on-disk format `load_chain_pair` reads: a pickle
-    of serialize_executable's (serialized, in_tree, out_tree) tuple at
-    ``{name}_{n}.pkl``."""
-    from jax.experimental import serialize_executable as se
+def _store_for(out_dir: str | pathlib.Path):
+    """The program store bench AOT entries live in: the process-wide
+    active store (``artifacts/programs/``, the PR 6 unification) when
+    enabled, else a store rooted AT ``out_dir`` (tests and explicitly
+    relocated caches). ``out_dir`` always contributes the key STEM — its
+    basename already encodes the config/code-hash the offline compilers
+    derive — so entries from different sweep configs cannot collide."""
+    from distributed_sddmm_tpu import programs
 
-    out_dir = pathlib.Path(out_dir)
-    out_dir.mkdir(parents=True, exist_ok=True)
-    (out_dir / f"{name}_{n}.pkl").write_bytes(
-        pickle.dumps(se.serialize(compiled)))
+    store = programs.active()
+    return store if store is not None else programs.ProgramStore(out_dir)
+
+
+def _aot_key(out_dir: str | pathlib.Path, name: str, n: int,
+             backend: str) -> str:
+    from distributed_sddmm_tpu.programs import bench_aot_key
+
+    return bench_aot_key(pathlib.Path(out_dir).name, name, n, backend)
+
+
+def save_executable(compiled, out_dir: str | pathlib.Path, name: str,
+                    n: int, backend: str | None = None) -> None:
+    """Persist one serialized executable into the program store under a
+    ``bench:<dir-stem>:<name>:<n>`` key (the historical ``{name}_{n}.pkl``
+    per-directory pickles became store entries in PR 6; `load_executable`
+    still reads the legacy files as a fallback). ``backend`` is the
+    TARGET platform — offline compilers pass their topology device's
+    platform; default is the live backend."""
+    if backend is None:
+        from distributed_sddmm_tpu.programs.store import live_backend
+
+        backend = live_backend()
+    pathlib.Path(out_dir).mkdir(parents=True, exist_ok=True)
+    store = _store_for(out_dir)
+    if not store.save(_aot_key(out_dir, name, n, backend), compiled,
+                      meta={"name": name, "n": n}, backend=backend):
+        # This jax generation cannot serialize: keep the legacy pickle
+        # format working rather than silently storing nothing.
+        from jax.experimental import serialize_executable as se
+
+        (pathlib.Path(out_dir) / f"{name}_{n}.pkl").write_bytes(
+            pickle.dumps(se.serialize(compiled)))
 
 
 def compile_chain_pair(step_fn, state, trials: int, device,
@@ -91,16 +122,24 @@ def compile_chain_pair(step_fn, state, trials: int, device,
     for n in trip_counts(trials):
         t0 = time.monotonic()
         compiled = _chain(step_fn, n).lower(sds_state).compile()
-        save_executable(compiled, out_dir, name, n)
+        save_executable(compiled, out_dir, name, n,
+                        backend=device.platform)
         times[n] = round(time.monotonic() - t0, 2)
     return times
 
 
 def load_executable(out_dir: str | pathlib.Path, name: str, n: int, device):
-    """Deserialize one saved executable onto ``device``. Raises on any
-    failure — callers fall back to the jitted path."""
+    """Deserialize one saved executable onto ``device``: the program
+    store first (PR 6 entries), then the legacy per-directory
+    ``{name}_{n}.pkl`` pickle (pre-PR 6 caches stay loadable). Raises on
+    any failure — callers fall back to the jitted path."""
     from distributed_sddmm_tpu import compat
 
+    store = _store_for(out_dir)
+    loaded = store.load(_aot_key(out_dir, name, n, device.platform),
+                        device=device)
+    if loaded is not None:
+        return loaded
     serialized, in_tree, out_tree = pickle.loads(
         (pathlib.Path(out_dir) / f"{name}_{n}.pkl").read_bytes())
     return compat.deserialize_and_load(

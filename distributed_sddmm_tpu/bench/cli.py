@@ -153,6 +153,7 @@ def _run_configs(S, alg_names, args, r_values=None):
                             checkpoint_dir=getattr(args, "checkpoint_dir", None),
                             checkpoint_every=getattr(args, "checkpoint_every", 1),
                             resume=getattr(args, "resume", False),
+                            overlap=getattr(args, "fusion", None) == "overlap",
                         )
                 except ValueError as e:
                     # Divisibility constraints differ per algorithm
@@ -187,6 +188,15 @@ def _add_common(p: argparse.ArgumentParser) -> None:
         "candidates first; 'auto' measures when possible",
     )
     p.add_argument("--fused", default="yes", choices=["yes", "no", "both"])
+    p.add_argument(
+        "--fusion", default="sequential", choices=["sequential", "overlap"],
+        help="ring-loop build for the 1.5D shift strategies: 'sequential' "
+        "(kernel then ppermute per tile) or 'overlap' (double-buffered "
+        "local kernel overlap — the next tile's ppermute is issued before "
+        "the current tile's kernel, the reference's BufferPair strategy); "
+        "bit-identical results, gated structurally by "
+        "`bench overlap --fusion-hlo`",
+    )
     p.add_argument(
         "--breakdown", action="store_true",
         help="add {Replication, Propagation, Computation} region attribution "
@@ -293,6 +303,21 @@ def build_parser() -> argparse.ArgumentParser:
         "--hlo-topology", default=None, metavar="NAME",
         help="also AOT-compile for this TPU topology (e.g. v5e:2x4) and "
         "report the structural start/compute/done overlap evidence",
+    )
+    ov.add_argument(
+        "--fusion-hlo", default=None, metavar="TOPOLOGY", nargs="?",
+        const="v5e:2x4",
+        help="AOT-compile the 1.5D dense-shift fused program (with "
+        "--fusion overlap's double-buffered build) for a TPU topology "
+        "and report whether collective-permute-start/done bracket the "
+        "per-step local kernel — the --fusion overlap structural gate "
+        "(set TPU_SKIP_MDS_QUERY=1 on machines without TPU metadata)",
+    )
+    ov.add_argument(
+        "--fusion-mode", default="overlap",
+        choices=["overlap", "sequential"],
+        help="which ring-loop build --fusion-hlo compiles (default "
+        "overlap; 'sequential' probes the baseline build for comparison)",
     )
     ov.add_argument("-o", "--output-file", default=None)
 
@@ -756,7 +781,8 @@ def _dispatch(args) -> int:
 
     if args.cmd == "overlap":
         from distributed_sddmm_tpu.bench.overlap import (
-            hlo_overlap_report, run_overlap_experiment,
+            fusion_overlap_hlo_report, hlo_overlap_report,
+            run_overlap_experiment,
         )
 
         rec = run_overlap_experiment(
@@ -768,6 +794,13 @@ def _dispatch(args) -> int:
             rec = hlo_overlap_report(
                 topology_name=args.hlo_topology,
                 block=args.block, steps_work=args.steps_work,
+                output_file=args.output_file,
+            )
+            print(json.dumps(rec))
+        if args.fusion_hlo:
+            rec = fusion_overlap_hlo_report(
+                topology_name=args.fusion_hlo,
+                overlap=args.fusion_mode == "overlap",
                 output_file=args.output_file,
             )
             print(json.dumps(rec))

@@ -55,6 +55,13 @@ ALGORITHM_FACTORIES: dict[str, Callable[..., DistributedSparse]] = {
 GAT_REFERENCE_LAYERS = [(256, 256, 4), (1024, 256, 4), (1536, 256, 6)]
 
 
+#: Strategies with a double-buffered local-kernel-overlap program
+#: variant (``--fusion overlap``): the 1.5D shift family. The 2.5D
+#: Cannon strategies have no overlap build — requesting one is a
+#: configuration error the sweep driver's skip logic reports.
+OVERLAP_CAPABLE = ("15d_fusion1", "15d_fusion2", "15d_sparse")
+
+
 def make_algorithm(
     name: str,
     S: HostCOO,
@@ -62,13 +69,24 @@ def make_algorithm(
     c: int,
     kernel=None,
     devices=None,
+    overlap: bool = False,
     **kw,
 ) -> DistributedSparse:
-    """Instantiate one of the five named algorithm configurations."""
+    """Instantiate one of the five named algorithm configurations.
+    ``overlap=True`` selects the double-buffered local-kernel-overlap
+    ring programs (shift strategies only)."""
     if name not in ALGORITHM_FACTORIES:
         raise ValueError(
             f"unknown algorithm {name!r}; available: {sorted(ALGORITHM_FACTORIES)}"
         )
+    if overlap:
+        if name not in OVERLAP_CAPABLE:
+            raise ValueError(
+                f"fusion 'overlap' is implemented for the 1.5D shift "
+                f"strategies {OVERLAP_CAPABLE}; {name} has no "
+                "double-buffered variant"
+            )
+        kw["overlap"] = True
     return ALGORITHM_FACTORIES[name](S, R, c, kernel=kernel, devices=devices, **kw)
 
 
@@ -179,6 +197,7 @@ def benchmark_algorithm(
     checkpoint_dir: Optional[str] = None,
     checkpoint_every: int = 1,
     resume: bool = False,
+    overlap: bool = False,
 ) -> dict:
     """Run one benchmark configuration; append a JSON record to
     ``output_file`` (if given) and return it.
@@ -191,6 +210,7 @@ def benchmark_algorithm(
     FLOPs), and — when tracing is active — ``run_id`` and ``trace_path``
     tying the record to its trace + manifest.
     """
+    from distributed_sddmm_tpu.obs import metrics as obs_metrics
     from distributed_sddmm_tpu.obs import trace as obs_trace
     from distributed_sddmm_tpu.obs import watchdog as obs_watchdog
     from distributed_sddmm_tpu.resilience import faults
@@ -214,7 +234,35 @@ def benchmark_algorithm(
             "attributes the fusedSpMM op)"
         )
 
-    alg = make_algorithm(algorithm_name, S, R, c, kernel=kernel, devices=devices)
+    # Program-store attribution: the record carries how many programs
+    # this run compiled live vs recalled from disk (GLOBAL counter
+    # deltas — the runstore's cold-start column reads them).
+    _prog_before = {
+        k: obs_metrics.GLOBAL.get(k)
+        for k in ("program_store_hits", "program_store_misses",
+                  "live_compiles")
+    }
+
+    alg = make_algorithm(algorithm_name, S, R, c, kernel=kernel,
+                         devices=devices, overlap=overlap)
+    # Bind the strategy (and the app chains built on it) to the active
+    # persistent program store under the problem fingerprint — the
+    # strategy-config tag in the key keeps sweep cells apart. No active
+    # store (tests, --no-store environments): the pre-PR 6 jit path.
+    from distributed_sddmm_tpu import programs as program_store_mod
+
+    if program_store_mod.active() is not None:
+        from distributed_sddmm_tpu.autotune.fingerprint import (
+            Problem, machine_signature, make_fingerprint,
+        )
+
+        _p, _backend, _kernels = machine_signature(devices)
+        program_store_mod.bind_strategy(
+            alg,
+            make_fingerprint(Problem.from_coo(S, R), _p, _backend,
+                             _kernels).key,
+            content_key=program_store_mod.matrix_content_key(S),
+        )
     if post_build is not None:
         # Hook for callers that prepare the strategy before any program
         # runs — e.g. tpu_apps injecting offline-AOT-compiled executables.
@@ -262,6 +310,7 @@ def benchmark_algorithm(
         "R": alg.R,
         "c": c,
         "fused": bool(fused),
+        "fusion": "overlap" if overlap else "sequential",
         "num_trials": trials,
         "elapsed": elapsed,
         "overall_throughput": throughput,
@@ -269,6 +318,10 @@ def benchmark_algorithm(
         "alg_info": alg.json_algorithm_info(),
         "perf_stats": perf_stats,
         "metrics": alg.metrics.to_dict(),
+        "program_store": {
+            k: obs_metrics.GLOBAL.get(k) - v
+            for k, v in _prog_before.items()
+        },
         **app_stats,
         **(extra_info or {}),
     }

@@ -175,6 +175,25 @@ def hlo_overlap_report(
         "p": p,
         "block": block,
         "steps_work": steps_work,
+        **scan_overlap_hlo(hlo),
+    }
+    if output_file:
+        with open(output_file, "a") as f:
+            f.write(json.dumps(record) + "\n")
+    return record
+
+
+def scan_overlap_hlo(hlo: str) -> dict:
+    """Structural overlap facts from one scheduled HLO text: whether the
+    module is scheduled, how many ``collective-permute-start``/``-done``
+    async pairs exist, and whether any computation places compute
+    fusions/dots INSIDE a start→done window (the latency-hiding
+    scheduler's signature — communication in flight behind the local
+    kernel). Shared by the synthetic ring probe and the shift-strategy
+    fusion probe below."""
+    import re
+
+    record = {
         "is_scheduled": "is_scheduled=true" in hlo,
         # Count op DEFINITIONS only — the matching done op's operand list
         # also contains the start op's name and must not double-count.
@@ -199,6 +218,104 @@ def hlo_overlap_report(
                 if inside:
                     record["loop_body_overlaps_compute"] = True
                 open_start = None
+    return record
+
+
+def fusion_overlap_hlo_report(
+    topology_name: str = "v5e:2x4",
+    log_m: int = 8,
+    edge_factor: int = 8,
+    R: int = 16,
+    c: int = 1,
+    algorithm: str = "15d_fusion2",
+    overlap: bool = True,
+    unroll: bool = False,
+    output_file: str | None = None,
+) -> dict:
+    """Structural overlap evidence for the ACTUAL shift-strategy fused
+    program — the ``--fusion overlap`` acceptance gate.
+
+    The strategy is constructed on the live (CPU test) mesh — tile
+    ingest needs real buffers — then program construction is retargeted
+    at a real TPU topology mesh of the same shape
+    (``jax.experimental.topologies``, no chips needed; the
+    ``artifacts/multichip_hlo`` pattern) and the fused SDDMM→SpMM
+    program is AOT-compiled with ShapeDtypeStruct operands. The
+    scheduled HLO is then scanned for ``collective-permute-start``/
+    ``-done`` bracketing the per-step local kernel: ``async_pairs >= 1``
+    with ``loop_body_overlaps_compute`` is the double-buffered
+    local-kernel-overlap structure the reference built by hand with
+    ``BufferPair``. Default ``unroll=False`` compiles the rolled ring so
+    the evidence sits in an actual while-loop body.
+
+    Environment note: on machines without TPU instance metadata, set
+    ``TPU_SKIP_MDS_QUERY=1`` before first jax/libtpu init or the
+    topology lookup stalls ~minutes in metadata retries.
+    """
+    from jax.experimental import topologies
+
+    from distributed_sddmm_tpu.bench.harness import make_algorithm
+    from distributed_sddmm_tpu.common import MatMode
+    from distributed_sddmm_tpu.parallel.mesh import GridSpec, make_grid
+    from distributed_sddmm_tpu.utils.coo import HostCOO
+
+    devices = jax.devices()
+    topo = topologies.get_topology_desc(
+        platform="tpu", topology_name=topology_name
+    )
+    if len(topo.devices) < len(devices):
+        raise ValueError(
+            f"topology {topology_name} has {len(topo.devices)} < "
+            f"{len(devices)} chips"
+        )
+
+    S = HostCOO.rmat(log_m=log_m, edge_factor=edge_factor, seed=0)
+    alg = make_algorithm(
+        algorithm, S, R, c, devices=devices, overlap=overlap, unroll=unroll
+    )
+    vals = alg.like_s_values(1.0)
+    if algorithm == "15d_sparse":
+        op, args = "spmm", (alg.dummy_initialize(MatMode.B),
+                            *alg._spmm_args(alg.S_tiles, vals))
+    else:
+        op = "fused" if alg.fusion_approach == 2 else "fused_twopass"
+        args = (alg.dummy_initialize(MatMode.A),
+                alg.dummy_initialize(MatMode.B),
+                *alg._tile_args(alg.S_tiles, vals))
+
+    # Retarget program construction at the TPU topology mesh; operands
+    # become ShapeDtypeStructs sharded over it.
+    g = alg.grid
+    tpu_grid = make_grid(g.nr, g.nc, g.nh, adjacency=g.adjacency,
+                         devices=list(topo.devices)[: alg.p])
+    alg.grid = GridSpec(mesh=tpu_grid.mesh, nr=g.nr, nc=g.nc, nh=g.nh,
+                        adjacency=g.adjacency)
+    alg._programs.clear()
+    mesh = alg.grid.mesh
+
+    def sds_like(x):
+        return jax.ShapeDtypeStruct(
+            x.shape, x.dtype,
+            sharding=jax.sharding.NamedSharding(mesh, x.sharding.spec),
+        )
+
+    prog = alg._program(op, use_st=False)
+    hlo = prog.lower(*(sds_like(a) for a in args)).compile().as_text()
+
+    record = {
+        "experiment": "fusion-overlap-hlo",
+        "topology": topology_name,
+        "algorithm": algorithm,
+        "fusion": "overlap" if overlap else "sequential",
+        "op": op,
+        "p": alg.p,
+        "c": c,
+        "M": S.M,
+        "nnz": S.nnz,
+        "R": R,
+        "unrolled": bool(unroll),
+        **scan_overlap_hlo(hlo),
+    }
     if output_file:
         with open(output_file, "a") as f:
             f.write(json.dumps(record) + "\n")

@@ -97,6 +97,28 @@ def _supports_programs(d_ops: DistributedSparse) -> bool:
     )
 
 
+def donation_enabled() -> bool:
+    """Whether the chained programs donate their carry buffers.
+
+    Donation invalidates input buffers after every call, which is
+    exactly right for CG/layer carries (each call's inputs are the
+    previous call's outputs, never reused) — but it is incompatible with
+    the resilience ladder's retry rung: a retry re-invokes the program
+    with the SAME argument buffers, which a donating first attempt
+    already consumed. ``_timed`` only routes through the retrying
+    ``_resilient_call`` when a fault plan or output guards are active,
+    so donation follows the inverse of that predicate exactly.
+    ``DSDDMM_DONATE=0`` is the kill switch.
+    """
+    import os
+
+    if os.environ.get("DSDDMM_DONATE", "1").lower() in (
+        "0", "off", "false", "no"
+    ):
+        return False
+    return faults.active() is None and not guards.enabled()
+
+
 class DistributedALS:
     """Alternating least squares over any distributed strategy.
 
@@ -245,8 +267,26 @@ class DistributedALS:
         every vector update. Same math as the open-coded loop below —
         the difference is dispatch: one compiled call per iteration
         instead of one per distributed op. Keyed by λ too: a damped
-        restart recompiles with the stiffer ridge baked in."""
-        key = (mode, self.d_ops.R, lam)
+        restart recompiles with the stiffer ridge baked in.
+
+        The CG carries (X, r, p, rsold) are **donated**: each call's
+        inputs are the previous call's outputs and are never read again,
+        so XLA updates them in place instead of allocating four fresh
+        buffers per iteration (``_cg_run`` copy-protects the two
+        entry-point aliases — see there). Donation follows
+        :func:`donation_enabled` (off under the resilience ladder's
+        retry rung; ``DSDDMM_DONATE=0``). The stationary ``other``
+        factor is deliberately NOT donated — the caller reuses it every
+        iteration.
+
+        Models over a store-bound strategy (``programs.bind_strategy``
+        — the Plan.instantiate and bench-harness paths) additionally
+        resolve the compiled iteration through the persistent program
+        store under the strategy's fingerprint + config, so a repeat run
+        recalls ``cgStep`` from disk instead of compiling.
+        """
+        donate = donation_enabled() and self._use_programs
+        key = (mode, self.d_ops.R, lam, donate)
         if key in self._cg_programs:
             return self._cg_programs[key]
         d = self.d_ops
@@ -262,7 +302,15 @@ class DistributedALS:
             Mp = out + lam * p
             return _cg_vector_update(X, r, p, rsold, Mp, eps)
 
-        prog = jax.jit(one_iter)
+        prog = jax.jit(
+            one_iter, donate_argnums=(0, 2, 3, 4) if donate else ()
+        )
+        from distributed_sddmm_tpu import programs
+
+        prog = programs.chained_program(
+            d, f"cgStep-{mode.name}-{lam:g}-{'don' if donate else 'nodon'}",
+            prog,
+        )
         self._cg_programs[key] = prog
         return prog
 
@@ -297,6 +345,17 @@ class DistributedALS:
             use_programs = self._use_programs
             prog = self._cg_iter_program(mode, lam) if use_programs else None
             other = self.B if mode == MatMode.A else self.A
+            if use_programs and donation_enabled():
+                # The donating program consumes its carry buffers; the
+                # two entry-point aliases must not be donated away:
+                # ``X`` aliases the live factor attribute (self.A /
+                # self.B — still the committed state if this half-step
+                # is abandoned), and ``p`` aliases ``r`` (donating one
+                # buffer through two parameters is a runtime error).
+                # One copy each per half-step, against four saved
+                # allocations per CG iteration.
+                X = jnp.copy(X)
+                p = jnp.copy(r)
             for _ in range(cg_max_iter):
                 faults.maybe_raise("als:cg_iter")
                 if use_programs:

@@ -150,11 +150,29 @@ class GAT:
         """ONE jitted program for layer ``i``: every head's projection,
         SDDMM logits, LeakyReLU, SpMM aggregation and ReLU, plus the head
         concat — the raw-program composition of
-        :meth:`compute_self_attention_head` (same math, one dispatch)."""
-        if i in self._layer_programs:
-            return self._layer_programs[i]
+        :meth:`compute_self_attention_head` (same math, one dispatch).
+
+        Square layers (``input_features == output_features``) **donate**
+        the carried activation ``X``: the forward loop rebinds it every
+        layer and never reads the old buffer again, so XLA reuses it for
+        the output instead of allocating. Donation is shape-gated —
+        non-square layers would only earn a "donated buffer unusable"
+        warning — and follows ``models.als.donation_enabled`` (off under
+        the resilience retry rung; ``DSDDMM_DONATE=0``). Models over a
+        store-bound strategy also resolve the compiled layer through
+        the persistent program store under the strategy's fingerprint
+        + config."""
+        from distributed_sddmm_tpu.models.als import donation_enabled
+
         d = self.d_ops
         layer = self.layers[i]
+        donate = (
+            donation_enabled()
+            and layer.input_features == layer.output_features
+        )
+        key = (i, donate)
+        if key in self._layer_programs:
+            return self._layer_programs[key]
         alpha = self.leaky_relu_alpha
         mode = MatMode.A
 
@@ -181,8 +199,19 @@ class GAT:
             )
 
         d.set_r_value(layer.output_features)
-        prog = jax.jit(layer_fn, out_shardings=d.a_sharding())
-        self._layer_programs[i] = prog
+        prog = jax.jit(
+            layer_fn, out_shardings=d.a_sharding(),
+            donate_argnums=(0,) if donate else (),
+        )
+        from distributed_sddmm_tpu import programs
+
+        # alpha is baked into the traced body as a Python constant —
+        # neither avals nor the models code hash see a ctor override.
+        prog = programs.chained_program(
+            d, f"gatLayer-{i}-a{alpha:g}-{'don' if donate else 'nodon'}",
+            prog,
+        )
+        self._layer_programs[key] = prog
         return prog
 
     def forward(self, X: jax.Array | None = None) -> jax.Array:
@@ -195,6 +224,15 @@ class GAT:
         if X is None:
             d.set_r_value(self.layers[0].input_features)
             X = d.dummy_initialize(MatMode.A) * (1.0 / (d.M * self.layers[0].input_features))
+        elif self._use_programs:
+            from distributed_sddmm_tpu.models.als import donation_enabled
+
+            layer0 = self.layers[0]
+            if (donation_enabled()
+                    and layer0.input_features == layer0.output_features):
+                # A donating first layer would consume the CALLER'S
+                # buffer; the copy keeps donation an internal detail.
+                X = jnp.copy(X)
         guarding = guards.enabled()
         wd = obs_watchdog.active()
         for i, layer in enumerate(self.layers):

@@ -15,8 +15,12 @@ Two registries, two jobs:
   plus per-op retries, communication words and FLOPs.
 * :data:`GLOBAL` — a process-wide :class:`Counters` for cross-cutting
   events (faults fired, exec retries, guard repairs, plan-cache
-  hits/misses, checkpoints saved/loaded). Cheap enough to bump
-  unconditionally; snapshot lands in bench records and smoke reports.
+  hits/misses, checkpoints saved/loaded; since PR 6 also the program
+  store's ``program_store_hits`` / ``program_store_misses`` /
+  ``live_compiles`` — disk-recalled vs in-process-compiled programs,
+  the cold-start cost the runstore's compile column surfaces). Cheap
+  enough to bump unconditionally; snapshot lands in bench records and
+  smoke reports.
 
 Communication/FLOP accounting conventions (matching
 ``tools/costmodel.py`` so counted volume is directly comparable to the

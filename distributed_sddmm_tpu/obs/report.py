@@ -89,11 +89,13 @@ def _history_table(rows: list[dict]) -> str:
         "<table><tr><th class=l>run_id</th><th class=l>source</th>"
         "<th class=l>algorithm</th><th>app</th><th>R</th><th>c</th>"
         "<th>backend</th><th>elapsed&nbsp;s</th><th>GFLOP/s</th>"
+        "<th>cold&nbsp;compiles</th>"
         "<th>anomalies</th><th class=l>key</th></tr>"
     ]
     for r in rows:
         anom = r.get("anomaly_count", 0)
         style = ' class="regression"' if anom else ""
+        live = r.get("live_compiles")
         cells.append(
             f"<tr{style}><td class=l>{_esc(r.get('run_id'))}</td>"
             f"<td class=l>{_esc(r.get('source'))}</td>"
@@ -102,6 +104,7 @@ def _history_table(rows: list[dict]) -> str:
             f"<td>{_esc(r.get('c'))}</td><td>{_esc(r.get('backend'))}</td>"
             f"<td>{_fmt(r.get('elapsed'))}</td>"
             f"<td>{_fmt(r.get('overall_throughput'))}</td>"
+            f"<td>{'-' if live is None else int(live)}</td>"
             f"<td>{anom or ''}</td>"
             f"<td class=l>{_esc((r.get('key') or '')[:16])}</td></tr>"
         )

@@ -55,6 +55,9 @@ _INDEX_FIELDS = (
     "overall_throughput", "source", "anomaly_count",
     # Serving records (`bench serve`) only; None elsewhere.
     "latency_p99_ms", "shed_count",
+    # Program-store cold-start cost: in-process compiles this run paid
+    # (0 for a fully disk-warmed run; None for pre-PR 6 records).
+    "live_compiles",
 )
 
 #: Configuration axes (beyond the fingerprint key) two runs must share
@@ -306,6 +309,13 @@ def _index_row(doc: dict) -> dict:
         "anomaly_count": sum(a.get("count", 1) for a in anomalies),
         "latency_p99_ms": (rec.get("latency_ms") or {}).get("p99"),
         "shed_count": rec.get("shed_count"),
+        # Offline records carry the GLOBAL counter delta; serving
+        # records the engine's own ladder attribution.
+        "live_compiles": (
+            (rec.get("program_store") or {}).get("live_compiles")
+            if rec.get("program_store") is not None
+            else (rec.get("engine") or {}).get("live_compiles")
+        ),
     }
     return {k: row[k] for k in _INDEX_FIELDS}
 

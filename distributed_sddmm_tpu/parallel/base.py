@@ -75,6 +75,12 @@ class DistributedSparse(abc.ABC):
         self._op_cost_cache: dict = {}
         self._trace_meta_emitted = False
         self._programs: dict = {}
+        #: Optional program-store binder (``programs.bind_strategy``):
+        #: ``binder(op_key, jit_fn) -> callable``. When set, strategies
+        #: pass every shard_map op program they build through
+        #: :meth:`_finalize_program` so compiles resolve via the
+        #: persistent AOT store instead of always tracing live.
+        self._program_binder = None
 
         # Subclasses must set these before use:
         self.M_pad: int = -1
@@ -247,6 +253,36 @@ class DistributedSparse(abc.ABC):
         """Global column order -> resident layout (identity default)."""
         return X
 
+    def bind_program_store(self, binder) -> None:
+        """Install a program-store binder (``binder(op_key, jit_fn) ->
+        callable``; see ``programs.bind_strategy``). Cached op programs
+        are dropped so they rebuild through the binder — the jits
+        re-trace on their next call, exactly when they would have
+        compiled anyway, so binding costs nothing it wasn't going to
+        spend."""
+        self._program_binder = binder
+        self._programs.clear()
+
+    def _finalize_program(self, op_key, fn):
+        """Route one freshly built op program through the binder (when
+        bound). ``op_key`` is the strategy's program-cache key — op
+        name, tile set, ablation mode (and fusion variant where it
+        shapes the program); stringified into the store key so ablated
+        or overlap variants can never answer for the real program."""
+        if self._program_binder is None:
+            return fn
+        return self._program_binder("-".join(str(k) for k in op_key), fn)
+
+    def _program_cache_key(self, op: str, use_st: bool) -> tuple:
+        """The strategy's program-cache key for one op under the CURRENT
+        ablation mode — the single shape ``_program`` and
+        ``inject_program`` must agree on (strategies with additional
+        program variants, e.g. the shift strategies' fusion builds,
+        override to append their segments)."""
+        from distributed_sddmm_tpu.parallel.loops import ablation
+
+        return (op, use_st, ablation())
+
     def inject_program(self, op: str, use_st: bool, loaded) -> None:
         """Install a pre-built executable (e.g. a `deserialize_and_load`
         result from an offline AOT compile, `scripts/aot_compile_apps.py`)
@@ -258,9 +294,7 @@ class DistributedSparse(abc.ABC):
         per-layer feature widths) — correctness never depends on the
         injection, only compile latency does.
         """
-        from distributed_sddmm_tpu.parallel.loops import ablation
-
-        key = (op, use_st, ablation())
+        key = self._program_cache_key(op, use_st)
         fallback = self._program(op, use_st)
         warned = []
 

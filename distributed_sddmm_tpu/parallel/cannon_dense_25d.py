@@ -47,7 +47,7 @@ from distributed_sddmm_tpu.compat import shard_map
 from distributed_sddmm_tpu.common import KernelMode, MatMode, divide_round_up
 from distributed_sddmm_tpu.parallel.base import DistributedSparse
 from distributed_sddmm_tpu.parallel.loops import (
-    abl_all_gather, abl_ppermute, ablation, ring_loop, ring_perm, vary,
+    abl_all_gather, abl_ppermute, ring_loop, ring_perm, vary,
 )
 from distributed_sddmm_tpu.parallel.layouts import BlockCyclic25D
 from distributed_sddmm_tpu.parallel.mesh import make_grid
@@ -336,11 +336,13 @@ class CannonDense25D(DistributedSparse):
         )
 
     def _program(self, op: str, use_st: bool):
-        key = (op, use_st, ablation())
+        key = self._program_cache_key(op, use_st)
         if key in self._programs:
             return self._programs[key]
         if self._use_blocked(self.ST_tiles if use_st else self.S_tiles):
-            fn = self._build_blocked_program(op, use_st)
+            fn = self._finalize_program(
+                key, self._build_blocked_program(op, use_st)
+            )
             self._programs[key] = fn
             return fn
 
@@ -453,7 +455,11 @@ class CannonDense25D(DistributedSparse):
         else:
             raise ValueError(op)
 
-        fn = jax.jit(shard_map(prog, mesh=mesh, in_specs=in_specs, out_specs=out_specs))
+        fn = self._finalize_program(
+            key,
+            jax.jit(shard_map(prog, mesh=mesh, in_specs=in_specs,
+                              out_specs=out_specs)),
+        )
         self._programs[key] = fn
         return fn
 

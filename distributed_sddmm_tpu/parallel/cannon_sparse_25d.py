@@ -47,7 +47,7 @@ from distributed_sddmm_tpu.compat import shard_map
 from distributed_sddmm_tpu.common import KernelMode, MatMode, divide_round_up
 from distributed_sddmm_tpu.parallel.base import DistributedSparse
 from distributed_sddmm_tpu.parallel.loops import (
-    abl_all_gather, abl_ppermute, abl_psum_scatter, ablation, ring_loop,
+    abl_all_gather, abl_ppermute, abl_psum_scatter, ring_loop,
     ring_perm, vary,
 )
 from distributed_sddmm_tpu.parallel.layouts import Floor2D
@@ -400,11 +400,13 @@ class CannonSparse25D(DistributedSparse):
         )
 
     def _program(self, op: str, use_st: bool):
-        key = (op, use_st, ablation())
+        key = self._program_cache_key(op, use_st)
         if key in self._programs:
             return self._programs[key]
         if self._use_blocked(self.ST_tiles if use_st else self.S_tiles):
-            fn = self._build_blocked_program(op, use_st)
+            fn = self._finalize_program(
+                key, self._build_blocked_program(op, use_st)
+            )
             self._programs[key] = fn
             return fn
 
@@ -509,7 +511,11 @@ class CannonSparse25D(DistributedSparse):
         else:
             raise ValueError(op)
 
-        fn = jax.jit(shard_map(prog, mesh=mesh, in_specs=in_specs, out_specs=out_specs))
+        fn = self._finalize_program(
+            key,
+            jax.jit(shard_map(prog, mesh=mesh, in_specs=in_specs,
+                              out_specs=out_specs)),
+        )
         self._programs[key] = fn
         return fn
 

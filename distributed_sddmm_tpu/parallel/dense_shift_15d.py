@@ -40,8 +40,8 @@ from distributed_sddmm_tpu.compat import shard_map
 from distributed_sddmm_tpu.common import MatMode, divide_round_up
 from distributed_sddmm_tpu.parallel.base import DistributedSparse
 from distributed_sddmm_tpu.parallel.loops import (
-    abl_all_gather, abl_ppermute, abl_psum_scatter, ablation, ring_loop,
-    ring_perm, vary,
+    abl_all_gather, abl_ppermute, abl_psum_scatter, ring_loop,
+    ring_loop_overlap, ring_perm, vary,
 )
 from distributed_sddmm_tpu.parallel.layouts import ShardedBlockCyclicColumn
 from distributed_sddmm_tpu.parallel.mesh import make_grid
@@ -69,6 +69,7 @@ class DenseShift15D(DistributedSparse):
         devices=None,
         dtype=jnp.float32,
         unroll: bool = True,
+        overlap: bool = False,
     ):
         if devices is None:
             devices = jax.devices()
@@ -80,6 +81,13 @@ class DenseShift15D(DistributedSparse):
         grid = make_grid(p // c, c, 1, adjacency=adjacency, devices=devices)
         super().__init__(grid, S.M, S.N, R, c, kernel=kernel, dtype=dtype)
         self.fusion_approach = fusion_approach
+        #: ``overlap=True`` builds every ring program double-buffered
+        #: (``ring_loop_overlap``): the next tile's ``ppermute`` is issued
+        #: before the current tile's local kernel — the reference's
+        #: ``BufferPair`` local-kernel-overlap strategy in program
+        #: structure, bit-identical to the sequential loop (CLI
+        #: ``--fusion overlap``).
+        self.overlap = bool(overlap)
         self.cost_model_name = (
             "15d_fusion2" if fusion_approach == 2 else "15d_fusion1"
         )
@@ -166,6 +174,14 @@ class DenseShift15D(DistributedSparse):
     # shard_map programs
     # ------------------------------------------------------------------ #
 
+    def _program_cache_key(self, op: str, use_st: bool) -> tuple:
+        """Base key + the fusion build: overlap and sequential programs
+        are distinct compilations (and distinct store entries)."""
+        return (
+            *super()._program_cache_key(op, use_st),
+            "overlap" if self.overlap else "seq",
+        )
+
     def _program(self, op: str, use_st: bool):
         """Build (and cache) the jitted shard_map program for one op.
 
@@ -179,11 +195,13 @@ class DenseShift15D(DistributedSparse):
         same ring/collective structure, but local compute runs feature-major
         through the tile-level Pallas kernels.
         """
-        key = (op, use_st, ablation())
+        key = self._program_cache_key(op, use_st)
         if key in self._programs:
             return self._programs[key]
         if self._use_blocked(self.ST_tiles if use_st else self.S_tiles):
-            fn = self._build_blocked_program(op, use_st)
+            fn = self._finalize_program(
+                key, self._build_blocked_program(op, use_st)
+            )
             self._programs[key] = fn
             return fn
 
@@ -194,10 +212,14 @@ class DenseShift15D(DistributedSparse):
         kern = self.kernel
         perm = ring_perm(nr)
         unroll = self.unroll
+        overlap = self.overlap
+
+        def shift_one(mov):
+            return abl_ppermute(mov, "rows", perm)
 
         def shift_mov(state):
             carry, mov = state
-            return carry, abl_ppermute(mov, "rows", perm)
+            return carry, shift_one(mov)
 
         def tile_at(arr, s):
             # s is a Python int when unrolled, a traced index when rolled.
@@ -225,6 +247,19 @@ class DenseShift15D(DistributedSparse):
 
         def sddmm_pass(stat_rep, mov, t_rows, t_cols, t_vals, out_vals,
                        complete_rotation=False):
+            if overlap:
+                def body(s, out_vals, mov):
+                    dots = kern.sddmm(
+                        tile_at(t_rows, s), tile_at(t_cols, s),
+                        tile_at(t_vals, s), stat_rep, mov,
+                    )
+                    return out_vals.at[s].set(dots)
+
+                return ring_loop_overlap(
+                    nr, body, out_vals, mov, shift_one,
+                    final_shift=complete_rotation, unroll=unroll,
+                )
+
             def body(s, state):
                 out_vals, mov = state
                 dots = kern.sddmm(
@@ -240,6 +275,17 @@ class DenseShift15D(DistributedSparse):
             )
 
         def spmm_pass(mov, t_rows, t_cols, vals_tiles, acc):
+            if overlap:
+                def body(s, acc, mov):
+                    return acc + kern.spmm(
+                        tile_at(t_rows, s), tile_at(t_cols, s),
+                        tile_at(vals_tiles, s), mov, stat_rows,
+                    )
+
+                return ring_loop_overlap(
+                    nr, body, acc, mov, shift_one, unroll=unroll
+                )
+
             def body(s, state):
                 acc, mov = state
                 acc = acc + kern.spmm(
@@ -283,21 +329,38 @@ class DenseShift15D(DistributedSparse):
             def prog(stat, mov, t_rows, t_cols, t_vals):
                 t_rows, t_cols, t_vals = squeeze(t_rows), squeeze(t_cols), squeeze(t_vals)
                 stat_rep = replicate(stat)
-
-                def body(s, state):
-                    (acc, out_vals), mov = state
-                    rs, cs = tile_at(t_rows, s), tile_at(t_cols, s)
-                    mid = kern.sddmm(rs, cs, tile_at(t_vals, s), stat_rep, mov)
-                    out_vals = out_vals.at[s].set(mid)
-                    return (acc + kern.spmm(rs, cs, mid, mov, stat_rows), out_vals), mov
-
                 init = (
                     dvary(jnp.zeros((stat_rows, mov.shape[1]), mov.dtype)),
                     dvary(jnp.zeros((T, max_nnz), t_vals.dtype)),
                 )
-                (acc, out_vals), _ = ring_loop(
-                    nr, body, (init, mov), shift_mov, unroll=unroll
-                )
+
+                if overlap:
+                    def body(s, carry, mov):
+                        acc, out_vals = carry
+                        rs, cs = tile_at(t_rows, s), tile_at(t_cols, s)
+                        mid = kern.sddmm(
+                            rs, cs, tile_at(t_vals, s), stat_rep, mov
+                        )
+                        out_vals = out_vals.at[s].set(mid)
+                        return (
+                            acc + kern.spmm(rs, cs, mid, mov, stat_rows),
+                            out_vals,
+                        )
+
+                    (acc, out_vals), _ = ring_loop_overlap(
+                        nr, body, init, mov, shift_one, unroll=unroll
+                    )
+                else:
+                    def body(s, state):
+                        (acc, out_vals), mov = state
+                        rs, cs = tile_at(t_rows, s), tile_at(t_cols, s)
+                        mid = kern.sddmm(rs, cs, tile_at(t_vals, s), stat_rep, mov)
+                        out_vals = out_vals.at[s].set(mid)
+                        return (acc + kern.spmm(rs, cs, mid, mov, stat_rows), out_vals), mov
+
+                    (acc, out_vals), _ = ring_loop(
+                        nr, body, (init, mov), shift_mov, unroll=unroll
+                    )
                 return reduce_out(acc), out_vals.reshape(1, 1, 1, T, max_nnz)
 
             in_specs = (dense_spec, dense_spec, _TILE_SPEC, _TILE_SPEC, _TILE_SPEC)
@@ -327,8 +390,12 @@ class DenseShift15D(DistributedSparse):
         else:
             raise ValueError(op)
 
-        fn = jax.jit(
-            shard_map(prog, mesh=mesh, in_specs=in_specs, out_specs=out_specs)
+        fn = self._finalize_program(
+            key,
+            jax.jit(
+                shard_map(prog, mesh=mesh, in_specs=in_specs,
+                          out_specs=out_specs)
+            ),
         )
         self._programs[key] = fn
         return fn
@@ -349,13 +416,17 @@ class DenseShift15D(DistributedSparse):
         kern = self.kernel
         perm = ring_perm(nr)
         unroll = self.unroll
+        overlap = self.overlap
         bm, bn, grb, gcb, grp = tiles.blk_geom
         rows_pad, cols_pad = grb * bm, gcb * bn
         chunk_len = CHUNK
 
+        def shift_one(mov):
+            return abl_ppermute(mov, "rows", perm)
+
         def shift_mov(state):
             carry, mov = state
-            return carry, abl_ppermute(mov, "rows", perm)
+            return carry, shift_one(mov)
 
         def tile_at(arr, s):
             if unroll:
@@ -393,6 +464,19 @@ class DenseShift15D(DistributedSparse):
             )
 
         def sddmm_pass(at, mov, fields, t_vals, out_vals, complete_rotation=False):
+            if overlap:
+                def body(s, out_vals, mov):
+                    mid = kern.sddmm_tile_t(
+                        blk_at(fields, s), tile_at(t_vals, s),
+                        at, kern.prep(mov, cols_pad), t_vals.dtype,
+                    )
+                    return out_vals.at[s].set(mid)
+
+                return ring_loop_overlap(
+                    nr, body, out_vals, mov, shift_one,
+                    final_shift=complete_rotation, unroll=unroll,
+                )
+
             def body(s, state):
                 out_vals, mov = state
                 mid = kern.sddmm_tile_t(
@@ -408,6 +492,17 @@ class DenseShift15D(DistributedSparse):
             )
 
         def spmm_pass(mov, fields, vals_tiles, accT):
+            if overlap:
+                def body(s, accT, mov):
+                    return accT + kern.spmm_tile_t(
+                        blk_at(fields, s), tile_at(vals_tiles, s),
+                        kern.prep(mov, cols_pad),
+                    )
+
+                return ring_loop_overlap(
+                    nr, body, accT, mov, shift_one, unroll=unroll
+                )
+
             def body(s, state):
                 accT, mov = state
                 accT = accT + kern.spmm_tile_t(
@@ -457,22 +552,35 @@ class DenseShift15D(DistributedSparse):
                 fields = squeeze_blk(blr, blc, bmeta)
                 t_vals = t_vals.reshape(T, max_nnz)
                 at = kern.prep(replicate(stat), rows_pad)
-
-                def body(s, state):
-                    (accT, out_vals), mov = state
-                    pT, mid = kern.fused_tile_t(
-                        blk_at(fields, s), tile_at(t_vals, s),
-                        at, kern.prep(mov, cols_pad), t_vals.dtype,
-                    )
-                    return (accT + pT, out_vals.at[s].set(mid)), mov
-
                 init = (
                     dvary(jnp.zeros((mov.shape[-1], rows_pad), jnp.float32)),
                     dvary(jnp.zeros((T, max_nnz), t_vals.dtype)),
                 )
-                (accT, out_vals), _ = ring_loop(
-                    nr, body, (init, mov), shift_mov, unroll=unroll
-                )
+
+                if overlap:
+                    def body(s, carry, mov):
+                        accT, out_vals = carry
+                        pT, mid = kern.fused_tile_t(
+                            blk_at(fields, s), tile_at(t_vals, s),
+                            at, kern.prep(mov, cols_pad), t_vals.dtype,
+                        )
+                        return (accT + pT, out_vals.at[s].set(mid))
+
+                    (accT, out_vals), _ = ring_loop_overlap(
+                        nr, body, init, mov, shift_one, unroll=unroll
+                    )
+                else:
+                    def body(s, state):
+                        (accT, out_vals), mov = state
+                        pT, mid = kern.fused_tile_t(
+                            blk_at(fields, s), tile_at(t_vals, s),
+                            at, kern.prep(mov, cols_pad), t_vals.dtype,
+                        )
+                        return (accT + pT, out_vals.at[s].set(mid)), mov
+
+                    (accT, out_vals), _ = ring_loop(
+                        nr, body, (init, mov), shift_mov, unroll=unroll
+                    )
                 return finish(accT, mov), out_vals.reshape(1, 1, 1, T, max_nnz)
 
             in_specs = (dense_spec, dense_spec) + blk_specs + (_TILE_SPEC,)

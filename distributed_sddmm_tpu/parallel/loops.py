@@ -109,6 +109,71 @@ def vary(x, axes):
     return pvary(x, axes)
 
 
+def ring_loop_overlap(
+    n: int,
+    body: Callable,
+    carry,
+    mov,
+    shift_mov: Callable,
+    shift_carry: Optional[Callable] = None,
+    final_shift: bool = False,
+    unroll: bool = True,
+):
+    """Double-buffered ring loop — the paper's *local kernel overlap*
+    (reference ``BufferPair``, `common.h:49-93`), expressed in program
+    structure: each step ISSUES the next tile's hop of the moving
+    operand **before** the body consumes the resident buffer, so the
+    collective's input never depends on the step's compute and the TPU
+    latency-hiding scheduler can split the ``ppermute`` into
+    ``collective-permute-start``/``-done`` bracketing the local kernel
+    (the structural evidence ``bench overlap --fusion-hlo`` gates on).
+
+    ``body(s, carry, mov) -> carry`` computes on the resident ``mov``;
+    ``shift_mov(mov)`` is the ring hop (a pytree hop for traveling
+    struct-of-arrays tiles). ``shift_carry`` is the escape hatch for
+    state that must travel but *depends on the body* (1.5D sparse-shift
+    SDDMM's accumulating dots): it hops AFTER the body, sequentially —
+    only the body-independent operands double-buffer. ``final_shift``
+    runs the hop(s) after the last step too (a traveling operand
+    completing its round trip home); hop counts then match the
+    sequential ``ring_loop`` exactly: ``n-1`` hops without it, ``n``
+    with. Returns ``(carry, mov)``.
+
+    Bit-identical to the sequential loop by construction: every step's
+    body consumes exactly the buffers the sequential path would, in the
+    same order — only the issue position of the hop moves.
+    """
+
+    # n == 1: every operand is already home — mirror ``ring_loop``'s
+    # ``n > 1`` guard on the trailing shift instead of emitting a
+    # self-loop permute.
+    final_shift = final_shift and n > 1
+
+    def step(s, state):
+        c, m = state
+        nxt = shift_mov(m)  # issued BEFORE the body: no data dependence
+        c = body(s, c, m)
+        if shift_carry is not None:
+            c = shift_carry(c)
+        return c, nxt
+
+    if unroll:
+        state = (carry, mov)
+        for s in range(n):
+            if s < n - 1 or final_shift:
+                state = step(s, state)
+            else:
+                c, m = state
+                state = (body(s, c, m), m)
+        return state
+    if final_shift:
+        # Uniform step (hop every iteration incl. the last): fori-able.
+        return lax.fori_loop(0, n, step, (carry, mov))
+    if n > 1:
+        carry, mov = lax.fori_loop(0, n - 1, step, (carry, mov))
+    return body(n - 1, carry, mov), mov
+
+
 def ring_loop(
     n: int,
     body: Callable,

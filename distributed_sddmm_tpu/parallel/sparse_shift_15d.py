@@ -39,7 +39,8 @@ from distributed_sddmm_tpu.compat import shard_map
 from distributed_sddmm_tpu.common import MatMode, divide_round_up
 from distributed_sddmm_tpu.parallel.base import DistributedSparse
 from distributed_sddmm_tpu.parallel.loops import (
-    abl_all_gather, abl_ppermute, ablation, ring_loop, ring_perm, vary,
+    abl_all_gather, abl_ppermute, ring_loop, ring_loop_overlap,
+    ring_perm, vary,
 )
 from distributed_sddmm_tpu.parallel.layouts import ShardedBlockRow
 from distributed_sddmm_tpu.parallel.mesh import make_grid
@@ -65,6 +66,7 @@ class SparseShift15D(DistributedSparse):
         devices=None,
         dtype=jnp.float32,
         unroll: bool = True,
+        overlap: bool = False,
     ):
         if devices is None:
             devices = jax.devices()
@@ -80,6 +82,12 @@ class SparseShift15D(DistributedSparse):
             )
         grid = make_grid(nr, c, 1, adjacency=adjacency, devices=devices)
         super().__init__(grid, S.M, S.N, R, c, kernel=kernel, dtype=dtype)
+        #: Double-buffered ring programs (``--fusion overlap``): the
+        #: traveling tile's body-independent arrays (indices, mask/vals)
+        #: hop BEFORE the local kernel consumes the resident copy; the
+        #: SDDMM pass's accumulating dots — which depend on the body —
+        #: still hop after it (``ring_loop_overlap``'s ``shift_carry``).
+        self.overlap = bool(overlap)
         self.r_split = True
         self.r_split_axis = "rows"  # psum axis for CG dot products
         self.unroll = unroll
@@ -140,6 +148,7 @@ class SparseShift15D(DistributedSparse):
         kern = self.kernel
         perm = ring_perm(nr)
         unroll = self.unroll
+        overlap = self.overlap
         bm, bn, grb, gcb, grp = tiles.blk_geom
         rows_pad, cols_pad = grb * bm, gcb * bn
         C = max_nnz // CHUNK
@@ -182,28 +191,37 @@ class SparseShift15D(DistributedSparse):
 
             def prog(a_role, b_role, blr, blc, bmeta, t_mask, t_vals):
                 bt = kern.prep(replicate_stationary(b_role), cols_pad)
-                fields = squeeze_blk(blr, blc, bmeta)
-                init = (
-                    fields,
-                    t_mask.reshape(max_nnz),
-                    dvary(jnp.zeros((max_nnz,), t_mask.dtype)),
-                )
+                mov0 = (squeeze_blk(blr, blc, bmeta), t_mask.reshape(max_nnz))
+                acc0 = dvary(jnp.zeros((max_nnz,), t_mask.dtype))
 
-                def body(s, state):
-                    fields, mask, acc = state
+                def local(s, fields, mask, acc):
                     stripe = lax.dynamic_index_in_dim(
                         a_role, my_stripe(s), axis=0, keepdims=False
                     ).reshape(out_bw, a_role.shape[-1])
                     at = kern.prep(stripe, rows_pad)
-                    acc = acc + kern.sddmm_tile_t(
+                    return acc + kern.sddmm_tile_t(
                         blk_of(fields), mask, at, bt, mask.dtype
                     )
-                    return (fields, mask, acc)
 
-                state = ring_loop(
-                    nr, body, init, shift, shift_final=shift, unroll=unroll
-                )
-                acc = state[2]
+                if overlap:
+                    def body(s, acc, mov):
+                        fields, mask = mov
+                        return local(s, fields, mask, acc)
+
+                    acc, _ = ring_loop_overlap(
+                        nr, body, acc0, mov0, shift, shift_carry=shift,
+                        final_shift=True, unroll=unroll,
+                    )
+                else:
+                    def body(s, state):
+                        (fields, mask), acc = state
+                        return ((fields, mask), local(s, fields, mask, acc))
+
+                    state = ring_loop(
+                        nr, body, (mov0, acc0), shift,
+                        shift_final=shift, unroll=unroll,
+                    )
+                    acc = state[1]
                 return (t_vals.reshape(max_nnz) * acc).reshape(1, 1, 1, 1, max_nnz)
 
             in_specs = (
@@ -216,29 +234,40 @@ class SparseShift15D(DistributedSparse):
 
             def prog(stat, blr, blc, bmeta, t_vals):
                 bt = kern.prep(replicate_stationary(stat), cols_pad)
-                fields = squeeze_blk(blr, blc, bmeta)
-                init = (
-                    fields,
-                    t_vals.reshape(max_nnz),
-                    dvary(jnp.zeros((nr, 1, out_bw, stat.shape[-1]), stat.dtype)),
+                mov0 = (squeeze_blk(blr, blc, bmeta), t_vals.reshape(max_nnz))
+                out0 = dvary(
+                    jnp.zeros((nr, 1, out_bw, stat.shape[-1]), stat.dtype)
                 )
 
-                def body(s, state):
-                    fields, vals, out = state
+                def local(s, fields, vals, out):
                     partial = kern.spmm_tile_t(blk_of(fields), vals, bt)
                     stripe = partial.T[:out_bw].astype(out.dtype)
-                    out = lax.dynamic_update_index_in_dim(
+                    return lax.dynamic_update_index_in_dim(
                         out, stripe[None, :, :], my_stripe(s), axis=0
                     )
-                    return (fields, vals, out)
+
+                if overlap:
+                    def body(s, out, mov):
+                        fields, vals = mov
+                        return local(s, fields, vals, out)
+
+                    out, _ = ring_loop_overlap(
+                        nr, body, out0, mov0, shift, unroll=unroll
+                    )
+                    return out
+
+                def body(s, state):
+                    (fields, vals), out = state
+                    return ((fields, vals), local(s, fields, vals, out))
 
                 def shift_tile_only(state):
-                    fields, vals, out = state
-                    fields, vals = shift((fields, vals))
-                    return (fields, vals, out)
+                    mov, out = state
+                    return (shift(mov), out)
 
-                state = ring_loop(nr, body, init, shift_tile_only, unroll=unroll)
-                return state[2]
+                state = ring_loop(
+                    nr, body, (mov0, out0), shift_tile_only, unroll=unroll
+                )
+                return state[1]
 
             in_specs = (_DENSE_SPEC, BLK6, BLK6, _TILE_SPEC, _TILE_SPEC)
             out_specs = _DENSE_SPEC
@@ -253,12 +282,21 @@ class SparseShift15D(DistributedSparse):
             )
         )
 
+    def _program_cache_key(self, op: str, use_st: bool) -> tuple:
+        """Base key + the fusion build (see DenseShift15D)."""
+        return (
+            *super()._program_cache_key(op, use_st),
+            "overlap" if self.overlap else "seq",
+        )
+
     def _program(self, op: str, use_st: bool):
-        key = (op, use_st, ablation())
+        key = self._program_cache_key(op, use_st)
         if key in self._programs:
             return self._programs[key]
         if self._use_blocked(self.ST_tiles if use_st else self.S_tiles):
-            fn = self._build_blocked_program(op, use_st)
+            fn = self._finalize_program(
+                key, self._build_blocked_program(op, use_st)
+            )
             self._programs[key] = fn
             return fn
 
@@ -269,6 +307,7 @@ class SparseShift15D(DistributedSparse):
         kern = self.kernel
         perm = ring_perm(nr)
         unroll = self.unroll
+        overlap = self.overlap
 
         def shift(tree):
             if nr == 1:
@@ -302,27 +341,48 @@ class SparseShift15D(DistributedSparse):
                 # replicated across layers (reference Arole/Brole split,
                 # `15D_sparse_shift.hpp:176-199`).
                 b_rep = replicate_stationary(b_role)  # (rows_pad, r_loc)
-                init = (
+                fields = (
                     squeeze_tile(t_rows),
                     squeeze_tile(t_cols),
                     squeeze_tile(t_mask),
-                    dvary(jnp.zeros((max_nnz,), t_mask.dtype)),
                 )
+                acc0 = dvary(jnp.zeros((max_nnz,), t_mask.dtype))
 
-                def body(s, state):
-                    rows, cols, mask, acc = state
-                    stripe = lax.dynamic_index_in_dim(
+                def stripe_at(s):
+                    return lax.dynamic_index_in_dim(
                         a_role, my_stripe(s), axis=0, keepdims=False
                     ).reshape(out_bw, a_role.shape[-1])
-                    acc = acc + kern.sddmm(rows, cols, mask, stripe, b_rep)
-                    return (rows, cols, mask, acc)
 
-                # The accumulating dots travel WITH the tile; the final shift
-                # completes their round trip home.
-                state = ring_loop(
-                    nr, body, init, shift, shift_final=shift, unroll=unroll
-                )
-                acc = state[3]
+                if overlap:
+                    # Index/mask arrays are body-independent: they
+                    # double-buffer. The accumulating dots depend on the
+                    # body, so they hop after it (shift_carry) — the one
+                    # leg of this traveling tile that cannot overlap.
+                    def body(s, acc, fields):
+                        rows, cols, mask = fields
+                        return acc + kern.sddmm(
+                            rows, cols, mask, stripe_at(s), b_rep
+                        )
+
+                    acc, _ = ring_loop_overlap(
+                        nr, body, acc0, fields, shift, shift_carry=shift,
+                        final_shift=True, unroll=unroll,
+                    )
+                else:
+                    def body(s, state):
+                        rows, cols, mask, acc = state
+                        acc = acc + kern.sddmm(
+                            rows, cols, mask, stripe_at(s), b_rep
+                        )
+                        return (rows, cols, mask, acc)
+
+                    # The accumulating dots travel WITH the tile; the
+                    # final shift completes their round trip home.
+                    state = ring_loop(
+                        nr, body, (*fields, acc0), shift,
+                        shift_final=shift, unroll=unroll,
+                    )
+                    acc = state[3]
                 return (squeeze_tile(t_vals) * acc).reshape(1, 1, 1, 1, max_nnz)
 
             in_specs = (
@@ -337,12 +397,30 @@ class SparseShift15D(DistributedSparse):
 
             def prog(stat, t_rows, t_cols, t_vals):
                 stat_rep = replicate_stationary(stat)
-                init = (
+                fields = (
                     squeeze_tile(t_rows),
                     squeeze_tile(t_cols),
                     squeeze_tile(t_vals),
-                    dvary(jnp.zeros((nr, 1, out_bw, stat.shape[-1]), stat.dtype)),
                 )
+                out0 = dvary(
+                    jnp.zeros((nr, 1, out_bw, stat.shape[-1]), stat.dtype)
+                )
+
+                if overlap:
+                    # The whole traveling tile is body-independent here
+                    # (the output stays put): every hop double-buffers.
+                    def body(s, out, fields):
+                        rows, cols, vals = fields
+                        stripe = kern.spmm(rows, cols, vals, stat_rep, out_bw)
+                        return lax.dynamic_update_index_in_dim(
+                            out, stripe[None, :, :].astype(out.dtype),
+                            my_stripe(s), axis=0,
+                        )
+
+                    out, _ = ring_loop_overlap(
+                        nr, body, out0, fields, shift, unroll=unroll
+                    )
+                    return out
 
                 def body(s, state):
                     rows, cols, vals, out = state
@@ -357,7 +435,9 @@ class SparseShift15D(DistributedSparse):
                     rows, cols, vals = shift((rows, cols, vals))
                     return (rows, cols, vals, out)
 
-                state = ring_loop(nr, body, init, shift_tile_only, unroll=unroll)
+                state = ring_loop(
+                    nr, body, (*fields, out0), shift_tile_only, unroll=unroll
+                )
                 return state[3]
 
             in_specs = (_DENSE_SPEC, _TILE_SPEC, _TILE_SPEC, _TILE_SPEC)
@@ -366,7 +446,11 @@ class SparseShift15D(DistributedSparse):
         else:
             raise ValueError(op)
 
-        fn = jax.jit(shard_map(prog, mesh=mesh, in_specs=in_specs, out_specs=out_specs))
+        fn = self._finalize_program(
+            key,
+            jax.jit(shard_map(prog, mesh=mesh, in_specs=in_specs,
+                              out_specs=out_specs)),
+        )
         self._programs[key] = fn
         return fn
 

@@ -1,0 +1,213 @@
+"""Canonical program-store keys: one grammar for every compiled-program
+cache.
+
+Three look-alike fingerprint-key builders grew independently — the
+autotune plan fingerprints (``autotune/fingerprint.py``), the serve
+engine's bucket-ladder keys, and the bench AOT file stems — and the
+program store unifies their *compiled-program* halves here so store keys
+cannot silently diverge again. The design rules are the plan cache's
+(``autotune/fingerprint.py`` module doc):
+
+* a key is a pure function of (problem shape, machine, code generation):
+  same inputs in two processes MUST produce the same key — cross-restart
+  and cross-process reuse both depend on it;
+* the code generation is baked INTO the key (``code_hash`` for programs
+  shaped by ``ops/`` + ``parallel/``, ``serve_code_hash`` for serving
+  programs): a new code generation is a new key, so a stale entry can
+  never answer for new code;
+* the **aval signature** (shapes + dtypes of the example arguments) is
+  part of the key: compiled executables are shape-rigid, and two
+  problems that share a fingerprint bucket can still disagree on padded
+  tile geometry.
+
+Keys are colon-joined printable segments (safe as file-name stems after
+:func:`safe_stem`); every builder has a matching parser and the pair is
+round-trip tested (``tests/test_program_keys.py``).
+
+This module deliberately imports neither jax nor the strategy code —
+keys must be computable in subprocesses and offline tooling (same
+discipline as ``autotune/fingerprint.py``; the only jax touch-point,
+:func:`sig_for_args`, duck-types on ``shape``/``dtype``).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import re
+
+_SEG_RE = re.compile(r"^[A-Za-z0-9._=+-]+$")
+
+
+def _seg(value) -> str:
+    """One key segment: printable and colon-free, or content-hashed."""
+    s = str(value)
+    if _SEG_RE.match(s):
+        return s
+    return "h" + hashlib.sha256(s.encode()).hexdigest()[:12]
+
+
+def sig_for_args(args) -> str:
+    """Short stable hash of the argument aval signature (shapes +
+    dtypes, structure-order). Works on jax arrays, numpy arrays and
+    ShapeDtypeStructs — anything with ``shape`` and ``dtype``."""
+    parts = []
+    for a in args:
+        shape = tuple(getattr(a, "shape", ()))
+        dtype = str(getattr(a, "dtype", type(a).__name__))
+        parts.append(f"{shape}{dtype}")
+    return hashlib.sha256(";".join(parts).encode()).hexdigest()[:10]
+
+
+# --------------------------------------------------------------------- #
+# Plan-routed strategy programs (autotune Plan.instantiate)
+# --------------------------------------------------------------------- #
+
+
+def plan_program_key(
+    fingerprint_key: str,
+    op: str,
+    sig: str,
+    backend: str,
+    code: str | None = None,
+) -> str:
+    """Key for one compiled strategy program under an autotune plan.
+
+    ``fingerprint_key`` is the plan fingerprint (problem + machine +
+    code already hashed in); ``op`` names the strategy's program-cache
+    key (op name, tile set, ablation mode); ``sig`` is
+    :func:`sig_for_args` over the concrete call arguments. ``code``
+    defaults to the live ``autotune.fingerprint.code_hash()`` — baked in
+    even though the fingerprint already covers it, so a key parsed out
+    of the store is self-describing about its generation.
+    """
+    if code is None:
+        from distributed_sddmm_tpu.autotune.fingerprint import code_hash
+
+        code = code_hash()
+    return ":".join(
+        ("plan", _seg(fingerprint_key), _seg(op), _seg(sig),
+         _seg(backend), _seg(code))
+    )
+
+
+def parse_plan_key(key: str) -> dict | None:
+    parts = key.split(":")
+    if len(parts) != 6 or parts[0] != "plan":
+        return None
+    return dict(zip(
+        ("family", "fingerprint_key", "op", "sig", "backend", "code_hash"),
+        parts,
+    ))
+
+
+# --------------------------------------------------------------------- #
+# Serving bucket-ladder programs (serve/engine.py)
+# --------------------------------------------------------------------- #
+
+
+def serve_program_key(
+    workload: str,
+    batch_bucket: int,
+    inner_bucket: int,
+    r,
+    backend: str,
+    code: str | None = None,
+    params: str | None = None,
+    sig: str | None = None,
+) -> str:
+    """Cache key for one serving bucket cell — the grammar the engine
+    has used since PR 5 (``serve:<workload>:b<bb>:i<ib>:r<R>:<backend>:
+    <serve_code_hash>``), now owned here, with two optional trailing
+    segments the store appends: ``p<params>`` (workload constants the
+    traced program bakes in — the fold-in top-k size and ridge, which
+    change the executable without changing any argument shape) and
+    ``s<sig>`` (the aval signature, so a program compiled against one
+    model's array shapes can never answer for another's)."""
+    if code is None:
+        from distributed_sddmm_tpu.autotune.fingerprint import serve_code_hash
+
+        code = serve_code_hash()
+    key = (
+        f"serve:{_seg(workload)}:b{int(batch_bucket)}:i{int(inner_bucket)}"
+        f":r{_seg(r)}:{_seg(backend)}:{_seg(code)}"
+    )
+    if params:
+        key += f":p{_seg(params)}"
+    if sig:
+        key += f":s{_seg(sig)}"
+    return key
+
+
+def parse_serve_key(key: str) -> dict | None:
+    parts = key.split(":")
+    if not (7 <= len(parts) <= 9) or parts[0] != "serve":
+        return None
+    if not (parts[2].startswith("b") and parts[3].startswith("i")
+            and parts[4].startswith("r")):
+        return None
+    out = {
+        "family": "serve",
+        "workload": parts[1],
+        "batch_bucket": int(parts[2][1:]),
+        "inner_bucket": int(parts[3][1:]),
+        "r": parts[4][1:],
+        "backend": parts[5],
+        "code_hash": parts[6],
+    }
+    for extra in parts[7:]:
+        if extra.startswith("p"):
+            out["params"] = extra[1:]
+        elif extra.startswith("s"):
+            out["sig"] = extra[1:]
+        else:
+            return None
+    return out
+
+
+# --------------------------------------------------------------------- #
+# Bench AOT chain executables (bench/aot.py)
+# --------------------------------------------------------------------- #
+
+
+def bench_aot_key(stem: str, name: str, n: int, backend: str = "tpu") -> str:
+    """Key for one serialized bench chain executable. ``stem`` is the
+    config-describing cache-directory basename the offline compilers
+    already derive (it embeds the code/knob hash — e.g.
+    ``distgap_16_32_128_t5_<hash>``), ``name``/``n`` the program name
+    and trip count that used to form the ``{name}_{n}.pkl`` file stem."""
+    return ":".join(("bench", _seg(stem), _seg(name), str(int(n)),
+                     _seg(backend)))
+
+
+def parse_bench_key(key: str) -> dict | None:
+    parts = key.split(":")
+    if len(parts) != 5 or parts[0] != "bench":
+        return None
+    try:
+        n = int(parts[3])
+    except ValueError:
+        return None
+    return {"family": "bench", "stem": parts[1], "name": parts[2],
+            "n": n, "backend": parts[4]}
+
+
+# --------------------------------------------------------------------- #
+
+
+def parse_key(key: str) -> dict | None:
+    """Parse any store key; None when the grammar is unrecognized."""
+    for parser in (parse_plan_key, parse_serve_key, parse_bench_key):
+        out = parser(key)
+        if out is not None:
+            return out
+    return None
+
+
+def safe_stem(key: str) -> str:
+    """Key -> file-name stem: colon separators become ``__``; anything
+    else path-unsafe is hashed away by :func:`_seg` at build time. A
+    trailing short hash of the FULL key disambiguates the (theoretical)
+    collision of two keys mapping to one sanitized stem."""
+    body = key.replace(":", "__")
+    body = "".join(c if (c.isalnum() or c in "._=+-") else "_" for c in body)
+    return f"{body[:140]}-{hashlib.sha256(key.encode()).hexdigest()[:8]}"

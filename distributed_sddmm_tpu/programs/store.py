@@ -1,0 +1,631 @@
+"""Persistent, fingerprint-keyed AOT compiled-program store.
+
+Three compiled-program caches grew independently — the plan-routed
+strategy programs (``autotune/plan.py``), the serve engine's bucket
+ladder (``serve/engine.py``), and the bench AOT executables
+(``bench/aot.py``) — each recompiling programs a previous run already
+built. This module is the single store all three now read and write:
+one directory (``artifacts/programs/`` by default) of serialized XLA
+executables keyed by the shared grammar in ``programs/keys.py``
+(problem shape + machine + code generation + aval signature), so a
+serving cold start or a fresh worker process warms from disk instead of
+compiling.
+
+Durability discipline (the plan cache's, hardened by its corruption
+suite):
+
+* every write goes through ``utils/atomic.py`` (temp file +
+  ``os.replace``; the resilience layer's write-fault hook applies),
+* the summary ``index.json`` is derivative state behind the same
+  advisory ``flock`` as the run store — corrupt or missing, it is
+  rebuilt from the entry files, never trusted,
+* a corrupt, truncated, schema-mismatched, foreign-key or
+  wrong-backend entry reads as a **miss and is evicted**; the caller
+  falls through to a live compile. The store is a pure accelerator —
+  it can cost a compile, never an error,
+* deserialization runs through ``compat.deserialize_and_load`` (the
+  jax-generation shim), and any failure there also evicts and falls
+  through.
+
+Counters land in ``obs.metrics.GLOBAL``: ``program_store_hits`` (disk),
+``program_store_misses`` (absent/evicted), ``live_compiles`` (an
+executable was built in-process — the number a warmed cold start must
+drive to zero).
+
+Activation mirrors the run store: ``DSDDMM_PROGRAMS`` = ``0``/``off``
+disables, a path relocates, unset/``1`` selects the default root.
+Unlike the run store (telemetry), the program store defaults ON — it is
+a functional cache — but the test conftest vetoes it so CI cannot silt
+``artifacts/``.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import pathlib
+import pickle
+import threading
+import time
+
+from distributed_sddmm_tpu.programs import keys as keys_mod
+from distributed_sddmm_tpu.utils.atomic import atomic_write_bytes, atomic_write_json
+
+#: Entry payload schema generation; readers evict entries they cannot read.
+SCHEMA_VERSION = 1
+
+_REPO = pathlib.Path(__file__).resolve().parents[2]
+DEFAULT_ROOT = _REPO / "artifacts" / "programs"
+
+
+def _global_counters():
+    from distributed_sddmm_tpu.obs import metrics as obs_metrics
+
+    return obs_metrics.GLOBAL
+
+
+def live_backend() -> str | None:
+    """Platform of the default jax backend, initializing it if needed —
+    the store's load path runs next to a compile, so a backend is
+    already (or about to be) up; this is not the manifest's
+    never-initialize context."""
+    try:
+        import jax
+
+        return jax.default_backend()
+    except Exception:  # noqa: BLE001 — no backend, no backend gate
+        return None
+
+
+class ProgramStore:
+    """One directory of serialized executables plus a derived index.
+
+    Layout::
+
+        <root>/entries/<safe_stem(key)>.prog   pickled entry dict
+        <root>/index.json                      summary rows (derived)
+
+    An entry dict: ``{"schema", "key", "backend", "created_epoch",
+    "meta", "payload"}`` where ``payload`` is
+    ``jax.experimental.serialize_executable.serialize``'s
+    ``(serialized, in_tree, out_tree)`` tuple.
+    """
+
+    def __init__(self, root: str | os.PathLike | None = None):
+        self.root = pathlib.Path(root) if root else DEFAULT_ROOT
+        self.entries_dir = self.root / "entries"
+        self.index_path = self.root / "index.json"
+        self._lock = threading.Lock()
+        # Per-instance counters (tests + engine stats); the GLOBAL
+        # counters aggregate across stores process-wide.
+        self.hits = 0
+        self.misses = 0
+        self.live_compiles = 0
+
+    def _path(self, key: str) -> pathlib.Path:
+        return self.entries_dir / f"{keys_mod.safe_stem(key)}.prog"
+
+    # ------------------------------------------------------------------ #
+    # flock'd index (the run store's cross-process discipline)
+    # ------------------------------------------------------------------ #
+
+    @contextlib.contextmanager
+    def _flock(self):
+        try:
+            import fcntl
+        except ImportError:
+            yield
+            return
+        self.root.mkdir(parents=True, exist_ok=True)
+        with open(self.root / ".lock", "w") as fh:
+            fcntl.flock(fh, fcntl.LOCK_EX)
+            try:
+                yield
+            finally:
+                fcntl.flock(fh, fcntl.LOCK_UN)
+
+    def _read_index(self) -> list | None:
+        import json
+
+        try:
+            rows = json.loads(self.index_path.read_text())
+        except FileNotFoundError:
+            return []
+        except (OSError, ValueError):
+            return None  # corrupt — rebuild
+        if not isinstance(rows, list):
+            return None
+        return [r for r in rows if isinstance(r, dict) and r.get("key")]
+
+    def _rebuild_index_locked(self) -> list:
+        rows = []
+        for f in sorted(self.entries_dir.glob("*.prog")):
+            entry = self._read_entry_file(f)
+            if entry is not None:
+                rows.append(self._index_row(entry))
+        atomic_write_json(self.index_path, rows)
+        return rows
+
+    @staticmethod
+    def _index_row(entry: dict) -> dict:
+        return {
+            "key": entry.get("key"),
+            "backend": entry.get("backend"),
+            "created_epoch": entry.get("created_epoch"),
+            "meta": entry.get("meta") or {},
+        }
+
+    def _update_index(self, entry: dict | None, drop_key: str | None = None):
+        with self._flock():
+            rows = self._read_index()
+            if rows is None:
+                rows = self._rebuild_index_locked()
+            if drop_key is not None:
+                rows = [r for r in rows if r.get("key") != drop_key]
+            if entry is not None:
+                rows = [r for r in rows if r.get("key") != entry.get("key")]
+                rows.append(self._index_row(entry))
+            rows.sort(key=lambda r: (r.get("created_epoch") or 0, r["key"]))
+            atomic_write_json(self.index_path, rows)
+
+    def index(self) -> list[dict]:
+        with self._lock:
+            rows = self._read_index()
+            if rows is None:
+                with self._flock():
+                    rows = self._rebuild_index_locked()
+            return rows
+
+    # ------------------------------------------------------------------ #
+    # Entry I/O
+    # ------------------------------------------------------------------ #
+
+    def _read_entry_file(self, path: pathlib.Path) -> dict | None:
+        try:
+            raw = path.read_bytes()
+        except OSError:
+            return None
+        try:
+            entry = pickle.loads(raw)
+        except Exception:  # noqa: BLE001 — truncated/garbled pickle
+            return None
+        if not isinstance(entry, dict):
+            return None
+        if entry.get("schema") != SCHEMA_VERSION:
+            return None
+        return entry
+
+    def evict(self, key: str) -> None:
+        """Drop one entry (corruption, staleness); never raises."""
+        try:
+            os.unlink(self._path(key))
+        except OSError:
+            pass
+        try:
+            self._update_index(None, drop_key=key)
+        except OSError:
+            pass
+
+    def load(self, key: str, *, backend: str | None = None, device=None):
+        """The deserialized executable for ``key``, or None.
+
+        Misses: absent file, unreadable/truncated pickle, schema or
+        embedded-key mismatch (a renamed/copied entry must not answer
+        for a foreign key), backend mismatch (an executable serialized
+        for another platform cannot run here), or a deserialize failure
+        — every non-absent miss also EVICTS the entry so the slot heals
+        on the next save (backend mismatch excepted: a store shared
+        between backends is legal, the entry is another platform's).
+        Never raises for entry-content reasons.
+
+        ``device`` pins deserialization to one device (the bench AOT
+        re-homing path); default is the process's first device.
+        """
+        from distributed_sddmm_tpu import compat
+        from distributed_sddmm_tpu.obs import log as obs_log
+
+        path = self._path(key)
+        entry = self._read_entry_file(path)
+        if entry is None:
+            if path.exists():
+                self.evict(key)
+            self._miss()
+            return None
+        if entry.get("key") != key:
+            self.evict(key)
+            self._miss()
+            return None
+        if backend is not None:
+            want_backend = backend
+        elif device is not None:
+            want_backend = device.platform
+        else:
+            want_backend = live_backend()
+        if want_backend is not None and entry.get("backend") != want_backend:
+            self._miss()
+            return None
+        try:
+            import jax
+
+            serialized, in_tree, out_tree = entry["payload"]
+            client = (
+                device.client if device is not None
+                else jax.devices()[0].client
+            )
+            loaded = compat.deserialize_and_load(
+                serialized, in_tree, out_tree, backend=client,
+                execution_devices=[device] if device is not None else None,
+            )
+        except Exception as e:  # noqa: BLE001 — any failure -> live compile
+            obs_log.warn(
+                "programs", "deserialize failed; evicting entry",
+                key=key, error=f"{type(e).__name__}: {e}",
+            )
+            self.evict(key)
+            self._miss()
+            return None
+        with self._lock:
+            self.hits += 1
+        _global_counters().add("program_store_hits")
+        return loaded
+
+    def save(self, key: str, compiled, meta: dict | None = None,
+             backend: str | None = None) -> bool:
+        """Serialize + persist one compiled executable atomically.
+
+        ``backend`` is the executable's TARGET platform; it defaults to
+        the live backend but offline AOT compilers (a CPU-pinned process
+        compiling for a TPU topology) must pass the target explicitly or
+        the load-side backend gate would reject their own entries.
+
+        Returns False (never raises) when this jax generation or
+        executable cannot serialize — the store is an accelerator, and
+        the caller already holds a working compiled program.
+        """
+        from distributed_sddmm_tpu.obs import log as obs_log
+
+        try:
+            from jax.experimental import serialize_executable as se
+
+            payload = se.serialize(compiled)
+            entry = {
+                "schema": SCHEMA_VERSION,
+                "key": key,
+                "backend": backend if backend is not None else live_backend(),
+                "created_epoch": time.time(),
+                "meta": dict(meta or {}),
+                "payload": payload,
+            }
+            atomic_write_bytes(self._path(key), pickle.dumps(entry))
+            self._update_index(entry)
+            return True
+        except Exception as e:  # noqa: BLE001 — persistence is best-effort
+            obs_log.warn(
+                "programs", "serialize/store failed; entry not persisted",
+                key=key, error=f"{type(e).__name__}: {e}",
+            )
+            return False
+
+    # ------------------------------------------------------------------ #
+    # The one call sites use
+    # ------------------------------------------------------------------ #
+
+    def get_or_compile(self, key: str, compile_fn, meta: dict | None = None):
+        """(program, source): the deserialized entry (``"disk"``) or a
+        live ``compile_fn()`` result (``"live"``, persisted for the next
+        process). ``compile_fn`` must return a callable compiled
+        executable (e.g. ``jit_fn.lower(*args).compile()``)."""
+        prog = self.load(key)
+        if prog is not None:
+            return prog, "disk"
+        prog = compile_fn()
+        self._live()
+        self.save(key, prog, meta=meta)
+        return prog, "live"
+
+    def _miss(self) -> None:
+        with self._lock:
+            self.misses += 1
+        _global_counters().add("program_store_misses")
+
+    def _live(self) -> None:
+        with self._lock:
+            self.live_compiles += 1
+        _global_counters().add("live_compiles")
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "hits": self.hits,
+                "misses": self.misses,
+                "live_compiles": self.live_compiles,
+            }
+
+
+# --------------------------------------------------------------------- #
+# Store-backed jit wrapper (the strategy/app integration point)
+# --------------------------------------------------------------------- #
+
+
+class StoredProgram:
+    """Wrap a jitted function with store-backed resolution per aval
+    signature.
+
+    On a call with concrete arrays the argument signature selects a
+    store key (``key_fn(sig)``); resolution tries the store first
+    (disk hit), else AOT-compiles the jit via ``lower(*args).compile()``
+    (live compile, persisted). Under a jax trace (the wrapped program is
+    being inlined into a larger jitted program — the cgStep/gatLayer
+    chains do exactly this) the wrapper steps aside and calls the jit
+    directly: tracers have no buffers to load into.
+
+    A disk-loaded executable that rejects a call (shape drift the key
+    missed, donation/layout mismatch) permanently falls back to the jit
+    for that signature — correctness never depends on the store.
+    """
+
+    def __init__(self, jit_fn, key_fn, store: "ProgramStore | None",
+                 meta: dict | None = None, on_resolve=None):
+        self._jit_fn = jit_fn
+        self._key_fn = key_fn
+        self._store = store
+        self._meta = meta or {}
+        self._on_resolve = on_resolve  # callback(source: "disk"|"live")
+        self._resolved: dict[str, object] = {}
+        self._lock = threading.Lock()
+
+    def __call__(self, *args):
+        import jax
+
+        if self._store is None:
+            return self._jit_fn(*args)
+        # One traversal serves both the tracer check and the dispatch
+        # key. The resolved-program cache is keyed on the raw
+        # (shape, dtype) tuple — comparable in cost to jit's own cache
+        # lookup — and the sha-based store signature is computed only on
+        # the resolution miss, not per dispatch.
+        shapes = []
+        for x in jax.tree_util.tree_leaves(args):
+            if isinstance(x, jax.core.Tracer):
+                # Being inlined into a larger jitted program: step aside.
+                return self._jit_fn(*args)
+            shapes.append((getattr(x, "shape", ()),
+                           str(getattr(x, "dtype", ""))))
+        cache_key = tuple(shapes)
+        prog = self._resolved.get(cache_key)
+        if prog is None:
+            sig = keys_mod.sig_for_args(jax.tree_util.tree_leaves(args))
+            prog, src = self._store.get_or_compile(
+                self._key_fn(sig),
+                lambda: self._jit_fn.lower(*args).compile(),
+                meta=self._meta,
+            )
+            with self._lock:
+                self._resolved[cache_key] = prog
+            if self._on_resolve is not None:
+                self._on_resolve(src)
+            if src == "disk":
+                # A loaded executable must actually accept this call;
+                # reject -> permanent jit fallback for the signature.
+                try:
+                    return prog(*args)
+                except Exception as e:  # noqa: BLE001
+                    from distributed_sddmm_tpu.obs import log as obs_log
+
+                    obs_log.warn(
+                        "programs",
+                        "stored program rejected a call; jit fallback",
+                        key=self._key_fn(sig),
+                        error=f"{type(e).__name__}: {e}",
+                    )
+                    with self._lock:
+                        self._resolved[cache_key] = self._jit_fn
+                    self._store._live()
+                    return self._jit_fn(*args)
+        return prog(*args)
+
+    # jit-API passthroughs some callers poke at.
+    def lower(self, *args, **kw):
+        return self._jit_fn.lower(*args, **kw)
+
+
+def stored(jit_fn, key_fn, store: "ProgramStore | None" = None,
+           meta: dict | None = None):
+    """``StoredProgram`` over the active store (or ``store``); returns
+    the jit unchanged when no store is active — zero overhead when the
+    layer is disabled."""
+    store = store if store is not None else active()
+    if store is None:
+        return jit_fn
+    return StoredProgram(jit_fn, key_fn, store, meta=meta)
+
+
+# --------------------------------------------------------------------- #
+# Strategy binding (autotune Plan.instantiate's hook)
+# --------------------------------------------------------------------- #
+
+
+def strategy_config_tag(alg) -> str:
+    """The strategy-configuration half of a program key.
+
+    The problem fingerprint alone does NOT determine the compiled
+    program: one fingerprint legitimately runs under several
+    (algorithm, c, kernel) configurations — a heatmap sweep benchmarks
+    every algorithm at every cell, and a re-measured plan can change its
+    algorithm under an unchanged fingerprint — so the key must carry
+    the configuration or entries would alias across them. Tile geometry
+    and block shapes are already covered by the aval signature; this tag
+    covers what avals cannot see: the strategy class, replication
+    factor, the ring-build knobs (overlap fusion, rolled loops — same
+    avals, different traced program), and the kernel knobs that reshape
+    the traced program without changing argument shapes (precision,
+    gather chunking, scatter form, batch step).
+    """
+    kern = alg.kernel
+    bits = [type(alg).__name__, f"c{alg.c}", type(kern).__name__]
+    if getattr(alg, "overlap", False):
+        bits.append("ov")
+    if not getattr(alg, "unroll", True):
+        bits.append("rolled")
+    for attr in ("precision", "gather_budget", "scatter_form",
+                 "batch_step"):
+        v = getattr(kern, attr, None)
+        if v is not None:
+            bits.append(f"{attr[:4]}{v}")
+    return "-".join(bits)
+
+
+def matrix_content_key(S) -> str:
+    """Content digest of one sparse matrix (indices + values + shape).
+
+    The strategy's shard_map programs take the tile arrays as
+    *arguments*, so their store entries are content-generic — but the
+    jit-chained app programs (``cgStep``, ``gatLayer``) trace through
+    the raw-program accessors' closures and bake the concrete tile
+    index/mask arrays into the executable as constants. Two matrices
+    with identical coarse fingerprints (same M, N, nnz, R, p) would
+    otherwise alias one chained entry and serve the wrong sparsity
+    pattern; this digest keys them apart.
+    """
+    import hashlib
+
+    import numpy as np
+
+    h = hashlib.sha256()
+    for arr in (S.rows, S.cols, S.vals):
+        h.update(np.ascontiguousarray(arr).tobytes())
+    h.update(f"{S.M}x{S.N}".encode())
+    return h.hexdigest()[:12]
+
+
+def bind_strategy(alg, fingerprint_key: str,
+                  store: "ProgramStore | None" = None,
+                  content_key: str | None = None) -> bool:
+    """Install a program binder on a strategy: every shard_map program
+    the strategy builds from now on resolves through the store under
+    ``plan:<fingerprint_key>:<config>-<op>:<sig>`` keys. Returns False
+    (no-op) when no store is active. Already-built programs are dropped
+    so they rebuild through the binder — cheap: the jit wrappers
+    re-trace only on their next call, which is when they would have
+    compiled anyway.
+
+    The binding facts land on ``alg._program_store_meta`` so the
+    jit-chained app programs built ON TOP of the strategy (``cgStep``,
+    ``gatLayer``) can resolve through the same store under the same
+    fingerprint."""
+    store = store if store is not None else active()
+    if store is None or not fingerprint_key:
+        return False
+    backend = live_backend() or "unknown"
+    cfg = strategy_config_tag(alg)
+
+    def binder(op_key: str, jit_fn):
+        def key_fn(sig: str) -> str:
+            return keys_mod.plan_program_key(
+                fingerprint_key, f"{cfg}-{op_key}", sig, backend
+            )
+
+        return StoredProgram(
+            jit_fn, key_fn, store,
+            meta={"fingerprint_key": fingerprint_key, "op": op_key,
+                  "config": cfg},
+        )
+
+    alg.bind_program_store(binder)
+    alg._program_store_meta = {
+        "store": store, "fingerprint_key": fingerprint_key,
+        "config": cfg, "backend": backend,
+        # Matrix-content digest (:func:`matrix_content_key`), consumed
+        # by :func:`chained_program` — see there for why the chains
+        # need it and the strategy programs do not.
+        "content": content_key or "",
+    }
+    return True
+
+
+def chained_program(alg, op: str, jit_fn):
+    """Store-back one jit-chained APP program (cgStep, gatLayer) built
+    over a bound strategy: resolves under the strategy's binding
+    (fingerprint + config tag) PLUS the ``models/`` code generation —
+    the chain bakes the app-side math (CG vector algebra, the GAT layer
+    body) into the executable, which the plan-scope ``code_hash`` in
+    the fingerprint deliberately does not cover. Returns ``jit_fn``
+    unchanged when the strategy is unbound — the pre-store behavior,
+    byte for byte."""
+    meta = getattr(alg, "_program_store_meta", None)
+    if not meta:
+        return jit_fn
+    if not meta.get("content"):
+        # No content digest recorded at bind time: the chain would bake
+        # this matrix's tile constants under a content-blind key — a
+        # same-shape different-content matrix could then recall the
+        # wrong sparsity pattern. Stay on the plain jit instead.
+        return jit_fn
+    from distributed_sddmm_tpu.autotune.fingerprint import models_code_hash
+
+    op = f"{op}-m{models_code_hash()}-x{meta['content']}"
+
+    def key_fn(sig: str) -> str:
+        return keys_mod.plan_program_key(
+            meta["fingerprint_key"], f"{meta['config']}-{op}", sig,
+            meta["backend"],
+        )
+
+    return StoredProgram(
+        jit_fn, key_fn, meta["store"],
+        meta={"fingerprint_key": meta["fingerprint_key"], "op": op,
+              "config": meta["config"]},
+    )
+
+
+# --------------------------------------------------------------------- #
+# Module-level activation (env grammar shared with the run store)
+# --------------------------------------------------------------------- #
+
+_active: ProgramStore | None = None
+_env_checked = False
+_registry_lock = threading.Lock()
+
+
+def default_root() -> pathlib.Path:
+    from distributed_sddmm_tpu.obs.store import parse_env_spec
+
+    _enabled, root = parse_env_spec(os.environ.get("DSDDMM_PROGRAMS"))
+    return pathlib.Path(root) if root else DEFAULT_ROOT
+
+
+def enable(root: str | os.PathLike | None = None) -> ProgramStore:
+    """Activate the process-wide store (idempotent; an active store
+    wins — same semantics as the run store and tracer)."""
+    global _active, _env_checked
+    with _registry_lock:
+        _env_checked = True
+        if _active is None:
+            _active = ProgramStore(root)
+        return _active
+
+
+def disable() -> None:
+    global _active, _env_checked
+    with _registry_lock:
+        _active = None
+        _env_checked = True
+
+
+def active() -> ProgramStore | None:
+    """The active store, resolving ``DSDDMM_PROGRAMS`` on first query.
+    Unlike the run store (telemetry, off unless asked), the program
+    store is a functional cache and defaults ON at the default root;
+    ``DSDDMM_PROGRAMS=0`` (the test conftest) vetoes it."""
+    global _active, _env_checked
+    if _env_checked:
+        return _active
+    with _registry_lock:
+        if not _env_checked:
+            _env_checked = True
+            from distributed_sddmm_tpu.obs.store import parse_env_spec
+
+            enabled, root = parse_env_spec(os.environ.get("DSDDMM_PROGRAMS"))
+            if enabled:
+                _active = ProgramStore(root)
+    return _active

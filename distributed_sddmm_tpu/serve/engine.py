@@ -79,6 +79,7 @@ class ServingEngine:
         exec_timeout_s: Optional[float] = None,
         exec_retries: Optional[int] = None,
         recorder: Optional[LatencyRecorder] = None,
+        program_store=None,
     ):
         self.workload = workload
         self.queue = RequestQueue(
@@ -97,6 +98,16 @@ class ServingEngine:
         )
         self.recorder = recorder if recorder is not None else LatencyRecorder()
 
+        #: Persistent AOT program store (``programs/``): cold starts warm
+        #: ladder cells from disk instead of compiling. ``program_store``
+        #: overrides; the default follows ``programs.active()``
+        #: (``DSDDMM_PROGRAMS`` env; None disables — in-process jit only).
+        if program_store is None:
+            from distributed_sddmm_tpu import programs
+
+            program_store = programs.active()
+        self.program_store = program_store
+
         self._programs: dict[str, object] = {}
         #: Fast path: (batch_bucket, inner_bucket) -> resolved program.
         #: The fingerprint-style key exists to pin the cache to a code
@@ -106,6 +117,12 @@ class ServingEngine:
         self._cache_lock = threading.Lock()
         self.cache_hits = 0
         self.cache_misses = 0
+        #: Disk-vs-live compile attribution for this engine's ladder
+        #: (``disk_hits`` counts programs deserialized from the store;
+        #: ``live_compiles`` counts in-process compiles — the number a
+        #: warmed cold start must hold at zero).
+        self.disk_hits = 0
+        self.live_compiles = 0
         self.served = 0
         self.degraded_batches = 0
         self._thread: Optional[threading.Thread] = None
@@ -115,8 +132,9 @@ class ServingEngine:
     # Warm program cache (autotune-fingerprint-style keys)
     # ------------------------------------------------------------------ #
 
-    def program_key(self, batch_bucket: int, inner_bucket: int) -> str:
-        from distributed_sddmm_tpu.autotune import fingerprint as fp
+    def program_key(self, batch_bucket: int, inner_bucket: int,
+                    sig: str | None = None) -> str:
+        from distributed_sddmm_tpu.programs import keys as program_keys
 
         backend = "unknown"
         try:
@@ -126,9 +144,17 @@ class ServingEngine:
         except Exception:  # noqa: BLE001 — key quality, not correctness
             pass
         r = getattr(self.workload, "R", getattr(self.workload, "_F", 0))
-        return fp.serve_program_key(
-            self.workload.name, batch_bucket, inner_bucket, r, backend
+        return program_keys.serve_program_key(
+            self.workload.name, batch_bucket, inner_bucket, r, backend,
+            params=self.workload.program_params(), sig=sig,
         )
+
+    def _note_resolve(self, source: str) -> None:
+        with self._cache_lock:
+            if source == "disk":
+                self.disk_hits += 1
+            else:
+                self.live_compiles += 1
 
     def _program(self, batch_bucket: int, inner_bucket: int):
         cell = (batch_bucket, inner_bucket)
@@ -140,6 +166,27 @@ class ServingEngine:
             self.cache_misses += 1
         key = self.program_key(batch_bucket, inner_bucket)
         prog = self.workload.build_program(batch_bucket, inner_bucket)
+        if self.program_store is not None:
+            # Store-backed cell: the first call (warmup's, normally)
+            # resolves against the persistent store — a cold start whose
+            # keys a previous process warmed deserializes instead of
+            # compiling (aval signature appended to the key so a program
+            # compiled against another model's shapes can never answer).
+            from distributed_sddmm_tpu.programs import StoredProgram
+
+            prog = StoredProgram(
+                prog,
+                key_fn=lambda sig, bb=batch_bucket, ib=inner_bucket: (
+                    self.program_key(bb, ib, sig=sig)
+                ),
+                store=self.program_store,
+                meta={"workload": self.workload.name},
+                on_resolve=self._note_resolve,
+            )
+        else:
+            # No store: the cell build implies one in-process compile at
+            # first dispatch; count it so cold-start cost stays visible.
+            self._note_resolve("live")
         with self._cache_lock:
             prog = self._programs.setdefault(key, prog)
             self._cell_programs[cell] = prog
@@ -439,6 +486,8 @@ class ServingEngine:
                 "programs": len(self._programs),
                 "cache_hits": self.cache_hits,
                 "cache_misses": self.cache_misses,
+                "disk_hits": self.disk_hits,
+                "live_compiles": self.live_compiles,
                 "served": self.served,
                 "degraded_batches": self.degraded_batches,
                 "queue_shed": self.queue.shed_count,

@@ -154,6 +154,13 @@ class ServingWorkload(abc.ABC):
     def ingest(self, payloads: list[dict]) -> None:
         """Optional online-ingest hook, called after a batch is served."""
 
+    def program_params(self) -> str:
+        """Workload constants BAKED INTO the traced program (beyond what
+        the argument avals capture) — part of the persistent program-
+        store key, or two configurations would alias one executable.
+        Empty when every knob rides in as an argument."""
+        return ""
+
 
 # --------------------------------------------------------------------- #
 # ALS: user fold-in + top-k recommendation
@@ -239,6 +246,11 @@ class ALSFoldInTopK(ServingWorkload):
             "items": items,
             "ratings": rng.standard_normal(n).astype(np.float64),
         }
+
+    def program_params(self) -> str:
+        # k and the ridge are trace-time constants of fold_in_topk; the
+        # factor matrix itself is an argument (shape covered by avals).
+        return f"k{self.k}-l{self.ridge_lambda:g}"
 
     # -- device program ------------------------------------------------ #
 

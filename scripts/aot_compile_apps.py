@@ -89,7 +89,10 @@ def main() -> int:
         t0 = time.monotonic()
         compiled = prog.lower(*arg_sds).compile()
         name = f"{op}_{'b' if use_st else 'a'}"
-        aot.save_executable(compiled, out_dir, name, 0)
+        # Target platform (the topology chip), not the CPU-pinned
+        # process backend — the on-chip loader's backend gate must match.
+        aot.save_executable(compiled, out_dir, name, 0,
+                            backend=topo.devices[0].platform)
         report["compile_s"][name] = round(time.monotonic() - t0, 2)
     (out_dir / "meta.json").write_text(json.dumps(report, indent=1))
     print(json.dumps(report))

@@ -86,7 +86,10 @@ def main() -> int:
     for n in (1, 1 + trials):
         t0 = time.monotonic()
         compiled = bench.make_headline_chain(prog, n).lower(*arg_sds).compile()
-        aot.save_executable(compiled, out_dir, "headline", n)
+        # Target platform, not this (CPU-pinned) process's backend: the
+        # store's load-side backend gate must accept these on the chip.
+        aot.save_executable(compiled, out_dir, "headline", n,
+                            backend=topo.devices[0].platform)
         report["compile_s"][n] = round(time.monotonic() - t0, 1)
     (out_dir / "meta.json").write_text(json.dumps(report, indent=1))
     print(json.dumps(report))

@@ -1,0 +1,102 @@
+"""Program-store smoke: cross-process warm start, end to end.
+
+The store's whole value proposition is that process B never compiles
+what process A already built. This script is ONE of those processes: it
+builds a plan-routed 1.5D dense-shift strategy bound to a program store
+at ``--store``, dispatches one fused SDDMM→SpMM pair and one serving
+ladder warmup, and reports the store counters as JSON. The tier-1 test
+(``tests/test_programs_smoke.py``) runs it twice against one store
+directory and pins the contract:
+
+* process 1 (cold): ``live_compiles > 0``, ``program_store_hits == 0``;
+* process 2 (warm): ``program_store_hits >= 1`` and
+  ``live_compiles == 0`` for the warmed keys, with bit-identical
+  outputs (the fused output fingerprint is part of the report).
+
+Usage::
+
+    python scripts/programs_smoke.py --store DIR [-o out.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import pathlib
+import sys
+
+REPO = pathlib.Path(__file__).resolve().parents[1]
+sys.path.insert(0, str(REPO))
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--store", required=True, help="program-store root")
+    ap.add_argument("-o", "--output-file", default=None)
+    args = ap.parse_args()
+
+    from distributed_sddmm_tpu.utils.platform import force_cpu_platform
+
+    force_cpu_platform(n_devices=8, replace=True)
+
+    import numpy as np
+
+    from distributed_sddmm_tpu import programs
+    from distributed_sddmm_tpu.autotune import Problem, get_plan
+    from distributed_sddmm_tpu.autotune.cache import PlanCache
+    from distributed_sddmm_tpu.common import MatMode
+    from distributed_sddmm_tpu.models.als import DistributedALS
+    from distributed_sddmm_tpu.obs import metrics as obs_metrics
+    from distributed_sddmm_tpu.serve import ALSFoldInTopK, ServingEngine
+    from distributed_sddmm_tpu.utils.coo import HostCOO
+
+    store_root = pathlib.Path(args.store)
+    store = programs.ProgramStore(store_root)
+    plan_cache = PlanCache(store_root / "_plans")
+
+    S = HostCOO.erdos_renyi(64, 48, 6, seed=0, values="normal")
+    plan = get_plan(Problem.from_coo(S, 8), mode="model", cache=plan_cache)
+
+    # --- plan-routed strategy program ------------------------------------
+    alg = plan.instantiate(S, R=8, program_store=store)
+    A = alg.dummy_initialize(MatMode.A)
+    B = alg.dummy_initialize(MatMode.B)
+    ones = alg.like_s_values(1.0)
+    out, _mid = alg.fused_spmm(A, B, ones, MatMode.A)
+    fused_fp = float(np.sum(np.asarray(out, dtype=np.float64) ** 2))
+
+    # --- serving bucket ladder -------------------------------------------
+    model = DistributedALS(alg, S_host=S)
+    model.initialize_embeddings()
+    workload = ALSFoldInTopK(model, k=3, item_buckets=(4, 8))
+    engine = ServingEngine(
+        workload, max_batch=2, max_depth=8, max_wait_ms=2.0,
+        program_store=store,
+    )
+    warmed = engine.warmup()
+
+    rep = {
+        "ok": True,
+        "plan": {"algorithm": plan.algorithm, "c": plan.c,
+                 "key": plan.fingerprint_key},
+        "fused_fingerprint": fused_fp,
+        "ladder_cells": warmed,
+        "engine": {k: engine.stats()[k]
+                   for k in ("programs", "disk_hits", "live_compiles")},
+        "store": store.stats(),
+        "global": {
+            k: obs_metrics.GLOBAL.get(k)
+            for k in ("program_store_hits", "program_store_misses",
+                      "live_compiles")
+        },
+        "entries_on_disk": len(list((store_root / "entries").glob("*.prog"))),
+    }
+    text = json.dumps(rep, indent=1)
+    print(text)
+    if args.output_file:
+        pathlib.Path(args.output_file).write_text(text)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -18,6 +18,13 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 # bypasses it.
 os.environ["DSDDMM_RUNSTORE"] = "0"
 
+# Same veto for the persistent AOT program store (artifacts/programs):
+# unlike the run store it defaults ON (it is a functional cache, not
+# telemetry), so CI must explicitly opt out or every test run would
+# write serialized executables into the checkout. Tests that exercise
+# the store construct ProgramStore(tmp_path) or re-enable explicitly.
+os.environ["DSDDMM_PROGRAMS"] = "0"
+
 from distributed_sddmm_tpu.utils.platform import force_cpu_platform  # noqa: E402
 
 force_cpu_platform(n_devices=8, replace=True)

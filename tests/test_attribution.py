@@ -53,10 +53,11 @@ def test_ablated_programs_are_distinct_compilations(S):
     # only this shard's contribution, so at p > 1 the numbers must differ.
     assert out_full.shape == out_local.shape
     assert not np.allclose(np.asarray(out_full), np.asarray(out_local))
-    # Cache keys keep the variants separate.
+    # Cache keys keep the variants separate (since PR 6 the key also
+    # carries the fusion build — sequential here).
     keys = {k for k in alg._programs if isinstance(k, tuple) and k[0] == "fused"}
-    assert ("fused", False, "full") in keys
-    assert ("fused", False, "local") in keys
+    assert ("fused", False, "full", "seq") in keys
+    assert ("fused", False, "local", "seq") in keys
 
 
 def test_breakdown_through_blocked_programs(S):

@@ -246,15 +246,22 @@ def test_cli_auto_runs_end_to_end(tmp_path, monkeypatch, capsys):
     from distributed_sddmm_tpu.bench import cli
 
     monkeypatch.setenv("DSDDMM_PLAN_CACHE", str(tmp_path))
+    out = tmp_path / "records.jsonl"
     rc = cli.main(
         ["er", "6", "4", "auto", "16", "1", "--trials", "1",
-         "--kernel", "xla", "--plan-mode", "model"]
+         "--kernel", "xla", "--plan-mode", "model", "-o", str(out)]
     )
     assert rc == 0
     line = capsys.readouterr().out.strip().splitlines()[-1]
     rec = json.loads(line)
     assert rec["algorithm"] in ALGORITHM_FACTORIES
-    assert rec["GFLOPs"] > 0
+    # The full (unrounded) record, not the stdout line: its GFLOPs field
+    # rounds to 3 decimals, and one timed ~30ms trial of this toy problem
+    # rounds to 0.0 whenever the 1-core CI box is busy — a scheduler
+    # coin flip, not a signal about the auto path.
+    full = json.loads(out.read_text().splitlines()[-1])
+    assert full["overall_throughput"] > 0
+    assert full["plan"]["algorithm"] == rec["algorithm"]
 
 
 def test_als_through_plan_routes_onto_program_path():

@@ -1,0 +1,165 @@
+"""Local-kernel-overlap fusion (``--fusion overlap``): the
+double-buffered ring programs must be bit-identical to the sequential
+path on every kernel mode of both shift strategies — the oracle the
+structural HLO gate (tests/test_overlap_gate.py) complements."""
+
+import numpy as np
+import pytest
+
+from distributed_sddmm_tpu.common import MatMode
+from distributed_sddmm_tpu.parallel.dense_shift_15d import DenseShift15D
+from distributed_sddmm_tpu.parallel.sparse_shift_15d import SparseShift15D
+from distributed_sddmm_tpu.utils import oracle
+from distributed_sddmm_tpu.utils.coo import HostCOO
+
+
+def _S():
+    return HostCOO.erdos_renyi(96, 80, 4, seed=3, values="normal")
+
+
+def _pair(cls, S, unroll, **kw):
+    seq = cls(S, R=16, unroll=unroll, **kw)
+    ov = cls(S, R=16, unroll=unroll, overlap=True, **kw)
+    assert ov.overlap and not seq.overlap
+    return seq, ov
+
+
+def _check_all_modes(seq, ov):
+    """The four kernel modes + (dense) the fused pair, bitwise."""
+    A = seq.dummy_initialize(MatMode.A)
+    B = seq.dummy_initialize(MatMode.B)
+    ones = seq.like_s_values(1.0)
+    ones_t = seq.like_st_values(1.0)
+
+    mid_seq = seq.sddmm_a(A, B, ones)
+    mid_ov = ov.sddmm_a(A, B, ones)
+    assert np.array_equal(np.asarray(mid_seq), np.asarray(mid_ov)), "sddmmA"
+    midt_seq = seq.sddmm_b(A, B, ones_t)
+    midt_ov = ov.sddmm_b(A, B, ones_t)
+    assert np.array_equal(np.asarray(midt_seq), np.asarray(midt_ov)), "sddmmB"
+    assert np.array_equal(
+        np.asarray(seq.spmm_a(A, B, mid_seq)),
+        np.asarray(ov.spmm_a(A, B, mid_seq)),
+    ), "spmmA"
+    assert np.array_equal(
+        np.asarray(seq.spmm_b(A, B, midt_seq)),
+        np.asarray(ov.spmm_b(A, B, midt_seq)),
+    ), "spmmB"
+    if isinstance(seq, DenseShift15D):
+        o1, m1 = seq.fused_spmm(A, B, ones, MatMode.A)
+        o2, m2 = ov.fused_spmm(A, B, ones, MatMode.A)
+        assert np.array_equal(np.asarray(o1), np.asarray(o2)), "fused out"
+        assert np.array_equal(np.asarray(m1), np.asarray(m2)), "fused mid"
+
+
+@pytest.mark.parametrize("unroll", [True, False])
+@pytest.mark.parametrize(
+    "kw", [dict(c=1, fusion_approach=2), dict(c=2, fusion_approach=2),
+           dict(c=2, fusion_approach=1)],
+    ids=["c1-f2", "c2-f2", "c2-f1"],
+)
+def test_dense_shift_overlap_bit_identical(kw, unroll):
+    S = _S()
+    seq, ov = _pair(DenseShift15D, S, unroll, **kw)
+    _check_all_modes(seq, ov)
+
+
+@pytest.mark.parametrize("unroll", [True, False])
+@pytest.mark.parametrize("c", [1, 2])
+def test_sparse_shift_overlap_bit_identical(c, unroll):
+    S = _S()
+    seq, ov = _pair(SparseShift15D, S, unroll, c=c)
+    _check_all_modes(seq, ov)
+
+
+def test_overlap_matches_float64_oracle():
+    """Not only self-consistent: the overlap fused pair agrees with the
+    scipy/numpy ground truth like every other program."""
+    S = _S()
+    ov = DenseShift15D(S, R=16, c=2, fusion_approach=2, overlap=True)
+    A = ov.dummy_initialize(MatMode.A)
+    B = ov.dummy_initialize(MatMode.B)
+    A_host = oracle.dummy_dense(ov.M_pad, ov.R)
+    B_host = oracle.dummy_dense(ov.N_pad, ov.R)
+    s_vals = ov.scatter_s_values(S.vals)
+    out, mid = ov.fused_spmm(A, B, s_vals, MatMode.A)
+    np.testing.assert_allclose(
+        ov.gather_s_values(mid), oracle.sddmm(S, A_host, B_host), rtol=1e-4
+    )
+    np.testing.assert_allclose(
+        ov.host_a(out)[: S.M], oracle.fused_spmm_a(S, A_host, B_host),
+        rtol=1e-3, atol=1e-2,
+    )
+
+
+def test_overlap_comm_profile_matches_sequential():
+    """Double buffering reorders hops, it must not change their count or
+    volume — the trace report's comm-vs-costmodel agreement depends on
+    the profile staying truthful for both builds."""
+    S = _S()
+    seq, ov = _pair(DenseShift15D, S, True, c=2, fusion_approach=2)
+    for op in ("fusedSpMM", "sddmmA", "spmmA", "cgStep", "fusedSpMMB"):
+        assert seq.comm_profile(op) == ov.comm_profile(op), op
+
+
+def test_overlap_programs_cached_separately():
+    """One strategy instance keys overlap and sequential variants apart
+    (the program store inherits the distinction through the key)."""
+    S = _S()
+    ov = DenseShift15D(S, R=16, c=1, fusion_approach=2, overlap=True)
+    ov._program("fused", use_st=False)
+    assert any("overlap" in str(k) for k in ov._programs)
+    seq = DenseShift15D(S, R=16, c=1, fusion_approach=2)
+    seq._program("fused", use_st=False)
+    assert any("seq" in str(k) for k in seq._programs)
+
+
+def test_make_algorithm_overlap_gating():
+    from distributed_sddmm_tpu.bench.harness import make_algorithm
+
+    S = _S()
+    alg = make_algorithm("15d_fusion2", S, 16, 1, overlap=True)
+    assert alg.overlap
+    alg = make_algorithm("15d_sparse", S, 16, 2, overlap=True)
+    assert alg.overlap
+    with pytest.raises(ValueError, match="overlap"):
+        make_algorithm("25d_dense_replicate", S, 16, 1, overlap=True)
+
+
+def test_cli_fusion_flag_reaches_record(tmp_path):
+    """`--fusion overlap` flows through the CLI into the strategy build
+    and the emitted record."""
+    import json
+
+    from distributed_sddmm_tpu.bench import cli
+
+    out = tmp_path / "rec.jsonl"
+    rc = cli.main([
+        "er", "6", "4", "15d_fusion2", "16", "1",
+        "--fusion", "overlap", "--trials", "1", "--warmup", "0",
+        "--no-runstore", "-o", str(out),
+    ])
+    assert rc == 0
+    rec = json.loads(out.read_text().splitlines()[0])
+    assert rec["fusion"] == "overlap"
+    assert rec["algorithm"] == "15d_fusion2"
+
+
+def test_rolled_overlap_als_end_to_end():
+    """The chained cgStep program over an overlap-built strategy (the
+    combination the pod-scale path will run: rolled loops + overlap)
+    converges identically to the sequential build."""
+    from distributed_sddmm_tpu.models.als import DistributedALS
+
+    S = HostCOO.erdos_renyi(64, 48, 5, seed=2, values="normal")
+
+    def run(overlap):
+        alg = DenseShift15D(S, R=8, c=1, fusion_approach=2, unroll=False,
+                            overlap=overlap)
+        m = DistributedALS(alg, S_host=S)
+        m.run_cg(2, cg_iters=4)
+        return np.asarray(m.A), np.asarray(m.B)
+
+    A1, B1 = run(False)
+    A2, B2 = run(True)
+    assert np.array_equal(A1, A2) and np.array_equal(B1, B2)

@@ -1,0 +1,111 @@
+"""Structural HLO gate for ``--fusion overlap`` (the tier-1 acceptance
+check): the overlap-built 1.5D dense-shift fused program, AOT-compiled
+for a real v5e TPU topology (``jax.experimental.topologies``, no chips
+needed), must schedule ``collective-permute-start``/``-done`` BRACKETING
+the per-step local kernel — ``async_pairs >= 1`` and
+``loop_body_overlaps_compute`` — i.e. the ring hop is in flight behind
+the compute, the reference's hand-built ``BufferPair`` behavior.
+
+The compile runs in a subprocess: libtpu reads its environment once at
+first init, and on machines without TPU instance metadata the topology
+lookup stalls in ~minute-long metadata retries unless
+``TPU_SKIP_MDS_QUERY=1`` is exported first (this container's case).
+
+The scanner itself is also unit-tested on synthetic HLO so a regression
+in the gate's own parsing cannot masquerade as scheduler evidence.
+"""
+
+import json
+import os
+import pathlib
+import subprocess
+import sys
+
+from distributed_sddmm_tpu.bench.overlap import scan_overlap_hlo
+
+REPO = pathlib.Path(__file__).resolve().parents[1]
+
+_PROBE = """
+import json, sys
+sys.path.insert(0, {repo!r})
+from distributed_sddmm_tpu.utils.platform import force_cpu_platform
+force_cpu_platform(n_devices=8, replace=True)
+from distributed_sddmm_tpu.bench.overlap import fusion_overlap_hlo_report
+print("RESULT " + json.dumps(fusion_overlap_hlo_report(overlap=True)))
+"""
+
+
+def test_fusion_overlap_v5e_hlo_gate():
+    env = dict(os.environ)
+    env.update({
+        "TPU_SKIP_MDS_QUERY": "1",
+        "DSDDMM_PROGRAMS": "0",
+        "DSDDMM_RUNSTORE": "0",
+        "PYTHONPATH": str(REPO),
+    })
+    proc = subprocess.run(
+        [sys.executable, "-c", _PROBE.format(repo=str(REPO))],
+        capture_output=True, text=True, timeout=540, env=env,
+    )
+    assert proc.returncode == 0, proc.stdout[-2000:] + proc.stderr[-2000:]
+    line = [l for l in proc.stdout.splitlines() if l.startswith("RESULT ")]
+    assert line, proc.stdout[-2000:]
+    rec = json.loads(line[0][len("RESULT "):])
+    assert rec["fusion"] == "overlap" and rec["topology"] == "v5e:2x4"
+    assert rec["is_scheduled"] is True
+    assert rec["async_pairs"] >= 1, rec
+    assert rec["loop_body_overlaps_compute"] is True, rec
+
+
+# --------------------------------------------------------------------- #
+# The scanner's own contract, on synthetic scheduled-HLO text
+# --------------------------------------------------------------------- #
+
+_HLO_OVERLAPPED = """\
+HloModule jit_prog, is_scheduled=true
+
+%body (arg: f32[8]) -> f32[8] {
+  %start = collective-permute-start(f32[8] %x), source_target_pairs={{0,1}}
+  %f = f32[8] fusion(f32[8] %y), kind=kLoop
+  %done = f32[8] collective-permute-done(%start)
+  ROOT %r = f32[8] add(%f, %done)
+}
+"""
+
+_HLO_SEQUENTIAL = """\
+HloModule jit_prog, is_scheduled=true
+
+%body (arg: f32[8]) -> f32[8] {
+  %f = f32[8] fusion(f32[8] %y), kind=kLoop
+  %start = collective-permute-start(f32[8] %x), source_target_pairs={{0,1}}
+  %done = f32[8] collective-permute-done(%start)
+  ROOT %r = f32[8] add(%f, %done)
+}
+"""
+
+_HLO_SYNC = """\
+HloModule jit_prog, is_scheduled=true
+
+%body (arg: f32[8]) -> f32[8] {
+  %p = f32[8] collective-permute(f32[8] %x), source_target_pairs={{0,1}}
+  ROOT %f = f32[8] fusion(%p), kind=kLoop
+}
+"""
+
+
+def test_scanner_detects_bracketed_compute():
+    rec = scan_overlap_hlo(_HLO_OVERLAPPED)
+    assert rec == {"is_scheduled": True, "async_pairs": 1,
+                   "loop_body_overlaps_compute": True}
+
+
+def test_scanner_rejects_unbracketed_compute():
+    rec = scan_overlap_hlo(_HLO_SEQUENTIAL)
+    assert rec["async_pairs"] == 1
+    assert rec["loop_body_overlaps_compute"] is False
+
+
+def test_scanner_counts_zero_pairs_for_sync_permute():
+    rec = scan_overlap_hlo(_HLO_SYNC)
+    assert rec["async_pairs"] == 0
+    assert rec["loop_body_overlaps_compute"] is False

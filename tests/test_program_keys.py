@@ -1,0 +1,125 @@
+"""The unified program-store key grammar (programs/keys.py): round-trip
+parsing for every family, cross-process stability, and the serve-key
+compat surface the engine has exposed since PR 5."""
+
+import subprocess
+import sys
+
+from distributed_sddmm_tpu.programs import keys
+
+
+def test_plan_key_roundtrip():
+    key = keys.plan_program_key(
+        "7cb78b1d38555cd0", "fused-False-full-seq", "a1b2c3d4e5",
+        "cpu", code="deadbeef1234",
+    )
+    parsed = keys.parse_plan_key(key)
+    assert parsed == {
+        "family": "plan",
+        "fingerprint_key": "7cb78b1d38555cd0",
+        "op": "fused-False-full-seq",
+        "sig": "a1b2c3d4e5",
+        "backend": "cpu",
+        "code_hash": "deadbeef1234",
+    }
+    assert keys.parse_key(key) == parsed
+
+
+def test_serve_key_roundtrip_and_legacy_grammar():
+    key = keys.serve_program_key("als", 4, 8, 16, "cpu", code="cafe12")
+    # The PR 5 grammar is preserved byte for byte up to the sig segment.
+    assert key == "serve:als:b4:i8:r16:cpu:cafe12"
+    parsed = keys.parse_serve_key(key)
+    assert parsed["workload"] == "als"
+    assert parsed["batch_bucket"] == 4 and parsed["inner_bucket"] == 8
+    assert parsed["backend"] == "cpu" and parsed["code_hash"] == "cafe12"
+    assert "sig" not in parsed
+
+    sigged = keys.serve_program_key("als", 4, 8, 16, "cpu", code="cafe12",
+                                    sig="0123456789")
+    parsed = keys.parse_serve_key(sigged)
+    assert parsed["sig"] == "0123456789"
+    assert keys.parse_key(sigged) == parsed
+
+    full = keys.serve_program_key("als", 4, 8, 16, "cpu", code="cafe12",
+                                  params="k10-l0.1", sig="0123456789")
+    parsed = keys.parse_serve_key(full)
+    assert parsed["params"] == "k10-l0.1" and parsed["sig"] == "0123456789"
+    assert keys.parse_key(full) == parsed
+
+
+def test_serve_key_separates_baked_workload_constants():
+    """Two fold-in configurations differing only in trace-time constants
+    (top-k size, ridge) must produce distinct keys — the constants are
+    invisible to both the aval signature and the bucket geometry."""
+    a = keys.serve_program_key("als", 4, 8, 16, "cpu", code="c",
+                               params="k10-l0.1", sig="s")
+    b = keys.serve_program_key("als", 4, 8, 16, "cpu", code="c",
+                               params="k20-l0.1", sig="s")
+    assert a != b
+
+
+def test_bench_key_roundtrip():
+    key = keys.bench_aot_key("distgap_16_32_128_t5_ab12cd34ef", "headline",
+                             6, "tpu")
+    parsed = keys.parse_bench_key(key)
+    assert parsed == {
+        "family": "bench", "stem": "distgap_16_32_128_t5_ab12cd34ef",
+        "name": "headline", "n": 6, "backend": "tpu",
+    }
+    assert keys.parse_key(key) == parsed
+
+
+def test_unsafe_segments_are_hashed_not_leaked():
+    key = keys.plan_program_key("fp", "op with:colons/and spaces", "s",
+                                "cpu", code="c")
+    assert ":colons" not in key and " " not in key
+    parsed = keys.parse_plan_key(key)
+    assert parsed is not None and parsed["op"].startswith("h")
+
+
+def test_parse_rejects_foreign_grammars():
+    assert keys.parse_key("nonsense") is None
+    assert keys.parse_plan_key("serve:als:b4:i8:r16:cpu:c") is None
+    assert keys.parse_serve_key("plan:a:b:c:d:e") is None
+    assert keys.parse_bench_key("bench:stem:name:notanint:cpu") is None
+
+
+def test_sig_for_args_shape_dtype_sensitivity():
+    import numpy as np
+
+    a = np.zeros((4, 8), np.float32)
+    b = np.zeros((4, 8), np.float32)
+    assert keys.sig_for_args([a]) == keys.sig_for_args([b])
+    assert keys.sig_for_args([a]) != keys.sig_for_args(
+        [np.zeros((8, 4), np.float32)]
+    )
+    assert keys.sig_for_args([a]) != keys.sig_for_args(
+        [np.zeros((4, 8), np.float64)]
+    )
+    assert keys.sig_for_args([a, b]) != keys.sig_for_args([a])
+
+
+def test_keys_stable_across_process_restart():
+    """Two processes given the same inputs MUST produce the same key —
+    cross-process warm starts depend on it (the plan-cache fingerprint
+    discipline, extended to program keys)."""
+    key = keys.plan_program_key("fpk", "op", "sig", "cpu", code="cc")
+    code = (
+        "from distributed_sddmm_tpu.programs import keys; "
+        "print(keys.plan_program_key('fpk', 'op', 'sig', 'cpu', code='cc'))"
+    )
+    out = subprocess.run(
+        [sys.executable, "-c", code], capture_output=True, text=True,
+        timeout=120, check=True,
+    )
+    assert out.stdout.strip() == key
+
+
+def test_safe_stem_is_pathsafe_and_collision_tagged():
+    k1 = keys.serve_program_key("als", 4, 8, 16, "cpu", code="c1")
+    k2 = keys.serve_program_key("als", 4, 8, 16, "cpu", code="c2")
+    s1, s2 = keys.safe_stem(k1), keys.safe_stem(k2)
+    assert s1 != s2
+    for s in (s1, s2):
+        assert "/" not in s and ":" not in s and not s.startswith(".")

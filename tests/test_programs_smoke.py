@@ -1,0 +1,46 @@
+"""Tier-1 two-process program-store smoke: scripts/programs_smoke.py run
+twice against one store directory — the second process must warm every
+program from disk (>= 1 disk hit, 0 live compiles for the warmed keys)
+and reproduce the first process's fused-output fingerprint exactly."""
+
+import json
+import pathlib
+import subprocess
+import sys
+
+REPO = pathlib.Path(__file__).resolve().parents[1]
+
+
+def _run(store_dir, out_file):
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "scripts" / "programs_smoke.py"),
+         "--store", str(store_dir), "-o", str(out_file)],
+        capture_output=True, text=True, timeout=540,
+        env={"PATH": "/usr/bin:/bin:/usr/local/bin", "HOME": "/tmp"},
+    )
+    assert proc.returncode == 0, proc.stdout[-2000:] + proc.stderr[-2000:]
+    return json.loads(out_file.read_text())
+
+
+def test_second_process_warms_from_disk(tmp_path):
+    store = tmp_path / "store"
+    cold = _run(store, tmp_path / "cold.json")
+    warm = _run(store, tmp_path / "warm.json")
+
+    # Process 1 paid the compiles and persisted them.
+    assert cold["store"]["live_compiles"] > 0
+    assert cold["store"]["hits"] == 0
+    assert cold["entries_on_disk"] == cold["store"]["live_compiles"]
+    assert cold["engine"]["live_compiles"] == cold["ladder_cells"]
+
+    # Process 2: every key present on disk loads, nothing compiles.
+    assert warm["store"]["live_compiles"] == 0, warm["store"]
+    assert warm["store"]["hits"] >= 1
+    assert warm["store"]["hits"] == cold["store"]["live_compiles"]
+    assert warm["engine"]["disk_hits"] == warm["ladder_cells"]
+    assert warm["engine"]["live_compiles"] == 0
+
+    # Disk-loaded executables compute the same bits.
+    assert warm["fused_fingerprint"] == cold["fused_fingerprint"]
+    assert warm["plan"] == cold["plan"]
+    assert warm["global"]["live_compiles"] == 0
