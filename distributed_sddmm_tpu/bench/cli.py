@@ -34,6 +34,10 @@ layer's store/regress/report half):
   records into the store
 * ``report-html`` — self-contained HTML dashboard (``obs/report.py``)
 * ``report-trace``— per-phase aggregate of one trace file
+* ``trace-merge`` — offset-align and merge per-process trace shards
+  into one schema-valid trace (``obs/tracemerge.py``)
+* ``top``         — live serving telemetry view over the sampler's
+  JSONL stream (``obs/telemetry.py``)
 
 Benchmark-producing subcommands (``er``/``file``/``heatmap``) persist
 every record into the run store automatically (``--no-runstore`` opts
@@ -372,6 +376,16 @@ def build_parser() -> argparse.ArgumentParser:
     )
     sv.add_argument("--trace", nargs="?", const="1", default=None,
                     metavar="PATH")
+    sv.add_argument(
+        "--telemetry", nargs="?", const="1", default=None, metavar="DIR",
+        help="sample live telemetry (queue depth, latency histogram, "
+        "shed/degrade, program-store hits, SLO burn rate) to "
+        "artifacts/telemetry/<run_id>.jsonl every --telemetry-interval "
+        "seconds; DIR relocates (equivalent to DSDDMM_TELEMETRY); "
+        "watch it live with `bench top`",
+    )
+    sv.add_argument("--telemetry-interval", type=float, default=0.5,
+                    metavar="SECONDS")
     sv.add_argument("--profile", default=None, metavar="LOGDIR")
     sv.add_argument("--watchdog", default=None, choices=["warn", "strict"])
     sv.add_argument("--no-runstore", action="store_true")
@@ -393,6 +407,40 @@ def build_parser() -> argparse.ArgumentParser:
     rt.add_argument("trace", help="path to a <run_id>.jsonl trace")
     rt.add_argument("--json", action="store_true")
     rt.add_argument("--no-strict", action="store_true")
+
+    tm = sub.add_parser(
+        "trace-merge",
+        help="offset-align and merge per-process trace shards into one "
+        "schema-valid trace (each shard's begin record carries its "
+        "perf_counter<->wall-clock origin; the earliest becomes the "
+        "merged timeline's zero); exits 2 on unmergeable/invalid shards",
+    )
+    tm.add_argument(
+        "shards", nargs="+",
+        help="shard files, shard directories, or a PATH.jsonl stem "
+        "(merged with its sibling PATH.shards/ directory)",
+    )
+    tm.add_argument("-o", "--output-file", default=None,
+                    help="default <first shard dir>/<merged id>.jsonl")
+    tm.add_argument("--no-strict", action="store_true",
+                    help="tolerate (and drop) malformed shard lines")
+
+    tp = sub.add_parser(
+        "top",
+        help="live serving telemetry view: queue depth, histogram "
+        "percentiles, shed/degrade counters, program-store hit rates, "
+        "SLO burn rate — over the sampler stream `bench serve "
+        "--telemetry` writes to artifacts/telemetry/",
+    )
+    tp.add_argument(
+        "path", nargs="?", default=None,
+        help="telemetry .jsonl stream (default: the newest one under "
+        "artifacts/telemetry/ or $DSDDMM_TELEMETRY)",
+    )
+    tp.add_argument(
+        "--watch", type=float, default=0.0, metavar="SECONDS",
+        help="refresh every N seconds until interrupted (0 = one shot)",
+    )
 
     def _store_arg(p):
         p.add_argument(
@@ -570,6 +618,12 @@ def main(argv=None) -> int:
             sub_argv.append("--no-strict")
         return tracereport.main(sub_argv)
 
+    if args.cmd == "trace-merge":
+        return _dispatch_trace_merge(args)
+
+    if args.cmd == "top":
+        return _dispatch_top(args)
+
     if args.cmd in ("history", "compare", "gate", "backfill", "report-html"):
         return _dispatch_store(args)
 
@@ -617,6 +671,69 @@ def main(argv=None) -> int:
     return _dispatch(args)
 
 
+def _dispatch_trace_merge(args) -> int:
+    """``bench trace-merge``: discover shards, offset-align, write one
+    merged trace, re-validate it. Exit 0 valid, 2 unmergeable."""
+    from distributed_sddmm_tpu.obs import tracemerge
+    from distributed_sddmm_tpu.tools import tracereport
+
+    strict = not args.no_strict
+    paths: list = []
+    try:
+        for spec in args.shards:
+            for p in tracemerge.discover(spec):
+                if p not in paths:
+                    paths.append(p)
+        out, merged = tracemerge.write_merged(
+            paths, args.output_file, strict=strict
+        )
+        # Round-trip: the merged file must satisfy the same reader
+        # contract any single-process trace does.
+        tracereport.load_trace(out, strict=True)
+    except (FileNotFoundError, ValueError) as e:
+        print(f"trace-merge failed: {e}", file=sys.stderr)
+        return 2
+    print(json.dumps({
+        "merged": str(out),
+        "run_id": merged["begin"]["run_id"],
+        "shards": len(merged["begin"]["shards"]),
+        "spans": len(merged["spans"]),
+        "events": len(merged["events"]),
+        "skipped_lines": len(merged["errors"]),
+    }))
+    return 0
+
+
+def _dispatch_top(args) -> int:
+    """``bench top``: render the newest telemetry snapshot(s); --watch
+    refreshes until interrupted."""
+    import time as _time
+
+    from distributed_sddmm_tpu.obs import telemetry
+
+    path = args.path
+    if path is None:
+        _enabled, root = telemetry.parse_env_spec(
+            os.environ.get("DSDDMM_TELEMETRY")
+        )
+        path = telemetry.newest_stream(root)
+        if path is None:
+            print("no telemetry streams found (run `bench serve "
+                  "--telemetry` first)", file=sys.stderr)
+            return 1
+    while True:
+        snaps = telemetry.read_snapshots(path)
+        if args.watch:
+            print("\x1b[2J\x1b[H", end="")  # clear screen between frames
+        print(telemetry.render_top(snaps))
+        if not args.watch:
+            return 0
+        try:
+            _time.sleep(args.watch)
+        except KeyboardInterrupt:
+            return 0
+
+
 def _dispatch_serve(args) -> int:
     """``bench serve``: build a warm engine, drive it open-loop, report
     + persist the serving record. Exit 0 on a clean run, 1 on any
@@ -635,6 +752,11 @@ def _dispatch_serve(args) -> int:
         max_batch=args.max_batch, max_depth=args.max_depth,
         max_wait_ms=args.max_wait_ms,
     )
+    # XLA-cost cursor: warmup + serving programs resolved from here on
+    # feed the record's analytic-vs-XLA cross-check.
+    from distributed_sddmm_tpu import programs as programs_mod
+
+    _cost_cursor = programs_mod.cost_log_len()
     print(f"[serve] building warm {args.app} engine "
           f"(2^{args.log_m} matrix, R={args.R})", file=sys.stderr)
     if args.app == "als":
@@ -658,13 +780,33 @@ def _dispatch_serve(args) -> int:
     _anomalies_before = len(_watchdog.events) if _watchdog else 0
     d_ops.reset_performance_timers()
 
+    # Live telemetry: a sampler thread snapshotting the engine to
+    # artifacts/telemetry/<run_id>.jsonl for `bench top` and post-hoc
+    # burn-rate forensics (--telemetry / DSDDMM_TELEMETRY).
+    from distributed_sddmm_tpu.obs import telemetry as obs_telemetry
+
+    sampler = None
+    tel_spec = args.telemetry or os.environ.get("DSDDMM_TELEMETRY")
+    tel_enabled, tel_root = obs_telemetry.parse_env_spec(tel_spec)
+    if tel_enabled:
+        sampler = obs_telemetry.TelemetrySampler(
+            eng, interval_s=args.telemetry_interval, out_dir=tel_root,
+            slo=slo,
+        )
+
     eng.start()  # compile-ahead warmup of the whole bucket ladder
     try:
+        if sampler is not None:
+            sampler.start()
+            print(f"[telemetry] sampling to {sampler.path}",
+                  file=sys.stderr)
         summary = run_load(
             eng, duration_s=args.duration, rate_hz=args.rate,
             seed=args.seed, oracle_every=args.oracle_every, slo=slo,
         )
     finally:
+        if sampler is not None:
+            sampler.stop()
         eng.stop()
 
     record = {
@@ -691,6 +833,18 @@ def _dispatch_serve(args) -> int:
     }
     if plan is not None:
         record["plan"] = plan.to_dict()
+    if sampler is not None:
+        record["telemetry_path"] = str(sampler.path)
+    # Analytic-vs-XLA FLOP cross-check over the engine's resolved
+    # programs (strategy ops only — serve fold-in programs have no
+    # analytic model to disagree with).
+    _xla_cost = programs_mod.xla_cost_summary(
+        record["metrics"], since=_cost_cursor
+    )
+    if _xla_cost:
+        record["xla_cost"] = _xla_cost
+        if _watchdog is not None:
+            _watchdog.check_xla_costs(record["metrics"], _xla_cost["ops"])
     if obs_trace.enabled():
         record["run_id"] = obs_trace.run_id()
         record["trace_path"] = obs_trace.trace_path()
@@ -716,6 +870,8 @@ def _dispatch_serve(args) -> int:
         "oracle_checked": summary["oracle_checked"],
         "oracle_failures": summary["oracle_failures"],
         "slo_violations": summary["slo_violations"],
+        "burn_rate": summary.get("burn_rate"),
+        "latency_hist_ms": summary.get("latency_hist_ms"),
     }))
     if args.output_file:
         with open(args.output_file, "a") as f:

@@ -242,6 +242,12 @@ def benchmark_algorithm(
         for k in ("program_store_hits", "program_store_misses",
                   "live_compiles")
     }
+    # XLA-cost cursor: only programs THIS run resolved contribute to
+    # its analytic-vs-XLA FLOP cross-check (a sweep's earlier cells
+    # compiled at other geometries).
+    from distributed_sddmm_tpu import programs as program_store_mod
+
+    _cost_cursor = program_store_mod.cost_log_len()
 
     alg = make_algorithm(algorithm_name, S, R, c, kernel=kernel,
                          devices=devices, overlap=overlap)
@@ -325,6 +331,17 @@ def benchmark_algorithm(
         **app_stats,
         **(extra_info or {}),
     }
+    # Analytic-vs-XLA FLOP cross-check: XLA's own cost_analysis numbers
+    # for the programs this run resolved, joined per op. The watchdog
+    # flags beyond-band disagreement; the run store turns the ratio
+    # into a gate axis (xla:<op>_flops).
+    _xla_cost = program_store_mod.xla_cost_summary(
+        record["metrics"], since=_cost_cursor
+    )
+    if _xla_cost:
+        record["xla_cost"] = _xla_cost
+        if _watchdog is not None:
+            _watchdog.check_xla_costs(record["metrics"], _xla_cost["ops"])
     if obs_trace.enabled():
         record["run_id"] = obs_trace.run_id()
         record["trace_path"] = obs_trace.trace_path()

@@ -40,15 +40,30 @@ The cross-run half (PR 4) closes the loop:
 * :mod:`~distributed_sddmm_tpu.obs.report` — self-contained HTML
   dashboard (``bench report-html``): history, trends, latest compare.
 
+The request-level / multi-process half (PR 7):
+
+* :mod:`~distributed_sddmm_tpu.obs.clock` — THE clock module: one
+  calibrated monotonic/wall pair per process (a lint forbids raw
+  ``time.*`` clock reads in ``serve/`` and ``obs/`` span paths).
+* :mod:`~distributed_sddmm_tpu.obs.tracemerge` — offset-aligned merge
+  of per-process trace shards (``bench trace-merge``); shards align on
+  each ``begin`` record's ``t0_epoch`` calibration header.
+* :mod:`~distributed_sddmm_tpu.obs.telemetry` — mergeable fixed-bucket
+  latency histograms, the SLO error-budget burn rate, and the sampler
+  thread behind ``bench serve --telemetry`` / ``bench top``.
+
 The trace reader/report side lives in ``tools/tracereport.py``
-(``python -m distributed_sddmm_tpu.bench report-trace <trace.jsonl>``).
+(``python -m distributed_sddmm_tpu.bench report-trace <trace.jsonl>``),
+including the serving request-chain reconstruction
+(``tracereport.request_chains``).
 """
 
 from distributed_sddmm_tpu.obs import (
-    log, manifest, metrics, profiler, regress, report, store, trace, watchdog,
+    clock, log, manifest, metrics, profiler, regress, report, store,
+    telemetry, trace, tracemerge, watchdog,
 )
 
 __all__ = [
-    "trace", "metrics", "log", "profiler", "manifest",
-    "store", "regress", "watchdog", "report",
+    "clock", "trace", "tracemerge", "metrics", "telemetry", "log",
+    "profiler", "manifest", "store", "regress", "watchdog", "report",
 ]

@@ -1,0 +1,58 @@
+"""The one clock module: monotonic now(), wall epoch(), calibration.
+
+Every duration and timeline stamp in the serving and observability
+layers reads :func:`now` (``time.perf_counter`` — monotonic, immune to
+NTP steps and wall-clock adjustments); every piece of *metadata* that
+must be meaningful across processes and reboots reads :func:`epoch`
+(``time.time``). The split matters because the two clocks drift: a span
+whose ``t0`` came from one and ``t1`` from the other can report a
+negative duration across an NTP correction, and a multi-process trace
+whose shards mixed them cannot be offset-aligned.
+
+:func:`calibration` returns the pair ``(perf_origin, epoch_origin)``
+captured together — the perf_counter↔wall-clock anchor the tracer
+writes into every trace's ``begin`` record and ``bench trace-merge``
+uses to offset-align shards from different processes: two shards'
+monotonic timelines become comparable by shifting each by its own
+``epoch_origin`` relative to the earliest shard's.
+
+``tests/test_obs_lint.py`` enforces the discipline: raw
+``time.time()``/``time.perf_counter()`` calls are forbidden in
+``serve/`` and ``obs/`` outside this module (a line tagged
+``# wall-clock-ok`` opts out for the rare legitimate exception).
+"""
+
+from __future__ import annotations
+
+import time
+
+#: Captured together at import: the perf_counter↔epoch anchor. The pair
+#: is the process's clock calibration — ``epoch_for`` maps any
+#: perf_counter value to an (approximate) wall-clock time through it.
+PERF_ORIGIN = time.perf_counter()  # wall-clock-ok — the calibration pair
+EPOCH_ORIGIN = time.time()  # wall-clock-ok — the calibration pair
+
+
+def now() -> float:
+    """Monotonic seconds (``time.perf_counter``): durations, timelines,
+    deadlines. Comparable only within this process."""
+    return time.perf_counter()  # wall-clock-ok — this IS the clock module
+
+
+def epoch() -> float:
+    """Wall-clock seconds since the Unix epoch (``time.time``):
+    created-at metadata, cross-process alignment. Never subtract two of
+    these for a duration — NTP can step between them."""
+    return time.time()  # wall-clock-ok — this IS the clock module
+
+
+def calibration() -> dict:
+    """The process's perf_counter↔epoch anchor pair, JSON-ready."""
+    return {"perf_origin": PERF_ORIGIN, "epoch_origin": EPOCH_ORIGIN}
+
+
+def epoch_for(perf_t: float) -> float:
+    """Approximate wall-clock time of a perf_counter stamp, through the
+    import-time calibration (good to clock-drift accuracy — fine for
+    aligning traces, not for billing)."""
+    return EPOCH_ORIGIN + (perf_t - PERF_ORIGIN)

@@ -22,8 +22,8 @@ import os
 import pathlib
 import subprocess
 import sys
-import time
 
+from distributed_sddmm_tpu.obs import clock
 from distributed_sddmm_tpu.utils.atomic import atomic_write_json
 
 #: Manifest schema generation (validated by tools/tracereport.py).
@@ -110,7 +110,7 @@ def build(run_id: str, extra: dict | None = None) -> dict:
     m = {
         "schema": SCHEMA_VERSION,
         "run_id": run_id,
-        "created_epoch": time.time(),
+        "created_epoch": clock.epoch(),
         "python": sys.version.split()[0],
         "platform": sys.platform,
         "argv": sys.argv,

@@ -46,6 +46,13 @@ GATE_NO_DATA = 3
 _SKIP_PHASES = ("bench",)
 
 
+def _optional_axis(name: str) -> bool:
+    """Axes that only exist when optional telemetry ran (SLO burn rate
+    needs an SLO spec; XLA cost needs the program store). Their absence
+    in the judged run is "not measured", never a gate failure."""
+    return name.startswith("xla:") or name == "serve:burn_rate"
+
+
 def phase_stats(doc: dict) -> dict[str, dict]:
     """Normalize one run document to ``{phase: row}``.
 
@@ -113,6 +120,7 @@ def phase_stats(doc: dict) -> dict[str, dict]:
             )
         out[name] = row
     out.update(_serving_rows(doc))
+    out.update(_xla_rows(doc))
     return out
 
 
@@ -130,25 +138,56 @@ def _pseudo_row(calls: int, value: float) -> dict:
 def _serving_rows(doc: dict) -> dict[str, dict]:
     """The serving verdict axes (``bench serve`` records): tail latency
     percentiles as pseudo-phases (``t_call`` = the percentile in
-    seconds) plus the shed rate. Offline records have none of these
-    fields and contribute no rows, so serving and kernel docs never
-    produce spurious "missing" verdicts against each other only when
-    the config axes differ — which the store's ``app=serve-*`` axis
-    already guarantees."""
+    seconds), the shed rate, and — since PR 7 — the SLO error-budget
+    burn rate. Offline records have none of these fields and contribute
+    no rows, so serving and kernel docs never produce spurious
+    "missing" verdicts against each other only when the config axes
+    differ — which the store's ``app=serve-*`` axis already
+    guarantees."""
     rec = doc.get("record") or {}
     lat = rec.get("latency_ms") or {}
     requests = rec.get("requests") or 0
-    if not (requests and lat):
+    if not requests:
         return {}
     rows = {}
     for pct in (50, 99):
         v = lat.get(f"p{pct}")
         if v is not None:
             rows[f"serve:latency_p{pct}"] = _pseudo_row(requests, v / 1e3)
-    if rec.get("shed_rate") is not None:
+    if rec.get("shed_rate") is not None and lat:
         rows["serve:shed_rate"] = _pseudo_row(
             requests, float(rec["shed_rate"])
         )
+    if rec.get("burn_rate") is not None:
+        # Burn rate regresses like a latency: higher = burning budget
+        # faster. Pre-PR-7 docs lack the field and simply lack the axis
+        # (an OPTIONAL axis — see compare()'s not-measured verdict).
+        rows["serve:burn_rate"] = _pseudo_row(
+            requests, float(rec["burn_rate"])
+        )
+    return rows
+
+
+def _xla_rows(doc: dict) -> dict[str, dict]:
+    """Analytic-vs-XLA FLOP agreement axes: one pseudo-phase per op
+    whose compiled programs reported a cost analysis, ``t_call`` =
+    counted/XLA FLOP ratio. The gate judges the ratio's *stability*
+    run over run — the two counts measure different things (useful vs
+    compiled work) so the interesting signal is drift, not closeness
+    to 1. Docs without ``xla_cost`` (store disabled, pre-PR-7) have no
+    rows; the axes are OPTIONAL in compare()."""
+    rec = doc.get("record") or {}
+    metrics = rec.get("metrics") or {}
+    ops = (rec.get("xla_cost") or {}).get("ops") or {}
+    rows = {}
+    for op, cost in ops.items():
+        m = metrics.get(op) or {}
+        calls, flops = m.get("calls") or 0, m.get("flops") or 0.0
+        xla = cost.get("flops_per_call") or 0.0
+        if calls and flops and xla:
+            rows[f"xla:{op}_flops"] = _pseudo_row(
+                calls, (flops / calls) / xla
+            )
     return rows
 
 
@@ -219,6 +258,14 @@ def compare(
     for name in sorted(set(stats_a) | set(stats_b)):
         a, b = stats_a.get(name), stats_b.get(name)
         if b is None:
+            if _optional_axis(name):
+                # Optional instrumentation axes (burn rate, XLA cost)
+                # appear only when their telemetry ran; absent is
+                # "not measured", not "work vanished" — pre-PR-7 docs
+                # and store-disabled runs must not gate-fail on them.
+                phases[name] = {"a": a, "b": None,
+                                "verdict": "not-measured"}
+                continue
             missing.append(name)
             phases[name] = {"a": a, "b": None, "verdict": "missing"}
             continue
@@ -252,6 +299,10 @@ def compare(
                 # Serving axes carry no comm/overhead split to blame;
                 # the axis itself names what went bad.
                 row["attribution"] = "serving"
+            elif name.startswith("xla:"):
+                # Agreement drifted: either the analytic count or the
+                # compiled program changed — the axis IS the blame.
+                row["attribution"] = "xla-cost"
             else:
                 base_row = dict(a)
                 base_row["t_call"] = med
@@ -349,7 +400,7 @@ def render_compare(report: dict) -> str:
     lines += [header, "-" * len(header)]
     for name, row in report["phases"].items():
         a, b = row.get("a"), row.get("b")
-        if row["verdict"] in ("missing", "new"):
+        if row["verdict"] in ("missing", "new", "not-measured"):
             src = a if b is None else b
             dash = " ".join(
                 "-".rjust(w) for w in (10, 10, 7, 8, 8, 9, 11)

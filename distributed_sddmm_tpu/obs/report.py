@@ -90,12 +90,20 @@ def _history_table(rows: list[dict]) -> str:
         "<th class=l>algorithm</th><th>app</th><th>R</th><th>c</th>"
         "<th>backend</th><th>elapsed&nbsp;s</th><th>GFLOP/s</th>"
         "<th>cold&nbsp;compiles</th>"
+        "<th>p99&nbsp;ms</th><th>burn</th>"
         "<th>anomalies</th><th class=l>key</th></tr>"
     ]
     for r in rows:
         anom = r.get("anomaly_count", 0)
-        style = ' class="regression"' if anom else ""
+        burn = r.get("burn_rate")
+        style = (
+            ' class="regression"'
+            if anom or (burn is not None and burn > 1.0) else ""
+        )
         live = r.get("live_compiles")
+        p99 = r.get("hist_p99_ms")
+        if p99 is None:
+            p99 = r.get("latency_p99_ms")
         cells.append(
             f"<tr{style}><td class=l>{_esc(r.get('run_id'))}</td>"
             f"<td class=l>{_esc(r.get('source'))}</td>"
@@ -105,6 +113,8 @@ def _history_table(rows: list[dict]) -> str:
             f"<td>{_fmt(r.get('elapsed'))}</td>"
             f"<td>{_fmt(r.get('overall_throughput'))}</td>"
             f"<td>{'-' if live is None else int(live)}</td>"
+            f"<td>{_fmt(p99, 1)}</td>"
+            f"<td>{_fmt(burn, 2)}</td>"
             f"<td>{anom or ''}</td>"
             f"<td class=l>{_esc((r.get('key') or '')[:16])}</td></tr>"
         )
@@ -162,6 +172,19 @@ def _latency_series(store, rows: list[dict]) -> dict:
                 series.setdefault(f"latency {pct} (ms)", []).append(
                     (x, lat[pct])
                 )
+    return series
+
+
+def _burn_series(rows: list[dict]) -> dict:
+    """SLO error-budget burn-rate trend (index-only: the burn rate is a
+    PR-7 index column). Pre-PR-7 rows carry None and contribute
+    nothing — the panel renders only when measured history exists."""
+    series: dict[str, list] = {}
+    for x, r in enumerate(rows):
+        if r.get("burn_rate") is not None:
+            series.setdefault("error-budget burn rate", []).append(
+                (x, r["burn_rate"])
+            )
     return series
 
 
@@ -231,6 +254,19 @@ def build_html(
     if png:
         sections += ["<h2>Serving latency trend (all serve runs)</h2>",
                      f'<img src="{png}" alt="serving latency trend">']
+
+    burn_series = _burn_series(all_rows)
+    png = _chart_png(
+        lambda ax: charts.trend_chart(
+            ax, burn_series, ylabel="burn rate (x budget)", logy=False)
+    )
+    if png:
+        sections += [
+            "<h2>SLO error-budget burn rate (all serve runs)</h2>",
+            "<p class=meta>1.0 = burning exactly at budget; above the "
+            "line the SLO will be violated if the window holds.</p>",
+            f'<img src="{png}" alt="burn rate trend">',
+        ]
 
     if len(focus_rows) >= 2:
         newest = store.get(focus_rows[-1]["run_id"])

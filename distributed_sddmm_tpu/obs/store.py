@@ -37,8 +37,8 @@ import json
 import os
 import pathlib
 import threading
-import time
 
+from distributed_sddmm_tpu.obs import clock
 from distributed_sddmm_tpu.utils.atomic import atomic_write_json
 
 #: Run-document schema generation; readers skip docs they cannot read.
@@ -58,6 +58,11 @@ _INDEX_FIELDS = (
     # Program-store cold-start cost: in-process compiles this run paid
     # (0 for a fully disk-warmed run; None for pre-PR 6 records).
     "live_compiles",
+    # PR 7 serving telemetry: percentiles from the mergeable fixed-
+    # bucket request histogram plus the SLO error-budget burn rate.
+    # None on every earlier doc — readers must treat absence as
+    # "not measured", never as a verdict.
+    "hist_p50_ms", "hist_p95_ms", "hist_p99_ms", "burn_rate",
 )
 
 #: Configuration axes (beyond the fingerprint key) two runs must share
@@ -93,7 +98,7 @@ class RunStore:
         if not run_id:
             raise ValueError("run doc needs a run_id")
         doc.setdefault("schema", SCHEMA_VERSION)
-        doc.setdefault("created_epoch", time.time())
+        doc.setdefault("created_epoch", clock.epoch())
         path = self.runs_dir / f"{_safe_id(run_id)}.json"
         with self._lock, self._flock():
             atomic_write_json(path, doc)
@@ -272,7 +277,7 @@ class RunStore:
 
     def ingest_prebuilt(self, doc: dict) -> dict:
         """Persist an already-joined document (backfill path)."""
-        doc.setdefault("created_epoch", time.time())
+        doc.setdefault("created_epoch", clock.epoch())
         self.put(doc)
         return doc
 
@@ -309,6 +314,10 @@ def _index_row(doc: dict) -> dict:
         "anomaly_count": sum(a.get("count", 1) for a in anomalies),
         "latency_p99_ms": (rec.get("latency_ms") or {}).get("p99"),
         "shed_count": rec.get("shed_count"),
+        "hist_p50_ms": (rec.get("latency_hist_ms") or {}).get("p50"),
+        "hist_p95_ms": (rec.get("latency_hist_ms") or {}).get("p95"),
+        "hist_p99_ms": (rec.get("latency_hist_ms") or {}).get("p99"),
+        "burn_rate": rec.get("burn_rate"),
         # Offline records carry the GLOBAL counter delta; serving
         # records the engine's own ladder attribution.
         "live_compiles": (
@@ -362,7 +371,7 @@ def build_run_doc(record: dict, source: str = "bench") -> dict:
     doc = {
         "schema": SCHEMA_VERSION,
         "run_id": record.get("run_id") or _fallback_run_id(),
-        "created_epoch": time.time(),
+        "created_epoch": clock.epoch(),
         "source": source,
         "record": record,
         "anomalies": record.get("anomalies"),

@@ -1,0 +1,395 @@
+"""Live serving telemetry: mergeable histograms + a sampler thread.
+
+The run store and regression gate judge *finished* runs; this module is
+the during-the-run view (the ROADMAP's "heavy traffic" operations half):
+
+* :class:`LatencyHistogram` — a **fixed-bucket** latency histogram.
+  Fixed bounds are the whole point: two histograms from two processes
+  (or two sampling windows) merge by element-wise count addition, which
+  is associative and commutative — the property multi-host aggregation
+  and `bench trace-merge` need, and the property sample-list percentiles
+  do not have without shipping every sample. Percentiles come back as
+  bucket upper bounds (nearest-rank over the cumulative counts), so a
+  merged p99 is conservative by at most one bucket's width.
+* :class:`TelemetrySampler` — a daemon thread that snapshots a serving
+  engine every ``interval_s``: queue depth/occupancy, shed/degrade/error
+  counters, the request histogram, program-store hit rates, and the SLO
+  error-budget burn rate, appended as JSONL to
+  ``artifacts/telemetry/<run_id>.jsonl`` (``DSDDMM_TELEMETRY`` or
+  ``bench serve --telemetry`` relocate/enable it). One snapshot is one
+  self-contained line — ``bench top`` tails the newest file and renders
+  the live view, and a crashed process leaves every completed line
+  readable.
+* **Burn rate** — the SRE error-budget framing: for a latency target
+  ``pXX_ms=L`` the budget is the ``(100-XX)%`` of requests allowed over
+  ``L``; ``burn_rate = observed_bad_fraction / budget_fraction``. 1.0
+  means burning exactly at budget; >1 means the SLO will be violated if
+  the window is representative. The worst axis wins. ``bench gate``
+  regresses the recorded burn rate as a serving verdict axis.
+
+Clock discipline: everything here reads ``obs.clock`` (the lint in
+``tests/test_obs_lint.py`` forbids raw ``time.*`` calls in ``obs/``).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import pathlib
+import threading
+
+from distributed_sddmm_tpu.obs import clock
+
+_REPO = pathlib.Path(__file__).resolve().parents[2]
+DEFAULT_TELEMETRY_DIR = _REPO / "artifacts" / "telemetry"
+
+#: Fixed histogram bucket upper bounds in milliseconds (log-ish 1-2-5
+#: ladder, 0.25 ms .. 30 s) plus an implicit overflow bucket. FIXED so
+#: histograms from any two processes of any run merge; changing these
+#: is a schema change (readers check the bounds match before merging).
+BUCKET_BOUNDS_MS: tuple[float, ...] = (
+    0.25, 0.5, 1.0, 2.0, 5.0, 10.0, 20.0, 50.0, 100.0, 250.0,
+    500.0, 1000.0, 2500.0, 5000.0, 10000.0, 30000.0,
+)
+
+
+class LatencyHistogram:
+    """Fixed-bucket counts; ``merge`` is associative + commutative."""
+
+    __slots__ = ("bounds_ms", "counts")
+
+    def __init__(self, bounds_ms: tuple[float, ...] = BUCKET_BOUNDS_MS,
+                 counts: list[int] | None = None):
+        self.bounds_ms = tuple(float(b) for b in bounds_ms)
+        n = len(self.bounds_ms) + 1  # +1: overflow bucket
+        if counts is None:
+            counts = [0] * n
+        if len(counts) != n:
+            raise ValueError(
+                f"histogram needs {n} counts for {n - 1} bounds, "
+                f"got {len(counts)}"
+            )
+        self.counts = [int(c) for c in counts]
+
+    # -- feeding ------------------------------------------------------- #
+
+    def add(self, latency_ms: float) -> None:
+        for i, bound in enumerate(self.bounds_ms):
+            if latency_ms <= bound:
+                self.counts[i] += 1
+                return
+        self.counts[-1] += 1  # overflow
+
+    # -- algebra ------------------------------------------------------- #
+
+    @property
+    def total(self) -> int:
+        return sum(self.counts)
+
+    def merge(self, other: "LatencyHistogram") -> "LatencyHistogram":
+        """A NEW histogram holding both operands' counts. Raises on a
+        bounds mismatch — silently merging different bucketings would
+        produce a histogram that means nothing."""
+        if self.bounds_ms != other.bounds_ms:
+            raise ValueError("cannot merge histograms with different bounds")
+        return LatencyHistogram(
+            self.bounds_ms,
+            [a + b for a, b in zip(self.counts, other.counts)],
+        )
+
+    def __eq__(self, other) -> bool:
+        return (
+            isinstance(other, LatencyHistogram)
+            and self.bounds_ms == other.bounds_ms
+            and self.counts == other.counts
+        )
+
+    # -- reading ------------------------------------------------------- #
+
+    def quantile_ms(self, pct: float) -> float | None:
+        """Nearest-rank percentile as a bucket upper bound (None when
+        empty). Overflow-bucket hits report the last finite bound — a
+        floor, flagged by the caller comparing against ``total``."""
+        total = self.total
+        if total == 0:
+            return None
+        rank = max(1, int(pct / 100.0 * total + 0.999999))
+        cum = 0
+        for i, c in enumerate(self.counts):
+            cum += c
+            if cum >= rank:
+                return self.bounds_ms[min(i, len(self.bounds_ms) - 1)]
+        return self.bounds_ms[-1]
+
+    def fraction_above(self, threshold_ms: float) -> float:
+        """Fraction of observations in buckets that lie entirely above
+        ``threshold_ms`` (a lower bound on the true fraction: the bucket
+        straddling the threshold is not charged)."""
+        total = self.total
+        if total == 0:
+            return 0.0
+        # The overflow bucket's lower bound is the last finite bound;
+        # past that the bucket straddles the threshold and is not
+        # charged, like any other straddling bucket.
+        above = self.counts[-1] if self.bounds_ms[-1] >= threshold_ms else 0
+        for i, bound in enumerate(self.bounds_ms):
+            lower = self.bounds_ms[i - 1] if i else 0.0
+            if lower >= threshold_ms:
+                above += self.counts[i]
+        return above / total
+
+    def percentiles_ms(self, pcts=(50, 95, 99)) -> dict:
+        out = {}
+        for pct in pcts:
+            v = self.quantile_ms(pct)
+            if v is not None:
+                out[f"p{pct}"] = v
+        return out
+
+    # -- (de)serialization --------------------------------------------- #
+
+    def to_dict(self) -> dict:
+        return {"bounds_ms": list(self.bounds_ms), "counts": list(self.counts)}
+
+    @classmethod
+    def from_dict(cls, d: dict | None) -> "LatencyHistogram | None":
+        if not isinstance(d, dict):
+            return None
+        try:
+            return cls(tuple(d["bounds_ms"]), list(d["counts"]))
+        except (KeyError, TypeError, ValueError):
+            return None
+
+
+def merge_histograms(dicts) -> LatencyHistogram | None:
+    """Merge serialized histograms (e.g. one per trace shard / telemetry
+    stream); unreadable or bounds-mismatched inputs are skipped."""
+    out = None
+    for d in dicts:
+        h = d if isinstance(d, LatencyHistogram) else \
+            LatencyHistogram.from_dict(d)
+        if h is None:
+            continue
+        if out is None:
+            # Copy: with a single LatencyHistogram input the result must
+            # not alias the caller's object.
+            out = LatencyHistogram(h.bounds_ms, h.counts)
+        else:
+            try:
+                out = out.merge(h)  # merge() already returns a new one
+            except ValueError:
+                continue
+    return out
+
+
+# --------------------------------------------------------------------- #
+# The sampler thread (one per serving engine)
+# --------------------------------------------------------------------- #
+
+
+def parse_env_spec(spec: str | None) -> tuple[bool, pathlib.Path | None]:
+    """``DSDDMM_TELEMETRY`` grammar, matching the run store's: 0/off/
+    false/no disables, 1/on/true/yes/empty selects the default dir, any
+    other value is a directory."""
+    spec = spec or ""
+    low = spec.lower()
+    if low in ("", "0", "off", "false", "no"):
+        return False, None
+    if low in ("1", "on", "true", "yes"):
+        return True, None
+    return True, pathlib.Path(spec)
+
+
+class TelemetrySampler:
+    """Periodic engine snapshots appended as JSONL.
+
+    ``engine`` needs ``.queue`` (``depth()``, ``max_depth``,
+    ``submitted_count``, ``shed_count``), ``.stats()`` and
+    ``.recorder`` (a :class:`~distributed_sddmm_tpu.serve.slo.
+    LatencyRecorder`); ``slo`` (optional) adds the burn-rate field.
+    The thread is a daemon and every snapshot is one complete line, so
+    a dying process costs at most the in-flight line.
+    """
+
+    def __init__(self, engine, interval_s: float = 0.5, out_dir=None,
+                 slo=None, run_id: str | None = None):
+        from distributed_sddmm_tpu.obs import trace as obs_trace
+
+        self.engine = engine
+        self.interval_s = float(interval_s)
+        self.slo = slo
+        rid = run_id or obs_trace.run_id()
+        if rid is None:
+            from distributed_sddmm_tpu.obs.trace import _make_run_id
+
+            rid = _make_run_id()
+        self.run_id = rid
+        out_dir = pathlib.Path(out_dir) if out_dir else DEFAULT_TELEMETRY_DIR
+        self.path = out_dir / f"{rid}.jsonl"
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self.samples = 0
+
+    # -- one snapshot --------------------------------------------------- #
+
+    def snapshot(self) -> dict:
+        q = self.engine.queue
+        rec = self.engine.recorder
+        summary = rec.summary()
+        depth = q.depth()
+        snap = {
+            "schema": 1,
+            "run_id": self.run_id,
+            "t_epoch": clock.epoch(),
+            "queue_depth": depth,
+            "queue_capacity": q.max_depth,
+            "depth_frac": round(depth / q.max_depth, 4) if q.max_depth else 0.0,
+            "submitted": q.submitted_count,
+            "requests": summary.get("requests", 0),
+            "completed": summary.get("completed", 0),
+            "errors": summary.get("errors", 0),
+            "shed": summary.get("shed_count", 0),
+            "degraded": summary.get("degraded_count", 0),
+            "latency_hist": summary.get("request_hist"),
+            "latency_hist_ms": summary.get("latency_hist_ms"),
+            "batch_occupancy": (summary.get("batch_occupancy") or {}).get(
+                "mean"
+            ),
+        }
+        try:
+            stats = self.engine.stats()
+        except Exception:  # noqa: BLE001 — telemetry never fails serving
+            stats = {}
+        snap["program_store"] = {
+            k: stats.get(k)
+            for k in ("cache_hits", "cache_misses", "disk_hits",
+                      "live_compiles")
+            if stats.get(k) is not None
+        }
+        if self.slo is not None:
+            snap["burn_rate"] = self.slo.burn_rate(summary)
+        return snap
+
+    # -- lifecycle ------------------------------------------------------ #
+
+    def start(self) -> "TelemetrySampler":
+        if self._thread is not None:
+            raise RuntimeError("sampler already started")
+        self.path.parent.mkdir(parents=True, exist_ok=True)
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name="telemetry-sampler"
+        )
+        self._thread.start()
+        return self
+
+    def stop(self, timeout_s: float = 5.0) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout_s)
+            self._thread = None
+        self._emit()  # final snapshot: the end-of-run state always lands
+
+    def __enter__(self) -> "TelemetrySampler":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            self._emit()
+
+    def _emit(self) -> None:
+        try:
+            line = json.dumps(self.snapshot(), default=str)
+            with open(self.path, "a") as fh:
+                fh.write(line + "\n")
+            self.samples += 1
+        except Exception:  # noqa: BLE001 — telemetry never fails serving
+            pass
+
+
+# --------------------------------------------------------------------- #
+# `bench top` — the reader half
+# --------------------------------------------------------------------- #
+
+
+def read_snapshots(path) -> list[dict]:
+    """All parseable snapshot lines of one telemetry file (torn final
+    lines are skipped — the writer appends whole lines)."""
+    out = []
+    try:
+        text = pathlib.Path(path).read_text()
+    except OSError:
+        return out
+    for line in text.splitlines():
+        if not line.strip():
+            continue
+        try:
+            rec = json.loads(line)
+        except ValueError:
+            continue
+        if isinstance(rec, dict):
+            out.append(rec)
+    return out
+
+
+def newest_stream(root=None) -> pathlib.Path | None:
+    """The most recently modified telemetry file under ``root``."""
+    root = pathlib.Path(root) if root else DEFAULT_TELEMETRY_DIR
+    try:
+        files = sorted(root.glob("*.jsonl"), key=os.path.getmtime)
+    except OSError:
+        return None
+    return files[-1] if files else None
+
+
+def render_top(snapshots: list[dict]) -> str:
+    """The ``bench top`` screen: latest snapshot + short-window rates."""
+    if not snapshots:
+        return "no telemetry samples yet"
+    cur = snapshots[-1]
+    lines = [
+        f"run {cur.get('run_id')} · sample {len(snapshots)} · "
+        f"t={cur.get('t_epoch')}",
+        "",
+        f"  queue     {cur.get('queue_depth', 0):>6} / "
+        f"{cur.get('queue_capacity', 0)} "
+        f"({100.0 * (cur.get('depth_frac') or 0.0):.0f}% full)",
+        f"  requests  {cur.get('requests', 0):>6}   completed "
+        f"{cur.get('completed', 0)}   errors {cur.get('errors', 0)}   "
+        f"shed {cur.get('shed', 0)}   degraded {cur.get('degraded', 0)}",
+    ]
+    hist = LatencyHistogram.from_dict(cur.get("latency_hist"))
+    if hist is not None and hist.total:
+        p = hist.percentiles_ms()
+        lines.append(
+            f"  latency   p50 {p.get('p50', 0):>8.2f} ms   "
+            f"p95 {p.get('p95', 0):>8.2f} ms   "
+            f"p99 {p.get('p99', 0):>8.2f} ms   (n={hist.total})"
+        )
+    burn = cur.get("burn_rate")
+    if burn is not None:
+        state = "OVER BUDGET" if burn > 1.0 else "within budget"
+        lines.append(f"  slo burn  {burn:>8.3f}x  ({state})")
+    ps = cur.get("program_store") or {}
+    if ps:
+        lines.append(
+            "  programs  "
+            + "   ".join(f"{k}={v}" for k, v in sorted(ps.items()))
+        )
+    occ = cur.get("batch_occupancy")
+    if occ is not None:
+        lines.append(f"  occupancy {occ:>8.3f} mean batch fill")
+    if len(snapshots) >= 2:
+        prev = snapshots[-2]
+        dt = (cur.get("t_epoch") or 0) - (prev.get("t_epoch") or 0)
+        if dt > 0:
+            dc = (cur.get("completed") or 0) - (prev.get("completed") or 0)
+            ds = (cur.get("shed") or 0) - (prev.get("shed") or 0)
+            lines.append(
+                f"  window    {dc / dt:.1f} req/s served, "
+                f"{ds / dt:.1f} req/s shed (last {dt:.1f}s)"
+            )
+    return "\n".join(lines)

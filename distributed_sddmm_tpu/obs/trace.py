@@ -21,12 +21,27 @@ Design constraints, in order:
    that thread's enclosing span, never to another thread's.
 3. **Monotonic timestamps.** ``t0``/``t1`` are ``time.perf_counter``
    offsets from the tracer's start; the begin record carries the epoch
-   time of that origin so tools can reconstruct wall-clock.
+   time of that origin so tools can reconstruct wall-clock — and so
+   ``bench trace-merge`` can offset-align shards written by different
+   processes onto one timeline (each process's ``t0_epoch`` is its
+   shard's clock-calibration header).
+4. **One process, one file.** A trace file is owned by exactly one
+   process. Directory specs embed the run_id (which embeds the pid) in
+   the file name, so concurrent processes never collide; an *explicit*
+   ``PATH.jsonl`` spec that another live process already owns reroutes
+   this process's writes into the sibling shard directory
+   ``PATH.shards/<run_id>.jsonl`` instead of truncating or interleaving.
+   Enabling with an explicit file also exports ``DSDDMM_TRACE`` =
+   ``PATH.shards`` to child processes, so workers a traced run spawns
+   (serve smoke, ``tests/_mp_worker.py``) write per-process shards by
+   default; ``bench trace-merge PATH.jsonl`` stitches the stem file and
+   its shards back into one trace.
 
 Record schema (one JSON object per line, ``schema`` = SCHEMA_VERSION):
 
-* ``{"type": "begin", "schema": 1, "run_id": .., "t0_epoch": ..}``
-  — first line of every trace.
+* ``{"type": "begin", "schema": 1, "run_id": .., "t0_epoch": ..,
+  "pid": ..}`` — first line of every trace; ``t0_epoch`` is the
+  wall-clock time of the monotonic origin (the shard-alignment anchor).
 * ``{"type": "span", "name": .., "id": .., "parent": .., "tid": ..,
   "t0": .., "t1": .., "dur_s": .., "attrs": {..}}`` — emitted when
   the span *closes* (children therefore appear before their parent;
@@ -40,12 +55,15 @@ Record schema (one JSON object per line, ``schema`` = SCHEMA_VERSION):
 
 from __future__ import annotations
 
+import errno
 import json
 import os
 import pathlib
 import threading
 import time
 from typing import Optional
+
+from distributed_sddmm_tpu.obs import clock
 
 #: Trace record schema generation; readers reject records they cannot
 #: interpret. Bump on any incompatible change.
@@ -58,6 +76,11 @@ DEFAULT_TRACE_DIR = _REPO / "artifacts" / "traces"
 _active: Optional["Tracer"] = None
 _env_checked = False
 _registry_lock = threading.Lock()
+#: (previous DSDDMM_TRACE value, exported?) — enable() exports the shard
+#: directory to children; disable() restores the inherited value.
+_env_export: tuple[Optional[str], bool] = (None, False)
+#: The directory child processes of this traced run shard into.
+_shard_dir: Optional[str] = None
 
 
 def _make_run_id() -> str:
@@ -107,11 +130,11 @@ class Span:
         stack = tr.stack()
         self.parent = stack[-1] if stack else None
         stack.append(self.id)
-        self._t0 = time.perf_counter()
+        self._t0 = clock.now()
         return self
 
     def __exit__(self, *exc) -> bool:
-        t1 = time.perf_counter()
+        t1 = clock.now()
         tr = self.tracer
         stack = tr.stack()
         if stack and stack[-1] == self.id:
@@ -138,7 +161,7 @@ class Tracer:
     def __init__(self, path: pathlib.Path, run_id: str):
         self.path = path
         self.run_id = run_id
-        self.t0 = time.perf_counter()
+        self.t0 = clock.now()
         self._lock = threading.Lock()
         self._ids = 0
         self._local = threading.local()
@@ -146,14 +169,17 @@ class Tracer:
         # Truncate: one trace per file (re-running with the same explicit
         # --trace PATH.jsonl must not merge runs — the reader would
         # double-count). Default/directory specs embed the run_id in the
-        # file name, so concurrent processes never share a file; point
-        # multi-process runs at a directory, not a file.
+        # file name, and an explicit file another LIVE process owns was
+        # already rerouted into the shard directory by _resolve_path, so
+        # two running processes never share a file.
         self._fh = open(path, "w", buffering=1)  # line-buffered
+        # t0_epoch is the wall-clock reading of the monotonic origin —
+        # the shard's clock-calibration header trace-merge aligns on.
         self.emit({
             "type": "begin",
             "schema": SCHEMA_VERSION,
             "run_id": run_id,
-            "t0_epoch": time.time(),
+            "t0_epoch": clock.epoch(),
             "pid": os.getpid(),
         })
 
@@ -202,13 +228,72 @@ def _env_activate() -> None:
             _enable_locked(None if spec in ("1", "on", "true", "yes") else spec)
 
 
+def _owning_pid(path: pathlib.Path) -> Optional[int]:
+    """The pid in an existing trace file's begin record, or None."""
+    try:
+        with open(path) as fh:
+            rec = json.loads(fh.readline())
+    except (OSError, ValueError):
+        return None
+    if isinstance(rec, dict) and rec.get("type") == "begin":
+        pid = rec.get("pid")
+        return pid if isinstance(pid, int) else None
+    return None
+
+
+def _pid_alive(pid: int) -> bool:
+    try:
+        os.kill(pid, 0)
+    except OSError as e:
+        return e.errno == errno.EPERM  # exists, not ours to signal
+    return True
+
+
+def shard_dir_for(path) -> pathlib.Path:
+    """The shard directory belonging to an explicit ``PATH.jsonl`` trace
+    stem: sibling ``PATH.shards/`` (worker processes of the run write
+    their per-process shards there; ``bench trace-merge PATH.jsonl``
+    stitches stem + shards)."""
+    return pathlib.Path(path).with_suffix(".shards")
+
+
 def _resolve_path(spec, run_id: str) -> pathlib.Path:
     if spec is None:
         return DEFAULT_TRACE_DIR / f"{run_id}.jsonl"
     p = pathlib.Path(spec)
     if p.suffix != ".jsonl":  # treat as a directory
         return p / f"{run_id}.jsonl"
+    # Explicit file: if another LIVE process already owns it (a parent
+    # that exported this spec to us, or a sibling launched with the same
+    # flag), become a shard instead of truncating/interleaving its file.
+    owner = _owning_pid(p)
+    if owner is not None and owner != os.getpid() and _pid_alive(owner):
+        return shard_dir_for(p) / f"{run_id}.jsonl"
     return p
+
+
+def _export_child_spec(spec, resolved: pathlib.Path) -> None:
+    """Point child processes at the shard directory for this trace.
+
+    Directory/default specs already isolate per process (run_id in the
+    file name) — children share the directory. An explicit ``.jsonl``
+    file exports its sibling ``.shards`` directory, so workers a traced
+    run spawns write shards instead of fighting over one file. The
+    inherited ``DSDDMM_TRACE`` value is restored by :func:`disable`.
+    """
+    global _env_export, _shard_dir
+    if spec is None:
+        child = str(DEFAULT_TRACE_DIR)
+    else:
+        p = pathlib.Path(spec)
+        child = str(shard_dir_for(p) if p.suffix == ".jsonl" else p)
+    if resolved.parent != pathlib.Path(child) and resolved.suffix == ".jsonl" \
+            and resolved.parent.name.endswith(".shards"):
+        # We ourselves were rerouted into a shard dir: share it.
+        child = str(resolved.parent)
+    _env_export = (os.environ.get("DSDDMM_TRACE"), True)
+    _shard_dir = child
+    os.environ["DSDDMM_TRACE"] = child
 
 
 def _enable_locked(spec=None, run_id: Optional[str] = None) -> "Tracer":
@@ -216,7 +301,9 @@ def _enable_locked(spec=None, run_id: Optional[str] = None) -> "Tracer":
     if _active is not None:
         return _active
     rid = run_id or _make_run_id()
-    _active = Tracer(_resolve_path(spec, rid), rid)
+    path = _resolve_path(spec, rid)
+    _active = Tracer(path, rid)
+    _export_child_spec(spec, path)
     return _active
 
 
@@ -237,13 +324,29 @@ def enable(path=None, run_id: Optional[str] = None) -> "Tracer":
 
 
 def disable() -> None:
-    """Close and deactivate the tracer (tests; end-of-run flush)."""
-    global _active, _env_checked
+    """Close and deactivate the tracer (tests; end-of-run flush).
+    Restores the ``DSDDMM_TRACE`` value :func:`enable` exported for
+    child processes."""
+    global _active, _env_checked, _env_export, _shard_dir
     with _registry_lock:
         if _active is not None:
             _active.close()
         _active = None
         _env_checked = True
+        prev, exported = _env_export
+        if exported:
+            if prev is None:
+                os.environ.pop("DSDDMM_TRACE", None)
+            else:
+                os.environ["DSDDMM_TRACE"] = prev
+        _env_export = (None, False)
+        _shard_dir = None
+
+
+def shard_dir() -> Optional[str]:
+    """The directory child processes of this traced run write shards
+    into (the exported ``DSDDMM_TRACE``), or None when not tracing."""
+    return _shard_dir if _active is not None else None
 
 
 def tracer() -> Optional["Tracer"]:
@@ -262,6 +365,16 @@ def enabled() -> bool:
 def run_id() -> Optional[str]:
     tr = tracer()
     return tr.run_id if tr else None
+
+
+def rel_time(t_perf: float) -> Optional[float]:
+    """A ``clock.now()`` stamp as a trace-relative time (the unit span
+    ``t0``/``t1`` and event ``t`` use), or None when not tracing. Lets
+    emitters embed *precise* externally-captured stamps in event attrs —
+    an event's own ``t`` is its emission time, which can lag the moment
+    it describes by a thread-scheduling delay."""
+    tr = tracer()
+    return round(t_perf - tr.t0, 9) if tr else None
 
 
 def trace_path() -> Optional[str]:
@@ -295,6 +408,6 @@ def event(name: str, **attrs) -> None:
         "id": tr.next_id(),
         "parent": tr.current_span_id(),
         "tid": threading.get_ident(),
-        "t": round(time.perf_counter() - tr.t0, 9),
+        "t": round(clock.now() - tr.t0, 9),
         "attrs": attrs,
     })

@@ -1,0 +1,193 @@
+"""Offset-aligned merge of per-process trace shards into one trace.
+
+A multi-process run (the serve smoke's workers, ``tests/_mp_worker.py``,
+open item 1's multi-host pods) produces one JSONL shard per process —
+each with its own monotonic origin, so their ``t0``/``t1``/``t``
+timestamps are not comparable. Every shard's ``begin`` record carries
+the clock-calibration header the tracer has always written
+(``t0_epoch``: the wall-clock reading of the monotonic origin), and the
+merge aligns on it:
+
+* the earliest shard's ``t0_epoch`` becomes the merged origin;
+* every other shard's records shift by ``(its t0_epoch - base)`` —
+  monotonic-duration accuracy within a shard is preserved exactly, and
+  cross-shard ordering is accurate to wall-clock-sync accuracy (NTP on
+  one host: sub-millisecond; good enough to order batches, not kernels);
+* span/event ids are renumbered into disjoint ranges (each process
+  counts from 1) with ``parent`` links rewritten, and every record is
+  tagged with its ``shard`` (source run_id) and ``pid``;
+* the output is one schema-valid trace (``tools/tracereport`` validates
+  every record on load and again after the merge), time-sorted, with a
+  ``begin`` whose ``shards`` list records each source's run_id, pid,
+  epoch and applied offset.
+
+CLI: ``python -m distributed_sddmm_tpu.bench trace-merge SPEC... [-o
+OUT]`` where a SPEC is a shard file, a shard directory, or an explicit
+``PATH.jsonl`` stem (merged with its sibling ``PATH.shards/``
+directory, the layout ``obs/trace.py`` reroutes worker processes into).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import pathlib
+
+from distributed_sddmm_tpu.tools import tracereport
+
+
+def _is_merged_output(path: pathlib.Path) -> bool:
+    """True when the file's begin record is itself a merge product
+    (carries a ``shards`` list). Globbed spec expansion skips these so
+    re-running ``trace-merge`` over a directory that already holds a
+    prior merged output doesn't double-count every span."""
+    try:
+        with open(path) as fh:
+            rec = json.loads(fh.readline())
+    except (OSError, ValueError):
+        return False
+    return (isinstance(rec, dict) and rec.get("type") == "begin"
+            and "shards" in rec)
+
+
+def discover(spec) -> list[pathlib.Path]:
+    """Shard files for one CLI spec: a directory (every ``*.jsonl``
+    inside), a ``PATH.jsonl`` stem (itself + ``PATH.shards/*.jsonl``),
+    or a single file. Prior merged outputs found by globbing are
+    excluded; a merged trace named explicitly is kept as given."""
+    p = pathlib.Path(spec)
+    if p.is_dir():
+        out = [f for f in sorted(p.glob("*.jsonl"))
+               if not _is_merged_output(f)]
+        if not out:
+            raise FileNotFoundError(f"no *.jsonl shards in {p}")
+        return out
+    out = [p] if p.exists() else []
+    shards = p.with_suffix(".shards")
+    if p.suffix == ".jsonl" and shards.is_dir():
+        out += [f for f in sorted(shards.glob("*.jsonl"))
+                if not _is_merged_output(f)]
+    if not out:
+        raise FileNotFoundError(f"no trace shards at {spec}")
+    return out
+
+
+def merge(paths, strict: bool = True) -> dict:
+    """Merge shard files into ``{"begin", "spans", "events", "errors"}``
+    (the ``tracereport.load_trace`` shape, plus ``begin["shards"]``).
+
+    Raises ``ValueError`` when ``strict`` and any shard fails schema
+    validation, or when no shard contributes a ``begin`` record.
+    """
+    loaded, errors = [], []
+    for path in paths:
+        tr = tracereport.load_trace(path, strict=strict)
+        errors.extend(f"{path}: {e}" for e in tr["errors"])
+        if tr["begin"] is None:
+            errors.append(f"{path}: no begin record; shard skipped")
+            continue
+        loaded.append((pathlib.Path(path), tr))
+    if not loaded:
+        raise ValueError(
+            "no mergeable shards: " + "; ".join(errors[:5]) if errors
+            else "no mergeable shards"
+        )
+
+    base_epoch = min(
+        float(tr["begin"].get("t0_epoch") or 0.0) for _, tr in loaded
+    )
+    spans, events, shards_meta = [], [], []
+    id_base = 0
+    for path, tr in loaded:
+        b = tr["begin"]
+        off = float(b.get("t0_epoch") or base_epoch) - base_epoch
+        rid, pid = b.get("run_id"), b.get("pid")
+        max_id = 0
+        for sp in tr["spans"]:
+            sp = dict(sp)
+            max_id = max(max_id, int(sp["id"]))
+            sp["id"] = int(sp["id"]) + id_base
+            if sp.get("parent") is not None:
+                sp["parent"] = int(sp["parent"]) + id_base
+            sp["t0"] = round(sp["t0"] + off, 9)
+            sp["t1"] = round(sp["t1"] + off, 9)
+            sp["shard"] = rid
+            if pid is not None:
+                sp["pid"] = pid
+            spans.append(sp)
+        for ev in tr["events"]:
+            ev = dict(ev)
+            max_id = max(max_id, int(ev["id"]))
+            ev["id"] = int(ev["id"]) + id_base
+            if ev.get("parent") is not None:
+                ev["parent"] = int(ev["parent"]) + id_base
+            ev["t"] = round(ev["t"] + off, 9)
+            # serve:reply embeds precise trace-relative stamps alongside
+            # the emission-time `t`; they live in the same timebase and
+            # must shift with it or merged chains land in the source
+            # shard's timeline.
+            attrs = ev.get("attrs")
+            if isinstance(attrs, dict):
+                shifted = {
+                    k: round(attrs[k] + off, 9)
+                    for k in ("t_enqueue", "t_reply")
+                    if isinstance(attrs.get(k), (int, float))
+                }
+                if shifted:
+                    ev["attrs"] = {**attrs, **shifted}
+            ev["shard"] = rid
+            if pid is not None:
+                ev["pid"] = pid
+            events.append(ev)
+        shards_meta.append({
+            "run_id": rid, "pid": pid,
+            "t0_epoch": b.get("t0_epoch"), "offset_s": round(off, 9),
+            "path": str(path),
+            "spans": len(tr["spans"]), "events": len(tr["events"]),
+        })
+        id_base += max_id
+
+    spans.sort(key=lambda r: r["t0"])
+    events.sort(key=lambda r: r["t"])
+    digest = hashlib.sha256(
+        "|".join(str(s["run_id"]) for s in shards_meta).encode()
+    ).hexdigest()[:10]
+    begin = {
+        "type": "begin",
+        "schema": tracereport.SUPPORTED_SCHEMA,
+        "run_id": f"merged-{digest}",
+        "t0_epoch": base_epoch,
+        "shards": shards_meta,
+    }
+    return {"begin": begin, "spans": spans, "events": events,
+            "errors": errors}
+
+
+def write_merged(paths, out_path=None, strict: bool = True):
+    """Merge ``paths`` and write one time-sorted JSONL trace.
+
+    Returns ``(out_path, merged)``. Default output:
+    ``<first shard's directory>/<merged run_id>.jsonl``. Every written
+    record is re-validated — a merge that produced an invalid record is
+    a bug and raises rather than persisting garbage.
+    """
+    merged = merge(paths, strict=strict)
+    records = sorted(
+        merged["spans"] + merged["events"],
+        key=lambda r: r["t0"] if r["type"] == "span" else r["t"],
+    )
+    for rec in [merged["begin"]] + records:
+        errs = tracereport.validate_record(rec)
+        if errs:
+            raise ValueError(f"merge produced an invalid record: {errs}")
+    if out_path is None:
+        out_path = (
+            pathlib.Path(paths[0]).parent / f"{merged['begin']['run_id']}.jsonl"
+        )
+    out_path = pathlib.Path(out_path)
+    out_path.parent.mkdir(parents=True, exist_ok=True)
+    with open(out_path, "w") as fh:
+        fh.write(json.dumps(merged["begin"], default=str) + "\n")
+        for rec in records:
+            fh.write(json.dumps(rec, default=str) + "\n")
+    return out_path, merged

@@ -22,6 +22,11 @@ chosen because it has bitten this repo's own rounds:
   and analytic model are maintained independently; disagreement beyond
   tolerance means one of them drifted, and the run's accounting — the
   paper's whole argument — can no longer be trusted.
+* **xla_flop_mismatch** — the same independence argument one level
+  down: analytic per-op FLOPs against XLA's own ``cost_analysis`` of
+  the compiled executables (captured by the program store). Counted
+  exceeding compiled means the analytic accounting drifted; compiled
+  exceeding counted by the waste factor means padding/layout exploded.
 
 Every anomaly is recorded on the watchdog (for the end-of-run
 ``anomalies`` summary the bench record carries), emitted as an
@@ -76,6 +81,8 @@ class Watchdog:
         comm_rtol: float = 0.25,
         queue_frac: float = 0.75,
         queue_patience: int = 5,
+        xla_rtol: float = 0.25,
+        xla_waste_factor: float = 32.0,
     ):
         if mode not in ("warn", "strict"):
             raise ValueError(f"watchdog mode {mode!r}; expected warn|strict")
@@ -90,6 +97,8 @@ class Watchdog:
         self.comm_rtol = comm_rtol
         self.queue_frac = queue_frac
         self.queue_patience = queue_patience
+        self.xla_rtol = xla_rtol
+        self.xla_waste_factor = xla_waste_factor
         self._queue_streak = 0
         self._queue_flagged = False
 
@@ -329,6 +338,48 @@ class Watchdog:
         check, in one call."""
         self.check_comm(strategy, cost_op or op, counted_words, pairs)
         self.observe(op, dur_s)
+
+    # ------------------------------------------------------------------ #
+    # Analytic-vs-XLA FLOP agreement (the program store's cost capture)
+    # ------------------------------------------------------------------ #
+
+    def check_xla_costs(self, metrics: dict, xla_ops: dict) -> None:
+        """Counted analytic FLOPs/call per op against XLA's own
+        ``cost_analysis`` numbers for the op's compiled programs
+        (``programs.xla_cost_summary`` builds ``xla_ops``).
+
+        Two one-sided bands, because the two counts measure different
+        things: XLA charges the COMPILED program (padding, masking and
+        fusion included) while the analytic count is useful work only,
+        so ``xla >= counted`` is normal. ``counted > xla * (1 +
+        xla_rtol)`` means the executable does *less* arithmetic than
+        the useful work we claim — the analytic accounting drifted;
+        ``xla > counted * xla_waste_factor`` means padding/layout blew
+        the compiled FLOPs up pathologically. Anomalies are recorded
+        (``xla_flop_mismatch``) but never escalated: this runs at
+        record-assembly time, where the resilience ladder has nothing
+        left to degrade to.
+        """
+        for op, cost in (xla_ops or {}).items():
+            m = metrics.get(op) or {}
+            calls, flops = m.get("calls") or 0, m.get("flops") or 0.0
+            xla = cost.get("flops_per_call") or 0.0
+            if not (calls and flops and xla):
+                continue
+            counted = flops / calls
+            ratio = counted / xla
+            if counted > xla * (1.0 + self.xla_rtol):
+                self._anomaly(
+                    "xla_flop_mismatch", op, direction="counted_exceeds_xla",
+                    counted_flops=counted, xla_flops=xla,
+                    ratio=round(ratio, 4),
+                )
+            elif xla > counted * self.xla_waste_factor:
+                self._anomaly(
+                    "xla_flop_mismatch", op, direction="xla_waste",
+                    counted_flops=counted, xla_flops=xla,
+                    ratio=round(ratio, 4),
+                )
 
     # ------------------------------------------------------------------ #
     # End-of-run summary

@@ -26,9 +26,11 @@ from distributed_sddmm_tpu.programs.store import (  # noqa: F401
     active,
     bind_strategy,
     chained_program,
+    cost_log_len,
     disable,
     enable,
     matrix_content_key,
     stored,
     strategy_config_tag,
+    xla_cost_summary,
 )

@@ -30,7 +30,21 @@ suite):
 Counters land in ``obs.metrics.GLOBAL``: ``program_store_hits`` (disk),
 ``program_store_misses`` (absent/evicted), ``live_compiles`` (an
 executable was built in-process — the number a warmed cold start must
-drive to zero).
+drive to zero). Since PR 7 the same facts are also trace *events* —
+``program_store_hit`` / ``program_store_compile`` with the program key
+(and compile seconds on the compile side) — so cold-start cost shows up
+as tracereport phases, not just end-of-run counter deltas.
+
+XLA cost cross-check: :meth:`ProgramStore.save` captures the compiled
+executable's ``cost_analysis()`` FLOPs/bytes into the entry (and the
+index row), and every save/load registers the numbers in a process-wide
+cost log. :func:`xla_cost_summary` joins that log against the per-op
+analytic counters (token-matching op names inside plan keys), giving
+the tracereport/regress layers an *independent* FLOP column: the
+analytic cost model and XLA's own accounting are maintained by
+different parties, and their ratio drifting is how either one's bugs
+surface (``obs/watchdog.py::check_xla_costs`` flags beyond-band
+disagreement).
 
 Activation mirrors the run store: ``DSDDMM_PROGRAMS`` = ``0``/``off``
 disables, a path relocates, unset/``1`` selects the default root.
@@ -62,6 +76,99 @@ def _global_counters():
     from distributed_sddmm_tpu.obs import metrics as obs_metrics
 
     return obs_metrics.GLOBAL
+
+
+# --------------------------------------------------------------------- #
+# XLA cost capture (compiled.cost_analysis at compile/load time)
+# --------------------------------------------------------------------- #
+
+#: Process-wide append-only log of (key, cost) pairs, in resolution
+#: order — callers snapshot ``cost_log_len()`` before a run and summon
+#: ``xla_cost_summary(..., since=cursor)`` after, the same cursor
+#: discipline the fault plan and watchdog events use.
+_cost_log: list[tuple[str, dict]] = []
+_cost_lock = threading.Lock()
+
+
+def _cost_analysis(compiled) -> dict | None:
+    """``{"flops", "bytes_accessed"}`` from an executable's own cost
+    analysis, or None when this jax generation/backend exposes none.
+    The numbers are XLA's accounting of the COMPILED program (padding
+    and fusion included) — deliberately not the analytic model's."""
+    try:
+        cost = compiled.cost_analysis()
+        if isinstance(cost, (list, tuple)):
+            cost = cost[0] if cost else None
+        if not isinstance(cost, dict):
+            return None
+        out = {}
+        if cost.get("flops") is not None:
+            out["flops"] = float(cost["flops"])
+        if cost.get("bytes accessed") is not None:
+            out["bytes_accessed"] = float(cost["bytes accessed"])
+        return out or None
+    except Exception:  # noqa: BLE001 — cost capture is best-effort
+        return None
+
+
+def register_cost(key: str, cost: dict | None) -> None:
+    if not cost:
+        return
+    with _cost_lock:
+        _cost_log.append((key, cost))
+
+
+def cost_log_len() -> int:
+    with _cost_lock:
+        return len(_cost_log)
+
+
+#: Metric-op → program-cache-key tokens (the strategy names its cached
+#: programs "fused"/"sddmm"/"spmm"; app chains embed the metric name).
+_OP_KEY_TOKENS = {
+    "fusedSpMM": ("fused", "fused_twopass"),
+    "fusedSpMMB": ("fused", "fused_twopass"),
+    "sddmmA": ("sddmm",), "sddmmB": ("sddmm",),
+    "spmmA": ("spmm",), "spmmB": ("spmm",),
+}
+
+
+def xla_cost_summary(ops, since: int = 0) -> dict | None:
+    """Join the cost log against per-op analytic metrics ops.
+
+    ``ops`` is an iterable of op names (typically a bench record's
+    ``metrics`` keys). A logged key matches an op when one of the op's
+    program-cache tokens appears as a ``-``/``:``-separated token of
+    the key (plan keys embed the strategy's program-cache key, e.g.
+    ``...-fused-False-none``; chained app keys embed the metric name
+    itself, e.g. ``...-cgStep-A-...``). Returns ``{"programs": N,
+    "ops": {op: {"flops_per_call", "bytes_per_call", "programs"}}}``
+    averaging over matching programs (A/B-mode variants of one op
+    legitimately differ), or None when nothing matched — records
+    without the field simply lack the gate axis.
+    """
+    with _cost_lock:
+        log = _cost_log[since:]
+    if not log:
+        return None
+    out: dict[str, dict] = {}
+    for op in ops:
+        tokens = set(_OP_KEY_TOKENS.get(op, (op,)))
+        flops, bytes_, n = 0.0, 0.0, 0
+        for key, cost in log:
+            if tokens & set(key.replace(":", "-").split("-")):
+                n += 1
+                flops += cost.get("flops", 0.0)
+                bytes_ += cost.get("bytes_accessed", 0.0)
+        if n and flops:
+            out[op] = {
+                "flops_per_call": flops / n,
+                "bytes_per_call": bytes_ / n if bytes_ else None,
+                "programs": n,
+            }
+    if not out:
+        return None
+    return {"programs": len(log), "ops": out}
 
 
 def live_backend() -> str | None:
@@ -153,6 +260,9 @@ class ProgramStore:
             "backend": entry.get("backend"),
             "created_epoch": entry.get("created_epoch"),
             "meta": entry.get("meta") or {},
+            # XLA's own FLOPs/bytes for the executable (None on
+            # pre-PR-7 entries and cost-less backends).
+            "cost": entry.get("cost"),
         }
 
     def _update_index(self, entry: dict | None, drop_key: str | None = None):
@@ -267,6 +377,18 @@ class ProgramStore:
         with self._lock:
             self.hits += 1
         _global_counters().add("program_store_hits")
+        cost = entry.get("cost")
+        register_cost(key, cost)
+        # The counter's trace-event twin: disk warms are visible as
+        # events in tracereport, not just end-of-run counter deltas.
+        from distributed_sddmm_tpu.obs import trace as obs_trace
+
+        if obs_trace.enabled():
+            obs_trace.event(
+                "program_store_hit", key=key,
+                **({"xla_flops": cost["flops"]}
+                   if cost and cost.get("flops") else {}),
+            )
         return loaded
 
     def save(self, key: str, compiled, meta: dict | None = None,
@@ -288,12 +410,15 @@ class ProgramStore:
             from jax.experimental import serialize_executable as se
 
             payload = se.serialize(compiled)
+            cost = _cost_analysis(compiled)
+            register_cost(key, cost)
             entry = {
                 "schema": SCHEMA_VERSION,
                 "key": key,
                 "backend": backend if backend is not None else live_backend(),
                 "created_epoch": time.time(),
                 "meta": dict(meta or {}),
+                "cost": cost,
                 "payload": payload,
             }
             atomic_write_bytes(self._path(key), pickle.dumps(entry))
@@ -314,13 +439,31 @@ class ProgramStore:
         """(program, source): the deserialized entry (``"disk"``) or a
         live ``compile_fn()`` result (``"live"``, persisted for the next
         process). ``compile_fn`` must return a callable compiled
-        executable (e.g. ``jit_fn.lower(*args).compile()``)."""
+        executable (e.g. ``jit_fn.lower(*args).compile()``). Live
+        compiles emit a ``program_store_compile`` trace event carrying
+        the key and compile seconds, so cold-start cost shows up in
+        tracereport phases rather than only as a counter delta."""
+        from distributed_sddmm_tpu.obs import clock
+        from distributed_sddmm_tpu.obs import trace as obs_trace
+
         prog = self.load(key)
         if prog is not None:
             return prog, "disk"
+        t0 = clock.now()
         prog = compile_fn()
+        compile_s = clock.now() - t0
         self._live()
         self.save(key, prog, meta=meta)
+        if obs_trace.enabled():
+            with _cost_lock:
+                cost = dict(_cost_log[-1][1]) \
+                    if _cost_log and _cost_log[-1][0] == key else None
+            obs_trace.event(
+                "program_store_compile", key=key,
+                compile_s=round(compile_s, 6),
+                **({"xla_flops": cost["flops"]}
+                   if cost and cost.get("flops") else {}),
+            )
         return prog, "live"
 
     def _miss(self) -> None:

@@ -33,9 +33,9 @@ from __future__ import annotations
 
 import os
 import threading
-import time
 from typing import Optional
 
+from distributed_sddmm_tpu.obs import clock
 from distributed_sddmm_tpu.obs import log as obs_log
 from distributed_sddmm_tpu.obs import metrics as obs_metrics
 from distributed_sddmm_tpu.obs import trace as obs_trace
@@ -334,7 +334,7 @@ class ServingEngine:
         return groups
 
     def _serve_batch(self, batch: list[Request]) -> None:
-        t_batch = time.perf_counter()
+        t_batch = clock.now()
         depth_now = self.queue.depth()
         payloads = [req.payload for req in batch]
         answered_idx: list[int] = []
@@ -345,7 +345,7 @@ class ServingEngine:
             reqs = [batch[i] for i in idxs]
             bb = bucket_for(len(group), self.batch_buckets)
             self.recorder.record_batch(len(group), bb, depth_now)
-            t0 = time.perf_counter()
+            t0 = clock.now()
             for req in reqs:
                 # Per GROUP, not per batch: groups dispatch sequentially,
                 # and a later group's execute_s must not absorb an
@@ -355,9 +355,13 @@ class ServingEngine:
                 "serve:batch", workload=self.workload.name,
                 batch=len(group), batch_bucket=bb, inner_bucket=ib,
                 depth=depth_now,
+                # The trace-context link: which requests this dispatch
+                # carried — request_chains joins enqueue events, this
+                # span and the reply events on these ids.
+                req_ids=[r.req_id for r in reqs],
             ) as sp:
                 try:
-                    replies = self._dispatch(group, bb, ib)
+                    replies = self._dispatch(group, bb, ib, span=sp)
                     degraded = False
                 except Exception as e:  # noqa: BLE001 — degrade rung
                     replies = self._degrade(group, e)
@@ -374,8 +378,14 @@ class ServingEngine:
                 req.set_result(reply)
                 answered_idx.append(i)
                 if obs_trace.enabled():
+                    # t_enqueue/t_reply are the request's own precise
+                    # stamps in trace-relative time: the event's `t` is
+                    # its emission instant, which can lag set_result by
+                    # a scheduling delay once the client thread wakes.
                     obs_trace.event(
                         "serve:reply", req=req.req_id, degraded=degraded,
+                        t_enqueue=obs_trace.rel_time(req.t_enqueue),
+                        t_reply=obs_trace.rel_time(req.t_reply),
                         **{k: round(v, 6)
                            for k, v in req.stage_latencies_s().items()},
                     )
@@ -384,7 +394,7 @@ class ServingEngine:
                 try:
                     wd.observe(
                         f"serve:{self.workload.name}",
-                        time.perf_counter() - t0,
+                        clock.now() - t0,
                     )
                 except NumericalFault as alarm:
                     # Strict-mode spike/drift: the anomaly is recorded;
@@ -406,7 +416,7 @@ class ServingEngine:
             except Exception as e:  # noqa: BLE001 — ingest is best-effort
                 obs_log.warn("serve", "online ingest failed",
                              error=f"{type(e).__name__}: {e}")
-        dt = time.perf_counter() - t_batch
+        dt = clock.now() - t_batch
         if dt > 0:
             inst = len(batch) / dt
             self.queue.drain_rate_hint = (
@@ -419,14 +429,21 @@ class ServingEngine:
     # ------------------------------------------------------------------ #
 
     def _dispatch(
-        self, group: list[dict], batch_bucket: int, inner_bucket: int
+        self, group: list[dict], batch_bucket: int, inner_bucket: int,
+        span=None,
     ) -> list[dict]:
         from distributed_sddmm_tpu.resilience import guards
         from distributed_sddmm_tpu.resilience.retry import Backoff, retry_call
         from distributed_sddmm_tpu.utils.platform import force_fetch
 
         prog = self._program(batch_bucket, inner_bucket)
+        t_pad0 = clock.now()
         args = self.workload.pad_batch(group, batch_bucket, inner_bucket)
+        pad_s = clock.now() - t_pad0
+        if span is not None:
+            # The pad sub-segment of execute_s: how much of the dispatch
+            # window went to bucket padding rather than the program.
+            span.set(pad_s=round(pad_s, 9))
 
         def attempt():
             faults.maybe_raise(f"execute:{self.OP}")

@@ -18,8 +18,16 @@ latency; shedding converts that into an explicit, client-visible signal
 while requests already admitted still meet their latency target.
 
 Every request carries its timeline (enqueue → admit → execute → reply
-perf-counter stamps); ``serve/slo.py`` turns those into the percentile
-histograms the SLO gate judges.
+monotonic stamps, all read through ``obs.clock``); ``serve/slo.py``
+turns those into the percentile histograms the SLO gate judges.
+
+Trace context: the request id minted at :meth:`~RequestQueue.submit` is
+the correlation key the whole serving path carries — the queue emits a
+``serve:enqueue`` event per admission (and ``serve:shed`` per
+rejection), the engine's batch spans list their member ``req_ids``, and
+the per-request ``serve:reply`` event carries the full segment
+decomposition, so ``tools/tracereport.request_chains`` can reconstruct
+any request's enqueue→reply timeline from the trace alone.
 """
 
 from __future__ import annotations
@@ -27,8 +35,10 @@ from __future__ import annotations
 import collections
 import itertools
 import threading
-import time
 from typing import Any, Optional
+
+from distributed_sddmm_tpu.obs import clock
+from distributed_sddmm_tpu.obs import trace as obs_trace
 
 
 class ShedError(RuntimeError):
@@ -54,9 +64,11 @@ class Request:
 
     The reply slot is a one-shot event; :meth:`result` blocks the caller
     until the engine delivers (or raises what the engine recorded).
-    Timeline stamps are ``time.perf_counter`` values filled in by the
-    queue (``t_enqueue``), the batcher (``t_admit``), and the engine
-    (``t_execute``, ``t_reply``).
+    Timeline stamps are monotonic ``obs.clock.now()`` values filled in
+    by the queue (``t_enqueue``), the batcher (``t_admit``), and the
+    engine (``t_execute``, ``t_reply``); consecutive stamps bound the
+    segments ``queue_s`` / ``batch_wait_s`` / ``execute_s``, which
+    partition ``total_s`` exactly.
     """
 
     __slots__ = (
@@ -81,12 +93,12 @@ class Request:
     # -- engine side --------------------------------------------------- #
 
     def set_result(self, value: Any) -> None:
-        self.t_reply = time.perf_counter()
+        self.t_reply = clock.now()
         self._value = value
         self._done.set()
 
     def set_error(self, err: BaseException) -> None:
-        self.t_reply = time.perf_counter()
+        self.t_reply = clock.now()
         self._error = err
         self._done.set()
 
@@ -109,11 +121,17 @@ class Request:
     # -- timeline ------------------------------------------------------ #
 
     def stage_latencies_s(self) -> dict:
-        """{queue, execute, total} wall seconds (None-safe: requests that
-        were shed or errored mid-flight report what they have)."""
+        """{queue, batch_wait, execute, total} seconds (None-safe:
+        requests that were shed or errored mid-flight report what they
+        have). ``queue_s`` (enqueue→admit), ``batch_wait_s``
+        (admit→dispatch) and ``execute_s`` (dispatch→reply) partition
+        ``total_s`` exactly — the invariant
+        ``tools/tracereport.request_chains`` verifies per request."""
         out = {}
         if self.t_admit is not None:
             out["queue_s"] = self.t_admit - self.t_enqueue
+        if self.t_admit is not None and self.t_execute is not None:
+            out["batch_wait_s"] = self.t_execute - self.t_admit
         if self.t_execute is not None and self.t_reply is not None:
             out["execute_s"] = self.t_reply - self.t_execute
         if self.t_reply is not None:
@@ -158,28 +176,40 @@ class RequestQueue:
 
     def submit(self, payload: Any) -> Request:
         """Admit one request (raises :class:`ShedError` when full, or
-        ``RuntimeError`` after :meth:`close`)."""
+        ``RuntimeError`` after :meth:`close`). Admissions and sheds emit
+        ``serve:enqueue`` / ``serve:shed`` trace events carrying the
+        request id — the head of the request's trace chain."""
         with self._lock:
             if self._closed:
                 raise RuntimeError("queue is closed")
             if len(self._q) >= self.max_depth:
                 self.shed_count += 1
+                depth = len(self._q)
                 rate = self.drain_rate_hint
                 retry_after = (
-                    len(self._q) / rate if rate > 0
-                    else self.max_wait_ms / 1e3 * len(self._q) / self.max_batch
+                    depth / rate if rate > 0
+                    else self.max_wait_ms / 1e3 * depth / self.max_batch
                 )
-                raise ShedError(
-                    f"queue full ({len(self._q)}/{self.max_depth}); "
-                    f"retry after ~{retry_after:.3f}s",
-                    retry_after_s=retry_after,
-                )
-            req = Request(next(self._ids), payload)
-            req.t_enqueue = time.perf_counter()
-            self._q.append(req)
-            self.submitted_count += 1
-            self._not_empty.notify()
-            return req
+                shed_id = next(self._ids)
+            else:
+                req = Request(next(self._ids), payload)
+                req.t_enqueue = clock.now()
+                self._q.append(req)
+                self.submitted_count += 1
+                depth = len(self._q)
+                self._not_empty.notify()
+                shed_id = None
+        if shed_id is not None:
+            obs_trace.event("serve:shed", req=shed_id, depth=depth,
+                            retry_after_s=round(retry_after, 6))
+            raise ShedError(
+                f"queue full ({depth}/{self.max_depth}); "
+                f"retry after ~{retry_after:.3f}s",
+                retry_after_s=retry_after,
+            )
+        if obs_trace.enabled():
+            obs_trace.event("serve:enqueue", req=req.req_id, depth=depth)
+        return req
 
     def depth(self) -> int:
         with self._lock:
@@ -199,7 +229,7 @@ class RequestQueue:
         on ``timeout_s`` with nothing queued, or when closed and empty.
         """
         deadline = (
-            time.perf_counter() + timeout_s if timeout_s is not None else None
+            clock.now() + timeout_s if timeout_s is not None else None
         )
         with self._not_empty:
             while not self._q:
@@ -207,7 +237,7 @@ class RequestQueue:
                     return []
                 remaining = None
                 if deadline is not None:
-                    remaining = deadline - time.perf_counter()
+                    remaining = deadline - clock.now()
                     if remaining <= 0:
                         return []
                 self._not_empty.wait(remaining)
@@ -219,13 +249,13 @@ class RequestQueue:
                 len(self._q) < self.max_batch
                 and not self._closed
             ):
-                linger = batch_deadline - time.perf_counter()
+                linger = batch_deadline - clock.now()
                 if linger <= 0:
                     break
                 self._not_empty.wait(linger)
             n = min(len(self._q), self.max_batch)
             batch = [self._q.popleft() for _ in range(n)]
-        t_admit = time.perf_counter()
+        t_admit = clock.now()
         for req in batch:
             req.t_admit = t_admit
         return batch

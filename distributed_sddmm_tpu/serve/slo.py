@@ -31,12 +31,14 @@ import dataclasses
 import math
 import os
 import threading
-import time
+import time  # time.sleep only; clocks go through obs.clock
 from typing import Optional
 
 import numpy as np
 
+from distributed_sddmm_tpu.obs import clock
 from distributed_sddmm_tpu.obs import log as obs_log
+from distributed_sddmm_tpu.obs.telemetry import LatencyHistogram
 from distributed_sddmm_tpu.serve.queue import ShedError
 
 _PCTS = (50, 95, 99)
@@ -114,6 +116,33 @@ class SLOSpec:
                             "observed": round(got, 6)})
         return out
 
+    def burn_rate(self, summary: dict) -> float | None:
+        """Worst-axis error-budget burn rate for this spec over one
+        recorder summary (None when no constrained axis is measurable).
+
+        A ``pXX_ms`` target's budget is the ``(100-XX)%`` of requests
+        allowed above it; the observed bad fraction comes from the
+        summary's fixed-bucket ``request_hist`` so burn rates from
+        different processes/windows aggregate the way the histograms
+        do. ``err_rate``/``shed_rate`` budgets divide directly. 1.0 =
+        burning exactly at budget; >1 = on course to violate.
+        """
+        rates = []
+        hist = LatencyHistogram.from_dict(summary.get("request_hist"))
+        if hist is not None and hist.total:
+            for pct in _PCTS:
+                want = getattr(self, f"p{pct}_ms")
+                budget = 1.0 - pct / 100.0
+                if want is None or budget <= 0:
+                    continue
+                rates.append(hist.fraction_above(want) / budget)
+        for axis in ("err_rate", "shed_rate"):
+            want = getattr(self, axis)
+            got = summary.get(axis)
+            if want and got is not None:
+                rates.append(got / want)
+        return round(max(rates), 4) if rates else None
+
 
 class LatencyRecorder:
     """Thread-safe accumulator for one serving session's observations."""
@@ -122,9 +151,13 @@ class LatencyRecorder:
         self._lock = threading.Lock()
         self._total_s: list[float] = []
         self._queue_s: list[float] = []
+        self._batch_wait_s: list[float] = []
         self._execute_s: list[float] = []
         self._depth: list[int] = []
         self._occupancy: list[float] = []
+        #: Fixed-bucket total-latency histogram — the mergeable view
+        #: (sample-list percentiles above are exact but unmergeable).
+        self.hist = LatencyHistogram()
         self.completed = 0
         self.errors = 0
         self.degraded = 0
@@ -140,8 +173,11 @@ class LatencyRecorder:
                 self.degraded += 1
             if "total_s" in stages:
                 self._total_s.append(stages["total_s"])
+                self.hist.add(stages["total_s"] * 1e3)
             if "queue_s" in stages:
                 self._queue_s.append(stages["queue_s"])
+            if "batch_wait_s" in stages:
+                self._batch_wait_s.append(stages["batch_wait_s"])
             if "execute_s" in stages:
                 self._execute_s.append(stages["execute_s"])
 
@@ -176,11 +212,14 @@ class LatencyRecorder:
         with self._lock:
             total = list(self._total_s)
             queue = list(self._queue_s)
+            batch_wait = list(self._batch_wait_s)
             execute = list(self._execute_s)
             depth = list(self._depth)
             occ = list(self._occupancy)
             completed, errors = self.completed, self.errors
             shed, degraded = self.shed, self.degraded
+            hist = LatencyHistogram(self.hist.bounds_ms,
+                                    list(self.hist.counts))
         requests = completed + errors + shed
         out = {
             "requests": requests,
@@ -192,8 +231,14 @@ class LatencyRecorder:
             "shed_rate": shed / requests if requests else 0.0,
             "latency_ms": self._pct_ms(total),
             "queue_ms": self._pct_ms(queue),
+            "batch_wait_ms": self._pct_ms(batch_wait),
             "execute_ms": self._pct_ms(execute),
         }
+        if hist.total:
+            # The mergeable histogram view (bench-record fields the
+            # runstore index lifts into hist_p* columns).
+            out["request_hist"] = hist.to_dict()
+            out["latency_hist_ms"] = hist.percentiles_ms()
         if occ:
             out["batch_occupancy"] = {
                 "mean": round(sum(occ) / len(occ), 4),
@@ -271,9 +316,9 @@ def run_load(
                 oracle_failures[0] += 1
                 obs_log.error("serve", "oracle mismatch", req=req.req_id)
 
-    t0 = time.perf_counter()
+    t0 = clock.now()
     for i, t_arr in enumerate(arrivals):
-        delay = t0 + float(t_arr) - time.perf_counter()
+        delay = t0 + float(t_arr) - clock.now()
         if delay > 0:
             time.sleep(delay)
         payload = workload.sample_payload(rng)
@@ -294,7 +339,7 @@ def run_load(
 
     for w in waiters:
         w.join(reply_timeout_s)
-    elapsed = time.perf_counter() - t0
+    elapsed = clock.now() - t0
 
     summary = rec.summary()
     summary.update({
@@ -309,4 +354,7 @@ def run_load(
     })
     summary["slo"] = slo.to_dict()
     summary["slo_violations"] = slo.check(summary)
+    # Error-budget burn rate (None when the spec constrains nothing):
+    # the live-telemetry axis `bench gate` regresses run over run.
+    summary["burn_rate"] = slo.burn_rate(summary)
     return summary

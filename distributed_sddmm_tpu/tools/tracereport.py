@@ -14,7 +14,19 @@ Consumes the JSONL traces ``obs/trace.py`` emits (plus the sibling
   check the source paper runs between measured and modeled volume; a
   mismatch means either the layout math or the model drifted;
 * an **events summary** — faults fired (by kind), retries, guard
-  repairs, checkpoints, autotune trials/cache hits.
+  repairs, checkpoints, autotune trials/cache hits;
+* a **request-chain reconstruction** (serving traces) — every request
+  id minted at enqueue is followed through its ``serve:enqueue`` event,
+  the ``serve:batch`` span whose ``req_ids`` carried it, and its
+  ``serve:reply`` event; the reply's ``queue_s``/``batch_wait_s``/
+  ``execute_s`` segments must sum to its ``total_s`` (the stamps
+  partition the timeline exactly — a chain violating the 1 ms band is
+  reported as inconsistent);
+* a **program-store section** — ``program_store_hit`` /
+  ``program_store_compile`` events aggregated into disk-warm vs
+  live-compile counts and total compile seconds, plus a per-phase
+  ``xla_flops``/``xla_ratio`` column comparing the analytic FLOP count
+  against XLA's own ``cost_analysis`` of the op's compiled programs.
 
 CLI::
 
@@ -126,6 +138,181 @@ def _strategy_meta(events: list) -> dict | None:
     return metas[-1] if metas else None
 
 
+#: Tolerance for a request chain's segment-sum vs its recorded
+#: end-to-end latency: the stamps partition the timeline exactly, so
+#: anything beyond float rounding is a broken chain.
+REQUEST_CHAIN_TOL_S = 1e-3
+
+_CHAIN_SEGMENTS = ("queue_s", "batch_wait_s", "execute_s")
+
+
+def _req_key(rec: dict, req) -> tuple:
+    """Request correlation key: (shard, req_id) — merged multi-process
+    traces tag records with their source shard, under which each
+    process's ids are unique."""
+    return (rec.get("shard"), req)
+
+
+def request_chains(trace: dict) -> dict:
+    """Reconstruct per-request serving timelines from the trace alone.
+
+    Returns ``{"requests": {key: chain}, "complete", "incomplete",
+    "inconsistent", "shed"}`` where a chain is ``{"req", "t_enqueue",
+    "t_reply", "segments", "total_s", "batch_span", "degraded",
+    "complete", "consistent"}``. A chain is *complete* when its
+    enqueue event, a batch span listing it, and its reply event are all
+    present; *consistent* when the reply's segments sum to its
+    ``total_s`` within :data:`REQUEST_CHAIN_TOL_S` AND the trace-level
+    enqueue→reply distance agrees too.
+    """
+    chains: dict[tuple, dict] = {}
+    shed = 0
+    for ev in trace["events"]:
+        a = ev["attrs"]
+        if ev["name"] == "serve:enqueue":
+            ch = chains.setdefault(_req_key(ev, a.get("req")), {})
+            ch["req"] = a.get("req")
+            ch["t_enqueue"] = ev["t"]
+        elif ev["name"] == "serve:reply":
+            ch = chains.setdefault(_req_key(ev, a.get("req")), {})
+            ch["req"] = a.get("req")
+            # Prefer the precise embedded stamps: the event's own `t`
+            # is its emission instant, which can lag the reply by a
+            # thread-scheduling delay (the client wakes on set_result
+            # before the runner reaches the emit call).
+            ch["t_reply"] = a.get("t_reply", ev["t"])
+            if a.get("t_enqueue") is not None:
+                ch["t_enqueue"] = a["t_enqueue"]
+            ch["segments"] = {
+                k: a[k] for k in (*_CHAIN_SEGMENTS, "pad_s") if k in a
+            }
+            ch["total_s"] = a.get("total_s")
+            ch["degraded"] = a.get("degraded", False)
+        elif ev["name"] == "serve:shed":
+            shed += 1
+    for sp in trace["spans"]:
+        if sp["name"] != "serve:batch":
+            continue
+        for req in sp["attrs"].get("req_ids") or ():
+            ch = chains.setdefault(_req_key(sp, req), {})
+            ch.setdefault("req", req)
+            ch["batch_span"] = sp["id"]
+            # The pad sub-segment of execute_s is a property of the
+            # dispatch, so the engine records it on the batch span —
+            # join it into every member request's decomposition (it is
+            # informational, not part of the partition sum).
+            if sp["attrs"].get("pad_s") is not None:
+                ch.setdefault("segments", {}).setdefault(
+                    "pad_s", sp["attrs"]["pad_s"]
+                )
+    complete = incomplete = inconsistent = 0
+    for ch in chains.values():
+        ch["complete"] = all(
+            k in ch for k in ("t_enqueue", "t_reply", "batch_span",
+                              "total_s")
+        ) and ch.get("total_s") is not None
+        consistent = False
+        if ch["complete"]:
+            seg_sum = sum(
+                ch["segments"].get(k, 0.0) for k in _CHAIN_SEGMENTS
+            )
+            consistent = (
+                abs(seg_sum - ch["total_s"]) <= REQUEST_CHAIN_TOL_S
+                and abs((ch["t_reply"] - ch["t_enqueue"]) - ch["total_s"])
+                <= REQUEST_CHAIN_TOL_S
+            )
+        ch["consistent"] = consistent
+        if not ch["complete"]:
+            incomplete += 1
+        elif consistent:
+            complete += 1
+        else:
+            inconsistent += 1
+    return {
+        "requests": chains,
+        "complete": complete,
+        "incomplete": incomplete,
+        "inconsistent": inconsistent,
+        "shed": shed,
+    }
+
+
+def _request_summary(trace: dict) -> dict | None:
+    """The aggregate's ``requests`` block (None for non-serving
+    traces): chain counts plus mean segment decomposition."""
+    chains = request_chains(trace)
+    if not chains["requests"] and not chains["shed"]:
+        return None
+    seg_tot: dict[str, float] = {}
+    n = 0
+    for ch in chains["requests"].values():
+        if not ch.get("complete"):
+            continue
+        n += 1
+        for k, v in (ch.get("segments") or {}).items():
+            seg_tot[k] = seg_tot.get(k, 0.0) + v
+    out = {
+        "total": len(chains["requests"]),
+        "complete": chains["complete"],
+        "incomplete": chains["incomplete"],
+        "inconsistent": chains["inconsistent"],
+        "shed": chains["shed"],
+    }
+    if n:
+        out["mean_segments_ms"] = {
+            k: round(v / n * 1e3, 3) for k, v in sorted(seg_tot.items())
+        }
+    return out
+
+
+def _program_store_summary(events: list) -> dict | None:
+    """Disk-warm vs live-compile attribution from the program-store
+    trace events (None when the store emitted nothing)."""
+    hits = [e for e in events if e["name"] == "program_store_hit"]
+    compiles = [e for e in events if e["name"] == "program_store_compile"]
+    if not hits and not compiles:
+        return None
+    return {
+        "disk_hits": len(hits),
+        "live_compiles": len(compiles),
+        "compile_s": round(
+            sum(e["attrs"].get("compile_s", 0.0) for e in compiles), 6
+        ),
+        "keys_compiled": sorted(
+            {str(e["attrs"].get("key")) for e in compiles}
+        ),
+    }
+
+
+def _xla_flops_by_phase(events: list, phases: dict) -> None:
+    """Attach ``xla_flops``/``xla_ratio`` columns to phases whose
+    compiled programs reported a cost analysis (the analytic-vs-XLA
+    agreement column; matching mirrors ``programs.xla_cost_summary``)."""
+    from distributed_sddmm_tpu.programs.store import _OP_KEY_TOKENS
+
+    per_key: dict[str, float] = {}
+    for e in events:
+        if e["name"] in ("program_store_hit", "program_store_compile"):
+            fl = e["attrs"].get("xla_flops")
+            if fl:
+                per_key[str(e["attrs"].get("key"))] = float(fl)
+    if not per_key:
+        return
+    for name, ph in phases.items():
+        if not ph.get("calls") or not ph.get("flops"):
+            continue
+        tokens = set(_OP_KEY_TOKENS.get(name, (name,)))
+        matched = [
+            fl for key, fl in per_key.items()
+            if tokens & set(key.replace(":", "-").split("-"))
+        ]
+        if not matched:
+            continue
+        xla = sum(matched) / len(matched)
+        ph["xla_flops"] = xla
+        ph["xla_ratio"] = round(ph["flops"] / ph["calls"] / xla, 6)
+
+
 def _model_words_per_pair(meta: dict) -> float | None:
     from distributed_sddmm_tpu.tools import costmodel
 
@@ -180,6 +367,8 @@ def aggregate(trace: dict) -> dict:
                 if ph["model_words"] else None
             )
 
+    _xla_flops_by_phase(trace["events"], phases)
+
     ev_counts = collections.Counter(e["name"] for e in trace["events"])
     fault_kinds = collections.Counter(
         e["attrs"].get("kind", "?")
@@ -192,6 +381,15 @@ def aggregate(trace: dict) -> dict:
         "events": dict(sorted(ev_counts.items())),
         "faults_by_kind": dict(sorted(fault_kinds.items())),
     }
+    shards = (trace["begin"] or {}).get("shards")
+    if shards:
+        summary["shards"] = len(shards)
+    requests = _request_summary(trace)
+    if requests:
+        summary["requests"] = requests
+    programs = _program_store_summary(trace["events"])
+    if programs:
+        summary["program_store"] = programs
     return summary
 
 
@@ -220,6 +418,14 @@ def render(report: dict) -> str:
             f"{(model / 1e6 if model is not None else float('nan')):>9.3f} "
             f"{ph['flops'] / 1e9:>8.3f}"
         )
+    xla_rows = [
+        (name, ph) for name, ph in report["phases"].items()
+        if ph.get("xla_ratio") is not None
+    ]
+    if xla_rows:
+        lines.append("analytic/XLA flops: " + ", ".join(
+            f"{name}={ph['xla_ratio']:.3f}" for name, ph in xla_rows
+        ))
     if report["events"]:
         lines.append("events: " + ", ".join(
             f"{k}={v}" for k, v in report["events"].items()
@@ -228,6 +434,23 @@ def render(report: dict) -> str:
         lines.append("faults by kind: " + ", ".join(
             f"{k}={v}" for k, v in report["faults_by_kind"].items()
         ))
+    req = report.get("requests")
+    if req:
+        seg = req.get("mean_segments_ms") or {}
+        lines.append(
+            f"requests: {req['complete']}/{req['total']} complete chains"
+            f" ({req['inconsistent']} inconsistent, "
+            f"{req['incomplete']} incomplete, {req['shed']} shed)"
+            + ("; mean " + " ".join(
+                f"{k[:-2]}={v}ms" for k, v in seg.items()) if seg else "")
+        )
+    ps = report.get("program_store")
+    if ps:
+        lines.append(
+            f"program store: {ps['disk_hits']} disk hit(s), "
+            f"{ps['live_compiles']} live compile(s) "
+            f"({ps['compile_s']:.3f}s compiling)"
+        )
     return "\n".join(lines)
 
 

@@ -43,6 +43,16 @@ def main() -> None:
 
     faults.maybe_kill("mp_worker:start")
 
+    # Per-process trace shard: when the parent traces (DSDDMM_TRACE in the
+    # inherited env — a traced parent exports its shard directory), this
+    # worker writes its own <run_id>.jsonl shard there; `bench trace-merge`
+    # offset-aligns the shards back into one timeline. The event both
+    # activates the env-configured tracer and stamps which process this
+    # shard belongs to.
+    from distributed_sddmm_tpu.obs import trace as obs_trace
+
+    obs_trace.event("mp_worker:start", process=pid, pid_os=os.getpid())
+
     jax.distributed.initialize(
         coordinator_address=f"127.0.0.1:{port}", num_processes=2, process_id=pid,
         initialization_timeout=int(os.environ.get("DSDDMM_MP_INIT_TIMEOUT", 300)),
@@ -72,6 +82,8 @@ def main() -> None:
     # about to be reported — a kill here models losing a worker between a
     # completed step and its checkpoint.
     faults.maybe_kill("mp_worker:post_compute")
+    obs_trace.event("mp_worker:done", process=pid)
+    obs_trace.disable()  # flush the shard before the result line
     print(json.dumps({"pid": pid, "fp_out": fp_out, "fp_mid": fp_mid}),
           flush=True)
 

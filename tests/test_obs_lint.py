@@ -1,10 +1,18 @@
-"""Lint: no bare ``print(`` in library code.
+"""Lints: no bare ``print(`` in library code; monotonic clock discipline.
 
 Diagnostics go through ``obs.log`` (structured, level-gated, mirrored
 into traces); only allowlisted CLI modules — whose *product* is stdout
 text — and lines explicitly tagged ``# cli-output`` may print. This is
 what keeps the structured-logging satellite from regressing one stray
 debug print at a time.
+
+The second lint is the same mechanism pointed at clocks: raw
+``time.time()`` / ``time.perf_counter()`` calls are forbidden in
+``serve/`` and ``obs/`` — every span path reads ``obs.clock`` (one
+calibrated monotonic/wall pair per process) so trace timestamps stay
+mergeable across processes and a wall-clock step can never produce a
+negative duration. ``obs/clock.py`` itself is the allowlist, and a line
+tagged ``# wall-clock-ok`` opts out deliberately.
 """
 
 import pathlib
@@ -28,28 +36,68 @@ ALLOWLIST = {
 _PRINT_RE = re.compile(r"(?<![\w.\"'`])print\(")
 
 
+def _code_lines(path):
+    """(lineno, line) pairs with docstrings and comment lines skipped —
+    the shared scanner both lints use."""
+    in_doc = False
+    for ln, line in enumerate(path.read_text().splitlines(), 1):
+        stripped = line.strip()
+        # Cheap docstring tracking: toggle on triple quotes so prose
+        # mentioning a forbidden call does not count.
+        if stripped.count('"""') % 2 == 1:
+            in_doc = not in_doc
+            continue
+        if in_doc or stripped.startswith("#"):
+            continue
+        yield ln, line
+
+
 def test_no_bare_print_outside_cli_modules():
     violations = []
     for path in sorted(PKG.rglob("*.py")):
         rel = path.relative_to(PKG).as_posix()
         if rel in ALLOWLIST:
             continue
-        in_doc = False
-        for ln, line in enumerate(path.read_text().splitlines(), 1):
-            stripped = line.strip()
-            # Cheap docstring tracking: toggle on triple quotes so prose
-            # mentioning print( does not count.
-            if stripped.count('"""') % 2 == 1:
-                in_doc = not in_doc
-                continue
-            if in_doc or stripped.startswith("#"):
-                continue
+        for ln, line in _code_lines(path):
             if "# cli-output" in line:
                 continue
             if _PRINT_RE.search(line):
-                violations.append(f"{rel}:{ln}: {stripped[:70]}")
+                violations.append(f"{rel}:{ln}: {line.strip()[:70]}")
     assert not violations, (
         "bare print( in library code — use distributed_sddmm_tpu.obs.log "
         "(or tag deliberate CLI output with '# cli-output'):\n"
         + "\n".join(violations)
+    )
+
+
+#: Modules allowed to touch the raw clocks: the clock module IS the
+#: abstraction (everything else in serve/ and obs/ reads it).
+CLOCK_ALLOWLIST = {"obs/clock.py"}
+
+#: A raw wall/monotonic clock read (time.monotonic included — a third
+#: clock sneaking in would defeat the one-calibration-pair discipline).
+_CLOCK_RE = re.compile(r"\btime\.(time|perf_counter|monotonic)\(")
+
+
+def test_monotonic_clock_discipline_in_span_paths():
+    """serve/ and obs/ span paths read ``obs.clock``, not ``time.*``:
+    one calibrated clock pair per process is what makes multi-process
+    trace shards offset-alignable and keeps wall-clock steps out of
+    durations. ``# wall-clock-ok`` tags the deliberate exceptions."""
+    violations = []
+    for sub in ("serve", "obs"):
+        for path in sorted((PKG / sub).rglob("*.py")):
+            rel = path.relative_to(PKG).as_posix()
+            if rel in CLOCK_ALLOWLIST:
+                continue
+            for ln, line in _code_lines(path):
+                if "# wall-clock-ok" in line:
+                    continue
+                if _CLOCK_RE.search(line):
+                    violations.append(f"{rel}:{ln}: {line.strip()[:70]}")
+    assert not violations, (
+        "raw clock call in a serve/obs span path — read "
+        "distributed_sddmm_tpu.obs.clock (now()/epoch()) so timestamps "
+        "stay calibrated and mergeable, or tag a deliberate exception "
+        "with '# wall-clock-ok':\n" + "\n".join(violations)
     )

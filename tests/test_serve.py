@@ -106,8 +106,13 @@ class TestQueue:
         got.t_execute = time.perf_counter()
         got.set_result("ok")
         lat = req.stage_latencies_s()
-        assert set(lat) == {"queue_s", "execute_s", "total_s"}
+        assert set(lat) == {"queue_s", "batch_wait_s", "execute_s",
+                            "total_s"}
         assert lat["total_s"] >= lat["queue_s"] >= 0.0
+        # The segments partition total exactly — the invariant the
+        # trace-side request chains verify.
+        assert lat["queue_s"] + lat["batch_wait_s"] + lat["execute_s"] \
+            == pytest.approx(lat["total_s"], abs=1e-9)
 
 
 # --------------------------------------------------------------------- #

@@ -1,0 +1,163 @@
+"""Request-scoped tracing through the serving path.
+
+The tentpole contract: a request id minted at enqueue is carried
+through queue admission, batch formation, dispatch and reply, and
+``tools/tracereport.request_chains`` reconstructs every non-shed
+request's enqueue→reply timeline from the trace ALONE — with the
+``queue_s``/``batch_wait_s``/``execute_s`` segments summing to the
+request's recorded end-to-end latency within 1 ms (they partition the
+timeline exactly, so the band is float-rounding slack, not tolerance
+for missing time).
+"""
+
+import numpy as np
+import pytest
+
+from distributed_sddmm_tpu.obs import trace
+from distributed_sddmm_tpu.tools import tracereport
+
+
+@pytest.fixture(scope="module")
+def als_workload():
+    from distributed_sddmm_tpu.models.als import DistributedALS
+    from distributed_sddmm_tpu.parallel.dense_shift_15d import DenseShift15D
+    from distributed_sddmm_tpu.serve import ALSFoldInTopK
+    from distributed_sddmm_tpu.utils.coo import HostCOO
+
+    S = HostCOO.erdos_renyi(64, 48, 4, seed=7, values="normal")
+    alg = DenseShift15D(S, R=8, c=1, fusion_approach=2)
+    model = DistributedALS(alg, S_host=S)
+    model.run_cg(1, cg_iters=2)
+    return ALSFoldInTopK(model, k=4, item_buckets=(4,))
+
+
+@pytest.fixture
+def tracer(tmp_path, monkeypatch):
+    monkeypatch.delenv("DSDDMM_TRACE", raising=False)
+    trace.disable()
+    tr = trace.enable(tmp_path / "serve.jsonl")
+    yield tr
+    trace.disable()
+
+
+def _load(tr):
+    trace.disable()
+    return tracereport.load_trace(tr.path, strict=True)
+
+
+class TestRequestChains:
+    def test_every_request_reconstructs_within_1ms(
+        self, als_workload, tracer
+    ):
+        from distributed_sddmm_tpu.serve import ServingEngine
+
+        engine = ServingEngine(
+            als_workload, max_batch=4, max_depth=32, max_wait_ms=2.0
+        )
+        rng = np.random.default_rng(3)
+        payloads = [als_workload.sample_payload(rng) for _ in range(8)]
+        engine.start(warmup=False)
+        try:
+            reqs = [engine.submit(p) for p in payloads]
+            for r in reqs:
+                r.result(timeout_s=60.0)
+        finally:
+            engine.stop()
+        loaded = _load(tracer)
+
+        chains = tracereport.request_chains(loaded)
+        assert len(chains["requests"]) == len(payloads)
+        assert chains["complete"] == len(payloads)
+        assert chains["inconsistent"] == 0
+        assert chains["incomplete"] == 0
+        for ch in chains["requests"].values():
+            seg = ch["segments"]
+            seg_sum = seg["queue_s"] + seg["batch_wait_s"] + seg["execute_s"]
+            assert seg_sum == pytest.approx(ch["total_s"], abs=1e-3)
+            # The chain is anchored in trace time too: enqueue event →
+            # reply event distance agrees with the recorded latency.
+            assert (ch["t_reply"] - ch["t_enqueue"]) == pytest.approx(
+                ch["total_s"], abs=1e-3
+            )
+
+    def test_batch_spans_link_member_request_ids(
+        self, als_workload, tracer
+    ):
+        from distributed_sddmm_tpu.serve import ServingEngine
+
+        engine = ServingEngine(
+            als_workload, max_batch=4, max_depth=32, max_wait_ms=2.0
+        )
+        rng = np.random.default_rng(4)
+        engine.start(warmup=False)
+        try:
+            reqs = [engine.submit(als_workload.sample_payload(rng))
+                    for _ in range(5)]
+            for r in reqs:
+                r.result(timeout_s=60.0)
+        finally:
+            engine.stop()
+        loaded = _load(tracer)
+
+        batch_spans = [s for s in loaded["spans"]
+                       if s["name"] == "serve:batch"]
+        assert batch_spans
+        linked = set()
+        for sp in batch_spans:
+            ids = sp["attrs"]["req_ids"]
+            assert isinstance(ids, list) and ids
+            assert "pad_s" in sp["attrs"]  # pad sub-segment attributed
+            linked.update(ids)
+        assert linked == {r.req_id for r in reqs}
+
+    def test_shed_requests_emit_shed_events_not_chains(
+        self, als_workload, tracer
+    ):
+        from distributed_sddmm_tpu.serve import ServingEngine, ShedError
+
+        engine = ServingEngine(
+            als_workload, max_batch=2, max_depth=2, max_wait_ms=1.0
+        )
+        rng = np.random.default_rng(5)
+        shed = 0
+        for _ in range(5):  # no runner draining: 3 of 5 must shed
+            try:
+                engine.submit(als_workload.sample_payload(rng))
+            except ShedError:
+                shed += 1
+        engine.queue.close()
+        loaded = _load(tracer)
+        assert shed == 3
+        shed_events = [e for e in loaded["events"]
+                       if e["name"] == "serve:shed"]
+        assert len(shed_events) == 3
+        assert all(e["attrs"]["retry_after_s"] >= 0 for e in shed_events)
+        chains = tracereport.request_chains(loaded)
+        assert chains["shed"] == 3
+        # Shed requests never became chains (they hold no reply).
+        assert all(not ch.get("t_reply")
+                   for ch in chains["requests"].values())
+
+    def test_aggregate_carries_request_summary(self, als_workload, tracer):
+        from distributed_sddmm_tpu.serve import ServingEngine
+
+        engine = ServingEngine(
+            als_workload, max_batch=4, max_depth=16, max_wait_ms=1.0
+        )
+        rng = np.random.default_rng(6)
+        engine.start(warmup=False)
+        try:
+            reqs = [engine.submit(als_workload.sample_payload(rng))
+                    for _ in range(3)]
+            for r in reqs:
+                r.result(timeout_s=60.0)
+        finally:
+            engine.stop()
+        loaded = _load(tracer)
+        report = tracereport.aggregate(loaded)
+        req = report["requests"]
+        assert req["total"] == 3 and req["complete"] == 3
+        assert req["inconsistent"] == 0
+        assert "queue_s" in req["mean_segments_ms"]
+        # The renderer mentions the chains.
+        assert "complete chains" in tracereport.render(report)

@@ -1,0 +1,279 @@
+"""Live telemetry: histogram percentiles, burn rate, sampler, gate axes.
+
+The PR-7 contract surfaces:
+
+* fixed-bucket histogram percentiles and threshold fractions;
+* SLO error-budget burn rate (worst axis; histogram-derived);
+* the sampler thread writes self-contained JSONL snapshots `bench top`
+  renders;
+* `bench gate` evaluates the two new verdict axes — SLO burn rate and
+  analytic-vs-XLA FLOP agreement — under the existing 0/2/3 exit-code
+  contract, while docs WITHOUT the new fields (pre-PR-7, store-disabled)
+  produce "not-measured", never a spurious missing-verdict failure;
+* the runstore index gains histogram-percentile and burn-rate columns,
+  None-tolerant for old docs (backfill hygiene).
+"""
+
+import json
+import time
+
+import pytest
+
+from distributed_sddmm_tpu.obs import regress, telemetry
+from distributed_sddmm_tpu.obs.store import RunStore
+from distributed_sddmm_tpu.obs.telemetry import LatencyHistogram
+from distributed_sddmm_tpu.serve.slo import SLOSpec
+
+
+def _hist(values_ms):
+    h = LatencyHistogram()
+    for v in values_ms:
+        h.add(v)
+    return h
+
+
+class TestHistogram:
+    def test_quantiles_nearest_rank_upper_bound(self):
+        h = _hist([0.1] * 98 + [400.0, 400.0])
+        assert h.quantile_ms(50) == 0.25  # first bucket's upper bound
+        assert h.quantile_ms(99) == 500.0  # the 400ms bucket's bound
+        assert h.total == 100
+
+    def test_fraction_above(self):
+        h = _hist([1.0] * 95 + [300.0] * 5)
+        # 300ms sits in the (250, 500] bucket, entirely above 100ms.
+        assert h.fraction_above(100.0) == pytest.approx(0.05)
+        assert h.fraction_above(1000.0) == 0.0
+
+    def test_round_trip(self):
+        h = _hist([3.0, 70.0, 45000.0, 999999.0])
+        h2 = LatencyHistogram.from_dict(json.loads(json.dumps(h.to_dict())))
+        assert h2 == h
+        assert LatencyHistogram.from_dict(None) is None
+        assert LatencyHistogram.from_dict({"bogus": 1}) is None
+
+
+class TestBurnRate:
+    def test_latency_budget_burn(self):
+        # 5% of requests above the p99 target → 5x the 1% budget.
+        summary = {"request_hist": _hist([1.0] * 95 + [300.0] * 5).to_dict()}
+        spec = SLOSpec(p99_ms=100.0)
+        assert spec.burn_rate(summary) == pytest.approx(5.0)
+
+    def test_within_budget(self):
+        summary = {"request_hist": _hist([1.0] * 100).to_dict()}
+        assert SLOSpec(p99_ms=100.0).burn_rate(summary) == 0.0
+
+    def test_worst_axis_wins(self):
+        summary = {
+            "request_hist": _hist([1.0] * 100).to_dict(),
+            "err_rate": 0.02, "shed_rate": 0.0,
+        }
+        spec = SLOSpec(p99_ms=100.0, err_rate=0.01, shed_rate=0.5)
+        assert spec.burn_rate(summary) == pytest.approx(2.0)
+
+    def test_unconstrained_spec_is_none(self):
+        assert SLOSpec().burn_rate({"request_hist": _hist([1]).to_dict()}) \
+            is None
+
+
+class _StubQueue:
+    max_depth = 8
+    submitted_count = 12
+    shed_count = 2
+
+    def depth(self):
+        return 4
+
+
+class _StubRecorder:
+    def summary(self):
+        return {
+            "requests": 12, "completed": 9, "errors": 1, "shed_count": 2,
+            "degraded_count": 0,
+            "err_rate": 1 / 12, "shed_rate": 2 / 12,
+            "request_hist": _hist([2.0] * 9).to_dict(),
+            "latency_hist_ms": {"p50": 2.0, "p95": 2.0, "p99": 2.0},
+            "batch_occupancy": {"mean": 0.75},
+        }
+
+
+class _StubEngine:
+    queue = _StubQueue()
+    recorder = _StubRecorder()
+
+    def stats(self):
+        return {"cache_hits": 5, "cache_misses": 1, "disk_hits": 1,
+                "live_compiles": 0}
+
+
+class TestSampler:
+    def test_snapshot_shape(self, tmp_path):
+        s = telemetry.TelemetrySampler(
+            _StubEngine(), out_dir=tmp_path, slo=SLOSpec(err_rate=0.01),
+            run_id="tst",
+        )
+        snap = s.snapshot()
+        assert snap["queue_depth"] == 4 and snap["queue_capacity"] == 8
+        assert snap["depth_frac"] == 0.5
+        assert snap["completed"] == 9 and snap["shed"] == 2
+        assert snap["latency_hist_ms"]["p99"] == 2.0
+        assert snap["burn_rate"] == pytest.approx((1 / 12) / 0.01, rel=1e-3)
+        assert snap["program_store"]["live_compiles"] == 0
+
+    def test_sampler_writes_parseable_lines(self, tmp_path):
+        s = telemetry.TelemetrySampler(
+            _StubEngine(), interval_s=0.02, out_dir=tmp_path, run_id="tst2"
+        )
+        with s:
+            time.sleep(0.1)
+        snaps = telemetry.read_snapshots(s.path)
+        assert len(snaps) >= 1  # stop() always lands a final snapshot
+        assert all(sn["run_id"] == "tst2" for sn in snaps)
+        assert telemetry.newest_stream(tmp_path) == s.path
+
+    def test_render_top(self, tmp_path):
+        s = telemetry.TelemetrySampler(
+            _StubEngine(), out_dir=tmp_path, slo=SLOSpec(err_rate=0.01),
+            run_id="tst3",
+        )
+        text = telemetry.render_top([s.snapshot(), s.snapshot()])
+        assert "queue" in text and "p99" in text and "slo burn" in text
+        assert telemetry.render_top([]) == "no telemetry samples yet"
+
+    def test_bench_top_cli(self, tmp_path, capsys):
+        from distributed_sddmm_tpu.bench import cli
+
+        s = telemetry.TelemetrySampler(
+            _StubEngine(), out_dir=tmp_path, run_id="tst4"
+        )
+        s._emit()
+        assert cli.main(["top", str(s.path)]) == 0
+        out = capsys.readouterr().out
+        assert "requests" in out
+
+
+# --------------------------------------------------------------------- #
+# Gate axes (acceptance: burn rate + XLA FLOP agreement under 0/2/3)
+# --------------------------------------------------------------------- #
+
+
+def _doc(run_id, burn=None, xla_ratio=None, p99=10.0, key="k1"):
+    rec = {
+        "app": "serve-als", "algorithm": "15d_fusion2", "R": 16, "c": 1,
+        "fused": True, "kernel": "xla", "requests": 100,
+        "shed_rate": 0.0, "shed_count": 0,
+        "latency_ms": {"p50": p99 / 2, "p99": p99},
+        "latency_hist_ms": {"p50": 5.0, "p95": 9.0, "p99": p99},
+        "metrics": {},
+    }
+    # Every doc carries the per-op metrics (pre- and post-PR-7 alike);
+    # only the OPTIONAL xla_cost/burn_rate fields vary.
+    rec["metrics"] = {"fusedSpMM": {"calls": 10, "flops": 1e9 * 10,
+                                    "kernel_s": 1.0}}
+    if burn is not None:
+        rec["burn_rate"] = burn
+    if xla_ratio is not None:
+        rec["xla_cost"] = {"programs": 1, "ops": {"fusedSpMM": {
+            "flops_per_call": 1e9 / xla_ratio, "programs": 1}}}
+    return {"run_id": run_id, "key": key, "backend": "cpu",
+            "code_hash": "c1", "record": rec}
+
+
+class TestGateAxes:
+    def test_burn_rate_axis_exists_and_regresses(self, tmp_path):
+        store = RunStore(tmp_path)
+        for i in range(3):
+            store.put(_doc(f"b{i}", burn=0.5))
+        bad = _doc("new", burn=3.0)
+        store.put(bad)
+        code, report = regress.gate(store, bad, k=3)
+        assert code == regress.GATE_REGRESSION
+        assert "serve:burn_rate" in report["regressions"]
+        assert report["phases"]["serve:burn_rate"]["attribution"] == "serving"
+
+    def test_burn_rate_steady_passes(self, tmp_path):
+        store = RunStore(tmp_path)
+        for i in range(3):
+            store.put(_doc(f"b{i}", burn=0.5))
+        ok = _doc("new", burn=0.55)
+        store.put(ok)
+        code, _ = regress.gate(store, ok, k=3)
+        assert code == regress.GATE_PASS
+
+    def test_xla_agreement_axis_regresses_on_drift(self, tmp_path):
+        store = RunStore(tmp_path)
+        for i in range(3):
+            store.put(_doc(f"b{i}", xla_ratio=0.8))
+        drifted = _doc("new", xla_ratio=1.6)  # analytic count doubled
+        store.put(drifted)
+        code, report = regress.gate(store, drifted, k=3)
+        assert code == regress.GATE_REGRESSION
+        assert "xla:fusedSpMM_flops" in report["regressions"]
+        assert (report["phases"]["xla:fusedSpMM_flops"]["attribution"]
+                == "xla-cost")
+
+    def test_xla_agreement_stable_passes(self, tmp_path):
+        store = RunStore(tmp_path)
+        for i in range(3):
+            store.put(_doc(f"b{i}", xla_ratio=0.8))
+        ok = _doc("new", xla_ratio=0.82)
+        store.put(ok)
+        code, _ = regress.gate(store, ok, k=3)
+        assert code == regress.GATE_PASS
+
+    def test_old_doc_without_new_axes_is_not_missing(self, tmp_path):
+        """Backfill hygiene: judging a doc WITHOUT burn/xla fields
+        against a baseline WITH them must not fail the gate — the axes
+        read "not-measured", not "missing"."""
+        store = RunStore(tmp_path)
+        for i in range(3):
+            store.put(_doc(f"b{i}", burn=0.5, xla_ratio=0.8))
+        old = _doc("old-style")  # no burn_rate, no xla_cost
+        store.put(old)
+        code, report = regress.gate(store, old, k=3)
+        assert code == regress.GATE_PASS
+        assert report["missing"] == []
+        assert (report["phases"]["serve:burn_rate"]["verdict"]
+                == "not-measured")
+        assert (report["phases"]["xla:fusedSpMM_flops"]["verdict"]
+                == "not-measured")
+        # A real vanished phase still fails (the optional-axis carve-out
+        # is narrow).
+        assert regress._optional_axis("serve:latency_p99") is False
+
+
+class TestStoreColumns:
+    def test_index_carries_hist_and_burn_columns(self, tmp_path):
+        store = RunStore(tmp_path)
+        store.put(_doc("a", burn=1.25))
+        (row,) = store.index()
+        assert row["hist_p50_ms"] == 5.0
+        assert row["hist_p95_ms"] == 9.0
+        assert row["hist_p99_ms"] == 10.0
+        assert row["burn_rate"] == 1.25
+
+    def test_old_docs_read_none_not_crash(self, tmp_path):
+        store = RunStore(tmp_path)
+        store.put({"run_id": "pre7", "key": "k", "backend": "cpu",
+                   "record": {"app": "vanilla", "metrics": {}}})
+        (row,) = store.index()
+        assert row["hist_p99_ms"] is None and row["burn_rate"] is None
+        # history renders without the fields.
+        assert "pre7" in regress.render_history(store.history())
+
+    def test_watchdog_flags_xla_disagreement(self):
+        from distributed_sddmm_tpu.obs.watchdog import Watchdog
+
+        wd = Watchdog(mode="warn")
+        metrics = {"fusedSpMM": {"calls": 10, "flops": 1e10}}
+        # Counted (1e9/call) far above XLA's claim (5e8/call).
+        wd.check_xla_costs(metrics, {"fusedSpMM": {
+            "flops_per_call": 5e8}})
+        assert wd.events and wd.events[0]["kind"] == "xla_flop_mismatch"
+        assert wd.events[0]["direction"] == "counted_exceeds_xla"
+        # Agreement within band: no anomaly.
+        wd2 = Watchdog(mode="warn")
+        wd2.check_xla_costs(metrics, {"fusedSpMM": {
+            "flops_per_call": 1.1e9}})
+        assert wd2.events == []

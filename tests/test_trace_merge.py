@@ -1,0 +1,233 @@
+"""Shardable traces: reroute footgun, offset-aligned merge, round-trip.
+
+Covers the PR-7 multi-process trace story:
+
+* an explicit ``--trace PATH.jsonl`` owned by another LIVE process
+  reroutes this process into ``PATH.shards/<run_id>.jsonl`` instead of
+  truncating/interleaving (the multi-process footgun fix), and enabling
+  with an explicit file exports the shard directory to children via
+  ``DSDDMM_TRACE`` (restored on disable);
+* ``obs.tracemerge`` merges shards with skewed clock origins into ONE
+  monotonic, schema-valid trace (ids disjoint, parents rewritten,
+  offsets applied from each begin record's ``t0_epoch`` header);
+* histogram merge is associative and commutative (the property that
+  makes multi-process latency aggregation meaningful at all);
+* the merged file round-trips through ``bench report-trace`` (exit 0)
+  and ``bench trace-merge`` (the CLI path).
+"""
+
+import json
+import os
+
+import pytest
+
+from distributed_sddmm_tpu.obs import trace, tracemerge
+from distributed_sddmm_tpu.obs.telemetry import LatencyHistogram
+from distributed_sddmm_tpu.tools import tracereport
+
+
+@pytest.fixture(autouse=True)
+def _clean_tracer(monkeypatch):
+    monkeypatch.delenv("DSDDMM_TRACE", raising=False)
+    trace.disable()
+    yield
+    trace.disable()
+
+
+def _shard(path, run_id, t0_epoch, pid, spans=(), events=()):
+    """Write one synthetic shard file (schema v1)."""
+    recs = [{"type": "begin", "schema": 1, "run_id": run_id,
+             "t0_epoch": t0_epoch, "pid": pid}]
+    for i, (name, t0, t1) in enumerate(spans, 1):
+        recs.append({"type": "span", "name": name, "id": i,
+                     "parent": None, "tid": 1, "t0": t0, "t1": t1,
+                     "dur_s": round(t1 - t0, 9), "attrs": {}})
+    for j, (name, t) in enumerate(events, len(spans) + 1):
+        recs.append({"type": "event", "name": name, "id": j,
+                     "parent": None, "tid": 1, "t": t, "attrs": {}})
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text("".join(json.dumps(r) + "\n" for r in recs))
+    return path
+
+
+class TestShardReroute:
+    def test_explicit_file_owned_by_live_process_becomes_shard(
+        self, tmp_path
+    ):
+        stem = tmp_path / "t.jsonl"
+        # A live foreign owner: pid 1 (init) always exists.
+        stem.write_text(json.dumps({
+            "type": "begin", "schema": 1, "run_id": "parent",
+            "t0_epoch": 100.0, "pid": 1,
+        }) + "\n")
+        before = stem.read_text()
+        tr = trace.enable(stem)
+        assert tr.path.parent == tmp_path / "t.shards"
+        assert tr.path.suffix == ".jsonl"
+        trace.disable()
+        assert stem.read_text() == before  # parent file untouched
+
+    def test_own_or_dead_owner_truncates_as_before(self, tmp_path):
+        stem = tmp_path / "t.jsonl"
+        stem.write_text(json.dumps({
+            "type": "begin", "schema": 1, "run_id": "old",
+            "t0_epoch": 1.0, "pid": os.getpid(),
+        }) + "\n")
+        tr = trace.enable(stem)
+        assert tr.path == stem  # same process re-runs in place
+        trace.disable()
+
+    def test_enable_exports_shard_dir_and_disable_restores(
+        self, tmp_path, monkeypatch
+    ):
+        monkeypatch.setenv("DSDDMM_TRACE", "inherited-spec")
+        trace.disable()  # re-latch with the env var present
+        stem = tmp_path / "t.jsonl"
+        trace.enable(stem)
+        assert os.environ["DSDDMM_TRACE"] == str(tmp_path / "t.shards")
+        assert trace.shard_dir() == str(tmp_path / "t.shards")
+        trace.disable()
+        assert os.environ["DSDDMM_TRACE"] == "inherited-spec"
+        assert trace.shard_dir() is None
+
+
+class TestMerge:
+    def test_skewed_origins_merge_monotonic_and_valid(self, tmp_path):
+        # Shard B's process started 2.5 wall seconds after shard A's.
+        a = _shard(tmp_path / "a.jsonl", "rid-a", 1000.0, 11,
+                   spans=[("opA", 0.1, 0.2)], events=[("evA", 0.15)])
+        b = _shard(tmp_path / "b.jsonl", "rid-b", 1002.5, 22,
+                   spans=[("opB", 0.1, 0.3)], events=[("evB", 0.05)])
+        merged = tracemerge.merge([a, b], strict=True)
+        assert merged["begin"]["t0_epoch"] == 1000.0
+        assert len(merged["begin"]["shards"]) == 2
+        sp = {s["name"]: s for s in merged["spans"]}
+        # Shard A keeps its times; shard B shifts by +2.5.
+        assert sp["opA"]["t0"] == pytest.approx(0.1)
+        assert sp["opB"]["t0"] == pytest.approx(2.6)
+        assert sp["opB"]["t1"] == pytest.approx(2.8)
+        assert sp["opB"]["dur_s"] == pytest.approx(0.2)  # duration kept
+        ev = {e["name"]: e for e in merged["events"]}
+        assert ev["evB"]["t"] == pytest.approx(2.55)
+        # Ids disjoint, every record shard-tagged.
+        ids = [r["id"] for r in merged["spans"] + merged["events"]]
+        assert len(ids) == len(set(ids))
+        assert {r["shard"] for r in merged["spans"]} == {"rid-a", "rid-b"}
+        assert sp["opB"]["pid"] == 22
+
+    def test_write_merged_is_schema_valid_and_sorted(self, tmp_path):
+        a = _shard(tmp_path / "a.jsonl", "rid-a", 50.0, 11,
+                   spans=[("x", 0.0, 1.0), ("y", 1.0, 2.0)])
+        b = _shard(tmp_path / "b.jsonl", "rid-b", 50.5, 22,
+                   spans=[("z", 0.1, 0.2)])
+        out, merged = tracemerge.write_merged([a, b], tmp_path / "m.jsonl")
+        loaded = tracereport.load_trace(out, strict=True)
+        assert loaded["errors"] == []
+        assert len(loaded["spans"]) == 3
+        # Time-sorted output.
+        t0s = [s["t0"] for s in loaded["spans"]]
+        assert t0s == sorted(t0s)
+
+    def test_parent_links_rewritten(self, tmp_path):
+        a = tmp_path / "a.jsonl"
+        a.write_text("\n".join(json.dumps(r) for r in [
+            {"type": "begin", "schema": 1, "run_id": "ra",
+             "t0_epoch": 10.0, "pid": 1},
+            {"type": "span", "name": "child", "id": 2, "parent": 1,
+             "tid": 1, "t0": 0.1, "t1": 0.2, "dur_s": 0.1, "attrs": {}},
+            {"type": "span", "name": "root", "id": 1, "parent": None,
+             "tid": 1, "t0": 0.0, "t1": 0.3, "dur_s": 0.3, "attrs": {}},
+        ]) + "\n")
+        b = _shard(tmp_path / "b.jsonl", "rb", 11.0, 2,
+                   spans=[("other", 0.0, 0.1)])
+        merged = tracemerge.merge([a, b])
+        sp = {s["name"]: s for s in merged["spans"]}
+        assert sp["child"]["parent"] == sp["root"]["id"]
+        assert sp["other"]["id"] not in (sp["root"]["id"], sp["child"]["id"])
+
+    def test_discover_stem_plus_shards_dir(self, tmp_path):
+        stem = _shard(tmp_path / "t.jsonl", "parent", 1.0, 1,
+                      spans=[("p", 0.0, 0.1)])
+        _shard(tmp_path / "t.shards" / "w1.jsonl", "w1", 1.5, 2,
+               spans=[("w", 0.0, 0.1)])
+        paths = tracemerge.discover(stem)
+        assert len(paths) == 2 and paths[0] == stem
+
+    def test_real_tracer_shards_merge(self, tmp_path):
+        """Two actual Tracer instances (as two processes would write)
+        merge into a valid trace."""
+        t1 = trace.Tracer(tmp_path / "p1.jsonl", "p1-rid")
+        with trace.Span(t1, "work1", {}):
+            pass
+        t1.close()
+        t2 = trace.Tracer(tmp_path / "p2.jsonl", "p2-rid")
+        with trace.Span(t2, "work2", {}):
+            pass
+        t2.close()
+        out, merged = tracemerge.write_merged(
+            tracemerge.discover(tmp_path), tmp_path / "m.jsonl"
+        )
+        loaded = tracereport.load_trace(out, strict=True)
+        assert {s["name"] for s in loaded["spans"]} == {"work1", "work2"}
+
+    def test_unmergeable_raises(self, tmp_path):
+        bad = tmp_path / "bad.jsonl"
+        bad.write_text("not json\n")
+        with pytest.raises(ValueError):
+            tracemerge.merge([bad], strict=True)
+        with pytest.raises(FileNotFoundError):
+            tracemerge.discover(tmp_path / "nope.jsonl")
+
+
+class TestHistogramMergeAlgebra:
+    def _h(self, values_ms):
+        h = LatencyHistogram()
+        for v in values_ms:
+            h.add(v)
+        return h
+
+    def test_commutative(self):
+        a = self._h([0.3, 5, 120, 9000])
+        b = self._h([1, 1, 40000])
+        assert a.merge(b) == b.merge(a)
+
+    def test_associative(self):
+        a, b, c = (self._h([0.1, 2]), self._h([30, 400]),
+                   self._h([60000, 0.2]))
+        assert a.merge(b).merge(c) == a.merge(b.merge(c))
+
+    def test_merge_preserves_total_and_quantiles(self):
+        a = self._h([1.0] * 90)
+        b = self._h([200.0] * 10)
+        m = a.merge(b)
+        assert m.total == 100
+        assert m.quantile_ms(50) <= 2.0
+        assert m.quantile_ms(99) >= 200.0
+
+    def test_bounds_mismatch_raises(self):
+        a = LatencyHistogram()
+        b = LatencyHistogram(bounds_ms=(1.0, 10.0))
+        with pytest.raises(ValueError):
+            a.merge(b)
+
+
+class TestCLIRoundTrip:
+    def test_trace_merge_then_report_trace_exit_0(self, tmp_path):
+        from distributed_sddmm_tpu.bench import cli
+
+        _shard(tmp_path / "s" / "a.jsonl", "ra", 5.0, 1,
+               spans=[("op", 0.0, 0.1)])
+        _shard(tmp_path / "s" / "b.jsonl", "rb", 6.0, 2,
+               spans=[("op", 0.0, 0.2)], events=[("e", 0.1)])
+        out = tmp_path / "merged.jsonl"
+        rc = cli.main(["trace-merge", str(tmp_path / "s"),
+                       "-o", str(out)])
+        assert rc == 0 and out.exists()
+        assert cli.main(["report-trace", str(out)]) == 0
+
+    def test_trace_merge_invalid_exits_2(self, tmp_path):
+        from distributed_sddmm_tpu.bench import cli
+
+        bad = tmp_path / "bad.jsonl"
+        bad.write_text("garbage\n")
+        assert cli.main(["trace-merge", str(bad)]) == 2
