@@ -36,8 +36,13 @@ layer's store/regress/report half):
 * ``report-trace``— per-phase aggregate of one trace file
 * ``trace-merge`` — offset-align and merge per-process trace shards
   into one schema-valid trace (``obs/tracemerge.py``)
+* ``trace-export``— convert any schema-valid trace (merged multi-shard
+  included) to Chrome trace-event JSON openable in Perfetto, request
+  chains drawn as cross-thread flows (``obs/traceexport.py``)
 * ``top``         — live serving telemetry view over the sampler's
-  JSONL stream (``obs/telemetry.py``)
+  JSONL stream or a live ``--admin-port`` endpoint; ``--serve``
+  re-exports a telemetry stream as /metrics (``obs/telemetry.py``,
+  ``obs/httpexp.py``)
 
 Benchmark-producing subcommands (``er``/``file``/``heatmap``) persist
 every record into the run store automatically (``--no-runstore`` opts
@@ -249,6 +254,15 @@ def _add_common(p: argparse.ArgumentParser) -> None:
         "DSDDMM_WATCHDOG)",
     )
     p.add_argument(
+        "--flightrec", nargs="?", const="1", default=None, metavar="DIR",
+        help="arm the anomaly flight recorder: the tracer keeps an "
+        "in-memory ring of recent spans, and every watchdog anomaly "
+        "dumps it (plus metrics/telemetry snapshots, plus a short "
+        "jax.profiler window when --profile is also armed) to "
+        "artifacts/flightrec/<run_id>/; DIR relocates (equivalent to "
+        "DSDDMM_FLIGHTREC)",
+    )
+    p.add_argument(
         "--no-runstore", action="store_true",
         help="do not persist this run into the run store "
         "(artifacts/runstore); DSDDMM_RUNSTORE relocates or disables it",
@@ -388,6 +402,20 @@ def build_parser() -> argparse.ArgumentParser:
                     metavar="SECONDS")
     sv.add_argument("--profile", default=None, metavar="LOGDIR")
     sv.add_argument("--watchdog", default=None, choices=["warn", "strict"])
+    sv.add_argument(
+        "--admin-port", type=int, default=None, metavar="PORT",
+        help="serve the live operational surface on 127.0.0.1:PORT "
+        "(0 = ephemeral): Prometheus /metrics, /healthz + /readyz "
+        "(503 once the SLO error budget burns past 1x), "
+        "/debug/requests recent-timeline ring, /snapshot for "
+        "`bench top --admin-port` (obs/httpexp.py); the record gains "
+        "an admin_port field",
+    )
+    sv.add_argument(
+        "--flightrec", nargs="?", const="1", default=None, metavar="DIR",
+        help="arm the anomaly flight recorder (see the offline "
+        "subcommands' --flightrec; equivalent to DSDDMM_FLIGHTREC)",
+    )
     sv.add_argument("--no-runstore", action="store_true")
 
     vf = sub.add_parser("verify", help="fingerprint cross-check of algorithms")
@@ -425,21 +453,52 @@ def build_parser() -> argparse.ArgumentParser:
     tm.add_argument("--no-strict", action="store_true",
                     help="tolerate (and drop) malformed shard lines")
 
+    te = sub.add_parser(
+        "trace-export",
+        help="convert a schema-valid trace (merged multi-shard traces "
+        "included) to Chrome trace-event JSON openable in Perfetto / "
+        "chrome://tracing: one process lane per shard, one thread lane "
+        "per thread, spans as B/E pairs on the calibrated clock, and "
+        "flow arrows stitching each request's enqueue->batch->reply "
+        "chain across threads; exits 2 on an invalid trace",
+    )
+    te.add_argument("trace", help="path to a <run_id>.jsonl trace")
+    te.add_argument("-o", "--output-file", default=None,
+                    help="default <trace stem>.chrome.json")
+    te.add_argument("--no-strict", action="store_true",
+                    help="tolerate (and drop) malformed trace lines")
+
     tp = sub.add_parser(
         "top",
         help="live serving telemetry view: queue depth, histogram "
         "percentiles, shed/degrade counters, program-store hit rates, "
         "SLO burn rate — over the sampler stream `bench serve "
-        "--telemetry` writes to artifacts/telemetry/",
+        "--telemetry` writes to artifacts/telemetry/, or live off a "
+        "`bench serve --admin-port` endpoint",
     )
     tp.add_argument(
         "path", nargs="?", default=None,
         help="telemetry .jsonl stream (default: the newest one under "
-        "artifacts/telemetry/ or $DSDDMM_TELEMETRY)",
+        "artifacts/telemetry/ or $DSDDMM_TELEMETRY); a missing "
+        "explicit path exits 2",
     )
     tp.add_argument(
         "--watch", type=float, default=0.0, metavar="SECONDS",
         help="refresh every N seconds until interrupted (0 = one shot)",
+    )
+    tp.add_argument(
+        "--admin-port", type=int, default=None, metavar="PORT",
+        help="read the live /snapshot endpoint of a `bench serve "
+        "--admin-port` engine instead of a telemetry file; falls back "
+        "to the telemetry stream when the endpoint is unreachable",
+    )
+    tp.add_argument("--admin-host", default="127.0.0.1", metavar="HOST")
+    tp.add_argument(
+        "--serve", type=int, default=None, metavar="PORT", dest="serve_port",
+        help="standalone exporter: serve Prometheus /metrics (+ "
+        "/snapshot, /healthz, /readyz) rendered from the telemetry "
+        "stream on 127.0.0.1:PORT (0 = ephemeral) until interrupted — "
+        "the admin surface for runs that only wrote --telemetry",
     )
 
     def _store_arg(p):
@@ -621,6 +680,9 @@ def main(argv=None) -> int:
     if args.cmd == "trace-merge":
         return _dispatch_trace_merge(args)
 
+    if args.cmd == "trace-export":
+        return _dispatch_trace_export(args)
+
     if args.cmd == "top":
         return _dispatch_top(args)
 
@@ -663,7 +725,29 @@ def main(argv=None) -> int:
         tr = obs_trace.enable(None if args.trace == "1" else args.trace)
         print(f"[trace] writing {tr.path}", file=sys.stderr)
 
+    flightrec_armed = bool(getattr(args, "flightrec", None))
+    if flightrec_armed:
+        # AFTER --trace: the ring must tap the file tracer when both
+        # are armed, not install a memory-only one first.
+        from distributed_sddmm_tpu.obs import flightrec as obs_flightrec
+
+        fr = obs_flightrec.enable(
+            None if args.flightrec == "1" else args.flightrec,
+            profile_window_s=0.25 if getattr(args, "profile", None) else 0.0,
+        )
+        print(f"[flightrec] armed -> {fr.out_dir}", file=sys.stderr)
+
     if getattr(args, "profile", None):
+        if flightrec_armed:
+            # jax.profiler supports one capture at a time: a whole-run
+            # capture would make every anomaly window refuse. With the
+            # flight recorder armed, --profile means per-anomaly
+            # capture windows (dumped next to each flight record), not
+            # a whole-run trace.
+            print("[profile] flight recorder armed: capturing short "
+                  "per-anomaly windows instead of the whole run",
+                  file=sys.stderr)
+            return _dispatch(args)
         from distributed_sddmm_tpu.obs import profiler as obs_profiler
 
         with obs_profiler.capture(args.profile):
@@ -704,15 +788,63 @@ def _dispatch_trace_merge(args) -> int:
     return 0
 
 
-def _dispatch_top(args) -> int:
-    """``bench top``: render the newest telemetry snapshot(s); --watch
-    refreshes until interrupted."""
-    import time as _time
+def _dispatch_trace_export(args) -> int:
+    """``bench trace-export``: one schema-valid trace -> Chrome
+    trace-event JSON. Exit 0 written, 2 invalid/unreadable."""
+    from distributed_sddmm_tpu.obs import traceexport
+
+    try:
+        out, chrome = traceexport.write_chrome(
+            args.trace, args.output_file, strict=not args.no_strict
+        )
+    except (OSError, ValueError) as e:
+        print(f"trace-export failed: {e}", file=sys.stderr)
+        return 2
+    print(json.dumps({"exported": str(out), **chrome["metadata"]}))
+    return 0
+
+
+def _top_source(args):
+    """Resolve the ``bench top`` snapshot source.
+
+    Returns ``(read_fn, label)`` where ``read_fn()`` yields the
+    snapshot list to render, or raises SystemExit(2) for an explicitly
+    named telemetry file that does not exist (a one-line error, not a
+    traceback)."""
+    import pathlib as _pathlib
 
     from distributed_sddmm_tpu.obs import telemetry
 
+    if args.admin_port is not None:
+        from distributed_sddmm_tpu.obs import httpexp
+
+        def read_live():
+            snap = httpexp.fetch_json(
+                args.admin_host, args.admin_port, "/snapshot"
+            )
+            return [snap] if snap else []
+
+        try:
+            read_live()  # probe once; unreachable -> fall back to files
+            return read_live, (
+                f"admin {args.admin_host}:{args.admin_port}"
+            )
+        except (OSError, ValueError) as e:  # incl. a non-JSON body
+            print(
+                f"[top] admin endpoint {args.admin_host}:"
+                f"{args.admin_port} unreachable ({e}); falling back to "
+                "the telemetry stream", file=sys.stderr,
+            )
+
     path = args.path
-    if path is None:
+    if path is not None:
+        if not _pathlib.Path(path).exists():
+            # One-line contract: a typo'd path must not scroll a
+            # traceback past the operator.
+            print(f"bench top: no telemetry file at {path}",
+                  file=sys.stderr)
+            raise SystemExit(2)
+    else:
         _enabled, root = telemetry.parse_env_spec(
             os.environ.get("DSDDMM_TELEMETRY")
         )
@@ -720,9 +852,57 @@ def _dispatch_top(args) -> int:
         if path is None:
             print("no telemetry streams found (run `bench serve "
                   "--telemetry` first)", file=sys.stderr)
-            return 1
+            raise SystemExit(1)
+    return (lambda: telemetry.read_snapshots(path)), str(path)
+
+
+def _dispatch_top(args) -> int:
+    """``bench top``: render the newest telemetry snapshot(s) from a
+    file or a live admin endpoint; --watch refreshes until interrupted;
+    --serve re-exports the stream as a /metrics endpoint."""
+    import time as _time
+
+    from distributed_sddmm_tpu.obs import telemetry
+
+    try:
+        read_fn, label = _top_source(args)
+    except SystemExit as e:
+        return int(e.code or 0)
+
+    if args.serve_port is not None:
+        from distributed_sddmm_tpu.obs import httpexp
+
+        def latest():
+            snaps = read_fn()
+            return snaps[-1] if snaps else None
+
+        server = httpexp.AdminServer(
+            snapshot_fn=latest, port=args.serve_port
+        )
+        server.start()
+        print(f"[top] exporting {label} on "
+              f"http://127.0.0.1:{server.port}/metrics", file=sys.stderr)
+        try:
+            while True:
+                _time.sleep(1.0)
+        except KeyboardInterrupt:
+            return 0
+        finally:
+            server.stop()
+
     while True:
-        snaps = telemetry.read_snapshots(path)
+        try:
+            snaps = read_fn()
+        except (OSError, ValueError) as e:
+            # A live source can vanish mid-watch (serve exited, file
+            # unlinked) or answer mid-shutdown garbage (truncated JSON
+            # is a ValueError): one line, never a traceback. A watch
+            # loop keeps polling — the endpoint may come back.
+            print(f"bench top: snapshot source unavailable ({e})",
+                  file=sys.stderr)
+            if not args.watch:
+                return 1
+            snaps = []
         if args.watch:
             print("\x1b[2J\x1b[H", end="")  # clear screen between frames
         print(telemetry.render_top(snaps))
@@ -794,8 +974,34 @@ def _dispatch_serve(args) -> int:
             slo=slo,
         )
 
-    eng.start()  # compile-ahead warmup of the whole bucket ladder
+    # Live operational surface (obs/httpexp.py): started BEFORE warmup
+    # so /readyz honestly reports not-ready while the ladder compiles.
+    admin = None
+    if args.admin_port is not None:
+        from distributed_sddmm_tpu.obs import httpexp
+
+        admin = httpexp.AdminServer(
+            engine=eng, op_metrics=d_ops.metrics, slo=slo,
+            port=args.admin_port,
+        )
+        admin.start()
+        print(f"[admin] serving http://127.0.0.1:{admin.port} "
+              "(/metrics /healthz /readyz /debug/requests /snapshot)",
+              file=sys.stderr)
+
+    # An armed flight recorder gets the engine's telemetry snapshot as
+    # a dump source — an anomaly record then carries the queue/latency
+    # state of the moment it fired.
+    from distributed_sddmm_tpu.obs import flightrec as obs_flightrec
+
+    _fr = obs_flightrec.active()
+    if _fr is not None:
+        _fr.register_source(
+            "engine", lambda: obs_telemetry.engine_snapshot(eng, slo=slo)
+        )
+
     try:
+        eng.start()  # compile-ahead warmup of the whole bucket ladder
         if sampler is not None:
             sampler.start()
             print(f"[telemetry] sampling to {sampler.path}",
@@ -808,6 +1014,8 @@ def _dispatch_serve(args) -> int:
         if sampler is not None:
             sampler.stop()
         eng.stop()
+        if admin is not None:
+            admin.stop()
 
     record = {
         "app": f"serve-{args.app}",
@@ -835,6 +1043,11 @@ def _dispatch_serve(args) -> int:
         record["plan"] = plan.to_dict()
     if sampler is not None:
         record["telemetry_path"] = str(sampler.path)
+    if admin is not None:
+        record["admin_port"] = admin.port
+        record["admin_scrapes"] = admin.scrapes
+    if _fr is not None:
+        record["flightrec_dir"] = str(_fr.out_dir)
     # Analytic-vs-XLA FLOP cross-check over the engine's resolved
     # programs (strategy ops only — serve fold-in programs have no
     # analytic model to disagree with).

@@ -52,6 +52,25 @@ The request-level / multi-process half (PR 7):
   latency histograms, the SLO error-budget burn rate, and the sampler
   thread behind ``bench serve --telemetry`` / ``bench top``.
 
+The live operational half (PR 8):
+
+* :mod:`~distributed_sddmm_tpu.obs.httpexp` — zero-dependency stdlib
+  HTTP admin server: Prometheus ``/metrics`` text exposition (GLOBAL
+  counters, per-op registry, queue/latency-histogram families),
+  ``/healthz``/``/readyz`` liveness + SLO-burn readiness, and the
+  ``/debug/requests`` recent-timeline ring (``bench serve
+  --admin-port``; ``bench top --serve`` exporter mode).
+* :mod:`~distributed_sddmm_tpu.obs.flightrec` — anomaly-triggered
+  flight recorder: the tracer's in-memory span ring plus metrics/
+  telemetry snapshots dumped to ``artifacts/flightrec/<run_id>/``
+  whenever the watchdog fires (``--flightrec`` /
+  ``DSDDMM_FLIGHTREC``); the dump path is stamped into the anomaly
+  trace event and the bench record.
+* :mod:`~distributed_sddmm_tpu.obs.traceexport` — Chrome trace-event
+  export (``bench trace-export``): any schema-valid trace, merged
+  multi-shard included, as Perfetto-openable JSON with one lane per
+  shard/thread and request chains drawn as cross-thread flows.
+
 The trace reader/report side lives in ``tools/tracereport.py``
 (``python -m distributed_sddmm_tpu.bench report-trace <trace.jsonl>``),
 including the serving request-chain reconstruction
@@ -59,11 +78,12 @@ including the serving request-chain reconstruction
 """
 
 from distributed_sddmm_tpu.obs import (
-    clock, log, manifest, metrics, profiler, regress, report, store,
-    telemetry, trace, tracemerge, watchdog,
+    clock, flightrec, httpexp, log, manifest, metrics, profiler, regress,
+    report, store, telemetry, trace, traceexport, tracemerge, watchdog,
 )
 
 __all__ = [
-    "clock", "trace", "tracemerge", "metrics", "telemetry", "log",
-    "profiler", "manifest", "store", "regress", "watchdog", "report",
+    "clock", "trace", "tracemerge", "traceexport", "metrics", "telemetry",
+    "log", "profiler", "manifest", "store", "regress", "watchdog",
+    "report", "httpexp", "flightrec",
 ]

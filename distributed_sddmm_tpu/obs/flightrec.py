@@ -1,0 +1,228 @@
+"""Anomaly-triggered flight recorder: dump the last N spans on alarm.
+
+The watchdog (``obs/watchdog.py``) *detects* anomalies — EWMA step-time
+spikes, repair storms, comm mismatch, queue runaway — but until now it
+only recorded THAT something fired, never the context needed to explain
+it after the fact. This module is the black box: the tracer keeps a
+bounded in-memory ring of recent spans/events (``obs.trace.arm_ring``),
+and when the watchdog fires while a recorder is armed, the ring plus
+metrics/telemetry snapshots (and, when profiling is armed, a short
+``jax.profiler`` capture window) are dumped to::
+
+    artifacts/flightrec/<run_id>/<seq>-<kind>.json
+
+The dump path is stamped into the anomaly's trace event and into the
+bench record's ``anomalies`` summary (``snapshot_path``), so
+``report-html`` and post-mortems can jump from "p99 regressed at 14:03"
+straight to the spans surrounding the spike.
+
+Design constraints:
+
+* **Never in the hot path.** Disabled (the default) the only cost is
+  the watchdog's existing anomaly path checking one module-level
+  ``None``. Armed, the ring tap is one deque append per emitted record.
+* **Never fails the run.** ``dump()`` swallows everything; a failed
+  dump returns None and the anomaly proceeds exactly as before.
+* **Bounded.** ``max_dumps`` caps files per process (an anomaly storm
+  must not fill the disk with identical snapshots); the ring caps
+  memory.
+
+Activation mirrors the tracer/watchdog pattern: ``DSDDMM_FLIGHTREC``
+(``1``/``on`` → the default directory, ``0``/``off`` → disabled, any
+other value → a directory) or the bench CLI's ``--flightrec`` flag, or
+programmatic :func:`enable`.
+"""
+
+from __future__ import annotations
+
+import os
+import pathlib
+import threading
+from typing import Callable, Optional
+
+from distributed_sddmm_tpu.obs import clock
+from distributed_sddmm_tpu.obs import log as obs_log
+from distributed_sddmm_tpu.obs import metrics as obs_metrics
+from distributed_sddmm_tpu.obs import trace as obs_trace
+
+_REPO = pathlib.Path(__file__).resolve().parents[2]
+DEFAULT_FLIGHTREC_DIR = _REPO / "artifacts" / "flightrec"
+
+SCHEMA_VERSION = 1
+
+
+class FlightRecorder:
+    """One process's armed black box."""
+
+    def __init__(
+        self,
+        out_dir=None,
+        ring_capacity: int = 512,
+        max_dumps: int = 16,
+        profile_window_s: float = 0.0,
+        run_id: Optional[str] = None,
+    ):
+        self.out_root = (
+            pathlib.Path(out_dir) if out_dir else DEFAULT_FLIGHTREC_DIR
+        )
+        #: Whether WE armed the ring (vs. tapping one an AdminServer or
+        #: caller already armed) — module-level :func:`disable` only
+        #: disarms what the recorder armed, mirroring
+        #: ``AdminServer.stop``'s guard in the other direction.
+        self._armed_ring = obs_trace.ring() is None
+        self.ring = obs_trace.arm_ring(ring_capacity)
+        self.max_dumps = int(max_dumps)
+        #: >0 arms the short ``jax.profiler`` window per dump (the CLI
+        #: sets this only when ``--profile`` is also armed — capture has
+        #: real overhead and needs an operator opt-in).
+        self.profile_window_s = float(profile_window_s)
+        # The ring arm may have installed the run's (memory) tracer, so
+        # run_id() is authoritative after it.
+        self.run_id = run_id or obs_trace.run_id() or obs_trace._make_run_id()
+        self.out_dir = self.out_root / self.run_id
+        self._lock = threading.Lock()
+        self.dumps = 0
+        #: File-name sequence — monotonic and never refunded, unlike the
+        #: ``dumps`` budget: a failed dump gives its budget slot back,
+        #: but reusing its seq could overwrite a concurrent successful
+        #: dump's file (and the snapshot_path already stamped for it).
+        self._seq = 0
+        #: Paths written this session, in firing order.
+        self.paths: list[str] = []
+        #: Named snapshot callables merged into every dump (the serve
+        #: CLI registers the engine's telemetry snapshot; offline runs
+        #: get GLOBAL metrics regardless).
+        self._sources: dict[str, Callable[[], dict]] = {}
+
+    def register_source(self, name: str, fn: Callable[[], dict]) -> None:
+        """Attach a snapshot source (called per dump; exceptions are
+        recorded as the source's value, never raised)."""
+        with self._lock:
+            self._sources[name] = fn
+
+    # ------------------------------------------------------------------ #
+
+    def dump(self, kind: str, op: str, attrs: dict) -> Optional[str]:
+        """Write one flight record for an anomaly; returns its path or
+        None (budget exhausted / write failed). Never raises. A failed
+        write refunds its budget slot — a persistent serialization or
+        disk error must not silently exhaust ``max_dumps``."""
+        try:
+            return self._dump(kind, op, attrs)
+        except Exception as e:  # noqa: BLE001 — the run goes on
+            with self._lock:
+                self.dumps = max(0, self.dumps - 1)
+            obs_log.warn("flightrec", "dump failed",
+                         kind=kind, error=f"{type(e).__name__}: {e}")
+            return None
+
+    def _dump(self, kind: str, op: str, attrs: dict) -> Optional[str]:
+        with self._lock:
+            if self.dumps >= self.max_dumps:
+                return None
+            self.dumps += 1
+            seq = self._seq
+            self._seq += 1
+            sources = dict(self._sources)
+        path = self.out_dir / f"{seq:03d}-{kind}.json"
+        record = {
+            "schema": SCHEMA_VERSION,
+            "run_id": self.run_id,
+            "seq": seq,
+            "t_epoch": clock.epoch(),
+            "anomaly": {"kind": kind, "op": op, "attrs": dict(attrs)},
+            "ring": self.ring.records(),
+            "ring_seen": self.ring.appended,
+            "metrics": {"global": obs_metrics.GLOBAL.snapshot()},
+        }
+        for name, fn in sources.items():
+            try:
+                record.setdefault("sources", {})[name] = fn()
+            except Exception as e:  # noqa: BLE001 — recorded, not raised
+                record.setdefault("sources", {})[name] = {
+                    "error": f"{type(e).__name__}: {e}"
+                }
+        if self.profile_window_s > 0:
+            from distributed_sddmm_tpu.obs import profiler
+
+            logdir = str(self.out_dir / f"{seq:03d}-profile")
+            # Non-blocking: the watchdog fires from the dispatch path;
+            # the capture window rides a daemon thread and lands (or
+            # not — best effort) after the dump file does.
+            started = profiler.capture_window(
+                logdir, duration_s=self.profile_window_s, block=False
+            )
+            record["profile"] = {"logdir": logdir, "started": started}
+        from distributed_sddmm_tpu.utils.atomic import atomic_write_json
+
+        # default=str: the ring holds attrs exactly as emitted, and the
+        # tracer's own serializer stringifies non-JSON values (Paths,
+        # numpy scalars) — the dump must accept anything the ring can.
+        atomic_write_json(path, record, default=str)
+        with self._lock:
+            self.paths.append(str(path))
+        obs_metrics.GLOBAL.add("flightrec_dumps")
+        obs_log.warn("flightrec", "anomaly snapshot written",
+                     kind=kind, op=op, path=str(path))
+        return str(path)
+
+
+# --------------------------------------------------------------------- #
+# Module-level activation (env + CLI), watchdog/tracer-style
+# --------------------------------------------------------------------- #
+
+_active: Optional[FlightRecorder] = None
+_env_checked = False
+_registry_lock = threading.Lock()
+
+
+def parse_env_spec(spec: str | None) -> tuple[bool, pathlib.Path | None]:
+    """``DSDDMM_FLIGHTREC`` grammar, matching the telemetry/runstore
+    one: 0/off/false/no disables, 1/on/true/yes selects the default
+    directory, any other value is a directory."""
+    spec = spec or ""
+    low = spec.lower()
+    if low in ("", "0", "off", "false", "no"):
+        return False, None
+    if low in ("1", "on", "true", "yes"):
+        return True, None
+    return True, pathlib.Path(spec)
+
+
+def enable(out_dir=None, **knobs) -> FlightRecorder:
+    """Arm a process-wide flight recorder (replaces any previous one —
+    the dump budget and ring are per-session)."""
+    global _active, _env_checked
+    with _registry_lock:
+        _env_checked = True
+        _active = FlightRecorder(out_dir=out_dir, **knobs)
+        return _active
+
+
+def disable() -> None:
+    global _active, _env_checked
+    with _registry_lock:
+        fr = _active
+        _active = None
+        _env_checked = True
+    # Disarm only a ring the recorder armed itself: an AdminServer (or
+    # test) that armed it first still owns it — yanking it here would
+    # break /debug/requests and, when the memory-only tracer was the
+    # only tracer, silently stop span emission for the whole process.
+    if fr is not None and fr._armed_ring:
+        obs_trace.disarm_ring()
+
+
+def active() -> Optional[FlightRecorder]:
+    """The armed recorder, activating from ``DSDDMM_FLIGHTREC`` on
+    first query (the watchdog calls this on every anomaly)."""
+    global _active, _env_checked
+    if _env_checked:
+        return _active
+    with _registry_lock:
+        if not _env_checked:
+            _env_checked = True
+            enabled, root = parse_env_spec(os.environ.get("DSDDMM_FLIGHTREC"))
+            if enabled:
+                _active = FlightRecorder(out_dir=root)
+    return _active

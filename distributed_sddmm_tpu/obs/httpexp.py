@@ -1,0 +1,541 @@
+"""Zero-dependency HTTP admin surface: /metrics, health, debug ring.
+
+The rest of the obs stack records to files read *after* a run; this
+module is the live pull surface — the piece a fleet scheduler, a
+Prometheus scraper, or an on-call human hits while the process is still
+serving. Stdlib only (``http.server``), one daemon thread, bound to
+loopback by default.
+
+Endpoints (:class:`AdminServer`):
+
+* ``/metrics`` — Prometheus text exposition (format 0.0.4): every
+  :data:`~distributed_sddmm_tpu.obs.metrics.GLOBAL` counter (the
+  export-completeness lint in ``tests/test_obs_lint.py`` pins that new
+  counters cannot silently vanish from scrape — see
+  :data:`KNOWN_GLOBAL_COUNTERS`), the per-op :class:`OpMetrics`
+  registry, serving queue depth/occupancy gauges, program-store hit
+  counters, the SLO burn-rate gauge, and the PR-7
+  :class:`~distributed_sddmm_tpu.obs.telemetry.LatencyHistogram` as a
+  proper cumulative-bucket Prometheus histogram (``_bucket{le=..}`` /
+  ``_count`` / ``_sum``).
+* ``/healthz`` — liveness: 200 while the engine's runner thread is
+  alive (or always, in exporter mode), 503 once it died.
+* ``/readyz`` — readiness: 200 only while the runner is alive, the
+  warm program ladder is compiled, AND the SLO error-budget burn rate
+  is at or under ``burn_threshold`` — the signal a load balancer uses
+  to pull a replica that is still up but no longer meeting its SLO.
+* ``/debug/requests`` — recent request timelines reconstructed from
+  the tracer's in-memory span ring (``obs.trace.arm_ring``; the server
+  arms it on start) through ``tools/tracereport.request_chains`` —
+  the last N enqueue→batch→reply chains with their segment splits.
+* ``/snapshot`` — the :func:`~distributed_sddmm_tpu.obs.telemetry.
+  engine_snapshot` JSON (``bench top --admin-port`` reads this).
+
+Two sources, one exposition: a **live engine** (``bench serve
+--admin-port``) scrapes the engine/recorder/queue directly; a
+**snapshot function** (``bench top --serve`` — the standalone exporter
+over a telemetry JSONL stream) maps the latest sampler snapshot into
+the same metric families, so dashboards don't care which side wrote it.
+
+Clock discipline: reads ``obs.clock`` only (lint-enforced).
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Optional
+from urllib.parse import urlsplit
+
+from distributed_sddmm_tpu.obs import clock
+from distributed_sddmm_tpu.obs import log as obs_log
+from distributed_sddmm_tpu.obs import metrics as obs_metrics
+from distributed_sddmm_tpu.obs import trace as obs_trace
+from distributed_sddmm_tpu.obs.telemetry import LatencyHistogram
+
+#: Every GLOBAL counter the package increments, with scrape help text.
+#: ``tests/test_obs_lint.py::test_global_counters_exported_to_metrics``
+#: statically scans the package for ``GLOBAL.add("<name>")`` sites and
+#: fails if a name is neither listed here nor tagged ``# not-exported``
+#: at the call site — a new counter cannot silently vanish from scrape.
+KNOWN_GLOBAL_COUNTERS: dict = {
+    "faults_fired": "injected faults fired (resilience/faults.py)",
+    "exec_retries": "dispatch retries across offline + serving paths",
+    "guard_repairs": "NaN/Inf outputs repaired by guards",
+    "checkpoints_saved": "checkpoint steps persisted",
+    "checkpoints_loaded": "checkpoint steps restored",
+    "plan_cache_hits": "autotune plan-cache hits",
+    "plan_cache_misses": "autotune plan-cache misses",
+    "autotune_trial_retries": "autotune measured-trial retries",
+    "autotune_candidates_dropped": "autotune candidates pruned pre-trial",
+    "watchdog_anomalies": "anomalies recorded by the in-run watchdog",
+    "program_store_hits": "AOT program store disk hits",
+    "program_store_misses": "AOT program store misses",
+    "live_compiles": "in-process compiles (cold-start cost)",
+    "serve_shed": "requests shed by admission control",
+    "serve_degraded_batches": "serving batches degraded to the serial rung",
+    "flightrec_dumps": "flight-recorder snapshots written",
+}
+
+#: Exposition metric-name prefix.
+PREFIX = "dsddmm"
+
+
+def _fmt_value(v) -> str:
+    """A Prometheus sample value: floats rendered plainly, NaN allowed."""
+    if isinstance(v, float):
+        if math.isnan(v):
+            return "NaN"
+        if v == int(v) and abs(v) < 1e15:
+            return str(int(v))
+        return repr(v)
+    return str(v)
+
+
+def _escape_label(v: str) -> str:
+    return str(v).replace("\\", r"\\").replace('"', r"\"").replace("\n", r"\n")
+
+
+class Exposition:
+    """Prometheus text-format builder (one HELP/TYPE per family)."""
+
+    def __init__(self):
+        #: family -> (type, help, [(labels_dict_or_None, value), ...])
+        self._fams: dict[str, tuple[str, str, list]] = {}
+        self._order: list[str] = []
+
+    def _add(self, name, kind, help_text, labels, value):
+        fam = self._fams.get(name)
+        if fam is None:
+            fam = self._fams[name] = (kind, help_text, [])
+            self._order.append(name)
+        fam[2].append((labels, value))
+
+    def counter(self, name, value, help_text="", labels=None):
+        self._add(name, "counter", help_text, labels, value)
+
+    def gauge(self, name, value, help_text="", labels=None):
+        self._add(name, "gauge", help_text, labels, value)
+
+    def histogram_ms(self, name, hist: LatencyHistogram, sum_ms=None,
+                     help_text=""):
+        """A cumulative-bucket histogram from a fixed-bucket
+        :class:`LatencyHistogram` (buckets are already disjoint counts;
+        Prometheus wants cumulative ``le`` buckets + ``+Inf``)."""
+        fam = self._fams.get(name)
+        if fam is None:
+            fam = self._fams[name] = ("histogram", help_text, [])
+            self._order.append(name)
+        cum = 0
+        for bound, count in zip(hist.bounds_ms, hist.counts):
+            cum += count
+            fam[2].append(({"le": _fmt_value(float(bound))}, cum))
+        total = hist.total
+        fam[2].append(({"le": "+Inf"}, total))
+        fam[2].append(("_count", total))
+        # _sum is required by the format; the fixed-bucket histogram
+        # does not track it, so the caller passes the recorder's
+        # mean*count estimate (NaN when unknown — legal in the format).
+        fam[2].append(("_sum", float("nan") if sum_ms is None else sum_ms))
+
+    def render(self) -> str:
+        lines = []
+        for name in self._order:
+            kind, help_text, samples = self._fams[name]
+            if help_text:
+                lines.append(f"# HELP {name} {help_text}")
+            lines.append(f"# TYPE {name} {kind}")
+            for labels, value in samples:
+                if labels == "_count":
+                    lines.append(f"{name}_count {_fmt_value(value)}")
+                elif labels == "_sum":
+                    lines.append(f"{name}_sum {_fmt_value(value)}")
+                elif kind == "histogram":
+                    lines.append(
+                        f'{name}_bucket{{le="{labels["le"]}"}} '
+                        f"{_fmt_value(value)}"
+                    )
+                elif labels:
+                    lab = ",".join(
+                        f'{k}="{_escape_label(v)}"'
+                        for k, v in sorted(labels.items())
+                    )
+                    lines.append(f"{name}{{{lab}}} {_fmt_value(value)}")
+                else:
+                    lines.append(f"{name} {_fmt_value(value)}")
+        return "\n".join(lines) + "\n"
+
+
+# --------------------------------------------------------------------- #
+# Metric sources -> exposition
+# --------------------------------------------------------------------- #
+
+
+def _expose_global(expo: Exposition) -> None:
+    """Every known GLOBAL counter (0 when never bumped — Prometheus
+    counters should exist from the first scrape). Only declared names
+    are rendered: the lint keeps the declaration list complete, and a
+    counter deliberately tagged ``# not-exported`` must actually stay
+    off the scrape surface."""
+    snap = obs_metrics.GLOBAL.snapshot()
+    for name, help_text in KNOWN_GLOBAL_COUNTERS.items():
+        expo.counter(f"{PREFIX}_{name}_total", snap.get(name, 0.0),
+                     help_text)
+
+
+_OP_FIELDS = (
+    ("calls", f"{PREFIX}_op_calls_total", "dispatches per op"),
+    ("kernel_s", f"{PREFIX}_op_kernel_seconds_total",
+     "successful-attempt kernel seconds per op"),
+    ("overhead_s", f"{PREFIX}_op_overhead_seconds_total",
+     "retry/fault/guard overhead seconds per op"),
+    ("retries", f"{PREFIX}_op_retries_total", "retries per op"),
+    ("comm_words", f"{PREFIX}_op_comm_words_total",
+     "counted per-device communication words per op"),
+    ("flops", f"{PREFIX}_op_flops_total", "analytic useful FLOPs per op"),
+)
+
+
+def _expose_op_metrics(expo: Exposition, op_metrics) -> None:
+    ops = op_metrics.to_dict()
+    for field, metric, help_text in _OP_FIELDS:
+        for op, rec in ops.items():
+            expo.counter(metric, rec[field], help_text, labels={"op": op})
+
+
+def _expose_engine(expo: Exposition, engine, slo=None) -> None:
+    """Live-engine mode: one ``engine_snapshot`` rendered through the
+    exporter mapping — ONE family set for both sources, so the live and
+    ``bench top --serve`` expositions cannot drift apart — plus the
+    engine-only extras a telemetry snapshot line does not carry."""
+    from distributed_sddmm_tpu.obs.telemetry import engine_snapshot
+
+    snap = engine_snapshot(engine, slo=slo)
+    _expose_snapshot(expo, snap)
+    stats = engine.stats()
+    expo.counter(f"{PREFIX}_served_requests_total", stats.get("served", 0),
+                 "requests answered by the runner")
+    expo.counter(f"{PREFIX}_degraded_batches_total",
+                 stats.get("degraded_batches", 0),
+                 "batches that fell to the serial rung")
+
+
+def _expose_snapshot(expo: Exposition, snap: dict, sum_ms=None) -> None:
+    """One telemetry snapshot dict (``engine_snapshot``'s shape, live
+    or re-read from the sampler stream) mapped onto the metric
+    families. The histogram's ``_sum`` comes from the snapshot's own
+    ``latency_sum_ms`` (computed off the same summary instant as the
+    buckets) unless the caller overrides it."""
+    expo.gauge(f"{PREFIX}_queue_depth", snap.get("queue_depth", 0),
+               "serving queue depth")
+    expo.gauge(f"{PREFIX}_queue_capacity", snap.get("queue_capacity", 0),
+               "admission bound (requests shed beyond it)")
+    if snap.get("batch_occupancy") is not None:
+        expo.gauge(f"{PREFIX}_batch_occupancy_mean",
+                   snap["batch_occupancy"],
+                   "mean micro-batch fill fraction")
+    expo.counter(f"{PREFIX}_requests_submitted_total",
+                 snap.get("submitted", 0), "requests admitted past the queue")
+    for field, metric in (
+        ("completed", "requests_completed_total"),
+        ("errors", "requests_errors_total"),
+        ("shed", "requests_shed_total"),
+        ("degraded", "requests_degraded_total"),
+    ):
+        expo.counter(f"{PREFIX}_{metric}", snap.get(field, 0),
+                     f"recorder {field}")
+    for field in ("cache_hits", "cache_misses", "disk_hits",
+                  "live_compiles"):
+        v = (snap.get("program_store") or {}).get(field)
+        if v is not None:
+            expo.counter(f"{PREFIX}_program_{field}_total", v,
+                         f"engine program-cache {field}")
+    hist = LatencyHistogram.from_dict(snap.get("latency_hist")) \
+        or LatencyHistogram()
+    if sum_ms is None:
+        sum_ms = snap.get("latency_sum_ms")
+    expo.histogram_ms(f"{PREFIX}_request_latency_ms", hist, sum_ms=sum_ms,
+                      help_text="end-to-end request latency (ms)")
+    if snap.get("burn_rate") is not None:
+        expo.gauge(f"{PREFIX}_slo_burn_rate", snap["burn_rate"],
+                   "worst-axis error-budget burn rate (1.0 = at budget)")
+
+
+# --------------------------------------------------------------------- #
+# The admin server
+# --------------------------------------------------------------------- #
+
+
+class AdminServer:
+    """The operational HTTP surface for one process.
+
+    Construct with a live ``engine`` (``bench serve --admin-port``) or a
+    ``snapshot_fn`` returning the latest telemetry snapshot dict
+    (``bench top --serve`` exporter mode); ``op_metrics`` (an
+    :class:`~distributed_sddmm_tpu.obs.metrics.OpMetrics`) adds the
+    per-op families, ``slo`` the burn-rate gauge and the readiness burn
+    check. ``port=0`` binds an ephemeral port (read :attr:`port` after
+    :meth:`start`). Binds loopback by default — this is an *admin*
+    surface, not a public API.
+    """
+
+    def __init__(
+        self,
+        engine=None,
+        op_metrics=None,
+        slo=None,
+        snapshot_fn: Optional[Callable[[], Optional[dict]]] = None,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        burn_threshold: float = 1.0,
+        ring_capacity: int = 512,
+        debug_requests_limit: int = 64,
+    ):
+        self.engine = engine
+        self.op_metrics = op_metrics
+        self.slo = slo
+        self.snapshot_fn = snapshot_fn
+        self.host = host
+        self.port = int(port)
+        self.burn_threshold = float(burn_threshold)
+        self.ring_capacity = int(ring_capacity)
+        self.debug_requests_limit = int(debug_requests_limit)
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+        self._armed_ring = False
+        self.scrapes = 0
+
+    # -- rendering ------------------------------------------------------ #
+
+    def metrics_text(self) -> str:
+        expo = Exposition()
+        _expose_global(expo)
+        if self.op_metrics is not None:
+            _expose_op_metrics(expo, self.op_metrics)
+        if self.engine is not None:
+            _expose_engine(expo, self.engine, slo=self.slo)
+        elif self.snapshot_fn is not None:
+            snap = self.snapshot_fn()
+            if snap:
+                _expose_snapshot(expo, snap)
+        expo.gauge(f"{PREFIX}_admin_scrapes", self.scrapes,
+                   "scrapes served by this admin server")
+        return expo.render()
+
+    def snapshot(self) -> Optional[dict]:
+        """The telemetry-style JSON the ``/snapshot`` endpoint serves."""
+        if self.engine is not None:
+            from distributed_sddmm_tpu.obs.telemetry import engine_snapshot
+
+            return engine_snapshot(self.engine, slo=self.slo,
+                                   run_id=obs_trace.run_id())
+        if self.snapshot_fn is not None:
+            return self.snapshot_fn()
+        return None
+
+    def health(self) -> tuple[int, dict]:
+        """Liveness: the runner thread is the engine's beating heart.
+
+        An engine that has not been started yet is still *alive* — the
+        admin server deliberately comes up before warmup so readiness
+        can report the compile window honestly, and a liveness prober
+        that saw 503 there would kill the replica mid-warmup. Only a
+        runner that started and then died is down."""
+        if self.engine is None:
+            return 200, {"ok": True, "mode": "exporter"}
+        started = bool(getattr(self.engine, "ever_started", True))
+        alive = self.engine.runner_alive() or not started
+        return (200 if alive else 503), {
+            "ok": alive, "runner_alive": self.engine.runner_alive(),
+            "started": started,
+        }
+
+    def readiness(self) -> tuple[int, dict]:
+        """Readiness: alive AND warm AND within SLO error budget."""
+        checks: dict = {}
+        if self.engine is not None:
+            checks["runner_alive"] = self.engine.runner_alive()
+            checks["warm"] = bool(getattr(self.engine, "warmed", False))
+            if self.slo is not None:
+                burn = self.slo.burn_rate(self.engine.recorder.summary())
+                checks["burn_rate"] = burn
+                checks["slo_burn_ok"] = (
+                    burn is None or burn <= self.burn_threshold
+                )
+        elif self.snapshot_fn is not None:
+            snap = self.snapshot_fn()
+            checks["snapshot_available"] = snap is not None
+            if snap is not None and snap.get("burn_rate") is not None:
+                checks["burn_rate"] = snap["burn_rate"]
+                checks["slo_burn_ok"] = (
+                    snap["burn_rate"] <= self.burn_threshold
+                )
+        ready = all(
+            v for k, v in checks.items() if isinstance(v, bool)
+        ) if checks else True
+        return (200 if ready else 503), {"ready": ready, "checks": checks}
+
+    def debug_requests(self) -> dict:
+        """Recent request timelines from the tracer's span ring."""
+        from distributed_sddmm_tpu.tools import tracereport
+
+        ring = obs_trace.ring()
+        if ring is None:
+            return {"error": "span ring not armed", "requests": []}
+        recs = ring.records()
+        pseudo = {
+            "begin": None,
+            "spans": [r for r in recs if r.get("type") == "span"],
+            "events": [r for r in recs if r.get("type") == "event"],
+            "errors": [],
+        }
+        chains = tracereport.request_chains(pseudo)
+        rows = sorted(
+            chains["requests"].values(),
+            key=lambda ch: ch.get("t_reply") or ch.get("t_enqueue") or 0.0,
+        )[-self.debug_requests_limit:]
+        return {
+            "ring_records": len(recs),
+            "ring_seen": ring.appended,
+            "complete": chains["complete"],
+            "incomplete": chains["incomplete"],
+            "inconsistent": chains["inconsistent"],
+            "shed": chains["shed"],
+            "requests": rows,
+        }
+
+    # -- lifecycle ------------------------------------------------------ #
+
+    def start(self) -> "AdminServer":
+        if self._httpd is not None:
+            raise RuntimeError("admin server already started")
+        # /debug/requests source; remember whether WE armed it so stop()
+        # can put the process back exactly as found.
+        self._armed_ring = obs_trace.ring() is None
+        obs_trace.arm_ring(self.ring_capacity)
+        admin = self
+
+        class _Handler(BaseHTTPRequestHandler):
+            server_version = "dsddmm-admin/1"
+            protocol_version = "HTTP/1.1"
+
+            def do_GET(self):  # noqa: N802 — http.server API
+                try:
+                    admin._route(self)
+                except BrokenPipeError:
+                    pass
+                except Exception as e:  # noqa: BLE001 — 500, never die
+                    try:
+                        body = f"internal error: {type(e).__name__}: {e}"
+                        self.send_response(500)
+                        payload = body.encode()
+                        self.send_header("Content-Type", "text/plain")
+                        self.send_header("Content-Length", str(len(payload)))
+                        self.end_headers()
+                        self.wfile.write(payload)
+                    except Exception:  # noqa: BLE001
+                        pass
+
+            def log_message(self, fmt, *args):  # silence stderr chatter
+                obs_log.debug("admin", fmt % args)
+
+        self._httpd = ThreadingHTTPServer((self.host, self.port), _Handler)
+        self._httpd.daemon_threads = True
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, kwargs={"poll_interval": 0.2},
+            daemon=True, name=f"admin-{self.port}",
+        )
+        self._thread.start()
+        obs_log.info("admin", "serving",
+                     url=f"http://{self.host}:{self.port}")
+        return self
+
+    def stop(self) -> None:
+        if self._httpd is None:
+            return
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._httpd = None
+        if self._thread is not None:
+            self._thread.join(5.0)
+            self._thread = None
+        if getattr(self, "_armed_ring", False):
+            from distributed_sddmm_tpu.obs import flightrec
+
+            # Disarm only what we armed — and never yank the ring out
+            # from under an armed flight recorder. Without this, a
+            # stopped admin server would leave a memory-only tracer
+            # enabled() for the rest of the process.
+            if flightrec.active() is None:
+                obs_trace.disarm_ring()
+            self._armed_ring = False
+
+    def __enter__(self) -> "AdminServer":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    # -- routing -------------------------------------------------------- #
+
+    def _route(self, handler: BaseHTTPRequestHandler) -> None:
+        path = urlsplit(handler.path).path.rstrip("/") or "/"
+        if path == "/metrics":
+            self.scrapes += 1
+            self._send(handler, 200, self.metrics_text(),
+                       "text/plain; version=0.0.4; charset=utf-8")
+        elif path == "/healthz":
+            code, body = self.health()
+            self._send_json(handler, code, body)
+        elif path == "/readyz":
+            code, body = self.readiness()
+            self._send_json(handler, code, body)
+        elif path == "/debug/requests":
+            self._send_json(handler, 200, self.debug_requests())
+        elif path == "/snapshot":
+            snap = self.snapshot()
+            if snap is None:
+                self._send_json(handler, 404,
+                                {"error": "no snapshot source"})
+            else:
+                self._send_json(handler, 200, snap)
+        elif path == "/":
+            self._send_json(handler, 200, {
+                "endpoints": ["/metrics", "/healthz", "/readyz",
+                              "/debug/requests", "/snapshot"],
+                "t_epoch": clock.epoch(),
+            })
+        else:
+            self._send(handler, 404, f"no such endpoint: {path}\n",
+                       "text/plain")
+
+    @staticmethod
+    def _send(handler, code: int, body: str, content_type: str) -> None:
+        payload = body.encode()
+        handler.send_response(code)
+        handler.send_header("Content-Type", content_type)
+        handler.send_header("Content-Length", str(len(payload)))
+        handler.end_headers()
+        handler.wfile.write(payload)
+
+    @staticmethod
+    def _send_json(handler, code: int, body: dict) -> None:
+        AdminServer._send(
+            handler, code, json.dumps(body, default=str) + "\n",
+            "application/json",
+        )
+
+
+def fetch_json(host: str, port: int, path: str = "/snapshot",
+               timeout_s: float = 2.0) -> dict:
+    """GET a JSON endpoint off a local admin server (stdlib urllib —
+    ``bench top --admin-port`` uses this). Raises ``OSError`` family on
+    connection failure; callers fall back to the telemetry file."""
+    import urllib.request
+
+    url = f"http://{host}:{port}{path}"
+    with urllib.request.urlopen(url, timeout=timeout_s) as resp:
+        return json.loads(resp.read().decode())

@@ -128,13 +128,17 @@ def manifest_path_for(trace_path: str | os.PathLike) -> pathlib.Path:
     return p.with_name(p.stem + ".manifest.json")
 
 
-def write_for_trace(tracer, extra: dict | None = None) -> pathlib.Path:
+def write_for_trace(tracer, extra: dict | None = None) -> pathlib.Path | None:
     """Write (or refresh) the manifest next to ``tracer``'s trace file.
 
     Refreshes are cheap and idempotent, and once a manifest has been
     written WITH device facts (i.e. after backend init) further
     extras-free refreshes are skipped — a traced sweep calls this once
-    per bench record and nothing in it can change anymore."""
+    per bench record and nothing in it can change anymore. A memory-only
+    tracer (``trace.arm_ring`` with no file tracer; ``path is None``)
+    has nowhere to put a manifest and returns None."""
+    if tracer is None or tracer.path is None:
+        return None
     path = manifest_path_for(tracer.path)
     if extra is None and getattr(tracer, "_manifest_final", False):
         return path

@@ -1,11 +1,18 @@
 """Optional ``jax.profiler`` integration: capture + named annotations.
 
-Two pieces, both no-ops unless explicitly armed:
+Three pieces, all no-ops unless explicitly armed:
 
 * :func:`capture` / :func:`maybe_capture` — a context manager around a
   whole run that starts a ``jax.profiler`` trace into a log directory
   (TensorBoard/XProf-readable). Armed by the bench CLI's ``--profile
   DIR`` flag or the ``DSDDMM_PROFILE=DIR`` env var.
+* :func:`capture_window` — a bounded capture (a fraction of a second,
+  not a run): start a trace, hold it for ``duration_s``, stop. This is
+  the flight recorder's hook — when the watchdog fires an anomaly with
+  ``--profile`` armed, a short window catches the device timeline
+  *around* the anomaly without paying whole-run capture overhead.
+  Refuses (returns False) while another capture is active — two
+  concurrent ``jax.profiler`` sessions is an error in jax itself.
 * :func:`annotate` — a named ``jax.profiler.TraceAnnotation`` wrapped
   around each compiled-program dispatch (``cgStep``, ``gatLayer``, the
   sddmm/spmm/fused programs) so device timelines carry the framework's
@@ -13,13 +20,16 @@ Two pieces, both no-ops unless explicitly armed:
   (:func:`active`), so the hot path pays one boolean check otherwise.
 
 Everything degrades gracefully: a jax without the profiler API (or a
-backend that refuses to start one) logs a warning and runs untraced —
-profiling must never take down a run.
+backend that refuses to start one — :func:`capture_available` probes
+without side effects) logs a warning and runs untraced — profiling must
+never take down a run.
 """
 
 from __future__ import annotations
 
 import contextlib
+import threading
+import time  # time.sleep only; clocks go through obs.clock
 
 from distributed_sddmm_tpu.obs import log
 
@@ -83,3 +93,47 @@ def maybe_capture(logdir: str | None = None):
     if not target:
         return contextlib.nullcontext()
     return capture(target)
+
+
+def capture_available() -> bool:
+    """True when this jax exposes the start/stop trace API (no capture
+    is started — a pure probe, safe on any backend)."""
+    try:
+        import jax.profiler
+
+        return (
+            hasattr(jax.profiler, "start_trace")
+            and hasattr(jax.profiler, "stop_trace")
+        )
+    except Exception:  # noqa: BLE001 — absence is a normal answer
+        return False
+
+
+def capture_window(
+    logdir: str, duration_s: float = 0.25, block: bool = True,
+) -> bool:
+    """Capture a short ``jax.profiler`` window into ``logdir``.
+
+    Returns True when a window was attempted (profiler API present and
+    no capture already active), False otherwise — the graceful no-op
+    contract the flight recorder relies on. ``block=False`` runs the
+    window on a daemon thread so an anomaly hook never stalls the
+    dispatch path it fired from; the capture that actually lands is
+    still best-effort (a backend refusing to start one logs and moves
+    on, exactly like :func:`capture`).
+    """
+    if _capturing or not capture_available():
+        return False
+
+    def _window():
+        with capture(logdir):
+            if active():  # start_trace may still have refused
+                time.sleep(duration_s)
+
+    if block:
+        _window()
+        return True
+    threading.Thread(
+        target=_window, daemon=True, name="profiler-window"
+    ).start()
+    return True

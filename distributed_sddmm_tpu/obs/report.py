@@ -122,6 +122,46 @@ def _history_table(rows: list[dict]) -> str:
     return "".join(cells)
 
 
+def _flight_record_rows(store, rows: list[dict]) -> list[tuple]:
+    """(run_id, kind, op, count, snapshot_path) for every anomaly group
+    of every run that recorded any — ``snapshot_path`` present when the
+    flight recorder was armed (PR 8), letting the dashboard jump from
+    an anomaly row straight to the span-ring dump that explains it."""
+    out = []
+    for r in rows:
+        if not r.get("anomaly_count"):
+            continue
+        doc = store.get(r["run_id"])
+        anom = ((doc or {}).get("record") or {}).get("anomalies") or {}
+        for g in anom.get("anomalies") or ():
+            out.append((
+                r["run_id"], g.get("kind"), g.get("op"),
+                g.get("count", 1),
+                (g.get("first") or {}).get("snapshot_path"),
+            ))
+    return out
+
+
+def _flight_table(rows: list[tuple]) -> str:
+    cells = [
+        "<table><tr><th class=l>run_id</th><th class=l>anomaly</th>"
+        "<th class=l>op</th><th>count</th>"
+        "<th class=l>flight record</th></tr>"
+    ]
+    for run_id, kind, op, count, path in rows:
+        link = (
+            f'<a href="file://{_esc(path)}">{_esc(path)}</a>'
+            if path else "-"
+        )
+        cells.append(
+            f'<tr class="regression"><td class=l>{_esc(run_id)}</td>'
+            f"<td class=l>{_esc(kind)}</td><td class=l>{_esc(op)}</td>"
+            f"<td>{count}</td><td class=l>{link}</td></tr>"
+        )
+    cells.append("</table>")
+    return "".join(cells)
+
+
 def _compare_table(report: dict) -> str:
     cells = [
         "<table><tr><th class=l>phase</th><th>calls</th>"
@@ -232,6 +272,16 @@ def build_html(
         f" · focus key: {_esc((key or '')[:16])}</p>",
         "<h2>Runs</h2>", _history_table(all_rows),
     ]
+
+    flights = _flight_record_rows(store, all_rows)
+    if flights:
+        sections += [
+            "<h2>Anomalies &amp; flight records</h2>",
+            "<p class=meta>Watchdog anomalies per run; when the flight "
+            "recorder was armed, each links to the span-ring snapshot "
+            "written at the moment it fired.</p>",
+            _flight_table(flights),
+        ]
 
     per_phase, headline = _trend_series(store, focus_rows)
     png = _chart_png(lambda ax: charts.trend_chart(ax, per_phase))

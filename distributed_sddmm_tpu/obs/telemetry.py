@@ -200,15 +200,64 @@ def parse_env_spec(spec: str | None) -> tuple[bool, pathlib.Path | None]:
     return True, pathlib.Path(spec)
 
 
+def engine_snapshot(engine, slo=None, run_id: str | None = None) -> dict:
+    """One telemetry-style snapshot of a serving engine, JSON-ready.
+
+    ``engine`` needs ``.queue`` (``depth()``, ``max_depth``,
+    ``submitted_count``), ``.stats()`` and ``.recorder`` (a
+    :class:`~distributed_sddmm_tpu.serve.slo.LatencyRecorder`); ``slo``
+    (optional) adds the burn-rate field. This is THE snapshot shape —
+    the sampler appends it as JSONL lines, the admin server's
+    ``/snapshot`` endpoint serves it live, and ``bench top`` renders
+    either source through the same :func:`render_top`.
+    """
+    q = engine.queue
+    summary = engine.recorder.summary()
+    depth = q.depth()
+    snap = {
+        "schema": 1,
+        "run_id": run_id,
+        "t_epoch": clock.epoch(),
+        "queue_depth": depth,
+        "queue_capacity": q.max_depth,
+        "depth_frac": round(depth / q.max_depth, 4) if q.max_depth else 0.0,
+        "submitted": q.submitted_count,
+        "requests": summary.get("requests", 0),
+        "completed": summary.get("completed", 0),
+        "errors": summary.get("errors", 0),
+        "shed": summary.get("shed_count", 0),
+        "degraded": summary.get("degraded_count", 0),
+        "latency_hist": summary.get("request_hist"),
+        "latency_hist_ms": summary.get("latency_hist_ms"),
+        "batch_occupancy": (summary.get("batch_occupancy") or {}).get("mean"),
+    }
+    # mean*count from the SAME summary instant as the histogram above —
+    # the /metrics exposition's histogram ``_sum``; deriving it from a
+    # second summary() call would let requests complete in between and
+    # ship a self-inconsistent _sum/_count pair in one scrape.
+    lat = summary.get("latency_ms") or {}
+    if lat.get("mean") is not None:
+        snap["latency_sum_ms"] = lat["mean"] * summary.get("completed", 0)
+    try:
+        stats = engine.stats()
+    except Exception:  # noqa: BLE001 — telemetry never fails serving
+        stats = {}
+    snap["program_store"] = {
+        k: stats.get(k)
+        for k in ("cache_hits", "cache_misses", "disk_hits", "live_compiles")
+        if stats.get(k) is not None
+    }
+    if slo is not None:
+        snap["burn_rate"] = slo.burn_rate(summary)
+    return snap
+
+
 class TelemetrySampler:
     """Periodic engine snapshots appended as JSONL.
 
-    ``engine`` needs ``.queue`` (``depth()``, ``max_depth``,
-    ``submitted_count``, ``shed_count``), ``.stats()`` and
-    ``.recorder`` (a :class:`~distributed_sddmm_tpu.serve.slo.
-    LatencyRecorder`); ``slo`` (optional) adds the burn-rate field.
-    The thread is a daemon and every snapshot is one complete line, so
-    a dying process costs at most the in-flight line.
+    Engine/slo requirements are :func:`engine_snapshot`'s. The thread
+    is a daemon and every snapshot is one complete line, so a dying
+    process costs at most the in-flight line.
     """
 
     def __init__(self, engine, interval_s: float = 0.5, out_dir=None,
@@ -233,42 +282,7 @@ class TelemetrySampler:
     # -- one snapshot --------------------------------------------------- #
 
     def snapshot(self) -> dict:
-        q = self.engine.queue
-        rec = self.engine.recorder
-        summary = rec.summary()
-        depth = q.depth()
-        snap = {
-            "schema": 1,
-            "run_id": self.run_id,
-            "t_epoch": clock.epoch(),
-            "queue_depth": depth,
-            "queue_capacity": q.max_depth,
-            "depth_frac": round(depth / q.max_depth, 4) if q.max_depth else 0.0,
-            "submitted": q.submitted_count,
-            "requests": summary.get("requests", 0),
-            "completed": summary.get("completed", 0),
-            "errors": summary.get("errors", 0),
-            "shed": summary.get("shed_count", 0),
-            "degraded": summary.get("degraded_count", 0),
-            "latency_hist": summary.get("request_hist"),
-            "latency_hist_ms": summary.get("latency_hist_ms"),
-            "batch_occupancy": (summary.get("batch_occupancy") or {}).get(
-                "mean"
-            ),
-        }
-        try:
-            stats = self.engine.stats()
-        except Exception:  # noqa: BLE001 — telemetry never fails serving
-            stats = {}
-        snap["program_store"] = {
-            k: stats.get(k)
-            for k in ("cache_hits", "cache_misses", "disk_hits",
-                      "live_compiles")
-            if stats.get(k) is not None
-        }
-        if self.slo is not None:
-            snap["burn_rate"] = self.slo.burn_rate(summary)
-        return snap
+        return engine_snapshot(self.engine, slo=self.slo, run_id=self.run_id)
 
     # -- lifecycle ------------------------------------------------------ #
 

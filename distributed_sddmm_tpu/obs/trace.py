@@ -37,6 +37,16 @@ Design constraints, in order:
    default; ``bench trace-merge PATH.jsonl`` stitches the stem file and
    its shards back into one trace.
 
+Besides the JSONL file there is one optional in-memory sink: the
+**span ring** (:func:`arm_ring`), a bounded deque of the most recent
+emitted records. The flight recorder (``obs/flightrec.py``) dumps it
+when the watchdog fires, and the admin server's ``/debug/requests``
+endpoint reconstructs recent request timelines from it. Arming the
+ring with no file tracer active installs a *memory-only* tracer
+(``path is None``) so spans and events still flow — ``enabled()``
+becomes true but ``trace_path()`` stays None, and nothing touches the
+filesystem.
+
 Record schema (one JSON object per line, ``schema`` = SCHEMA_VERSION):
 
 * ``{"type": "begin", "schema": 1, "run_id": .., "t0_epoch": ..,
@@ -55,6 +65,7 @@ Record schema (one JSON object per line, ``schema`` = SCHEMA_VERSION):
 
 from __future__ import annotations
 
+import collections
 import errno
 import json
 import os
@@ -81,6 +92,39 @@ _registry_lock = threading.Lock()
 _env_export: tuple[Optional[str], bool] = (None, False)
 #: The directory child processes of this traced run shard into.
 _shard_dir: Optional[str] = None
+#: Optional bounded in-memory sink of emitted records (flight recorder
+#: ring + admin /debug/requests source); None = disarmed.
+_ring: Optional["SpanRing"] = None
+
+
+class SpanRing:
+    """Bounded ring of the most recent emitted trace records.
+
+    Thread-safe; holds the record dicts exactly as emitted (spans close
+    before they land here, so the ring is the last ``capacity`` *completed*
+    spans and events — an in-flight span is not visible until it exits).
+    """
+
+    def __init__(self, capacity: int = 512):
+        self.capacity = int(capacity)
+        self._lock = threading.Lock()
+        self._buf: collections.deque = collections.deque(maxlen=self.capacity)
+        #: Total records ever appended (rotation-aware: ``appended -
+        #: len(records())`` is how many the ring has already forgotten).
+        self.appended = 0
+
+    def append(self, rec: dict) -> None:
+        with self._lock:
+            self._buf.append(rec)
+            self.appended += 1
+
+    def records(self) -> list[dict]:
+        with self._lock:
+            return list(self._buf)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._buf.clear()
 
 
 def _make_run_id() -> str:
@@ -156,23 +200,32 @@ class Span:
 
 
 class Tracer:
-    """JSONL-emitting tracer bound to one output file."""
+    """JSONL-emitting tracer bound to one output file.
 
-    def __init__(self, path: pathlib.Path, run_id: str):
+    ``path=None`` is the memory-only mode :func:`arm_ring` installs when
+    no file tracer is active: spans and events flow (into the ring), but
+    nothing touches the filesystem and ``trace_path()`` stays None.
+    """
+
+    def __init__(self, path: Optional[pathlib.Path], run_id: str):
         self.path = path
         self.run_id = run_id
         self.t0 = clock.now()
         self._lock = threading.Lock()
         self._ids = 0
         self._local = threading.local()
-        path.parent.mkdir(parents=True, exist_ok=True)
-        # Truncate: one trace per file (re-running with the same explicit
-        # --trace PATH.jsonl must not merge runs — the reader would
-        # double-count). Default/directory specs embed the run_id in the
-        # file name, and an explicit file another LIVE process owns was
-        # already rerouted into the shard directory by _resolve_path, so
-        # two running processes never share a file.
-        self._fh = open(path, "w", buffering=1)  # line-buffered
+        if path is None:
+            self._fh = None
+        else:
+            path.parent.mkdir(parents=True, exist_ok=True)
+            # Truncate: one trace per file (re-running with the same
+            # explicit --trace PATH.jsonl must not merge runs — the
+            # reader would double-count). Default/directory specs embed
+            # the run_id in the file name, and an explicit file another
+            # LIVE process owns was already rerouted into the shard
+            # directory by _resolve_path, so two running processes never
+            # share a file.
+            self._fh = open(path, "w", buffering=1)  # line-buffered
         # t0_epoch is the wall-clock reading of the monotonic origin —
         # the shard's clock-calibration header trace-merge aligns on.
         self.emit({
@@ -195,6 +248,11 @@ class Tracer:
         return st
 
     def emit(self, record: dict) -> None:
+        ring = _ring
+        if ring is not None:
+            ring.append(record)
+        if self._fh is None:
+            return
         line = json.dumps(record, default=str)
         with self._lock:
             if self._fh.closed:
@@ -206,6 +264,8 @@ class Tracer:
         return st[-1] if st else None
 
     def close(self) -> None:
+        if self._fh is None:
+            return
         with self._lock:
             if not self._fh.closed:
                 self._fh.flush()
@@ -326,12 +386,14 @@ def enable(path=None, run_id: Optional[str] = None) -> "Tracer":
 def disable() -> None:
     """Close and deactivate the tracer (tests; end-of-run flush).
     Restores the ``DSDDMM_TRACE`` value :func:`enable` exported for
-    child processes."""
-    global _active, _env_checked, _env_export, _shard_dir
+    child processes, and disarms the span ring — ``disable()`` is the
+    full reset the test fixtures rely on."""
+    global _active, _env_checked, _env_export, _shard_dir, _ring
     with _registry_lock:
         if _active is not None:
             _active.close()
         _active = None
+        _ring = None
         _env_checked = True
         prev, exported = _env_export
         if exported:
@@ -341,6 +403,42 @@ def disable() -> None:
                 os.environ["DSDDMM_TRACE"] = prev
         _env_export = (None, False)
         _shard_dir = None
+
+
+def arm_ring(capacity: int = 512) -> SpanRing:
+    """Attach (or return) the in-memory span ring.
+
+    With a file tracer already active the ring simply taps its emit
+    stream; with no tracer a **memory-only** tracer is installed so
+    spans/events flow at all (``enabled()`` turns true, ``trace_path()``
+    stays None). Arm AFTER enabling file tracing when you want both —
+    ``enable()`` is idempotent and will not upgrade a memory tracer to
+    a file one. Idempotent: an armed ring is returned as-is (capacity
+    of the first arm wins)."""
+    global _ring, _active
+    if not _env_checked:
+        _env_activate()  # a DSDDMM_TRACE file spec must win over memory
+    with _registry_lock:
+        if _ring is None:
+            _ring = SpanRing(capacity)
+        if _active is None:
+            _active = Tracer(None, _make_run_id())
+        return _ring
+
+
+def disarm_ring() -> None:
+    """Detach the span ring; a memory-only tracer installed by
+    :func:`arm_ring` is deactivated too (a file tracer is untouched)."""
+    global _ring, _active
+    with _registry_lock:
+        _ring = None
+        if _active is not None and _active.path is None:
+            _active = None
+
+
+def ring() -> Optional[SpanRing]:
+    """The armed span ring, or None."""
+    return _ring
 
 
 def shard_dir() -> Optional[str]:
@@ -379,7 +477,7 @@ def rel_time(t_perf: float) -> Optional[float]:
 
 def trace_path() -> Optional[str]:
     tr = tracer()
-    return str(tr.path) if tr else None
+    return str(tr.path) if tr is not None and tr.path is not None else None
 
 
 def span(name: str, **attrs):

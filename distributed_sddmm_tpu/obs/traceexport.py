@@ -1,0 +1,242 @@
+"""Chrome trace-event export: open any trace in Perfetto.
+
+``obs/trace.py`` writes a private JSONL schema; this module converts
+any schema-valid trace — including ``bench trace-merge`` outputs whose
+records carry ``shard``/``pid`` tags — into the Chrome trace-event JSON
+format (the ``{"traceEvents": [...]}`` array flavor) that
+https://ui.perfetto.dev and ``chrome://tracing`` open directly.
+
+Mapping:
+
+* one Chrome **process lane per shard** (a single-process trace is one
+  lane named after its run_id), one **thread lane per source thread**,
+  both announced with ``process_name``/``thread_name`` metadata events;
+* **spans become B/E pairs** on the merged (offset-calibrated)
+  monotonic timeline, attrs riding along as ``args``. Ties at equal
+  timestamps are ordered by nesting depth (E closes deepest-first, B
+  opens shallowest-first) so viewers reconstruct the exact span tree;
+* **events become instants** (``ph:"i"``), except the request-scoped
+  ``serve:enqueue``/``serve:reply``/``serve:shed`` events, which become
+  1µs marker slices (``ph:"X"``) — Chrome *flow* events bind to
+  enclosing slices, and an instant cannot anchor a flow;
+* **request chains become flows**: for every request with an enqueue
+  event, a ``serve:batch`` span listing it, and a reply event, a
+  ``s``/``t``/``f`` flow triple (one disjoint flow id per request)
+  stitches enqueue → batch → reply across threads — the same joins
+  ``tools/tracereport.request_chains`` verifies, drawn as arrows.
+
+CLI: ``python -m distributed_sddmm_tpu.bench trace-export TRACE.jsonl
+[-o OUT.json]`` (exit 2 on a schema-invalid trace, like report-trace).
+"""
+
+from __future__ import annotations
+
+import json
+import pathlib
+
+from distributed_sddmm_tpu.tools import tracereport
+
+#: Trace events exported as 1µs marker slices instead of instants so
+#: request flows have slices to bind to.
+_MARKER_EVENTS = ("serve:enqueue", "serve:reply", "serve:shed")
+_MARKER_DUR_US = 1.0
+
+
+def _us(t_s: float) -> float:
+    return round(t_s * 1e6, 3)
+
+
+class _Lanes:
+    """shard → Chrome pid, (shard, raw tid) → Chrome tid, plus the
+    metadata events announcing both."""
+
+    def __init__(self, begin: dict | None):
+        self._pids: dict = {}
+        self._tids: dict = {}
+        self.meta: list[dict] = []
+        self._begin = begin or {}
+        # Merged traces pre-declare their shards (keeps lane order
+        # deterministic: shard meta order, not record order).
+        for meta in self._begin.get("shards") or ():
+            self.pid(meta.get("run_id"), os_pid=meta.get("pid"))
+
+    def pid(self, shard, os_pid=None) -> int:
+        if shard not in self._pids:
+            p = len(self._pids) + 1
+            self._pids[shard] = p
+            label = shard or self._begin.get("run_id") or "trace"
+            if os_pid is None and shard is None:
+                os_pid = self._begin.get("pid")
+            if os_pid is not None:
+                label = f"{label} (pid {os_pid})"
+            self.meta.append({
+                "name": "process_name", "ph": "M", "pid": p,
+                "args": {"name": f"shard {label}"},
+            })
+            self.meta.append({
+                "name": "process_sort_index", "ph": "M", "pid": p,
+                "args": {"sort_index": p},
+            })
+        return self._pids[shard]
+
+    def tid(self, shard, raw_tid) -> int:
+        key = (shard, raw_tid)
+        if key not in self._tids:
+            pid = self.pid(shard)
+            t = sum(1 for (s, _r) in self._tids if s == shard) + 1
+            self._tids[key] = (pid, t)
+            self.meta.append({
+                "name": "thread_name", "ph": "M", "pid": pid, "tid": t,
+                "args": {"name": f"thread {raw_tid}"},
+            })
+        return self._tids[key][1]
+
+
+def _span_depths(spans: list[dict]) -> dict:
+    """span id → nesting depth (root = 0), from parent links."""
+    parent = {sp["id"]: sp.get("parent") for sp in spans}
+    depths: dict = {}
+
+    def depth(i):
+        if i in depths:
+            return depths[i]
+        seen = []
+        d = 0
+        node = i
+        while node is not None and node not in depths:
+            seen.append(node)
+            node = parent.get(node)
+            d += 1
+            if d > len(parent) + 1:  # cycle guard: malformed parent
+                break
+        base = depths.get(node, -1)
+        for off, n in enumerate(reversed(seen), 1):
+            depths[n] = base + off
+        return depths[i]
+
+    for sp in spans:
+        depth(sp["id"])
+    return depths
+
+
+def _request_flows(trace: dict, lanes: _Lanes) -> list[dict]:
+    """One ``s``/``t``/``f`` flow triple per fully-joined request."""
+    enq: dict = {}
+    rep: dict = {}
+    for ev in trace["events"]:
+        req = ev["attrs"].get("req")
+        if req is None:
+            continue
+        key = tracereport.req_key(ev, req)
+        if ev["name"] == "serve:enqueue":
+            enq[key] = ev
+        elif ev["name"] == "serve:reply":
+            rep[key] = ev
+    batch: dict = {}
+    for sp in trace["spans"]:
+        if sp["name"] != "serve:batch":
+            continue
+        for req in sp["attrs"].get("req_ids") or ():
+            batch[tracereport.req_key(sp, req)] = sp
+    flows = []
+    for fid, key in enumerate(sorted(enq, key=str), 1):
+        e, b, r = enq[key], batch.get(key), rep.get(key)
+        if b is None or r is None:
+            continue
+        common = {"name": "request", "cat": "request", "id": fid,
+                  "args": {"req": e["attrs"]["req"], "shard": key[0]}}
+        flows.append({
+            **common, "ph": "s",
+            "pid": lanes.pid(e.get("shard")),
+            "tid": lanes.tid(e.get("shard"), e["tid"]),
+            "ts": _us(e["t"]) + _MARKER_DUR_US / 2,
+        })
+        flows.append({
+            **common, "ph": "t",
+            "pid": lanes.pid(b.get("shard")),
+            "tid": lanes.tid(b.get("shard"), b["tid"]),
+            "ts": round(_us(b["t0"]) + max(
+                _us(b["t1"]) - _us(b["t0"]), _MARKER_DUR_US) / 2, 3),
+        })
+        flows.append({
+            **common, "ph": "f", "bp": "e",
+            "pid": lanes.pid(r.get("shard")),
+            "tid": lanes.tid(r.get("shard"), r["tid"]),
+            "ts": _us(r["t"]) + _MARKER_DUR_US / 2,
+        })
+    return flows
+
+
+def to_chrome(trace: dict) -> dict:
+    """A ``tracereport.load_trace`` dict → Chrome trace-event JSON."""
+    begin = trace.get("begin") or {}
+    lanes = _Lanes(begin)
+    depths = _span_depths(trace["spans"])
+    out: list = []
+
+    for sp in trace["spans"]:
+        pid = lanes.pid(sp.get("shard"))
+        tid = lanes.tid(sp.get("shard"), sp["tid"])
+        d = depths.get(sp["id"], 0)
+        # Ties at one timestamp: E before B (close the old span before
+        # opening the next), E deepest-first, B shallowest-first.
+        out.append(((_us(sp["t0"]), 2, d), {
+            "name": sp["name"], "cat": "span", "ph": "B",
+            "pid": pid, "tid": tid, "ts": _us(sp["t0"]),
+            "args": sp.get("attrs") or {},
+        }))
+        out.append(((_us(sp["t1"]), 0, -d), {
+            "ph": "E", "pid": pid, "tid": tid, "ts": _us(sp["t1"]),
+        }))
+    for ev in trace["events"]:
+        pid = lanes.pid(ev.get("shard"))
+        tid = lanes.tid(ev.get("shard"), ev["tid"])
+        if ev["name"] in _MARKER_EVENTS:
+            out.append(((_us(ev["t"]), 2, 0), {
+                "name": ev["name"], "cat": "request", "ph": "X",
+                "pid": pid, "tid": tid, "ts": _us(ev["t"]),
+                "dur": _MARKER_DUR_US, "args": ev.get("attrs") or {},
+            }))
+        else:
+            out.append(((_us(ev["t"]), 2, 0), {
+                "name": ev["name"], "cat": "event", "ph": "i", "s": "t",
+                "pid": pid, "tid": tid, "ts": _us(ev["t"]),
+                "args": ev.get("attrs") or {},
+            }))
+    for fl in _request_flows(trace, lanes):
+        out.append(((fl["ts"], 1, 0), fl))
+
+    out.sort(key=lambda pair: pair[0])
+    events = lanes.meta + [rec for _key, rec in out]
+    n_flows = sum(1 for e in events if e.get("ph") == "s")
+    return {
+        "traceEvents": events,
+        "displayTimeUnit": "ms",
+        "metadata": {
+            "exporter": "distributed_sddmm_tpu trace-export",
+            "run_id": begin.get("run_id"),
+            "t0_epoch": begin.get("t0_epoch"),
+            "shards": [m.get("run_id") for m in begin.get("shards") or ()],
+            "spans": len(trace["spans"]),
+            "events": len(trace["events"]),
+            "request_flows": n_flows,
+        },
+    }
+
+
+def write_chrome(trace_path, out_path=None, strict: bool = True):
+    """Load + validate ``trace_path``, write its Chrome JSON.
+
+    Returns ``(out_path, chrome_dict)``. Default output sits next to
+    the trace: ``<stem>.chrome.json``. Raises ``ValueError`` on a
+    schema-invalid trace when ``strict`` (the CLI maps that to exit 2).
+    """
+    trace = tracereport.load_trace(trace_path, strict=strict)
+    chrome = to_chrome(trace)
+    if out_path is None:
+        p = pathlib.Path(trace_path)
+        out_path = p.with_name(p.stem + ".chrome.json")
+    out_path = pathlib.Path(out_path)
+    out_path.parent.mkdir(parents=True, exist_ok=True)
+    out_path.write_text(json.dumps(chrome, default=str))
+    return out_path, chrome

@@ -126,7 +126,20 @@ class Watchdog:
         raises — strict-mode escalation happens in :meth:`_escalate`
         AFTER every co-detected anomaly of the observation has been
         emitted (a raise mid-emission would permanently swallow a drift
-        or storm detected on the same dispatch as a spike)."""
+        or storm detected on the same dispatch as a spike).
+
+        An armed flight recorder (``obs/flightrec.py``) dumps its span
+        ring + snapshots FIRST — before the anomaly trace event — so
+        the dump's ring ends at the spans that *preceded* the anomaly,
+        and ``snapshot_path`` can ride both the trace event and the
+        bench record's ``anomalies`` summary."""
+        from distributed_sddmm_tpu.obs import flightrec
+
+        fr = flightrec.active()
+        if fr is not None:
+            snapshot_path = fr.dump(kind, op, attrs)
+            if snapshot_path:
+                attrs = {**attrs, "snapshot_path": snapshot_path}
         ev = {"kind": kind, "op": op, **attrs}
         with self._lock:
             self.events.append(ev)
@@ -388,10 +401,15 @@ class Watchdog:
     def summary(self, since: int = 0) -> dict:
         """Aggregate anomalies recorded after cursor ``since`` (the bench
         harness snapshots ``len(events)`` per record): grouped by
-        (kind, op) with a count and the first occurrence's detail."""
+        (kind, op) with a count and the first occurrence's detail.
+        ``snapshots`` lists every flight-record path the window's
+        anomalies produced, in firing order (``report-html`` links
+        them; the per-group ``first`` carries its own
+        ``snapshot_path`` too)."""
         with self._lock:
             events = list(self.events[since:])
         grouped: dict[tuple, dict] = {}
+        snapshots: list[str] = []
         for ev in events:
             k = (ev["kind"], ev["op"])
             g = grouped.get(k)
@@ -402,11 +420,16 @@ class Watchdog:
                               if a not in ("kind", "op")},
                 }
             g["count"] += 1
-        return {
+            if ev.get("snapshot_path"):
+                snapshots.append(ev["snapshot_path"])
+        out = {
             "mode": self.mode,
             "total": len(events),
             "anomalies": [grouped[k] for k in sorted(grouped)],
         }
+        if snapshots:
+            out["snapshots"] = snapshots
+        return out
 
 
 def _fmt(v):

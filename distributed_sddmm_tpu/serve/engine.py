@@ -125,6 +125,12 @@ class ServingEngine:
         self.live_compiles = 0
         self.served = 0
         self.degraded_batches = 0
+        #: True once :meth:`warmup` has compiled the whole ladder — the
+        #: admin server's ``/readyz`` warm check.
+        self.warmed = False
+        #: True once :meth:`start` has run: lets ``/healthz`` tell
+        #: "not started yet" (alive, warming) from "runner died" (503).
+        self.ever_started = False
         self._thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
 
@@ -209,6 +215,7 @@ class ServingEngine:
                     args = self.workload.pad_batch([], bb, ib)
                     force_fetch(prog(*args))
                     n += 1
+        self.warmed = True
         obs_log.info(
             "serve", "warmup complete", programs=n,
             batch_buckets=list(self.batch_buckets),
@@ -229,8 +236,20 @@ class ServingEngine:
         self._thread = threading.Thread(
             target=self._run, daemon=True, name=f"serve-{self.workload.name}"
         )
+        # Flips only once the runner thread exists: the admin server
+        # starts before warmup, and /healthz must read the whole warmup
+        # window as "alive, not started yet" (200) — a liveness prober
+        # seeing 503 there would kill the replica mid-compile.
+        self.ever_started = True
         self._thread.start()
         return self
+
+    def runner_alive(self) -> bool:
+        """Liveness signal for ``/healthz``: the runner thread exists
+        and is still draining (False before :meth:`start` and after
+        :meth:`stop` or a runner death)."""
+        t = self._thread
+        return t is not None and t.is_alive()
 
     def stop(self, drain: bool = True, timeout_s: float = 30.0) -> None:
         """Close admission; optionally drain queued requests, then stop

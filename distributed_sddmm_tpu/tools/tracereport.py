@@ -146,11 +146,15 @@ REQUEST_CHAIN_TOL_S = 1e-3
 _CHAIN_SEGMENTS = ("queue_s", "batch_wait_s", "execute_s")
 
 
-def _req_key(rec: dict, req) -> tuple:
+def req_key(rec: dict, req) -> tuple:
     """Request correlation key: (shard, req_id) — merged multi-process
     traces tag records with their source shard, under which each
-    process's ids are unique."""
+    process's ids are unique. Public: ``obs/traceexport.py`` builds its
+    Chrome request flows on exactly this join."""
     return (rec.get("shard"), req)
+
+
+_req_key = req_key
 
 
 def request_chains(trace: dict) -> dict:

@@ -1,0 +1,281 @@
+"""CPU-mesh admin-surface smoke: the live operational endpoints end to end.
+
+Boots one warm ALS fold-in engine with an **ephemeral** admin port
+(``AdminServer(port=0)`` — the library face of ``bench serve
+--admin-port 0``) on the same virtual 8-device CPU mesh the test suite
+uses, then drives real HTTP scrapes through stdlib urllib:
+
+1. **scrape** — ``/metrics`` under open-loop load: every line is
+   Prometheus-parseable (text format 0.0.4), the latency histogram's
+   cumulative buckets are monotone and agree with ``_count``, counters
+   are monotone between two scrapes, and — one scrape after the load
+   settles — counter values match the engine's own recorder/stats
+   numbers exactly.
+2. **health_ready** — ``/healthz`` and ``/readyz`` are 200 while the
+   runner is alive, warm, and within SLO budget; ``/debug/requests``
+   returns the recent request timelines off the tracer ring.
+3. **burn_flip** — the same engine judged by an impossibly tight SLO:
+   readiness flips to 503 with ``slo_burn_ok: false`` while liveness
+   stays 200 (pull the replica from rotation, don't restart it).
+4. **faulted** — an injected **persistent** ``execute:serveBatch``
+   fault: the engine degrades every batch to the serial rung but never
+   dies — ``/healthz`` stays 200 under the storm and the scrape's
+   degraded/retry counters record it.
+
+Usage::
+
+    python scripts/admin_smoke.py [-o out.json]
+
+Prints one JSON summary; exits nonzero if any check fails.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import pathlib
+import re
+import sys
+import time
+import urllib.error
+import urllib.request
+
+REPO = pathlib.Path(__file__).resolve().parents[1]
+sys.path.insert(0, str(REPO))
+
+#: One Prometheus text-format sample line (comments/blank handled apart).
+_SAMPLE_RE = re.compile(
+    r"^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^{}]*\})?\s+"
+    r"(-?[0-9.]+(?:[eE][-+]?[0-9]+)?|NaN)$"
+)
+
+
+def _get(port: int, path: str):
+    """(status, body) — 4xx/5xx are answers here, not exceptions."""
+    try:
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=10
+        ) as resp:
+            return resp.status, resp.read().decode()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read().decode()
+
+
+def parse_metrics(text: str) -> dict:
+    """{name or name{labels}: float} for every sample line; raises on a
+    line the format forbids — the parseability check IS this parse."""
+    out = {}
+    for ln, line in enumerate(text.splitlines(), 1):
+        if not line or line.startswith("#"):
+            continue
+        if not _SAMPLE_RE.match(line):
+            raise ValueError(f"line {ln} not Prometheus-parseable: {line!r}")
+        key, val = line.rsplit(None, 1)
+        out[key] = float(val)
+    return out
+
+
+def _build(seed: int = 0):
+    from distributed_sddmm_tpu.models.als import DistributedALS
+    from distributed_sddmm_tpu.parallel.dense_shift_15d import DenseShift15D
+    from distributed_sddmm_tpu.serve import ALSFoldInTopK, ServingEngine
+    from distributed_sddmm_tpu.utils.coo import HostCOO
+
+    S = HostCOO.erdos_renyi(64, 48, 6, seed=seed, values="normal")
+    alg = DenseShift15D(S, R=8, c=1, fusion_approach=2)
+    model = DistributedALS(alg, S_host=S)
+    model.run_cg(2, cg_iters=4)
+    workload = ALSFoldInTopK(model, k=5, item_buckets=(4, 8))
+    engine = ServingEngine(
+        workload, max_batch=4, max_depth=32, max_wait_ms=2.0
+    )
+    return model, workload, engine
+
+
+def check_scrape(model, engine, port) -> dict:
+    from distributed_sddmm_tpu.serve import run_load
+
+    first = parse_metrics(_get(port, "/metrics")[1])
+    run_load(engine, duration_s=1.2, rate_hz=30, seed=2, oracle_every=0)
+    mid = parse_metrics(_get(port, "/metrics")[1])
+    # One scrape interval after the load drains, the surface and the
+    # engine's own accounting must agree exactly.
+    time.sleep(0.2)
+    final = parse_metrics(_get(port, "/metrics")[1])
+    summary = engine.recorder.summary()
+    stats = engine.stats()
+
+    monotone = all(
+        final.get(k, 0.0) >= v
+        for k, v in mid.items()
+        if k.endswith("_total") or "_bucket" in k or k.endswith("_count")
+    ) and all(mid.get(k, 0.0) >= v for k, v in first.items()
+              if k.endswith("_total"))
+    buckets = [
+        (k, v) for k, v in final.items()
+        if k.startswith("dsddmm_request_latency_ms_bucket")
+    ]
+    cum = [v for _, v in buckets]
+    hist_ok = (
+        cum == sorted(cum)
+        and cum
+        and cum[-1] == final.get("dsddmm_request_latency_ms_count")
+    )
+    matches = {
+        "dsddmm_requests_completed_total": summary["completed"],
+        "dsddmm_requests_shed_total": summary["shed_count"],
+        "dsddmm_requests_errors_total": summary["errors"],
+        "dsddmm_served_requests_total": stats["served"],
+        "dsddmm_program_cache_misses_total": stats["cache_misses"],
+        "dsddmm_request_latency_ms_count": summary["completed"],
+    }
+    agree = {k: final.get(k) == float(v) for k, v in matches.items()}
+    return {
+        "name": "scrape",
+        "ok": bool(
+            monotone and hist_ok and all(agree.values())
+            and summary["completed"] > 0
+        ),
+        "completed": summary["completed"],
+        "monotone": monotone,
+        "hist_cumulative_ok": hist_ok,
+        "agree": agree,
+        "families": len(final),
+    }
+
+
+def check_health_ready(engine, port) -> dict:
+    h_code, _ = _get(port, "/healthz")
+    r_code, r_body = _get(port, "/readyz")
+    d_code, d_body = _get(port, "/debug/requests")
+    dbg = json.loads(d_body)
+    ready = json.loads(r_body)
+    return {
+        "name": "health_ready",
+        "ok": bool(
+            h_code == 200 and r_code == 200 and ready["ready"]
+            and ready["checks"]["warm"] and d_code == 200
+            and dbg["complete"] > 0 and dbg["requests"]
+        ),
+        "healthz": h_code,
+        "readyz": r_code,
+        "debug_complete_chains": dbg["complete"],
+    }
+
+
+def check_burn_flip(model, engine) -> dict:
+    from distributed_sddmm_tpu.obs import httpexp
+    from distributed_sddmm_tpu.serve import SLOSpec
+
+    tight = httpexp.AdminServer(
+        engine=engine, op_metrics=model.d_ops.metrics,
+        slo=SLOSpec.parse("p99_ms=0.0001"), port=0,
+    )
+    tight.start()
+    try:
+        r_code, r_body = _get(tight.port, "/readyz")
+        h_code, _ = _get(tight.port, "/healthz")
+        m = parse_metrics(_get(tight.port, "/metrics")[1])
+        ready = json.loads(r_body)
+    finally:
+        tight.stop()
+    burn = m.get("dsddmm_slo_burn_rate")
+    return {
+        "name": "burn_flip",
+        "ok": bool(
+            r_code == 503 and not ready["ready"]
+            and ready["checks"]["slo_burn_ok"] is False
+            and h_code == 200  # liveness unaffected: drain, don't restart
+            and burn is not None and burn > 1.0
+        ),
+        "readyz": r_code,
+        "healthz": h_code,
+        "burn_rate": burn,
+    }
+
+
+def check_faulted(engine, port) -> dict:
+    from distributed_sddmm_tpu.resilience import (
+        FaultPlan, FaultSpec, fault_plan,
+    )
+    from distributed_sddmm_tpu.serve import run_load
+
+    before = parse_metrics(_get(port, "/metrics")[1])
+    plan = FaultPlan([
+        FaultSpec(site="execute:serveBatch", kind="error", prob=1.0),
+    ])
+    with fault_plan(plan):
+        summary = run_load(
+            engine, duration_s=1.0, rate_hz=20, seed=5, oracle_every=4
+        )
+    h_code, _ = _get(port, "/metrics")  # scrape survives the storm
+    alive_code, _ = _get(port, "/healthz")
+    after = parse_metrics(_get(port, "/metrics")[1])
+    degraded_delta = (
+        after.get("dsddmm_requests_degraded_total", 0)
+        - before.get("dsddmm_requests_degraded_total", 0)
+    )
+    stats = engine.stats()
+    return {
+        "name": "faulted",
+        "ok": bool(
+            alive_code == 200 and h_code == 200
+            and summary["oracle_failures"] == 0
+            and degraded_delta > 0
+            and after.get("dsddmm_requests_degraded_total")
+            == float(summary["degraded_count"])
+            and after.get("dsddmm_degraded_batches_total")
+            == float(stats["degraded_batches"])
+        ),
+        "healthz_under_fault": alive_code,
+        "degraded_delta": degraded_delta,
+        "faults_fired": len(plan.events),
+        "oracle_failures": summary["oracle_failures"],
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("-o", "--output-file", default=None)
+    args = ap.parse_args(argv)
+
+    from distributed_sddmm_tpu.utils.platform import force_cpu_platform
+
+    force_cpu_platform(n_devices=8, replace=True)
+
+    from distributed_sddmm_tpu.obs import httpexp
+    from distributed_sddmm_tpu.serve import SLOSpec
+
+    t0 = time.perf_counter()
+    model, workload, engine = _build()
+    admin = httpexp.AdminServer(
+        engine=engine, op_metrics=model.d_ops.metrics,
+        slo=SLOSpec.parse("p99_ms=60000,err_rate=0.9"),  # loose: stays ready
+        port=0,  # ephemeral — the bench serve --admin-port 0 contract
+    )
+    admin.start()
+    engine.start()
+    try:
+        checks = [check_scrape(model, engine, admin.port)]
+        checks.append(check_health_ready(engine, admin.port))
+        checks.append(check_burn_flip(model, engine))
+        checks.append(check_faulted(engine, admin.port))
+    finally:
+        engine.stop()
+        admin.stop()
+
+    report = {
+        "ok": all(c["ok"] for c in checks),
+        "elapsed_s": round(time.perf_counter() - t0, 2),
+        "admin_port": admin.port,
+        "checks": checks,
+    }
+    text = json.dumps(report, indent=1)
+    print(text)
+    if args.output_file:
+        pathlib.Path(args.output_file).write_text(text)
+    return 0 if report["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

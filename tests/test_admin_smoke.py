@@ -1,0 +1,38 @@
+"""The CI entry point for the admin-surface smoke: live endpoints in
+miniature (ephemeral port, real HTTP scrapes, burn flip, fault storm)."""
+
+import json
+import pathlib
+import subprocess
+import sys
+
+REPO = pathlib.Path(__file__).resolve().parents[1]
+
+
+def test_admin_smoke_script(tmp_path):
+    out_file = tmp_path / "smoke.json"
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "scripts" / "admin_smoke.py"),
+         "-o", str(out_file)],
+        capture_output=True, text=True, timeout=540,
+        env={"PATH": "/usr/bin:/bin:/usr/local/bin", "HOME": "/tmp"},
+    )
+    assert proc.returncode == 0, proc.stdout[-2000:] + proc.stderr[-2000:]
+    rep = json.loads(out_file.read_text())
+    assert rep["ok"] is True
+    by_name = {c["name"]: c for c in rep["checks"]}
+    assert set(by_name) == {
+        "scrape", "health_ready", "burn_flip", "faulted",
+    }
+    # The contract bits, re-asserted here so a smoke refactor cannot
+    # silently stop checking them: exposition agrees with the engine's
+    # own stats, buckets are cumulative, readiness flips on burn while
+    # liveness does not, and a persistent fault storm never kills the
+    # surface.
+    assert all(by_name["scrape"]["agree"].values())
+    assert by_name["scrape"]["hist_cumulative_ok"] is True
+    assert by_name["burn_flip"]["readyz"] == 503
+    assert by_name["burn_flip"]["healthz"] == 200
+    assert by_name["burn_flip"]["burn_rate"] > 1.0
+    assert by_name["faulted"]["healthz_under_fault"] == 200
+    assert by_name["faulted"]["degraded_delta"] > 0

@@ -1,0 +1,314 @@
+"""Unit tests for the admin surface: exposition format, health logic,
+debug ring, exporter mode, and the `bench top` live/fallback paths.
+
+The heavier end-to-end path (real ALS engine + HTTP scrape + burn flip
++ fault storm) lives in scripts/admin_smoke.py / test_admin_smoke.py;
+these tests pin the pieces in isolation with a fake engine so failures
+localize.
+"""
+
+import json
+import re
+import urllib.error
+import urllib.request
+
+import pytest
+
+from distributed_sddmm_tpu.bench import cli
+from distributed_sddmm_tpu.obs import httpexp, metrics as obs_metrics, trace
+from distributed_sddmm_tpu.obs.telemetry import LatencyHistogram
+from distributed_sddmm_tpu.serve.slo import LatencyRecorder, SLOSpec
+
+_SAMPLE_RE = re.compile(
+    r"^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^{}]*\})?\s+"
+    r"(-?[0-9.]+(?:[eE][-+]?[0-9]+)?|NaN)$"
+)
+
+
+def _parse(text: str) -> dict:
+    out = {}
+    for line in text.splitlines():
+        if not line or line.startswith("#"):
+            continue
+        assert _SAMPLE_RE.match(line), f"not Prometheus-parseable: {line!r}"
+        key, val = line.rsplit(None, 1)
+        out[key] = float(val)
+    return out
+
+
+class _FakeQueue:
+    max_depth = 8
+    submitted_count = 5
+
+    @staticmethod
+    def depth():
+        return 3
+
+
+class _FakeReq:
+    degraded = False
+
+    @staticmethod
+    def stage_latencies_s():
+        return {"total_s": 0.004, "queue_s": 0.001, "batch_wait_s": 0.001,
+                "execute_s": 0.002}
+
+
+class _FakeEngine:
+    """Just enough surface for the exposition + health paths."""
+
+    def __init__(self, alive=True, warmed=True):
+        self.queue = _FakeQueue()
+        self.recorder = LatencyRecorder()
+        self.warmed = warmed
+        self._alive = alive
+        for _ in range(4):
+            self.recorder.record_reply(_FakeReq())
+        self.recorder.record_shed()
+        self.recorder.record_batch(3, 4, 2)
+
+    def runner_alive(self):
+        return self._alive
+
+    @staticmethod
+    def stats():
+        return {"programs": 2, "cache_hits": 7, "cache_misses": 2,
+                "disk_hits": 1, "live_compiles": 1, "served": 4,
+                "degraded_batches": 0, "queue_shed": 1}
+
+
+@pytest.fixture(autouse=True)
+def _clean_ring():
+    trace.disable()
+    yield
+    trace.disable()
+
+
+class TestExposition:
+    def test_families_declared_once_and_parseable(self):
+        expo = httpexp.Exposition()
+        expo.counter("a_total", 1, "help a", labels={"op": "x"})
+        expo.counter("a_total", 2, "help a", labels={"op": "y"})
+        expo.gauge("g", 1.5, "gauge")
+        text = expo.render()
+        assert text.count("# TYPE a_total counter") == 1
+        samples = _parse(text)
+        assert samples['a_total{op="x"}'] == 1
+        assert samples['a_total{op="y"}'] == 2
+        assert samples["g"] == 1.5
+
+    def test_label_escaping(self):
+        expo = httpexp.Exposition()
+        expo.counter("a_total", 1, labels={"op": 'we"ird\nname'})
+        line = [l for l in expo.render().splitlines() if "we" in l][0]
+        assert '\\"' in line and "\\n" in line and "\n" not in line[:-1]
+
+    def test_histogram_cumulative_with_inf_and_count(self):
+        h = LatencyHistogram()
+        for ms in (0.3, 7.0, 7.0, 99999.0):
+            h.add(ms)
+        expo = httpexp.Exposition()
+        expo.histogram_ms("lat_ms", h, sum_ms=123.0)
+        samples = _parse(expo.render())
+        buckets = [v for k, v in samples.items() if "lat_ms_bucket" in k]
+        assert buckets == sorted(buckets)  # cumulative, monotone
+        assert samples['lat_ms_bucket{le="+Inf"}'] == 4
+        assert samples["lat_ms_count"] == 4
+        assert samples["lat_ms_sum"] == 123.0
+
+    def test_known_global_counters_present_at_zero(self):
+        expo = httpexp.Exposition()
+        httpexp._expose_global(expo)
+        samples = _parse(expo.render())
+        for name in httpexp.KNOWN_GLOBAL_COUNTERS:
+            assert f"dsddmm_{name}_total" in samples
+
+    def test_undeclared_global_counter_stays_off_scrape(self):
+        """A counter deliberately kept out of KNOWN_GLOBAL_COUNTERS
+        (the ``# not-exported`` escape hatch the lint documents) must
+        actually stay off the exposition — declared names only."""
+        obs_metrics.GLOBAL.add("zz_test_only_counter", 3)  # not-exported
+        try:
+            expo = httpexp.Exposition()
+            httpexp._expose_global(expo)
+            samples = _parse(expo.render())
+            assert "dsddmm_zz_test_only_counter_total" not in samples
+        finally:
+            obs_metrics.GLOBAL.clear()
+
+    def test_engine_families_match_engine_numbers(self):
+        eng = _FakeEngine()
+        server = httpexp.AdminServer(engine=eng)
+        samples = _parse(server.metrics_text())
+        assert samples["dsddmm_queue_depth"] == 3
+        assert samples["dsddmm_queue_capacity"] == 8
+        assert samples["dsddmm_requests_completed_total"] == 4
+        assert samples["dsddmm_requests_shed_total"] == 1
+        assert samples["dsddmm_program_disk_hits_total"] == 1
+        assert samples["dsddmm_request_latency_ms_count"] == 4
+        # _sum derives from the recorder's mean * count (ms).
+        assert samples["dsddmm_request_latency_ms_sum"] == pytest.approx(
+            16.0, rel=1e-6
+        )
+
+
+class TestHealthReadiness:
+    def test_ready_when_alive_warm_within_budget(self):
+        slo = SLOSpec.parse("p99_ms=60000")
+        server = httpexp.AdminServer(engine=_FakeEngine(), slo=slo)
+        code, body = server.readiness()
+        assert code == 200 and body["ready"] is True
+        assert body["checks"]["warm"] is True
+
+    def test_dead_runner_fails_both(self):
+        server = httpexp.AdminServer(engine=_FakeEngine(alive=False))
+        assert server.health()[0] == 503
+        code, body = server.readiness()
+        assert code == 503 and body["checks"]["runner_alive"] is False
+
+    def test_cold_cache_fails_readiness_only(self):
+        server = httpexp.AdminServer(engine=_FakeEngine(warmed=False))
+        assert server.health()[0] == 200
+        code, body = server.readiness()
+        assert code == 503 and body["checks"]["warm"] is False
+
+    def test_burn_over_threshold_flips_readiness_not_health(self):
+        slo = SLOSpec.parse("p99_ms=0.0001")  # all 4 replies are "bad"
+        server = httpexp.AdminServer(engine=_FakeEngine(), slo=slo)
+        assert server.health()[0] == 200
+        code, body = server.readiness()
+        assert code == 503
+        assert body["checks"]["slo_burn_ok"] is False
+        assert body["checks"]["burn_rate"] > 1.0
+
+    def test_exporter_mode_readiness_tracks_snapshot(self):
+        server = httpexp.AdminServer(snapshot_fn=lambda: None)
+        assert server.health()[0] == 200  # exporter itself is alive
+        assert server.readiness()[0] == 503
+        server = httpexp.AdminServer(snapshot_fn=lambda: {"completed": 1})
+        assert server.readiness()[0] == 200
+
+
+class TestDebugRequests:
+    def test_chains_reconstructed_from_ring(self):
+        from distributed_sddmm_tpu.obs import clock
+
+        trace.arm_ring(64)
+        t0 = clock.now()
+        trace.event("serve:enqueue", req=7, depth=1)
+        with trace.span("serve:batch", req_ids=[7], pad_s=0.001):
+            pass
+        t1 = clock.now()
+        d = t1 - t0
+        trace.event("serve:reply", req=7, degraded=False,
+                    t_enqueue=trace.rel_time(t0), t_reply=trace.rel_time(t1),
+                    total_s=d, queue_s=d / 3, batch_wait_s=d / 3,
+                    execute_s=d - 2 * (d / 3))
+        server = httpexp.AdminServer()
+        dbg = server.debug_requests()
+        assert dbg["complete"] == 1
+        assert dbg["requests"][0]["req"] == 7
+        assert dbg["requests"][0]["complete"] is True
+
+    def test_unarmed_ring_reports_not_fails(self):
+        dbg = httpexp.AdminServer().debug_requests()
+        assert dbg["requests"] == [] and "error" in dbg
+
+
+class TestAdminServerHTTP:
+    def _get(self, port, path):
+        try:
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}{path}", timeout=5
+            ) as resp:
+                return resp.status, resp.read().decode()
+        except urllib.error.HTTPError as e:
+            return e.code, e.read().decode()
+
+    def test_round_trip_all_endpoints(self):
+        with httpexp.AdminServer(engine=_FakeEngine(), port=0) as server:
+            assert server.port > 0  # ephemeral port resolved
+            code, text = self._get(server.port, "/metrics")
+            assert code == 200
+            assert _parse(text)["dsddmm_requests_completed_total"] == 4
+            assert self._get(server.port, "/healthz")[0] == 200
+            assert self._get(server.port, "/readyz")[0] == 200
+            code, body = self._get(server.port, "/snapshot")
+            assert code == 200
+            assert json.loads(body)["completed"] == 4
+            code, body = self._get(server.port, "/debug/requests")
+            assert code == 200
+            assert self._get(server.port, "/nope")[0] == 404
+            # Server arms the trace ring for /debug/requests on start...
+            assert trace.ring() is not None
+        # ...and puts the process back as found on stop: no armed ring,
+        # no leaked memory-only tracer keeping trace.enabled() true.
+        assert trace.ring() is None
+        assert not trace.enabled()
+
+    def test_stop_leaves_flight_recorder_ring_armed(self, tmp_path):
+        from distributed_sddmm_tpu.obs import flightrec
+
+        flightrec.enable(tmp_path)
+        try:
+            with httpexp.AdminServer(engine=_FakeEngine(), port=0):
+                pass
+            # The recorder owns the ring; the admin server must not
+            # yank it away on stop.
+            assert trace.ring() is not None
+        finally:
+            flightrec.disable()
+
+    def test_healthz_200_before_first_start(self):
+        # Admin servers come up before warmup; a liveness prober must
+        # not kill the replica for still compiling. Only a runner that
+        # started and then died is down.
+        eng = _FakeEngine(alive=False, warmed=False)
+        eng.ever_started = False
+        server = httpexp.AdminServer(engine=eng)
+        assert server.health()[0] == 200
+        assert server.readiness()[0] == 503  # not ready, but alive
+
+    def test_scrape_counter_increments(self):
+        with httpexp.AdminServer(engine=_FakeEngine(), port=0) as server:
+            self._get(server.port, "/metrics")
+            _code, text = self._get(server.port, "/metrics")
+            assert _parse(text)["dsddmm_admin_scrapes"] >= 1
+
+
+class TestBenchTopCLI:
+    def test_admin_port_live_read(self, capsys):
+        snap = {
+            "schema": 1, "run_id": "live-test", "t_epoch": 1.0,
+            "queue_depth": 2, "queue_capacity": 8, "depth_frac": 0.25,
+            "submitted": 9, "requests": 9, "completed": 7, "errors": 0,
+            "shed": 2, "degraded": 0, "latency_hist": None,
+            "batch_occupancy": 0.5, "program_store": {},
+        }
+        with httpexp.AdminServer(snapshot_fn=lambda: snap, port=0) as srv:
+            rc = cli.main(["top", "--admin-port", str(srv.port)])
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert "live-test" in out and "shed 2" in out
+
+    def test_admin_port_unreachable_falls_back_to_file(
+        self, tmp_path, capsys
+    ):
+        tel = tmp_path / "t.jsonl"
+        tel.write_text(json.dumps({
+            "schema": 1, "run_id": "file-fallback", "t_epoch": 2.0,
+            "queue_depth": 0, "queue_capacity": 4, "completed": 3,
+        }) + "\n")
+        # Port 1 is unbindable/unreachable on loopback for a scrape.
+        rc = cli.main(["top", "--admin-port", "1", str(tel)])
+        assert rc == 0
+        captured = capsys.readouterr()
+        assert "file-fallback" in captured.out
+        assert "falling back" in captured.err
+
+    def test_missing_explicit_path_exits_2_one_line(self, tmp_path, capsys):
+        rc = cli.main(["top", str(tmp_path / "nope.jsonl")])
+        assert rc == 2
+        err = capsys.readouterr().err
+        assert "no telemetry file" in err
+        assert "Traceback" not in err

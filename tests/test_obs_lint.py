@@ -1,4 +1,4 @@
-"""Lints: no bare ``print(`` in library code; monotonic clock discipline.
+"""Lints: no bare ``print(``; clock discipline; counter export coverage.
 
 Diagnostics go through ``obs.log`` (structured, level-gated, mirrored
 into traces); only allowlisted CLI modules — whose *product* is stdout
@@ -13,6 +13,14 @@ calibrated monotonic/wall pair per process) so trace timestamps stay
 mergeable across processes and a wall-clock step can never produce a
 negative duration. ``obs/clock.py`` itself is the allowlist, and a line
 tagged ``# wall-clock-ok`` opts out deliberately.
+
+The third lint points it at the scrape surface: every GLOBAL counter
+the package increments must be declared in
+``obs.httpexp.KNOWN_GLOBAL_COUNTERS`` (and therefore rendered — at 0
+if never bumped — in the ``/metrics`` Prometheus exposition) or carry
+an explicit ``# not-exported`` tag at the ``GLOBAL.add`` site. A new
+counter can land in records and smoke reports but silently vanish from
+the live scrape; this is the tripwire.
 """
 
 import pathlib
@@ -100,4 +108,47 @@ def test_monotonic_clock_discipline_in_span_paths():
         "distributed_sddmm_tpu.obs.clock (now()/epoch()) so timestamps "
         "stay calibrated and mergeable, or tag a deliberate exception "
         "with '# wall-clock-ok':\n" + "\n".join(violations)
+    )
+
+
+#: A GLOBAL counter bump with a literal name: ``GLOBAL.add("x")`` or the
+#: program store's ``_global_counters().add("x")`` indirection.
+_COUNTER_ADD_RE = re.compile(
+    r"(?:\bGLOBAL|_global_counters\(\))\.add\(\s*[\"']([a-z0-9_]+)[\"']"
+)
+
+
+def test_global_counters_exported_to_metrics():
+    """Every ``GLOBAL.add("<name>")`` site in the package names a
+    counter declared in ``httpexp.KNOWN_GLOBAL_COUNTERS`` (so the
+    ``/metrics`` exposition renders it, 0-valued from the first scrape)
+    or carries a ``# not-exported`` tag — new counters cannot silently
+    vanish from the operational surface."""
+    from distributed_sddmm_tpu.obs import httpexp
+
+    known = set(httpexp.KNOWN_GLOBAL_COUNTERS)
+    violations, seen = [], set()
+    for path in sorted(PKG.rglob("*.py")):
+        rel = path.relative_to(PKG).as_posix()
+        for ln, line in _code_lines(path):
+            m = _COUNTER_ADD_RE.search(line)
+            if not m:
+                continue
+            seen.add(m.group(1))
+            if "# not-exported" in line:
+                continue
+            if m.group(1) not in known:
+                violations.append(f"{rel}:{ln}: {line.strip()[:70]}")
+    assert seen, "lint regex matched no GLOBAL.add sites — regex rotted"
+    assert not violations, (
+        "GLOBAL counter missing from the /metrics exposition — add it "
+        "to obs.httpexp.KNOWN_GLOBAL_COUNTERS (with help text) or tag "
+        "the site '# not-exported':\n" + "\n".join(violations)
+    )
+    # The reverse direction: a declared-but-never-bumped counter is a
+    # stale declaration (renamed counter keeps scraping as a frozen 0).
+    stale = known - seen
+    assert not stale, (
+        f"KNOWN_GLOBAL_COUNTERS entries no GLOBAL.add site bumps: "
+        f"{sorted(stale)}"
     )

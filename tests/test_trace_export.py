@@ -1,0 +1,230 @@
+"""Chrome trace export: schema mapping + the merge->export round trip.
+
+The tentpole contract pinned here: ``bench trace-merge`` a two-shard
+serve trace, ``bench trace-export`` the merged file, and every request
+chain ``tracereport.request_chains`` reconstructs appears as a
+connected ``s``/``t``/``f`` flow in the Chrome JSON — monotonic
+timestamps within each flow, disjoint flow ids across requests, every
+event valid per the Chrome trace-event schema, and B/E span pairs
+balanced per thread lane.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from distributed_sddmm_tpu.bench import cli
+from distributed_sddmm_tpu.obs import trace, traceexport
+from distributed_sddmm_tpu.tools import tracereport
+
+_REQUIRED_BY_PH = {
+    "M": ("name", "pid", "args"),
+    "B": ("name", "pid", "tid", "ts"),
+    "E": ("pid", "tid", "ts"),
+    "X": ("name", "pid", "tid", "ts", "dur"),
+    "i": ("name", "pid", "tid", "ts", "s"),
+    "s": ("name", "cat", "id", "pid", "tid", "ts"),
+    "t": ("name", "cat", "id", "pid", "tid", "ts"),
+    "f": ("name", "cat", "id", "pid", "tid", "ts"),
+}
+
+
+def _assert_valid_chrome(chrome: dict) -> None:
+    assert chrome["displayTimeUnit"] == "ms"
+    for ev in chrome["traceEvents"]:
+        ph = ev.get("ph")
+        assert ph in _REQUIRED_BY_PH, f"unknown phase {ph!r}: {ev}"
+        for field in _REQUIRED_BY_PH[ph]:
+            assert field in ev, f"{ph} event missing {field!r}: {ev}"
+        if "ts" in ev:
+            assert ev["ts"] >= 0
+
+
+def _flows(chrome: dict) -> dict:
+    out: dict = {}
+    for ev in chrome["traceEvents"]:
+        if ev.get("cat") == "request" and ev.get("ph") in ("s", "t", "f"):
+            out.setdefault(ev["id"], []).append(ev)
+    return out
+
+
+@pytest.fixture(autouse=True)
+def _clean_tracer(monkeypatch):
+    monkeypatch.delenv("DSDDMM_TRACE", raising=False)
+    trace.disable()
+    yield
+    trace.disable()
+
+
+def _synthetic_trace(tmp_path, name="t.jsonl"):
+    """One hand-written schema-valid trace: nested spans sharing
+    timestamps (the tie-ordering case) + one instant event."""
+    recs = [
+        {"type": "begin", "schema": 1, "run_id": "syn", "t0_epoch": 100.0,
+         "pid": 77},
+        # Parent and child open at the same instant; child closes when
+        # parent does — exactly the tie the exporter must order.
+        {"type": "span", "name": "child", "id": 2, "parent": 1, "tid": 9,
+         "t0": 1.0, "t1": 2.0, "dur_s": 1.0, "attrs": {"k": 1}},
+        {"type": "span", "name": "parent", "id": 1, "parent": None,
+         "tid": 9, "t0": 1.0, "t1": 2.0, "dur_s": 1.0, "attrs": {}},
+        {"type": "event", "name": "mark", "id": 3, "parent": 1, "tid": 9,
+         "t": 1.5, "attrs": {"x": "y"}},
+    ]
+    p = tmp_path / name
+    p.write_text("".join(json.dumps(r) + "\n" for r in recs))
+    return p
+
+
+class TestChromeMapping:
+    def test_spans_are_balanced_be_pairs_with_tie_ordering(self, tmp_path):
+        out, chrome = traceexport.write_chrome(_synthetic_trace(tmp_path))
+        _assert_valid_chrome(chrome)
+        seq = [e for e in chrome["traceEvents"] if e.get("ph") in "BE"]
+        # Open shallowest-first, close deepest-first: parent B, child B,
+        # child E, parent E — despite all four sharing two timestamps.
+        assert [(e.get("name"), e["ph"]) for e in seq] == [
+            ("parent", "B"), ("child", "B"), (None, "E"), (None, "E"),
+        ]
+        b_child = [e for e in seq if e.get("name") == "child"][0]
+        assert b_child["args"] == {"k": 1}
+        assert b_child["ts"] == pytest.approx(1.0e6)
+
+    def test_events_become_instants_and_meta_names_lanes(self, tmp_path):
+        _out, chrome = traceexport.write_chrome(_synthetic_trace(tmp_path))
+        inst = [e for e in chrome["traceEvents"] if e.get("ph") == "i"]
+        assert len(inst) == 1 and inst[0]["name"] == "mark"
+        metas = [e for e in chrome["traceEvents"] if e.get("ph") == "M"]
+        names = {e["name"] for e in metas}
+        assert {"process_name", "thread_name"} <= names
+        proc = [e for e in metas if e["name"] == "process_name"][0]
+        assert "syn" in proc["args"]["name"]
+        assert "77" in proc["args"]["name"]
+
+    def test_default_output_path_and_cli(self, tmp_path, capsys):
+        p = _synthetic_trace(tmp_path)
+        rc = cli.main(["trace-export", str(p)])
+        assert rc == 0
+        out = json.loads(capsys.readouterr().out)
+        assert out["exported"].endswith("t.chrome.json")
+        chrome = json.loads((tmp_path / "t.chrome.json").read_text())
+        _assert_valid_chrome(chrome)
+
+    def test_invalid_trace_exits_2(self, tmp_path, capsys):
+        bad = tmp_path / "bad.jsonl"
+        bad.write_text('{"type": "span"}\n')
+        rc = cli.main(["trace-export", str(bad)])
+        assert rc == 2
+        assert "trace-export failed" in capsys.readouterr().err
+
+    def test_missing_trace_exits_2(self, tmp_path, capsys):
+        rc = cli.main(["trace-export", str(tmp_path / "nope.jsonl")])
+        assert rc == 2
+
+
+# --------------------------------------------------------------------- #
+# The tentpole round trip: two-shard serve trace -> merge -> export
+# --------------------------------------------------------------------- #
+
+
+@pytest.fixture(scope="module")
+def als_workload():
+    from distributed_sddmm_tpu.models.als import DistributedALS
+    from distributed_sddmm_tpu.parallel.dense_shift_15d import DenseShift15D
+    from distributed_sddmm_tpu.serve import ALSFoldInTopK
+    from distributed_sddmm_tpu.utils.coo import HostCOO
+
+    S = HostCOO.erdos_renyi(64, 48, 4, seed=11, values="normal")
+    alg = DenseShift15D(S, R=8, c=1, fusion_approach=2)
+    model = DistributedALS(alg, S_host=S)
+    model.run_cg(1, cg_iters=2)
+    return ALSFoldInTopK(model, k=4, item_buckets=(4,))
+
+
+@pytest.fixture(scope="module")
+def merged_serve_trace(als_workload, tmp_path_factory):
+    """Two serve shards (distinct tracer origins, overlapping request
+    ids — the shard-key case) merged through the CLI."""
+    from distributed_sddmm_tpu.serve import ServingEngine
+
+    tmp = tmp_path_factory.mktemp("shards")
+    trace.disable()
+    shard_paths = []
+    for i in (0, 1):
+        tr = trace.enable(tmp / f"shard{i}.jsonl")
+        engine = ServingEngine(
+            als_workload, max_batch=4, max_depth=32, max_wait_ms=2.0
+        )
+        rng = np.random.default_rng(20 + i)
+        engine.start(warmup=False)
+        try:
+            reqs = [engine.submit(als_workload.sample_payload(rng))
+                    for _ in range(4)]
+            for r in reqs:
+                r.result(timeout_s=60.0)
+        finally:
+            engine.stop()
+        trace.disable()
+        shard_paths.append(str(tr.path))
+    out = tmp / "merged.jsonl"
+    rc = cli.main(["trace-merge", *shard_paths, "-o", str(out)])
+    assert rc == 0
+    return out
+
+
+class TestMergedRoundTrip:
+    def test_every_request_chain_is_a_connected_flow(
+        self, merged_serve_trace, tmp_path
+    ):
+        loaded = tracereport.load_trace(merged_serve_trace, strict=True)
+        chains = tracereport.request_chains(loaded)
+        assert chains["complete"] == 8  # 4 requests x 2 shards
+        assert chains["inconsistent"] == 0
+
+        out = tmp_path / "merged.chrome.json"
+        rc = cli.main(["trace-export", str(merged_serve_trace),
+                       "-o", str(out)])
+        assert rc == 0
+        chrome = json.loads(out.read_text())
+        _assert_valid_chrome(chrome)
+
+        flows = _flows(chrome)
+        # One flow per complete chain, ids disjoint by construction of
+        # the dict; each flow is the full s -> t -> f triple with
+        # monotonic timestamps (enqueue before batch before reply).
+        assert len(flows) == chains["complete"]
+        for fid, evs in flows.items():
+            assert [e["ph"] for e in evs] == ["s", "t", "f"]
+            ts = [e["ts"] for e in evs]
+            assert ts == sorted(ts)
+            assert evs[-1].get("bp") == "e"
+        # Flow endpoints land on both shards' process lanes.
+        assert {e["pid"] for f in flows.values() for e in f} == {1, 2}
+
+    def test_lanes_one_process_per_shard(self, merged_serve_trace):
+        loaded = tracereport.load_trace(merged_serve_trace, strict=True)
+        chrome = traceexport.to_chrome(loaded)
+        procs = [e for e in chrome["traceEvents"]
+                 if e.get("ph") == "M" and e["name"] == "process_name"]
+        assert len(procs) == 2
+        assert len(chrome["metadata"]["shards"]) == 2
+
+    def test_be_balanced_and_timestamps_monotone_per_lane(
+        self, merged_serve_trace
+    ):
+        loaded = tracereport.load_trace(merged_serve_trace, strict=True)
+        chrome = traceexport.to_chrome(loaded)
+        depth: dict = {}
+        for ev in chrome["traceEvents"]:
+            ph = ev.get("ph")
+            if ph == "B":
+                depth[(ev["pid"], ev["tid"])] = depth.get(
+                    (ev["pid"], ev["tid"]), 0) + 1
+            elif ph == "E":
+                key = (ev["pid"], ev["tid"])
+                depth[key] = depth.get(key, 0) - 1
+                assert depth[key] >= 0, "E without matching B"
+        assert all(v == 0 for v in depth.values())
+        assert chrome["metadata"]["spans"] == len(loaded["spans"])
+        assert chrome["metadata"]["request_flows"] == 8
