@@ -68,6 +68,7 @@ class Candidate:
     kernel: str = "xla"              # "xla" | "pallas"
     block: tuple | None = None       # Pallas (block_rows, block_cols)
     gather_budget: int | None = None  # set => chunked XLA kernel forced
+    variant: str | None = None       # codegen kernel-variant id (pallas)
 
     @property
     def chunked(self) -> bool:
@@ -184,6 +185,8 @@ def enumerate_candidates(
     budget_bytes: int = DEFAULT_HBM_BYTES,
 ) -> list[Candidate]:
     """All constructible, memory-safe candidates for (problem, machine)."""
+    from distributed_sddmm_tpu.codegen import variant_from_id, variant_ids_for
+
     out = []
     for algorithm in ALGORITHM_MODELS:
         for c in legal_c_values(algorithm, p, problem.R):
@@ -196,6 +199,30 @@ def enumerate_candidates(
                     cand = hbm_guard(problem, cand, p, budget_bytes)
                     if cand is not None:
                         out.append(cand)
+                if kernel == "pallas":
+                    # Codegen-specialized variants register beside the
+                    # generic Pallas candidates (band geometry rides in
+                    # the variant id, not the block knobs) and face the
+                    # same guards and cost-model ranking. The replicated
+                    # 2.5D layout cannot bank (build_replicated_tiles
+                    # falls back to the generic encoding), so a BANKED
+                    # candidate there would win on a discount it can
+                    # never realize and stamp a variant id onto a
+                    # byte-identical-to-generic run; non-banked R-regime
+                    # variants still apply.
+                    for vid in variant_ids_for(problem):
+                        if (
+                            algorithm == "25d_sparse_replicate"
+                            and variant_from_id(vid).banked
+                        ):
+                            continue
+                        cand = Candidate(
+                            algorithm=algorithm, c=c, kernel=kernel,
+                            variant=vid,
+                        )
+                        cand = hbm_guard(problem, cand, p, budget_bytes)
+                        if cand is not None:
+                            out.append(cand)
     return out
 
 
@@ -227,6 +254,14 @@ def model_cost(
     )
     if cand.chunked:
         t *= 1.1
+    if cand.variant:
+        from distributed_sddmm_tpu.codegen import variant_cost_factor
+
+        # Banked variants are charged by estimated padded-lane overhead
+        # relative to the generic encoding (a discount on skewed
+        # problems, a penalty when banking cannot help) — the same
+        # first-order role as the chunked kernel's 1.1x.
+        t *= variant_cost_factor(problem, cand.variant)
     return t
 
 

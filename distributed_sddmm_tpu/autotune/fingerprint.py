@@ -29,6 +29,8 @@ import hashlib
 import json
 import pathlib
 
+from distributed_sddmm_tpu.utils.buckets import pow2_bucket
+
 _PKG = pathlib.Path(__file__).resolve().parents[1]
 
 #: Fingerprint field-schema generation. Bump when the field set or any
@@ -58,27 +60,29 @@ class Problem:
 
     @property
     def npr_bucket(self) -> int:
-        """nnz/row rounded to the nearest power of two (>= 1)."""
-        npr = max(self.nnz_per_row, 1.0)
-        b = 1
-        while b * 2 <= npr * (2 ** 0.5):  # round at the geometric midpoint
-            b *= 2
-        return b
+        """nnz/row rounded to the nearest power of two (>= 1) — the
+        SHARED rule (``utils/buckets.pow2_bucket``) the serve ladder
+        and the codegen band selector also use, so plans, serving and
+        kernel banding bucket identically."""
+        return pow2_bucket(self.nnz_per_row)
 
 
 @functools.lru_cache(maxsize=1)
 def code_hash() -> str:
-    """Hash of the program-shaping sources (``ops/`` + ``parallel/``).
+    """Hash of the program-shaping sources (``ops`` + ``parallel`` +
+    ``codegen``).
 
     A plan measured under one code generation must not claim validity under
-    another — ring structure, tile ingest and kernel lowering all shape the
-    programs a plan names. Autotune's own modules (and models/bench/tools)
-    are excluded on purpose: editing selection logic or apps does not
-    change what a (algorithm, c, kernel) plan executes, and including them
-    would cold-start the cache on every subsystem tweak.
+    another — ring structure, tile ingest, kernel lowering and the codegen
+    variant geometry all shape the programs a plan names (``codegen/``
+    joined in PR 9: a banked-geometry change invalidates plans that chose a
+    variant). Autotune's own modules (and models/bench/tools) are excluded
+    on purpose: editing selection logic or apps does not change what a
+    (algorithm, c, kernel) plan executes, and including them would
+    cold-start the cache on every subsystem tweak.
     """
     h = hashlib.sha256()
-    for sub in ("ops", "parallel"):
+    for sub in ("ops", "parallel", "codegen"):
         for f in sorted((_PKG / sub).glob("*.py")):
             h.update(f.name.encode())
             h.update(f.read_bytes())

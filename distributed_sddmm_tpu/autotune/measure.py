@@ -38,11 +38,29 @@ class MeasureTimeout(CallTimeout):
 
 def _build_kernel(cand: Candidate):
     """The kernel instance a candidate names (chunked XLA = budget
-    override; Pallas block config applied via :func:`block_knobs`)."""
+    override; Pallas block config applied via :func:`block_knobs`;
+    codegen variant id -> the banked specialized kernel, falling back
+    to the generic Pallas kernel when the id's variant generation is
+    unknown to this code)."""
     from distributed_sddmm_tpu.ops.kernels import XlaKernel, get_kernel
 
     if cand.kernel == "xla":
         return XlaKernel(gather_budget=cand.gather_budget)
+    if cand.variant:
+        from distributed_sddmm_tpu import codegen
+        from distributed_sddmm_tpu.obs import log as obs_log
+
+        try:
+            return codegen.make_banked_kernel(cand.variant)
+        except ValueError as e:
+            obs_log.warn(
+                "codegen",
+                "unknown kernel variant; generic pallas fallback",
+                variant=cand.variant, error=str(e),
+            )
+            from distributed_sddmm_tpu.obs import metrics as obs_metrics
+
+            obs_metrics.GLOBAL.add("codegen_generic_fallbacks")
     return get_kernel(cand.kernel)
 
 

@@ -47,6 +47,11 @@ class Plan:
     kernel: str = "xla"
     block: tuple | None = None
     gather_budget: int | None = None
+    #: Codegen kernel-variant id (``codegen/variants.py``); None = the
+    #: generic kernel. Optional field — pre-PR-9 cached plans load with
+    #: None, and a plan carrying an unknown variant generation falls
+    #: back to the generic kernel at build time.
+    variant: str | None = None
     source: str = "model"            # model | measured | seed
     predicted_ms: float | None = None
     measured_gflops: float | None = None
@@ -66,6 +71,7 @@ class Plan:
             kernel=d.get("kernel", "xla"),
             block=tuple(block) if block else None,
             gather_budget=d.get("gather_budget"),
+            variant=d.get("variant"),
             source=d.get("source", "model"),
             predicted_ms=d.get("predicted_ms"),
             measured_gflops=d.get("measured_gflops"),
@@ -76,6 +82,7 @@ class Plan:
         return Candidate(
             algorithm=self.algorithm, c=self.c, kernel=self.kernel,
             block=self.block, gather_budget=self.gather_budget,
+            variant=self.variant,
         )
 
     def make_kernel(self):
@@ -228,6 +235,7 @@ def get_plan(
             algorithm=best_cand.algorithm, c=best_cand.c,
             kernel=best_cand.kernel, block=best_cand.block,
             gather_budget=best_cand.gather_budget,
+            variant=best_cand.variant,
             source="measured",
             predicted_ms=_predicted_ms(problem, best_cand, p, machine),
             measured_gflops=rec.get("overall_throughput"),
@@ -239,6 +247,7 @@ def get_plan(
             algorithm=best_cand.algorithm, c=best_cand.c,
             kernel=best_cand.kernel, block=best_cand.block,
             gather_budget=best_cand.gather_budget,
+            variant=best_cand.variant,
             source="seed" if seed is not None and best_cand == seed else "model",
             predicted_ms=cost * 1e3,
             fingerprint_key=fp.key,

@@ -90,9 +90,15 @@ def _resolve_algs(name: str) -> list[str]:
     )
 
 
-def _get_kernel(name: str):
+def _get_kernel(name: str, variant: str | None = None):
     from distributed_sddmm_tpu.ops import get_kernel
 
+    if variant:
+        from distributed_sddmm_tpu.codegen import make_banked_kernel
+
+        if name not in ("pallas", "auto"):
+            raise SystemExit("--kernel-variant requires the pallas kernel")
+        return make_banked_kernel(variant)
     return get_kernel(name)
 
 
@@ -126,11 +132,14 @@ def _run_configs(S, alg_names, args, r_values=None):
                 print(
                     f"plan[{plan.source}] {run_alg} c={run_c} "
                     f"kernel={plan.kernel}"
+                    + (f" variant={plan.variant}" if plan.variant else "")
                     + (" (chunked)" if plan.gather_budget else ""),
                     file=sys.stderr,
                 )
             else:
-                run_alg, run_c, kernel = alg, args.c, _get_kernel(args.kernel)
+                run_alg, run_c, kernel = alg, args.c, _get_kernel(
+                    args.kernel, getattr(args, "kernel_variant", None)
+                )
             for fused in ([True, False] if args.fused == "both" else [args.fused == "yes"]):
                 # The plan's Pallas block config applies at strategy BUILD
                 # (tile ingest bakes the geometry), so the whole benchmark
@@ -190,6 +199,11 @@ def _add_common(p: argparse.ArgumentParser) -> None:
     p.add_argument("--trials", type=int, default=5)
     p.add_argument("--warmup", type=int, default=1)
     p.add_argument("--kernel", default="auto", help="xla | pallas | auto")
+    p.add_argument(
+        "--kernel-variant", default=None, metavar="VID",
+        help="force a codegen kernel-variant id (e.g. v1.rb32.rm) on the "
+        "pallas kernel; plans select one automatically via --algorithm auto",
+    )
     p.add_argument(
         "--plan-mode", default="model", choices=["model", "auto", "measure"],
         help="with an 'auto' algorithm: 'model' selects by cost model / "
@@ -1024,6 +1038,7 @@ def _dispatch_serve(args) -> int:
         "c": plan.c if plan else d_ops.c,
         "fused": True,
         "kernel": getattr(d_ops.kernel, "name", type(d_ops.kernel).__name__),
+        "kernel_variant": eng.workload.kernel_variant,
         "num_trials": summary["completed"],
         "elapsed": summary["duration_s"],
         "overall_throughput": None,

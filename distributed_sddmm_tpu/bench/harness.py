@@ -29,7 +29,9 @@ import jax
 from distributed_sddmm_tpu.common import KernelMode, MatMode
 from distributed_sddmm_tpu.models.als import DistributedALS
 from distributed_sddmm_tpu.models.gat import GAT, GATLayer
-from distributed_sddmm_tpu.parallel.base import DistributedSparse
+from distributed_sddmm_tpu.parallel.base import (
+    DistributedSparse, realized_kernel_variant,
+)
 from distributed_sddmm_tpu.parallel.cannon_dense_25d import CannonDense25D
 from distributed_sddmm_tpu.parallel.cannon_sparse_25d import CannonSparse25D
 from distributed_sddmm_tpu.parallel.dense_shift_15d import DenseShift15D
@@ -321,6 +323,7 @@ def benchmark_algorithm(
         "elapsed": elapsed,
         "overall_throughput": throughput,
         "kernel": getattr(alg.kernel, "name", type(alg.kernel).__name__),
+        "kernel_variant": realized_kernel_variant(alg),
         "alg_info": alg.json_algorithm_info(),
         "perf_stats": perf_stats,
         "metrics": alg.metrics.to_dict(),

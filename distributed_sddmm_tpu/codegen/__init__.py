@@ -1,0 +1,55 @@
+"""Fingerprint-keyed specialized Pallas kernel codegen (PR 9).
+
+The generic Pallas tile kernel (``ops/pallas_kernels.py``) is
+one-shape-fits-all: one chunk geometry and one kernel body regardless of
+shape, nnz/row skew, R, or dtype. JITSPMM and "Sparse GPU Kernels for
+Deep Learning" (PAPERS.md) both show large wins from per-problem code
+generation; this package is that idea applied to the autotune
+fingerprint: ``get_plan()`` already knows (shape, npr_bucket, R, dtype),
+so the fingerprint becomes the codegen key and each problem class gets a
+specialized kernel variant instead of the generic one.
+
+* ``codegen.variants`` — the variant space: row-band thresholds derived
+  from the shared npr bucketing (``utils/buckets.py``), R-regime tile
+  geometry (small-R / headline / R>=1024), and per-band kernel-body
+  styles. Variant ids are stable, self-describing strings
+  (``v1.rb<thr>.<regime>``) that round-trip through plan records and
+  program-store keys.
+* ``codegen.banded`` — row-banked chunk-list construction: each tile's
+  rows are partitioned into nnz/row bands and one chunk list is built
+  per band, so short rows stop paying long-row padding inside 128-lane
+  chunks (measured by the counted padded-lane metric).
+* ``codegen.kernel`` — :class:`BankedPallasKernel`: the drop-in
+  ``LocalKernel`` that runs one specialized Pallas launch per band,
+  with the band's body chosen at trace time in pure Python (no runtime
+  branching inside any kernel).
+* ``codegen.hlo`` — the offline structural gate: AOT-compile a banked
+  program for a real TPU topology and assert the band-specialized
+  bodies are present in the scheduled HLO (one ``tpu_custom_call`` per
+  band per ring step), banking the R>=1024 compile point.
+
+Variants register as autotune candidates (``autotune/candidates.py``),
+are pruned by the cost model like every other candidate, compile through
+the PR-6 ProgramStore with the variant id in the program key, and report
+their variant through bench records, the runstore index and /metrics.
+"""
+
+from distributed_sddmm_tpu.codegen.variants import (  # noqa: F401
+    BandSpec,
+    KernelVariant,
+    select_variant,
+    variant_cost_factor,
+    variant_from_id,
+    variant_ids_for,
+)
+from distributed_sddmm_tpu.codegen.banded import (  # noqa: F401
+    Band,
+    BandedMeta,
+    build_banded,
+    padded_lane_count,
+)
+from distributed_sddmm_tpu.codegen.kernel import (  # noqa: F401
+    BankedPallasKernel,
+    BankedTile,
+    make_banked_kernel,
+)

@@ -1,0 +1,156 @@
+"""The banked Pallas kernel: one specialized launch per row band.
+
+:class:`BankedPallasKernel` is a drop-in for
+:class:`~distributed_sddmm_tpu.ops.pallas_kernels.PallasKernel` — same
+flat protocol, same tile-level entry points — that consumes the banked
+encoding (``codegen/banded.py``) when the tile set carries it. Each
+band is a STATIC chunk range with its own geometry and body style, so
+the per-band specialization is pure-Python trace-time dispatch: the
+emitted program contains one Pallas launch per band (visible as one
+``tpu_custom_call`` each in compiled HLO — what the structural gate
+counts) and no runtime branching inside any kernel.
+
+Per-band numerics: the SDDMM mid values are per-nonzero (band chunk
+ranges concatenate back into the flat layout); SpMM/fused dense
+partials are full-frame per band (every band's chunk list zeroes and
+flushes every row block) and combine by addition — each output row has
+real contributions in exactly one band, zeros elsewhere.
+
+When handed a plain ``BlockedTile`` (tile sets built without banding —
+the replicated 2.5D layout, degenerate block grids), every entry point
+falls through to the generic superclass path, so the banked kernel is
+safe to bind anywhere the generic one is.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+from distributed_sddmm_tpu.codegen.banded import Band
+from distributed_sddmm_tpu.codegen.variants import (
+    KernelVariant, variant_from_id,
+)
+from distributed_sddmm_tpu.ops.pallas_kernels import (
+    PallasKernel, _fused_op, _sddmm_op, _spmm_op,
+)
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class BankedTile:
+    """Per-(device, tile) banked chunk-list view.
+
+    The three arrays are the COMBINED (band-concatenated) chunk list —
+    the same arrays a :class:`BlockedTile` would hold — and ``bands``
+    carries the static per-band ranges/geometry the kernel slices by.
+    """
+
+    lr: jax.Array        # [C_tot, CHUNK] int32
+    lc: jax.Array        # [C_tot, CHUNK] int32
+    meta: jax.Array      # [C_tot] int32 (gr/gc relative to each band)
+    bands: tuple = dataclasses.field(
+        metadata=dict(static=True), default=()
+    )  # tuple[Band, ...]
+    rows_pad: int = dataclasses.field(metadata=dict(static=True), default=0)
+    cols_pad: int = dataclasses.field(metadata=dict(static=True), default=0)
+
+    @property
+    def n_chunks(self) -> int:
+        return self.lr.shape[0]
+
+
+class BankedPallasKernel(PallasKernel):
+    """Fingerprint-specialized Pallas kernel (one launch per row band).
+
+    ``variant`` is a :class:`~distributed_sddmm_tpu.codegen.variants.
+    KernelVariant` or its stable id string; the id is what plan
+    records, program keys and bench records carry.
+    """
+
+    def __init__(
+        self,
+        variant: KernelVariant | str,
+        precision: str | None = None,
+        interpret: bool | None = None,
+        scatter_form: str | None = None,
+        batch_step: bool | None = None,
+    ):
+        super().__init__(
+            precision=precision, interpret=interpret,
+            scatter_form=scatter_form, batch_step=batch_step,
+        )
+        if isinstance(variant, str):
+            variant = variant_from_id(variant)
+        self.variant = variant
+        self.variant_id = variant.variant_id
+
+    # ------------------------------------------------------------------ #
+    # Banded tile-level entry points
+    # ------------------------------------------------------------------ #
+
+    def _band_geom(self, band: Band) -> tuple:
+        batch = band.body in ("batched", "single")
+        single = band.body == "single"
+        return (
+            band.bm, band.bn, band.gr_blocks, band.gc_blocks, band.group,
+            self.interpret, self.scatter_form, batch, single,
+        )
+
+    def _band_slices(self, blk: BankedTile, band: Band):
+        return (
+            blk.meta[band.c0:band.c1],
+            blk.lr[band.c0:band.c1],
+            blk.lc[band.c0:band.c1],
+        )
+
+    def sddmm_tile_t(self, blk, vals, at, bt, out_dtype):
+        if not isinstance(blk, BankedTile):
+            return super().sddmm_tile_t(blk, vals, at, bt, out_dtype)
+        sv = self._chunk_vals(blk, vals)
+        mids = []
+        for band in blk.bands:
+            meta, lr, lc = self._band_slices(blk, band)
+            mid = _sddmm_op(
+                self._band_geom(band), meta, lr, lc,
+                sv[band.c0:band.c1], at, bt,
+            )
+            mids.append(mid.reshape(-1))
+        return jnp.concatenate(mids).astype(out_dtype)
+
+    def spmm_tile_t(self, blk, vals, bt):
+        if not isinstance(blk, BankedTile):
+            return super().spmm_tile_t(blk, vals, bt)
+        sv = self._chunk_vals(blk, vals)
+        outT = None
+        for band in blk.bands:
+            meta, lr, lc = self._band_slices(blk, band)
+            o = _spmm_op(
+                self._band_geom(band), meta, lr, lc,
+                sv[band.c0:band.c1], bt,
+            )
+            outT = o if outT is None else outT + o
+        return outT
+
+    def fused_tile_t(self, blk, vals, at, bt, out_dtype):
+        if not isinstance(blk, BankedTile):
+            return super().fused_tile_t(blk, vals, at, bt, out_dtype)
+        sv = self._chunk_vals(blk, vals)
+        outT, mids = None, []
+        for band in blk.bands:
+            meta, lr, lc = self._band_slices(blk, band)
+            o, mid = _fused_op(
+                self._band_geom(band), meta, lr, lc,
+                sv[band.c0:band.c1], at, bt,
+            )
+            outT = o if outT is None else outT + o
+            mids.append(mid.reshape(-1))
+        return outT, jnp.concatenate(mids).astype(out_dtype)
+
+
+def make_banked_kernel(variant: KernelVariant | str, **kw) -> BankedPallasKernel:
+    """Factory used by ``autotune/measure._build_kernel`` for variant
+    candidates (and by anything holding only a variant id)."""
+    return BankedPallasKernel(variant, **kw)

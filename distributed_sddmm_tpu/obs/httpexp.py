@@ -74,6 +74,9 @@ KNOWN_GLOBAL_COUNTERS: dict = {
     "program_store_hits": "AOT program store disk hits",
     "program_store_misses": "AOT program store misses",
     "live_compiles": "in-process compiles (cold-start cost)",
+    "codegen_variants_built": "specialized banked kernel encodings built",
+    "codegen_generic_fallbacks":
+        "kernel-variant requests that fell back to the generic encoding",
     "serve_shed": "requests shed by admission control",
     "serve_degraded_batches": "serving batches degraded to the serial rung",
     "flightrec_dumps": "flight-recorder snapshots written",
@@ -203,6 +206,13 @@ def _expose_op_metrics(expo: Exposition, op_metrics) -> None:
     for field, metric, help_text in _OP_FIELDS:
         for op, rec in ops.items():
             expo.counter(metric, rec[field], help_text, labels={"op": op})
+    for op, rec in ops.items():
+        if "padded_lane_frac" in rec:
+            expo.gauge(
+                f"{PREFIX}_op_padded_lane_frac", rec["padded_lane_frac"],
+                "inert pad-lane fraction of the op's chunk-list encoding",
+                labels={"op": op},
+            )
 
 
 def _expose_engine(expo: Exposition, engine, slo=None) -> None:

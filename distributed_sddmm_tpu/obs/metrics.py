@@ -83,6 +83,20 @@ class OpMetrics:
     def __init__(self):
         self._lock = threading.Lock()
         self._ops: dict[str, dict] = {}
+        #: Per-op gauge values (set, not accumulated): structural facts
+        #: like ``padded_lane_frac`` that describe what the op runs
+        #: OVER rather than what one dispatch did. Kept across
+        #: :meth:`clear` — a timer reset does not rebuild tiles.
+        self._gauges: dict[str, dict] = {}
+
+    def note(self, op: str, **gauges) -> None:
+        """Set per-op gauges (e.g. ``padded_lane_frac``). Last write
+        wins; values surface in :meth:`to_dict` alongside the op's
+        counters once the op has dispatched (a noted-but-never-run op
+        stays out of records and scrapes — strategies note every op
+        their tiles COULD serve at build time)."""
+        with self._lock:
+            self._gauges.setdefault(op, {}).update(gauges)
 
     def record(
         self,
@@ -136,7 +150,10 @@ class OpMetrics:
             )
 
     def to_dict(self) -> dict:
-        """Full per-op attribution, JSON-ready (sorted, rounded)."""
+        """Full per-op attribution, JSON-ready (sorted, rounded).
+        Noted gauges merge into their op's dict; gauge-only ops (noted
+        at tile build but never dispatched) are omitted so records and
+        scrapes list only ops that actually ran."""
         with self._lock:
             out = {}
             for op in sorted(self._ops):
@@ -149,6 +166,7 @@ class OpMetrics:
                     "comm_words": rec["comm_words"],
                     "comm_words_extra": rec["comm_words_extra"],
                     "flops": rec["flops"],
+                    **self._gauges.get(op, {}),
                 }
             return out
 

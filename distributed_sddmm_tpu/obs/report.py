@@ -88,6 +88,7 @@ def _history_table(rows: list[dict]) -> str:
     cells = [
         "<table><tr><th class=l>run_id</th><th class=l>source</th>"
         "<th class=l>algorithm</th><th>app</th><th>R</th><th>c</th>"
+        "<th class=l>variant</th>"
         "<th>backend</th><th>elapsed&nbsp;s</th><th>GFLOP/s</th>"
         "<th>cold&nbsp;compiles</th>"
         "<th>p99&nbsp;ms</th><th>burn</th>"
@@ -109,7 +110,9 @@ def _history_table(rows: list[dict]) -> str:
             f"<td class=l>{_esc(r.get('source'))}</td>"
             f"<td class=l>{_esc(r.get('algorithm'))}</td>"
             f"<td>{_esc(r.get('app'))}</td><td>{_esc(r.get('R'))}</td>"
-            f"<td>{_esc(r.get('c'))}</td><td>{_esc(r.get('backend'))}</td>"
+            f"<td>{_esc(r.get('c'))}</td>"
+            f"<td class=l>{_esc(r.get('kernel_variant') or '-')}</td>"
+            f"<td>{_esc(r.get('backend'))}</td>"
             f"<td>{_fmt(r.get('elapsed'))}</td>"
             f"<td>{_fmt(r.get('overall_throughput'))}</td>"
             f"<td>{'-' if live is None else int(live)}</td>"

@@ -51,8 +51,8 @@ DEFAULT_ROOT = _REPO / "artifacts" / "runstore"
 #: these without opening per-run files).
 _INDEX_FIELDS = (
     "run_id", "created_epoch", "key", "backend", "code_hash",
-    "algorithm", "app", "R", "c", "fused", "kernel", "elapsed",
-    "overall_throughput", "source", "anomaly_count",
+    "algorithm", "app", "R", "c", "fused", "kernel", "kernel_variant",
+    "elapsed", "overall_throughput", "source", "anomaly_count",
     # Serving records (`bench serve`) only; None elsewhere.
     "latency_p99_ms", "shed_count",
     # Program-store cold-start cost: in-process compiles this run paid
@@ -71,7 +71,11 @@ _INDEX_FIELDS = (
 #: a heatmap sweep benchmarks every algorithm at every R cell — and
 #: pooling a 2.5D Cannon run into a 1.5D-fused baseline would gate on
 #: an apples-to-oranges delta.
-_CONFIG_AXES = ("algorithm", "app", "c", "fused", "kernel")
+# ``kernel_variant`` joined in PR 9 — a banked-variant run must not
+# pool into the generic kernel's baseline (both directions would poison
+# the noise bands); pre-PR-9 docs carry None, which matches every other
+# None-variant run, so history stays comparable.
+_CONFIG_AXES = ("algorithm", "app", "c", "fused", "kernel", "kernel_variant")
 
 
 class RunStore:
@@ -308,6 +312,7 @@ def _index_row(doc: dict) -> dict:
         "c": rec.get("c"),
         "fused": rec.get("fused"),
         "kernel": rec.get("kernel"),
+        "kernel_variant": rec.get("kernel_variant"),
         "elapsed": rec.get("elapsed"),
         "overall_throughput": rec.get("overall_throughput"),
         "source": doc.get("source"),

@@ -135,6 +135,18 @@ class BlockedMeta:
         return np.where(self.pad_lane, 0, cols).astype(np.int32)
 
 
+def padded_lane_count(meta) -> int:
+    """Inert pad lanes in one chunk-list encoding (``BlockedMeta`` or
+    codegen's ``BandedMeta``) — the counted waste metric the banked
+    kernel variants exist to shrink."""
+    return int(meta.pad_lane.sum())
+
+
+def padded_lane_frac(meta) -> float:
+    total = meta.pad_lane.size
+    return float(meta.pad_lane.sum()) / total if total else 0.0
+
+
 def pack_meta(gr, gc, first, last):
     return (
         (gr.astype(np.int64) << _GR_SHIFT)

@@ -197,6 +197,54 @@ def _make_fused_body_batched(G, form):
     return body
 
 
+def _make_fused_body_single(G, form):
+    """Dense-short-row band body (codegen): every (bucket, gr) group is
+    host-proven to span EXACTLY one grid step with no trailing pad
+    steps (``codegen.banded._single_step_provable``), so the zero/flush
+    conditionals and the VMEM accumulator carry vanish — each step
+    writes its output window once, unconditionally. Same arithmetic as
+    the batched body (the accumulator add was ``0 + x``)."""
+
+    def body(meta_ref, lr_ref, lc_ref, sv_ref, at_ref, *rest):
+        bt_refs = rest[:G]
+        out_ref, mid_ref = rest[G], rest[G + 1]
+        bm = out_ref.shape[1]
+        lr_all = _lane_concat(lr_ref, G)
+        ohT_all, a_rT = _gathered(at_ref, lr_all)
+        b_rT = _gathered_cols(bt_refs, lc_ref, G)
+        sv_all = _lane_concat(sv_ref, G)
+        dots = jnp.sum(a_rT * b_rT, axis=0, keepdims=True) * sv_all
+        _write_mid(mid_ref, dots, G)
+        scT = (b_rT * dots).astype(at_ref.dtype)
+        out_ref[:] = _scattered(scT, ohT_all, lr_all, bm, form)
+
+    return body
+
+
+def _make_spmm_body_single(G, form):
+    """SpMM variant of :func:`_make_fused_body_single` (same single-step
+    precondition, no accumulator scratch, no scalar conditionals)."""
+
+    def body(meta_ref, lr_ref, lc_ref, sv_ref, *rest):
+        bt_refs = rest[:G]
+        out_ref = rest[G]
+        bm = out_ref.shape[1]
+        lr_all = _lane_concat(lr_ref, G)
+        b_rT = _gathered_cols(bt_refs, lc_ref, G)
+        sv_all = _lane_concat(sv_ref, G)
+        scT = (b_rT * sv_all).astype(bt_refs[0].dtype)
+        if form == "bt":
+            ohT_all = (
+                jax.lax.broadcasted_iota(jnp.int32, (bm, G * CHUNK), 0)
+                == lr_all
+            ).astype(scT.dtype)
+        else:
+            ohT_all = None
+        out_ref[:] = _scattered(scT, ohT_all, lr_all, bm, form)
+
+    return body
+
+
 def _make_spmm_body_batched(G, form):
     def body(meta_ref, lr_ref, lc_ref, sv_ref, *rest):
         bt_refs = rest[:G]
@@ -320,12 +368,12 @@ def _make_spmm_body(G, form):
     jax.jit,
     static_argnames=(
         "op", "bm", "bn", "gr_blocks", "gc_blocks", "group", "interpret",
-        "scatter_form", "batch_step",
+        "scatter_form", "batch_step", "single_step",
     ),
 )
 def _tile_call(
     meta, lr, lc, sv, at, bt, op, bm, bn, gr_blocks, gc_blocks, group,
-    interpret, scatter_form="bt", batch_step=False,
+    interpret, scatter_form="bt", batch_step=False, single_step=False,
 ):
     """Launch one chunk-list kernel. ``at``/``bt`` are feature-major padded
     dense operands [R, gr_blocks*bm] / [R, gc_blocks*bn]; ``sv`` is the
@@ -355,13 +403,16 @@ def _tile_call(
     mid_shape = jax.ShapeDtypeStruct((steps, G, CHUNK), jnp.float32)
 
     if op == "fused":
-        body = (_make_fused_body_batched if batch_step else _make_fused_body)(
-            G, scatter_form
-        )
+        if single_step:
+            body, scratch = _make_fused_body_single(G, scatter_form), []
+        else:
+            body = (
+                _make_fused_body_batched if batch_step else _make_fused_body
+            )(G, scatter_form)
+            scratch = [pltpu.VMEM((R, bm), jnp.float32)]
         in_specs = [chunk_spec, chunk_spec, chunk_spec, at_spec, *bt_specs]
         operands = (lr3, lc3, sv3, at, *([bt] * G))
         out_specs, out_shapes = [out_spec, chunk_spec], [out_shape, mid_shape]
-        scratch = [pltpu.VMEM((R, bm), jnp.float32)]
     elif op == "sddmm":
         body = (
             _make_sddmm_body_batched(G) if batch_step else _make_sddmm_body(G)
@@ -370,13 +421,16 @@ def _tile_call(
         operands = (lr3, lc3, sv3, at, *([bt] * G))
         out_specs, out_shapes, scratch = [chunk_spec], [mid_shape], []
     elif op == "spmm":
-        body = (_make_spmm_body_batched if batch_step else _make_spmm_body)(
-            G, scatter_form
-        )
+        if single_step:
+            body, scratch = _make_spmm_body_single(G, scatter_form), []
+        else:
+            body = (
+                _make_spmm_body_batched if batch_step else _make_spmm_body
+            )(G, scatter_form)
+            scratch = [pltpu.VMEM((R, bm), jnp.float32)]
         in_specs = [chunk_spec, chunk_spec, chunk_spec, *bt_specs]
         operands = (lr3, lc3, sv3, *([bt] * G))
         out_specs, out_shapes = [out_spec], [out_shape]
-        scratch = [pltpu.VMEM((R, bm), jnp.float32)]
     else:
         raise ValueError(op)
 
@@ -417,16 +471,17 @@ def _flat_indices(geom, meta, lr, lc):
 # don't-cares that the pad positions of value vectors absorb. The integer
 # metadata arrays are explicit arguments with float0 cotangents (custom_vjp
 # must not close over tracers); ``geom`` = (bm, bn, gr_blocks, gc_blocks,
-# group, interpret, scatter_form, batch_step) rides in nondiff_argnums.
+# group, interpret, scatter_form, batch_step, single_step) rides in
+# nondiff_argnums (``single_step`` selects the codegen direct-write body).
 
 
 def _geom_call(geom, op, meta, lr, lc, sv, at, bt):
-    bm, bn, grb, gcb, group, interpret, form, batch = geom
+    bm, bn, grb, gcb, group, interpret, form, batch, single = geom
     return tuple(
         _tile_call(
             meta, lr, lc, sv, at, bt, op=op, bm=bm, bn=bn,
             gr_blocks=grb, gc_blocks=gcb, group=group, interpret=interpret,
-            scatter_form=form, batch_step=batch,
+            scatter_form=form, batch_step=batch, single_step=single,
         )
     )
 
@@ -551,6 +606,12 @@ class PallasKernel:
     """
 
     is_blocked = True
+    #: Codegen specialization id carried by subclasses
+    #: (``codegen.kernel.BankedPallasKernel``); None = the generic
+    #: one-shape-fits-all kernel. Rides into program-store keys
+    #: (``parallel/base._program_cache_key``) and bench records.
+    variant_id: str | None = None
+    variant = None
 
     def __init__(
         self,
@@ -625,7 +686,7 @@ class PallasKernel:
     def _geom(self, blk: BlockedTile) -> tuple:
         return (
             blk.bm, blk.bn, blk.gr_blocks, blk.gc_blocks, blk.group,
-            self.interpret, self.scatter_form, self.batch_step,
+            self.interpret, self.scatter_form, self.batch_step, False,
         )
 
     def sddmm_tile_t(self, blk: BlockedTile, vals, at, bt, out_dtype):

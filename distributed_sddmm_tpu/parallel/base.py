@@ -42,6 +42,41 @@ from distributed_sddmm_tpu.parallel.mesh import GridSpec
 from distributed_sddmm_tpu.parallel.sharding import TileSet
 
 
+def _band_sig(tiles) -> str:
+    """Short digest of the REALIZED band structure (``.b<hex>``; "" for
+    an un-banked encoding). The banked kernel bakes the band tuple —
+    chunk ranges, merged widths, body upgrades — STATICALLY into the
+    traced program, and all of it is data-dependent (row-degree
+    distribution), while the autotune fingerprint only hashes aggregate
+    stats and the aval signature only sees ``[C_tot, CHUNK]`` shapes.
+    Without this segment, two same-fingerprint matrices with different
+    skew could alias one store entry and slice chunks at the wrong
+    static band boundary — silently wrong output. The digest is a pure
+    function of frozen int/str dataclasses, so it is cross-process
+    stable like every other key component."""
+    bands = getattr(tiles, "blk_bands", None)
+    if not bands:
+        return ""
+    import hashlib
+
+    return ".b" + hashlib.sha256(repr(bands).encode()).hexdigest()[:10]
+
+
+def realized_kernel_variant(alg):
+    """THE resolution rule for "what variant did this run actually
+    execute" — bench records (``harness``) and serve-ladder keys
+    (``serve/workloads``) both resolve through here so they can never
+    drift apart and split one run across gate baselines. Prefers the
+    strategy's :attr:`DistributedSparse.kernel_variant_realized` (None
+    there MEANS generic, e.g. a guard fallback); only an object without
+    that property falls back to the kernel's identity."""
+    missing = object()
+    realized = getattr(alg, "kernel_variant_realized", missing)
+    if realized is not missing:
+        return realized
+    return getattr(getattr(alg, "kernel", None), "variant_id", None)
+
+
 class DistributedSparse(abc.ABC):
     """Base class for the four communication-avoiding strategies."""
 
@@ -278,10 +313,27 @@ class DistributedSparse(abc.ABC):
         ablation mode — the single shape ``_program`` and
         ``inject_program`` must agree on (strategies with additional
         program variants, e.g. the shift strategies' fusion builds,
-        override to append their segments)."""
+        override to append their segments).
+
+        A codegen-specialized kernel (``codegen/``) appends its variant
+        id: the banked programs trace different Pallas launches from
+        the generic ones, so a program-store entry compiled under one
+        variant must never answer for another (or for the generic
+        kernel — whose keys are UNCHANGED, so pre-variant store entries
+        keep hitting)."""
         from distributed_sddmm_tpu.parallel.loops import ablation
 
-        return (op, use_st, ablation())
+        key = (op, use_st, ablation())
+        if getattr(self.kernel, "variant_id", None):
+            # The REALIZED variant of the tiles this op consumes, not
+            # the kernel's identity: when the build guard-felled to the
+            # generic encoding, the traced program IS the generic one
+            # and must share (not duplicate) its store entry.
+            tiles = self.ST_tiles if use_st else self.S_tiles
+            vid = getattr(tiles, "blk_variant", None)
+            if vid:
+                key += (f"variant={vid}{_band_sig(tiles)}",)
+        return key
 
     def inject_program(self, op: str, use_st: bool, loaded) -> None:
         """Install a pre-built executable (e.g. a `deserialize_and_load`
@@ -360,6 +412,71 @@ class DistributedSparse(abc.ABC):
         """True when the kernel consumes chunk-list metadata and the tile
         set carries it (``ops/blocked.py``)."""
         return getattr(self.kernel, "is_blocked", False) and tiles.has_blocked
+
+    def _blk_tile_factory(self, tiles):
+        """Constructor for the kernel's per-tile chunk-list view:
+        ``f(lr [C, CHUNK], lc [C, CHUNK], meta [C]) -> tile view``.
+
+        Returns a :class:`~distributed_sddmm_tpu.codegen.kernel.
+        BankedTile` builder when the tile set carries the banked
+        encoding (``blk_bands``), else the generic ``BlockedTile``
+        builder — the one place every strategy's blocked program binds
+        the kernel to the tile geometry."""
+        bands = getattr(tiles, "blk_bands", None)
+        if bands:
+            from distributed_sddmm_tpu.codegen.kernel import BankedTile
+
+            bm, bn, grb, gcb, _ = tiles.blk_geom
+            rows_pad, cols_pad = grb * bm, gcb * bn
+
+            def make(lr, lc, meta):
+                return BankedTile(
+                    lr, lc, meta, bands=bands,
+                    rows_pad=rows_pad, cols_pad=cols_pad,
+                )
+
+            return make
+        from distributed_sddmm_tpu.ops.pallas_kernels import BlockedTile
+
+        bm, bn, grb, gcb, grp = tiles.blk_geom
+
+        def make(lr, lc, meta):
+            return BlockedTile(
+                lr, lc, meta, bm=bm, bn=bn, gr_blocks=grb,
+                gc_blocks=gcb, group=grp,
+            )
+
+        return make
+
+    @property
+    def kernel_variant_realized(self):
+        """The codegen variant id that actually shaped this strategy's
+        tile encodings (None = generic, including a requested variant
+        that guard-felled to the generic build). Bench records and
+        serve keys report THIS, so a fallback run never pools into the
+        variant gate baseline nor claims a specialization that did not
+        run. If EITHER tile set realized the variant (the S/ST guards
+        can trip asymmetrically on rectangular matrices), the run is
+        labeled with it — a half-banked run has variant-shaped timings
+        and must not pool into the pure-generic baseline."""
+        return (
+            getattr(self.S_tiles, "blk_variant", None)
+            or getattr(self.ST_tiles, "blk_variant", None)
+        )
+
+    def _note_tile_metrics(self) -> None:
+        """Record the counted padded-lane fraction of each tile set as a
+        per-op metric gauge (scraped via ``/metrics`` and landed in
+        bench records) — the waste the codegen banked variants exist to
+        shrink. Called by strategy constructors once tiles exist."""
+        a_side = ("sddmmA", "spmmA", "fusedSpMM", "cgStep", "gatLayer")
+        b_side = ("sddmmB", "spmmB")
+        for tiles, ops in ((self.S_tiles, a_side), (self.ST_tiles, b_side)):
+            frac = getattr(tiles, "blk_pad_frac", None)
+            if frac is None:
+                continue
+            for op in ops:
+                self.metrics.note(op, padded_lane_frac=round(frac, 6))
 
     def _sddmm_args(self, tiles, vals) -> tuple:
         """Tile operands following the dense args for sddmm programs."""

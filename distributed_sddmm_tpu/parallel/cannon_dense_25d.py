@@ -108,16 +108,18 @@ class CannonDense25D(DistributedSparse):
         # scatters into the tile's COLUMN dimension (the rotating output,
         # `25D_cannon_dense.hpp:271-305`), so chunks must group by col block.
         block = getattr(self.kernel, "is_blocked", False)
+        variant = getattr(self.kernel, "variant", None)
         self.S_tiles = build_tiles(
             S, grid, BlockCyclic25D(self.M_pad, self.N_pad, sqrtpc, c),
             tile_rows=self.localArows * c, tile_cols=self.localBrows, dtype=dtype,
-            block=block, block_swap=True,
+            block=block, block_swap=True, variant=variant,
         )
         self.ST_tiles = build_tiles(
             S.transpose(), grid, BlockCyclic25D(self.N_pad, self.M_pad, sqrtpc, c),
             tile_rows=self.localBrows * c, tile_cols=self.localArows, dtype=dtype,
-            block=block, block_swap=True,
+            block=block, block_swap=True, variant=variant,
         )
+        self._note_tile_metrics()
 
     def set_r_value(self, R: int) -> None:
         if R % self.sqrtpc != 0:
@@ -203,7 +205,6 @@ class CannonDense25D(DistributedSparse):
         Tile chunk metadata and traveling values rotate around the ``cols``
         ring exactly like the flat struct-of-arrays."""
         from distributed_sddmm_tpu.ops.blocked import CHUNK
-        from distributed_sddmm_tpu.ops.pallas_kernels import BlockedTile
 
         tiles = self.ST_tiles if use_st else self.S_tiles
         n, c = self.sqrtpc, self.c
@@ -240,12 +241,11 @@ class CannonDense25D(DistributedSparse):
                 bmeta.reshape(C),
             )
 
+        make_tile = self._blk_tile_factory(tiles)
+
         def blk_of(fields):
             blr, blc, bmeta = fields
-            return BlockedTile(
-                blr, blc, bmeta, bm=bm, bn=bn, gr_blocks=grb,
-                gc_blocks=gcb, group=grp,
-            )
+            return make_tile(blr, blc, bmeta)
 
         BLK6 = P("rows", "cols", "layers", None, None, None)
         mesh = self.grid.mesh

@@ -107,16 +107,18 @@ class CannonSparse25D(DistributedSparse):
         self.b_spec = _DENSE_SPEC
 
         block = getattr(self.kernel, "is_blocked", False)
+        variant = getattr(self.kernel, "variant", None)
         self.S_tiles = build_replicated_tiles(
             S, grid, Floor2D(self.M_pad, self.N_pad, sqrtpc),
             tile_rows=self.localArows, tile_cols=self.localBrows, dtype=dtype,
-            block=block,
+            block=block, variant=variant,
         )
         self.ST_tiles = build_replicated_tiles(
             S.transpose(), grid, Floor2D(self.N_pad, self.M_pad, sqrtpc),
             tile_rows=self.localBrows, tile_cols=self.localArows, dtype=dtype,
-            block=block,
+            block=block, variant=variant,
         )
+        self._note_tile_metrics()
 
     def set_r_value(self, R: int) -> None:
         if R % (self.sqrtpc * self.c) != 0:

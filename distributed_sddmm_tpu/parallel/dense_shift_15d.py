@@ -106,16 +106,18 @@ class DenseShift15D(DistributedSparse):
         layout_s = ShardedBlockCyclicColumn(self.M_pad, self.N_pad, p, c)
         layout_st = ShardedBlockCyclicColumn(self.N_pad, self.M_pad, p, c)
         block = getattr(self.kernel, "is_blocked", False)
+        variant = getattr(self.kernel, "variant", None)
         self.S_tiles = build_tiles(
             S, grid, layout_s,
             tile_rows=self.localArows * c, tile_cols=self.localBrows, dtype=dtype,
-            block=block,
+            block=block, variant=variant,
         )
         self.ST_tiles = build_tiles(
             S.transpose(), grid, layout_st,
             tile_rows=self.localBrows * c, tile_cols=self.localArows, dtype=dtype,
-            block=block,
+            block=block, variant=variant,
         )
+        self._note_tile_metrics()
 
     def set_r_value(self, R: int) -> None:
         """Change the inner dimension (reference ``setRValue``,
@@ -407,7 +409,6 @@ class DenseShift15D(DistributedSparse):
 
     def _build_blocked_program(self, op: str, use_st: bool):
         from distributed_sddmm_tpu.ops.blocked import CHUNK
-        from distributed_sddmm_tpu.ops.pallas_kernels import BlockedTile
 
         tiles = self.ST_tiles if use_st else self.S_tiles
         nr, c = self.nr, self.c
@@ -456,11 +457,12 @@ class DenseShift15D(DistributedSparse):
                 bmeta.reshape(T, C),
             )
 
+        make_tile = self._blk_tile_factory(tiles)
+
         def blk_at(fields, s):
             blr, blc, bmeta = fields
-            return BlockedTile(
-                tile_at(blr, s), tile_at(blc, s), tile_at(bmeta, s),
-                bm=bm, bn=bn, gr_blocks=grb, gc_blocks=gcb, group=grp,
+            return make_tile(
+                tile_at(blr, s), tile_at(blc, s), tile_at(bmeta, s)
             )
 
         def sddmm_pass(at, mov, fields, t_vals, out_vals, complete_rotation=False):

@@ -59,6 +59,17 @@ class TileSet:
     blk_lc: jax.Array = None
     blk_meta: jax.Array = None  # (nr, nc, nh, T, C) int32 packed
     blk_geom: tuple = None      # (bm, bn, gr_blocks, gc_blocks)
+    # Codegen banked encoding (codegen/banded.py): per-band static chunk
+    # ranges + geometry when a kernel variant banded this tile set; None
+    # for the generic encoding. blk_pad_* count the encoding's inert pad
+    # lanes — the waste metric banked variants exist to shrink.
+    blk_bands: tuple = None
+    blk_pad_lanes: int = 0
+    blk_pad_frac: float = None
+    #: Variant id that ACTUALLY shaped the blocked encoding (None when
+    #: generic or when a requested variant guard-felled to generic) —
+    #: what records and program keys report, vs the kernel's identity.
+    blk_variant: str = None
 
     @property
     def has_blocked(self) -> bool:
@@ -125,11 +136,21 @@ class ReplicatedTiles:
     grid: GridSpec
     nnz_per_device: np.ndarray
     # Blocked (Pallas) chunk-list encoding; structure replicated over the
-    # fiber like rows/cols. None when not built.
+    # fiber like rows/cols. None when not built. The codegen banked
+    # encoding is NOT supported on this layout (the chunk-flat length
+    # must split into fiber value slices) — blk_bands stays None and a
+    # requested variant falls back to the generic encoding.
     blk_lr: jax.Array = None    # (nr, nc, C, 128) int32
     blk_lc: jax.Array = None
     blk_meta: jax.Array = None  # (nr, nc, C) int32 packed
     blk_geom: tuple = None
+    blk_bands: tuple = None
+    blk_pad_lanes: int = 0
+    blk_pad_frac: float = None
+    #: Variant id that ACTUALLY shaped the blocked encoding (None when
+    #: generic or when a requested variant guard-felled to generic) —
+    #: what records and program keys report, vs the kernel's identity.
+    blk_variant: str = None
 
     STRUCT_SPEC = P("rows", "cols", None)
     VALUES_SPEC = P("rows", "cols", "layers", None)
@@ -168,12 +189,18 @@ def build_replicated_tiles(
     tile_cols: int,
     dtype=jnp.float32,
     block: bool = False,
+    variant=None,
 ) -> ReplicatedTiles:
     """Bucket nonzeros onto the 2-D grid floor, replicate structure across
     layers, shard values 1/c per layer (contiguous equal slices).
     ``block=True`` additionally builds the chunk-list (Pallas) encoding and
     makes it the flat layout, with the chunk count padded so the chunk-flat
-    length splits evenly into fiber slices."""
+    length splits evenly into fiber slices. A codegen ``variant`` is NOT
+    bankable on this layout (band-concatenated chunk counts cannot be
+    re-padded into fiber slices); banking falls back to the generic
+    encoding and counts a ``codegen_generic_fallbacks``, but a
+    non-banked variant's R-regime block geometry (a single chunk list)
+    still applies."""
     nr, nc, nh = grid.nr, grid.nc, grid.nh
     res = layout(S.rows, S.cols)
     if res.i.size:
@@ -184,7 +211,14 @@ def build_replicated_tiles(
 
     blocked = None
     if block:
-        blocked = _try_build_blocked(n_buckets, dev, res, tile_rows, tile_cols)
+        if variant is not None and getattr(variant, "banked", False):
+            from distributed_sddmm_tpu.obs import metrics as obs_metrics
+
+            obs_metrics.GLOBAL.add("codegen_generic_fallbacks")
+            variant = None
+        blocked, blk_variant = _try_build_blocked(
+            n_buckets, dev, res, tile_rows, tile_cols, variant=variant
+        )
         if blocked is not None:
             from distributed_sddmm_tpu.ops.blocked import CHUNK, pad_chunk_count
 
@@ -233,6 +267,10 @@ def build_replicated_tiles(
 
     blocked_fields = {}
     if blocked is not None:
+        from distributed_sddmm_tpu.ops.blocked import (
+            padded_lane_count, padded_lane_frac,
+        )
+
         C = blocked.n_chunks
         chunk_spec = NamedSharding(grid.mesh, P("rows", "cols", None, None))
         meta_spec = NamedSharding(grid.mesh, P("rows", "cols", None))
@@ -248,6 +286,9 @@ def build_replicated_tiles(
                 blocked.bm, blocked.bn, blocked.gr_blocks, blocked.gc_blocks,
                 blocked.group,
             ),
+            blk_pad_lanes=padded_lane_count(blocked),
+            blk_pad_frac=padded_lane_frac(blocked),
+            blk_variant=blk_variant,
         )
 
     return ReplicatedTiles(
@@ -278,6 +319,7 @@ def build_tiles(
     min_pad: int = 1,
     block: bool = False,
     block_swap: bool = False,
+    variant=None,
 ) -> TileSet:
     """Bucket ``S``'s nonzeros by (device, tile) and pad to a static shape.
 
@@ -299,6 +341,12 @@ def build_tiles(
     contract requires chunks grouped by the scatter dimension, and SDDMM is
     role-symmetric so it simply flips its dense operands. The flat
     rows/cols arrays remain in true (row, col) convention either way.
+
+    ``variant`` (a ``codegen.KernelVariant``) banks the blocked encoding:
+    one chunk list per nnz/row band (``codegen/banded.py``), the combined
+    list presented through the same ``blk_*`` fields plus ``blk_bands``.
+    When banking is impossible (degenerate block grids) the build falls
+    back to the generic encoding and counts ``codegen_generic_fallbacks``.
     """
     nr, nc, nh = grid.nr, grid.nc, grid.nh
     T = layout.n_tiles
@@ -315,8 +363,9 @@ def build_tiles(
 
     blocked = None
     if block:
-        blocked = _try_build_blocked(
-            n_buckets, bucket, res, tile_rows, tile_cols, swap=block_swap
+        blocked, blk_variant = _try_build_blocked(
+            n_buckets, bucket, res, tile_rows, tile_cols, swap=block_swap,
+            variant=variant,
         )
 
     if blocked is not None:
@@ -362,6 +411,10 @@ def build_tiles(
 
     blocked_fields = {}
     if blocked is not None:
+        from distributed_sddmm_tpu.ops.blocked import (
+            padded_lane_count, padded_lane_frac,
+        )
+
         C = blocked.n_chunks
         chunk_spec = NamedSharding(
             grid.mesh, P("rows", "cols", "layers", None, None, None)
@@ -378,6 +431,10 @@ def build_tiles(
                 blocked.bm, blocked.bn, blocked.gr_blocks, blocked.gc_blocks,
                 blocked.group,
             ),
+            blk_bands=getattr(blocked, "bands", None),
+            blk_pad_lanes=padded_lane_count(blocked),
+            blk_pad_frac=padded_lane_frac(blocked),
+            blk_variant=blk_variant,
         )
 
     return TileSet(
@@ -399,7 +456,14 @@ def build_tiles(
 _BLOCK_PAIR_LIMIT = 200_000_000
 
 
-def _try_build_blocked(n_buckets, bucket, res, tile_rows, tile_cols, swap=False):
+def _try_build_blocked(n_buckets, bucket, res, tile_rows, tile_cols,
+                       swap=False, variant=None):
+    """Returns ``(blocked_meta_or_None, realized_variant_id)``: the
+    second element is the variant id ONLY when the variant actually
+    shaped the encoding — a guard fallback returns None there, so
+    records/keys never claim a specialization that did not build
+    (``kernel_variant`` is a gate config axis; a mislabeled generic run
+    would pool into the variant baseline)."""
     from distributed_sddmm_tpu.ops.blocked import (
         DEFAULT_BLOCK_COLS, DEFAULT_BLOCK_ROWS, DEFAULT_GROUP,
         build_blocked, pick_block,
@@ -411,18 +475,69 @@ def _try_build_blocked(n_buckets, bucket, res, tile_rows, tile_cols, swap=False)
         tile_rows, tile_cols = tile_cols, tile_rows
     # Estimate the pair grid in the SAME orientation build_blocked will use
     # (i.e. post-swap) — with asymmetric block preferences the pre-swap
-    # product differs and the guard would check the wrong count.
-    bm = pick_block(max(tile_rows, 1), DEFAULT_BLOCK_ROWS)
-    bn = pick_block(max(tile_cols, 1), DEFAULT_BLOCK_COLS)
-    n_pairs = (
-        n_buckets
-        * max(-(-tile_rows // bm), 1)
-        * max(-(-tile_cols // bn), 1)
-    )
-    if n_pairs > _BLOCK_PAIR_LIMIT:
-        return None
+    # product differs and the guard would check the wrong count. A
+    # variant builds with its heavy band's blocks (smaller in the rl
+    # regime => more pairs), one full-frame chunk list PER band.
+    def _est_pairs(pref_bm, pref_bn, n_lists):
+        bm = pick_block(max(tile_rows, 1), pref_bm)
+        bn = pick_block(max(tile_cols, 1), pref_bn)
+        return (
+            n_buckets
+            * max(-(-tile_rows // bm), 1)
+            * max(-(-tile_cols // bn), 1)
+            * n_lists
+        )
+
+    if variant is not None:
+        from distributed_sddmm_tpu.ops.blocked import MAX_BLOCKS
+
+        heavy = variant.bands[-1]
+        bm_v = pick_block(max(tile_rows, 1), heavy.block_rows)
+        bn_v = pick_block(max(tile_cols, 1), heavy.block_cols)
+        # Worst-case block counts are the heavy band's (auto-width bands
+        # only MERGE columns, and every band shares block_rows).
+        over_blocks = (
+            -(-tile_rows // bm_v) > MAX_BLOCKS
+            or -(-tile_cols // bn_v) > MAX_BLOCKS
+        )
+        if over_blocks or _est_pairs(
+            heavy.block_rows, heavy.block_cols, len(variant.bands)
+        ) > _BLOCK_PAIR_LIMIT:
+            # The variant's geometry (smaller rl blocks => more blocks
+            # per axis and more pairs, one full-frame list per band)
+            # blows the packed-meta or host-side budget; the generic
+            # encoding may still fit — fall back, don't raise and don't
+            # go unblocked.
+            from distributed_sddmm_tpu.obs import metrics as obs_metrics
+
+            obs_metrics.GLOBAL.add("codegen_generic_fallbacks")
+            variant = None
+    if _est_pairs(DEFAULT_BLOCK_ROWS, DEFAULT_BLOCK_COLS, 1) > _BLOCK_PAIR_LIMIT:
+        return None, None
+    if variant is not None and getattr(variant, "banked", False):
+        from distributed_sddmm_tpu.codegen.banded import build_banded
+        from distributed_sddmm_tpu.obs import metrics as obs_metrics
+
+        banded = build_banded(
+            n_buckets, bucket, local_r, local_c, tile_rows, tile_cols,
+            variant,
+        )
+        obs_metrics.GLOBAL.add("codegen_variants_built")
+        return banded, variant.variant_id
+    if variant is not None:
+        # Non-banked variant (pure R-regime tiling): count the build so
+        # /metrics distinguishes "variant active" from "fell back".
+        from distributed_sddmm_tpu.obs import metrics as obs_metrics
+
+        obs_metrics.GLOBAL.add("codegen_variants_built")
+        heavy = variant.bands[-1]
+        return build_blocked(
+            n_buckets, bucket, local_r, local_c, tile_rows, tile_cols,
+            block_rows=heavy.block_rows, block_cols=heavy.block_cols,
+            group=heavy.group,
+        ), variant.variant_id
     return build_blocked(
         n_buckets, bucket, local_r, local_c, tile_rows, tile_cols,
         block_rows=DEFAULT_BLOCK_ROWS, block_cols=DEFAULT_BLOCK_COLS,
         group=DEFAULT_GROUP,
-    )
+    ), None

@@ -101,16 +101,18 @@ class SparseShift15D(DistributedSparse):
         self.b_spec = _DENSE_SPEC
 
         block = getattr(self.kernel, "is_blocked", False)
+        variant = getattr(self.kernel, "variant", None)
         self.S_tiles = build_tiles(
             S, grid, ShardedBlockRow(self.M_pad, self.N_pad, p, c),
             tile_rows=self.blockAwidth, tile_cols=self.N_pad, dtype=dtype,
-            block=block,
+            block=block, variant=variant,
         )
         self.ST_tiles = build_tiles(
             S.transpose(), grid, ShardedBlockRow(self.N_pad, self.M_pad, p, c),
             tile_rows=self.blockBwidth, tile_cols=self.M_pad, dtype=dtype,
-            block=block,
+            block=block, variant=variant,
         )
+        self._note_tile_metrics()
 
     # Canonical dense representation: (stripes, c, block, R), see module doc.
     def dense_shape(self, mode: MatMode) -> tuple:
@@ -139,7 +141,6 @@ class SparseShift15D(DistributedSparse):
         arrays in the traveling struct-of-arrays), local compute runs through
         the feature-major tile kernels."""
         from distributed_sddmm_tpu.ops.blocked import CHUNK
-        from distributed_sddmm_tpu.ops.pallas_kernels import BlockedTile
 
         tiles = self.ST_tiles if use_st else self.S_tiles
         nr, c = self.nr, self.c
@@ -177,12 +178,11 @@ class SparseShift15D(DistributedSparse):
                 bmeta.reshape(C),
             )
 
+        make_tile = self._blk_tile_factory(tiles)
+
         def blk_of(fields):
             blr, blc, bmeta = fields
-            return BlockedTile(
-                blr, blc, bmeta, bm=bm, bn=bn, gr_blocks=grb,
-                gc_blocks=gcb, group=grp,
-            )
+            return make_tile(blr, blc, bmeta)
 
         BLK6 = P("rows", "cols", None, None, None, None)
         mesh = self.grid.mesh

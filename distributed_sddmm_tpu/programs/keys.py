@@ -114,15 +114,20 @@ def serve_program_key(
     code: str | None = None,
     params: str | None = None,
     sig: str | None = None,
+    variant: str | None = None,
 ) -> str:
     """Cache key for one serving bucket cell — the grammar the engine
     has used since PR 5 (``serve:<workload>:b<bb>:i<ib>:r<R>:<backend>:
-    <serve_code_hash>``), now owned here, with two optional trailing
+    <serve_code_hash>``), now owned here, with optional trailing
     segments the store appends: ``p<params>`` (workload constants the
     traced program bakes in — the fold-in top-k size and ridge, which
-    change the executable without changing any argument shape) and
+    change the executable without changing any argument shape),
     ``s<sig>`` (the aval signature, so a program compiled against one
-    model's array shapes can never answer for another's)."""
+    model's array shapes can never answer for another's) and
+    ``v<variant>`` (the warm model's codegen kernel-variant id, PR 9 —
+    a ladder warmed under one kernel specialization never answers for
+    another; variant-less keys are byte-identical to the PR 5-8
+    grammar, so existing stores keep hitting)."""
     if code is None:
         from distributed_sddmm_tpu.autotune.fingerprint import serve_code_hash
 
@@ -135,12 +140,14 @@ def serve_program_key(
         key += f":p{_seg(params)}"
     if sig:
         key += f":s{_seg(sig)}"
+    if variant:
+        key += f":v{_seg(variant)}"
     return key
 
 
 def parse_serve_key(key: str) -> dict | None:
     parts = key.split(":")
-    if not (7 <= len(parts) <= 9) or parts[0] != "serve":
+    if not (7 <= len(parts) <= 10) or parts[0] != "serve":
         return None
     if not (parts[2].startswith("b") and parts[3].startswith("i")
             and parts[4].startswith("r")):
@@ -159,6 +166,8 @@ def parse_serve_key(key: str) -> dict | None:
             out["params"] = extra[1:]
         elif extra.startswith("s"):
             out["sig"] = extra[1:]
+        elif extra.startswith("v"):
+            out["variant"] = extra[1:]
         else:
             return None
     return out

@@ -605,11 +605,25 @@ def strategy_config_tag(alg) -> str:
     gather chunking, scatter form, batch step).
     """
     kern = alg.kernel
-    bits = [type(alg).__name__, f"c{alg.c}", type(kern).__name__]
+    cls = type(kern).__name__
+    if getattr(kern, "variant_id", None):
+        # BankedPallasKernel traces the SAME program family as the
+        # generic PallasKernel (it falls through on generic tiles); the
+        # realized variant in the op segment is what distinguishes
+        # banked programs. Tagging the subclass name would fork a
+        # guard-fallback build away from the generic entry it is
+        # byte-identical to — and pre-PR-9 generic keys must not move.
+        cls = "PallasKernel"
+    bits = [type(alg).__name__, f"c{alg.c}", cls]
     if getattr(alg, "overlap", False):
         bits.append("ov")
     if not getattr(alg, "unroll", True):
         bits.append("rolled")
+    # The codegen kernel variant is deliberately ABSENT here: the
+    # per-op segment of the strategy's program-cache key carries the
+    # REALIZED variant (base._program_cache_key), so a build that
+    # guard-fell to the generic encoding shares the generic entry —
+    # tagging the kernel's identity would fork a duplicate.
     for attr in ("precision", "gather_budget", "scatter_form",
                  "batch_step"):
         v = getattr(kern, attr, None)

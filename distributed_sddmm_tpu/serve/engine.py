@@ -45,15 +45,13 @@ from distributed_sddmm_tpu.resilience.guards import NumericalFault
 from distributed_sddmm_tpu.serve.queue import Request, RequestError, RequestQueue
 from distributed_sddmm_tpu.serve.slo import LatencyRecorder
 from distributed_sddmm_tpu.serve.workloads import ServingWorkload, bucket_for
+from distributed_sddmm_tpu.utils.buckets import pow2_ladder
 
 
 def _default_batch_buckets(max_batch: int) -> tuple[int, ...]:
-    out, b = [], 1
-    while b < max_batch:
-        out.append(b)
-        b *= 2
-    out.append(max_batch)
-    return tuple(out)
+    # The shared power-of-two ladder rule (utils/buckets.py) — the same
+    # module the autotune fingerprint and codegen band thresholds use.
+    return pow2_ladder(max_batch)
 
 
 class ServingEngine:
@@ -153,6 +151,7 @@ class ServingEngine:
         return program_keys.serve_program_key(
             self.workload.name, batch_bucket, inner_bucket, r, backend,
             params=self.workload.program_params(), sig=sig,
+            variant=getattr(self.workload, "kernel_variant", None),
         )
 
     def _note_resolve(self, source: str) -> None:

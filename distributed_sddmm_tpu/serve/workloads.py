@@ -43,14 +43,30 @@ from distributed_sddmm_tpu.utils.coo import HostCOO
 ALS_ITEM_BUCKETS = (8, 16, 32, 64)
 GAT_NODE_BUCKETS = (1, 4, 16, 64)
 
+# Rung selection is the SHARED power-of-two bucketing rule
+# (``utils/buckets.py``) — the same module the autotune fingerprint's
+# npr_bucket and the codegen band selector use, so serving, plans and
+# kernel banding bucket identically. Re-exported under the historical
+# name (engine.py and tests import it from here).
+from distributed_sddmm_tpu.utils.buckets import bucket_for  # noqa: E402,F401
 
-def bucket_for(n: int, ladder: tuple[int, ...]) -> int:
-    """Smallest ladder rung >= n (the largest rung for oversize n —
-    callers clamp payloads to it at admission)."""
-    for b in ladder:
-        if n <= b:
-            return b
-    return ladder[-1]
+
+def _model_kernel_variant(model) -> Optional[str]:
+    """The warm model's codegen kernel-variant id (None = generic).
+
+    Workload constructors default ``kernel_variant`` from here so a
+    model built from a variant plan (``from_plan`` on skewed data)
+    stamps its specialization into the warm ladder's program keys
+    WITHOUT every caller having to thread it — the key-isolation
+    invariant (a cache warmed under one specialization never answers
+    for another) must hold by construction, not by caller diligence.
+    Resolution is the SHARED rule bench records use
+    (``parallel.base.realized_kernel_variant``) so records and serve
+    keys always agree on a run's variant.
+    """
+    from distributed_sddmm_tpu.parallel.base import realized_kernel_variant
+
+    return realized_kernel_variant(getattr(model, "d_ops", None))
 
 
 def _chol_solve(gram, rhs):
@@ -107,6 +123,11 @@ class ServingWorkload(abc.ABC):
     name: str = "?"
     #: Inner-size ladder (rated items / requested nodes).
     inner_buckets: tuple[int, ...] = (1,)
+    #: Codegen kernel-variant id of the warm model's plan (None = the
+    #: generic kernel). Baked into the warm ladder's program keys
+    #: (``programs/keys.serve_program_key``) so a cache warmed under one
+    #: specialization can never answer for another.
+    kernel_variant: Optional[str] = None
 
     @abc.abstractmethod
     def inner_size(self, payload: dict) -> int:
@@ -193,8 +214,14 @@ class ALSFoldInTopK(ServingWorkload):
         S_live: Optional[HostCOO] = None,
         ingest_rows: bool = True,
         ridge: float = 0.1,
+        kernel_variant: Optional[str] = None,
     ):
         import jax.numpy as jnp
+
+        self.kernel_variant = (
+            kernel_variant if kernel_variant is not None
+            else _model_kernel_variant(model)
+        )
 
         if model.B is None:
             raise ValueError(
@@ -394,8 +421,13 @@ class GATNodeScore(ServingWorkload):
         model,
         node_buckets: tuple[int, ...] = GAT_NODE_BUCKETS,
         head_seed: int = 0,
+        kernel_variant: Optional[str] = None,
     ):
         self.model = model
+        self.kernel_variant = (
+            kernel_variant if kernel_variant is not None
+            else _model_kernel_variant(model)
+        )
         self.inner_buckets = tuple(sorted(int(b) for b in node_buckets))
         self.M = model.d_ops.M
         self._F = model.layers[-1].output_features

@@ -1,0 +1,56 @@
+"""Shared power-of-two bucketing: ONE rounding rule for every subsystem.
+
+Three subsystems bucket sizes onto power-of-two grids and must agree:
+
+* the autotune fingerprint's ``npr_bucket`` (nnz/row rounded to the
+  nearest power of two at the geometric midpoint — octave-scale regime
+  boundaries, ``autotune/fingerprint.py``),
+* the serving engine's batch/inner bucket ladders (``serve/``), and
+* the codegen variant selector's nnz/row band thresholds
+  (``codegen/variants.py``), which must land on the SAME bucket the
+  fingerprint reports or a plan's variant would disagree with the
+  banding its kernel actually built.
+
+The logic used to live duplicated in ``autotune/fingerprint.py`` and
+``serve/`` (PR 9 extracted it here); both now import these helpers, so
+codegen, plans, and serving bucket identically by construction.
+
+Import discipline: this module imports nothing beyond the stdlib — it
+is used by ``autotune/fingerprint.py``, which must stay importable in
+subprocesses and offline tooling without jax.
+"""
+
+from __future__ import annotations
+
+
+def pow2_bucket(x: float) -> int:
+    """``x`` rounded to the nearest power of two (>= 1), rounding at the
+    geometric midpoint — ``Problem.npr_bucket``'s historical rule
+    (6 -> 8, 5 -> 4, 1.4 -> 1)."""
+    x = max(float(x), 1.0)
+    b = 1
+    while b * 2 <= x * (2 ** 0.5):  # round at the geometric midpoint
+        b *= 2
+    return b
+
+
+def pow2_ladder(cap: int) -> tuple[int, ...]:
+    """Ascending power-of-two rungs up to (and always including) ``cap``
+    — the serving engine's batch-bucket ladder shape. ``cap`` itself is
+    the final rung even when it is not a power of two."""
+    cap = int(cap)
+    out, b = [], 1
+    while b < cap:
+        out.append(b)
+        b *= 2
+    out.append(cap)
+    return tuple(out)
+
+
+def bucket_for(n: int, ladder: tuple[int, ...]) -> int:
+    """Smallest ladder rung >= ``n`` (the largest rung for oversize
+    ``n`` — callers clamp payloads to it at admission)."""
+    for b in ladder:
+        if n <= b:
+            return b
+    return ladder[-1]

@@ -1,0 +1,239 @@
+"""Codegen smoke: variant selection, banked waste reduction, store keys.
+
+One process, four sections, JSON report (the tier-1 test
+``tests/test_codegen_smoke.py`` asserts on it):
+
+* **selection** — the fingerprint-selected variant registers as an
+  autotune candidate beside the generic Pallas kernel, its id
+  round-trips through ``variant_from_id`` and through a ``Plan``
+  record, and the cost model discounts it on the skewed problem.
+* **waste** — on a skewed (R-mat) single-bucket tile, the banked
+  encoding cuts counted padded lanes >= 2x vs the generic encoding,
+  with BIT-IDENTICAL fused SDDMM->SpMM results (integer-valued data:
+  every f32 sum is exact, so reassociation cannot hide behind
+  tolerance) on the CPU Pallas interpreter.
+* **store** — a plan carrying the variant id binds its strategy to a
+  ProgramStore: the variant id appears in the program key, a second
+  strategy against the same root warms from disk with zero live
+  compiles, the GENERIC plan's key never aliases the variant's, and a
+  corrupted (stale) variant entry evicts-and-recompiles instead of
+  serving garbage.
+* **record** — a one-trial bench run under the banked kernel carries
+  ``kernel_variant`` and the per-op ``padded_lane_frac`` metric.
+
+Usage::
+
+    python scripts/codegen_smoke.py [-o out.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import pathlib
+import sys
+import tempfile
+
+REPO = pathlib.Path(__file__).resolve().parents[1]
+sys.path.insert(0, str(REPO))
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("-o", "--output-file", default=None)
+    args = ap.parse_args()
+
+    from distributed_sddmm_tpu.utils.platform import force_cpu_platform
+
+    force_cpu_platform(n_devices=8, replace=True)
+
+    import numpy as np
+    import jax.numpy as jnp
+
+    from distributed_sddmm_tpu import codegen, programs
+    from distributed_sddmm_tpu.autotune.candidates import enumerate_candidates
+    from distributed_sddmm_tpu.autotune.fingerprint import Problem
+    from distributed_sddmm_tpu.autotune.plan import Plan
+    from distributed_sddmm_tpu.bench.harness import benchmark_algorithm
+    from distributed_sddmm_tpu.obs import metrics as obs_metrics
+    from distributed_sddmm_tpu.ops.blocked import (
+        CHUNK, DEFAULT_GROUP, build_blocked,
+    )
+    from distributed_sddmm_tpu.ops.pallas_kernels import (
+        BlockedTile, PallasKernel,
+    )
+    from distributed_sddmm_tpu.utils.coo import HostCOO
+
+    report: dict = {}
+
+    # ------------------------------------------------------------------ #
+    # 1. Selection: variant candidates, id and plan round-trips
+    # ------------------------------------------------------------------ #
+    S = HostCOO.rmat(log_m=13, edge_factor=4, seed=0)
+    problem = Problem.from_coo(S, R=64)
+    variant = codegen.select_variant(problem)
+    vid = variant.variant_id
+
+    cands = enumerate_candidates(problem, p=8, kernels=("pallas", "xla"))
+    variant_cands = [c for c in cands if c.variant]
+    assert any(c.variant == vid for c in variant_cands), (vid, cands[:5])
+    rebuilt = codegen.variant_from_id(vid)
+    assert rebuilt == variant, (rebuilt, variant)
+    plan = Plan(algorithm="15d_fusion2", c=1, kernel="pallas", variant=vid,
+                fingerprint_key="fp-codegen-smoke")
+    assert Plan.from_dict(plan.to_dict()).variant == vid
+    factor = codegen.variant_cost_factor(problem, vid)
+    report["selection"] = {
+        "variant": vid,
+        "bands": [
+            {"npr_max": b.npr_max, "body": b.body} for b in variant.bands
+        ],
+        "variant_candidates": len(variant_cands),
+        "cost_factor": factor,
+    }
+    assert factor < 1.0, factor  # skewed problem: banking must rank better
+
+    # ------------------------------------------------------------------ #
+    # 2. Waste reduction + bit identity on the skewed tile
+    # ------------------------------------------------------------------ #
+    rows = S.rows.astype(np.int64)
+    cols = S.cols.astype(np.int64)
+    bucket = np.zeros(S.nnz, np.int64)
+    gen = build_blocked(1, bucket, rows, cols, S.M, S.N, group=DEFAULT_GROUP)
+    ban = codegen.build_banded(1, bucket, rows, cols, S.M, S.N, variant)
+    waste_gen = codegen.padded_lane_count(gen)
+    waste_ban = codegen.padded_lane_count(ban)
+    ratio = waste_gen / max(waste_ban, 1)
+
+    rng = np.random.default_rng(0)
+    R = 32
+    vals_h = rng.integers(-4, 5, S.nnz).astype(np.float32)
+    A = jnp.array(rng.integers(-3, 4, (S.M, R)).astype(np.float32))
+    B = jnp.array(rng.integers(-3, 4, (S.N, R)).astype(np.float32))
+
+    def chunk_vals(meta):
+        v = np.zeros(meta.n_chunks * CHUNK, np.float32)
+        v[meta.host_to_chunk] = vals_h
+        return jnp.array(v)
+
+    tile_g = BlockedTile(
+        lr=jnp.array(gen.lr[0]), lc=jnp.array(gen.lc[0]),
+        meta=jnp.array(gen.meta[0]), bm=gen.bm, bn=gen.bn,
+        gr_blocks=gen.gr_blocks, gc_blocks=gen.gc_blocks, group=gen.group,
+    )
+    tile_b = codegen.BankedTile(
+        lr=jnp.array(ban.lr[0]), lc=jnp.array(ban.lc[0]),
+        meta=jnp.array(ban.meta[0]), bands=ban.bands,
+        rows_pad=ban.rows_pad, cols_pad=ban.cols_pad,
+    )
+    kern_g = PallasKernel(precision="f32", interpret=True)
+    kern_b = codegen.BankedPallasKernel(variant, precision="f32",
+                                        interpret=True)
+    out_g, mid_g = kern_g.fused_tile(tile_g, chunk_vals(gen), A, B)
+    out_b, mid_b = kern_b.fused_tile(tile_b, chunk_vals(ban), A, B)
+    bit_identical = bool(
+        np.array_equal(np.asarray(out_g), np.asarray(out_b))
+        and np.array_equal(
+            np.asarray(mid_g)[gen.host_to_chunk],
+            np.asarray(mid_b)[ban.host_to_chunk],
+        )
+    )
+    report["waste"] = {
+        "pad_lanes_generic": waste_gen,
+        "pad_lanes_banked": waste_ban,
+        "reduction_ratio": ratio,
+        "bit_identical": bit_identical,
+        "bands": [
+            {"body": b.body, "bn": b.bn, "chunks": b.c1 - b.c0}
+            for b in ban.bands
+        ],
+    }
+    assert ratio >= 2.0, report["waste"]
+    assert bit_identical, report["waste"]
+
+    # ------------------------------------------------------------------ #
+    # 3. ProgramStore round-trip with variant-id keys
+    # ------------------------------------------------------------------ #
+    store_root = pathlib.Path(tempfile.mkdtemp(prefix="codegen_store_"))
+    S_small = HostCOO.erdos_renyi(64, 48, 6, seed=0, values="normal")
+
+    def run_plan(p, root):
+        store = programs.ProgramStore(root)
+        before = store.stats()
+        alg = p.instantiate(S_small, R=8, program_store=store)
+        A0 = alg.dummy_initialize(codegen_mat_mode())
+        B0 = alg.dummy_initialize(codegen_mat_mode(b=True))
+        out, _ = alg.fused_spmm(A0, B0, alg.like_s_values(1.0))
+        after = store.stats()
+        fp = float(np.sum(np.asarray(out, dtype=np.float64) ** 2))
+        delta = {k: after[k] - before.get(k, 0) for k in after}
+        return alg, store, delta, fp
+
+    def codegen_mat_mode(b=False):
+        from distributed_sddmm_tpu.common import MatMode
+
+        return MatMode.B if b else MatMode.A
+
+    alg1, store1, cold, fp_cold = run_plan(plan, store_root)
+    keys = [row["key"] for row in store1.index()]
+    assert any(f"variant={vid}" in k for k in keys), keys
+    _, _, warm, fp_warm = run_plan(plan, store_root)
+    assert warm.get("hits", 0) >= 1, warm
+    assert warm.get("live_compiles", 0) == 0, warm
+    assert fp_warm == fp_cold
+
+    # Generic plan: same fingerprint key, no variant — must MISS (its
+    # own compile), never alias the variant's entry.
+    plan_generic = Plan(algorithm="15d_fusion2", c=1, kernel="pallas",
+                        fingerprint_key="fp-codegen-smoke")
+    _, store3, generic_delta, _ = run_plan(plan_generic, store_root)
+    assert generic_delta.get("live_compiles", 0) >= 1, generic_delta
+
+    # Stale variant entry: corrupt the payload on disk -> the next
+    # process EVICTS and recompiles (never serves the torn entry).
+    victim = next(r for r in store1.index() if "variant=" in r["key"])
+    store1._path(victim["key"]).write_bytes(b'{"torn": tru')
+    _, _, evicted_delta, fp_evict = run_plan(plan, store_root)
+    assert evicted_delta.get("live_compiles", 0) >= 1, evicted_delta
+    assert fp_evict == fp_cold
+    report["store"] = {
+        "cold": cold, "warm": warm,
+        "generic": generic_delta, "evicted": evicted_delta,
+        "variant_keys": sum(1 for k in keys if "variant=" in k),
+    }
+
+    # ------------------------------------------------------------------ #
+    # 4. Bench record carries the variant + padded-lane metric
+    # ------------------------------------------------------------------ #
+    S_rec = HostCOO.rmat(log_m=9, edge_factor=4, seed=1)
+    rec = benchmark_algorithm(
+        S_rec, "15d_fusion2", None, fused=True, R=16, c=1,
+        trials=1, warmup=1,
+        kernel=codegen.BankedPallasKernel(
+            codegen.select_variant(Problem.from_coo(S_rec, R=16)),
+            precision="f32", interpret=True,
+        ),
+    )
+    assert rec["kernel_variant"], rec.get("kernel_variant")
+    plf = rec["metrics"]["fusedSpMM"].get("padded_lane_frac")
+    assert plf is not None and 0.0 <= plf < 1.0, plf
+    report["record"] = {
+        "kernel_variant": rec["kernel_variant"],
+        "padded_lane_frac": plf,
+    }
+
+    report["counters"] = {
+        k: v for k, v in obs_metrics.GLOBAL.snapshot().items()
+        if k.startswith("codegen_")
+    }
+    assert report["counters"].get("codegen_variants_built", 0) >= 1
+
+    out = json.dumps(report, indent=2, default=str)
+    print(out)  # cli-output
+    if args.output_file:
+        pathlib.Path(args.output_file).write_text(out)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,538 @@
+"""Codegen banked-kernel verification: bands, bit identity, bucketing.
+
+Bit-identity methodology: banked and generic kernels legitimately
+REASSOCIATE floating-point sums (different chunk packings group the
+scatter adds differently), so agreement is pinned on INTEGER-VALUED
+f32 data where every product and partial sum is exactly representable
+(|values| <= 4, |dense| <= 3, R <= 32, row degrees bounded): any
+arithmetic difference then shows up as a bit difference, and
+``np.array_equal`` cannot be rescued by tolerance. A separate oracle
+check on normal data guards against "identical but both wrong".
+
+The distributed matrix covers all four ``KernelMode``s (sddmmA/spmmA/
+spmmB/sddmmB) plus the fused pair, per generated variant regime,
+across skewed (R-mat), uniform, and zero-nnz inputs — the PR-9 test
+matrix.
+"""
+
+import functools
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from distributed_sddmm_tpu.autotune.fingerprint import Problem
+from distributed_sddmm_tpu.codegen import (
+    BankedPallasKernel, BankedTile, build_banded, padded_lane_count,
+    select_variant, variant_from_id,
+)
+from distributed_sddmm_tpu.codegen.variants import (
+    VARIANT_VERSION, r_regime, variant_cost_factor,
+)
+from distributed_sddmm_tpu.common import MatMode
+from distributed_sddmm_tpu.ops.blocked import (
+    CHUNK, DEFAULT_GROUP, build_blocked, unpack_meta,
+)
+from distributed_sddmm_tpu.ops.pallas_kernels import BlockedTile, PallasKernel
+from distributed_sddmm_tpu.parallel.dense_shift_15d import DenseShift15D
+from distributed_sddmm_tpu.utils import oracle
+from distributed_sddmm_tpu.utils.buckets import (
+    bucket_for, pow2_bucket, pow2_ladder,
+)
+from distributed_sddmm_tpu.utils.coo import HostCOO
+
+RNG = np.random.default_rng(7)
+
+
+def _skewed(Mr=1024, Nc=1024, seed=0):
+    """Skewed degree distribution: a few hub rows + a light tail.
+    Sizes are budgeted for the tier-1 wall clock — interpret-mode
+    Pallas walks every chunk on host, so cost scales with nnz."""
+    rng = np.random.default_rng(seed)
+    rows = np.concatenate([
+        rng.integers(0, 16, 1300), rng.integers(16, Mr, 1500)
+    ]).astype(np.int64)
+    cols = rng.integers(0, Nc, rows.size).astype(np.int64)
+    return rows, cols, Mr, Nc
+
+
+def _uniform(Mr=1024, Nc=896, seed=1):
+    rng = np.random.default_rng(seed)
+    rows = rng.integers(0, Mr, 2000).astype(np.int64)
+    cols = rng.integers(0, Nc, 2000).astype(np.int64)
+    return rows, cols, Mr, Nc
+
+
+def _empty(Mr=1024, Nc=768, seed=0):
+    return (np.zeros(0, np.int64), np.zeros(0, np.int64), Mr, Nc)
+
+
+def _int_data(nnz, Mr, Nc, R, seed=3):
+    rng = np.random.default_rng(seed)
+    vals = rng.integers(-4, 5, nnz).astype(np.float32)
+    A = rng.integers(-3, 4, (Mr, R)).astype(np.float32)
+    B = rng.integers(-3, 4, (Nc, R)).astype(np.float32)
+    return vals, A, B
+
+
+# --------------------------------------------------------------------- #
+# Shared bucketing (satellite: one helper for fingerprint/serve/codegen)
+# --------------------------------------------------------------------- #
+
+
+class TestSharedBucketing:
+    def test_npr_bucket_is_the_shared_rule(self):
+        for M, nnz in ((100, 100), (100, 550), (64, 4096), (1, 0)):
+            p = Problem(M=M, N=M, nnz=nnz, R=8)
+            assert p.npr_bucket == pow2_bucket(p.nnz_per_row)
+        # Geometric-midpoint rounding (the historical npr_bucket rule).
+        assert pow2_bucket(6) == 8
+        assert pow2_bucket(5) == 4
+        assert pow2_bucket(1.4) == 1
+        assert pow2_bucket(0.0) == 1
+
+    def test_serve_ladders_are_the_shared_rule(self):
+        from distributed_sddmm_tpu.serve.engine import _default_batch_buckets
+        from distributed_sddmm_tpu.serve import workloads
+
+        assert _default_batch_buckets(8) == (1, 2, 4, 8) == pow2_ladder(8)
+        assert _default_batch_buckets(6) == (1, 2, 4, 6)
+        assert _default_batch_buckets(1) == (1,)
+        # The serve module's bucket_for IS the shared helper.
+        assert workloads.bucket_for is bucket_for
+        assert bucket_for(3, (1, 2, 4, 8)) == 4
+        assert bucket_for(99, (1, 2, 4, 8)) == 8
+
+
+# --------------------------------------------------------------------- #
+# Variant space
+# --------------------------------------------------------------------- #
+
+
+class TestVariants:
+    def test_id_round_trip(self):
+        for R in (16, 128, 2048):
+            for npr in (2, 32, 200):
+                prob = Problem(M=4096, N=4096, nnz=4096 * npr, R=R)
+                v = select_variant(prob)
+                assert variant_from_id(v.variant_id) == v
+
+    def test_selection_is_fingerprint_keyed(self):
+        prob = Problem(M=1 << 16, N=1 << 16, nnz=(1 << 16) * 32, R=128)
+        v = select_variant(prob)
+        assert v.variant_id == f"v{VARIANT_VERSION}.rb32.rm"
+        assert v.banked and len(v.bands) == 3
+        assert v.bands[0].npr_max == prob.npr_bucket
+
+    def test_regimes(self):
+        assert r_regime(16) == "rs"
+        assert r_regime(128) == r_regime(512) == "rm"
+        assert r_regime(1024) == r_regime(4096) == "rl"
+        rl = variant_from_id("v1.rb8.rl")
+        rm = variant_from_id("v1.rb8.rm")
+        assert rl.bands[-1].block_rows < rm.bands[-1].block_rows
+
+    def test_heavy_bucket_disables_banding(self):
+        prob = Problem(M=1024, N=1024, nnz=1024 * 200, R=128)
+        v = select_variant(prob)
+        assert not v.banked and len(v.bands) == 1
+
+    def test_unknown_generation_raises(self):
+        with pytest.raises(ValueError):
+            variant_from_id("v999.rb8.rm")
+        with pytest.raises(ValueError):
+            variant_from_id("garbage")
+
+    def test_cost_factor_discounts_skew(self):
+        skew = Problem(M=1 << 16, N=1 << 16, nnz=(1 << 16) * 32, R=128)
+        vid = select_variant(skew).variant_id
+        assert variant_cost_factor(skew, vid) < 1.0
+        assert variant_cost_factor(skew, "v1.rb0.rm") == 1.0
+        assert variant_cost_factor(skew, "not-a-variant") == 1.0
+
+
+# --------------------------------------------------------------------- #
+# Banked encoding invariants
+# --------------------------------------------------------------------- #
+
+
+class TestBandedMeta:
+    def _build(self, data, R=32):
+        rows, cols, Mr, Nc = data
+        variant = select_variant(Problem(M=Mr, N=Nc, nnz=rows.size, R=R))
+        ban = build_banded(
+            1, np.zeros(rows.size, np.int64), rows, cols, Mr, Nc, variant
+        )
+        return ban, variant
+
+    @pytest.mark.parametrize("data_fn", [_skewed, _uniform])
+    def test_round_trip_and_pad_accounting(self, data_fn):
+        rows, cols, Mr, Nc = data_fn()
+        ban, _ = self._build((rows, cols, Mr, Nc))
+        assert np.all(ban.global_rows().reshape(-1)[ban.host_to_chunk] == rows)
+        assert np.all(ban.global_cols().reshape(-1)[ban.host_to_chunk] == cols)
+        assert ban.pad_lane.reshape(-1).sum() == (
+            ban.n_chunks * CHUNK - rows.size
+        )
+        # Bands tile [0, C_tot) contiguously; every band shares the frame.
+        assert ban.bands[0].c0 == 0 and ban.bands[-1].c1 == ban.n_chunks
+        for a, b in zip(ban.bands, ban.bands[1:]):
+            assert a.c1 == b.c0
+        for band in ban.bands:
+            assert band.bm * band.gr_blocks == ban.rows_pad
+            assert band.bn * band.gc_blocks == ban.cols_pad
+            # Per-band meta decodes within the band's own block grid.
+            gr, gc, _, _ = unpack_meta(ban.meta[:, band.c0:band.c1])
+            assert gr.max(initial=0) < band.gr_blocks
+            assert gc.max(initial=0) < band.gc_blocks
+
+    def test_band_partition_is_by_row_nnz(self):
+        rows, cols, Mr, Nc = _skewed()
+        ban, variant = self._build((rows, cols, Mr, Nc))
+        assert len(ban.bands) >= 2
+        counts = np.bincount(rows, minlength=Mr)
+        short = ban.bands[0]
+        grows = ban.global_rows()
+        in_short = grows[0, short.c0:short.c1][
+            ~ban.pad_lane[0, short.c0:short.c1]
+        ]
+        assert counts[np.unique(in_short)].max() <= variant.bands[0].npr_max
+
+    def test_empty_bands_dropped(self):
+        # Uniform degree ~ 3 with threshold >= 4: mid/heavy bands empty.
+        rng = np.random.default_rng(0)
+        rows = np.repeat(np.arange(512), 3).astype(np.int64)
+        cols = rng.integers(0, 512, rows.size).astype(np.int64)
+        ban, variant = self._build((rows, cols, 512, 512))
+        assert len(ban.bands) < len(variant.bands)
+
+    def test_zero_nnz_still_encodes_every_block(self):
+        ban, _ = self._build(_empty())
+        assert len(ban.bands) == 1
+        _, _, first, last = unpack_meta(ban.meta)
+        assert first.sum(axis=1).min() == ban.bands[0].gr_blocks
+        assert last.sum(axis=1).min() == ban.bands[0].gr_blocks
+
+    def test_single_step_upgrade(self):
+        # Sparse uniform tile where every row block fits one chunk: the
+        # batched request upgrades to the conditional-free single body.
+        rng = np.random.default_rng(0)
+        rows = rng.permutation(4096)[:500].astype(np.int64)
+        cols = rng.integers(0, 4096, 500).astype(np.int64)
+        ban, _ = self._build((rows, cols, 4096, 4096))
+        assert ban.bands[0].body == "single"
+        band = ban.bands[0]
+        assert band.c1 - band.c0 == band.gr_blocks * band.group
+
+    def test_non_pow2_block_grid_keeps_shared_frame(self):
+        # cols_pad / bn_floor = 3 (not a power of two): auto-width bands
+        # must pick widths that tile the shared frame EXACTLY — the
+        # halve-while-even / jump-to-full-width rule — or their Pallas
+        # windows would index past the prepped dense operands.
+        rng = np.random.default_rng(2)
+        Mr, Nc = 700, 1300
+        rows = np.concatenate([
+            rng.integers(0, 4, 600),                  # hub rows -> heavy band
+            rng.permutation(Mr)[:100].astype(np.int64),  # 1-nnz short rows
+        ]).astype(np.int64)
+        cols = rng.integers(0, Nc, rows.size).astype(np.int64)
+        ban, _ = self._build((rows, cols, Mr, Nc))
+        assert ban.cols_pad // 512 == 3  # the non-divisor grid
+        short = ban.bands[0]
+        assert short.gc_blocks == 1 and short.bn == ban.cols_pad  # odd jump
+        for band in ban.bands:
+            assert band.bm * band.gr_blocks == ban.rows_pad
+            assert band.bn * band.gc_blocks == ban.cols_pad
+        assert np.all(ban.global_rows().reshape(-1)[ban.host_to_chunk] == rows)
+        assert np.all(ban.global_cols().reshape(-1)[ban.host_to_chunk] == cols)
+
+    def test_waste_reduction_on_skewed_rmat(self):
+        S = HostCOO.rmat(log_m=13, edge_factor=4, seed=0)
+        rows, cols = S.rows.astype(np.int64), S.cols.astype(np.int64)
+        bucket = np.zeros(S.nnz, np.int64)
+        gen = build_blocked(1, bucket, rows, cols, S.M, S.N,
+                            group=DEFAULT_GROUP)
+        variant = select_variant(Problem.from_coo(S, R=128))
+        ban = build_banded(1, bucket, rows, cols, S.M, S.N, variant)
+        assert padded_lane_count(gen) >= 2 * padded_lane_count(ban)
+
+
+# --------------------------------------------------------------------- #
+# Tile-level bit identity (banked vs generic) + oracle
+# --------------------------------------------------------------------- #
+
+
+def _tiles_for(data, variant):
+    rows, cols, Mr, Nc = data
+    bucket = np.zeros(rows.size, np.int64)
+    gen = build_blocked(1, bucket, rows, cols, Mr, Nc, group=DEFAULT_GROUP)
+    ban = build_banded(1, bucket, rows, cols, Mr, Nc, variant)
+    tile_g = BlockedTile(
+        lr=jnp.array(gen.lr[0]), lc=jnp.array(gen.lc[0]),
+        meta=jnp.array(gen.meta[0]), bm=gen.bm, bn=gen.bn,
+        gr_blocks=gen.gr_blocks, gc_blocks=gen.gc_blocks, group=gen.group,
+    )
+    tile_b = BankedTile(
+        lr=jnp.array(ban.lr[0]), lc=jnp.array(ban.lc[0]),
+        meta=jnp.array(ban.meta[0]), bands=ban.bands,
+        rows_pad=ban.rows_pad, cols_pad=ban.cols_pad,
+    )
+    return gen, ban, tile_g, tile_b
+
+
+def _chunked(meta, host_vals):
+    v = np.zeros(meta.n_chunks * CHUNK, np.float32)
+    v[meta.host_to_chunk] = host_vals
+    return jnp.array(v)
+
+
+class TestBankedTileKernels:
+    @pytest.mark.parametrize(
+        "data_fn", [_skewed, _uniform, _empty],
+        ids=["skewed", "uniform", "zero-nnz"],
+    )
+    def test_bit_identity_vs_generic(self, data_fn):
+        data = data_fn()
+        rows, cols, Mr, Nc = data
+        R = 32
+        variant = select_variant(
+            Problem(M=Mr, N=Nc, nnz=max(rows.size, 1), R=R)
+        )
+        gen, ban, tile_g, tile_b = _tiles_for(data, variant)
+        vals, A, B = _int_data(rows.size, Mr, Nc, R)
+        A, B = jnp.array(A), jnp.array(B)
+        kg = PallasKernel(precision="f32", interpret=True)
+        kb = BankedPallasKernel(variant, precision="f32", interpret=True)
+        vg, vb = _chunked(gen, vals), _chunked(ban, vals)
+
+        mid_g = np.asarray(kg.sddmm_tile(tile_g, vg, A, B))
+        mid_b = np.asarray(kb.sddmm_tile(tile_b, vb, A, B))
+        assert np.array_equal(
+            mid_g[gen.host_to_chunk], mid_b[ban.host_to_chunk]
+        )
+        assert np.all(mid_b[ban.pad_lane.reshape(-1)] == 0)
+
+        out_g = np.asarray(kg.spmm_tile(tile_g, vg, B, Mr))
+        out_b = np.asarray(kb.spmm_tile(tile_b, vb, B, Mr))
+        assert np.array_equal(out_g, out_b)
+
+        fo_g, fm_g = kg.fused_tile(tile_g, vg, A, B)
+        fo_b, fm_b = kb.fused_tile(tile_b, vb, A, B)
+        assert np.array_equal(np.asarray(fo_g), np.asarray(fo_b))
+        assert np.array_equal(
+            np.asarray(fm_g)[gen.host_to_chunk],
+            np.asarray(fm_b)[ban.host_to_chunk],
+        )
+
+    def test_oracle_agreement_normal_data(self):
+        # Guards the bit-identity test against "identical but wrong":
+        # the banked kernel must also match the float64 oracle.
+        data = _skewed(seed=5)
+        rows, cols, Mr, Nc = data
+        R = 32
+        variant = select_variant(Problem(M=Mr, N=Nc, nnz=rows.size, R=R))
+        _, ban, _, tile_b = _tiles_for(data, variant)
+        rng = np.random.default_rng(2)
+        vals = rng.standard_normal(rows.size).astype(np.float32)
+        A = rng.standard_normal((Mr, R)).astype(np.float32)
+        B = rng.standard_normal((Nc, R)).astype(np.float32)
+        kb = BankedPallasKernel(variant, precision="f32", interpret=True)
+        vb = _chunked(ban, vals)
+        S = HostCOO(rows, cols, vals, Mr, Nc)
+        ref_mid = oracle.sddmm(S, A.astype(np.float64), B.astype(np.float64))
+        mid = np.asarray(kb.sddmm_tile(tile_b, vb, jnp.array(A), jnp.array(B)))
+        scale = np.abs(ref_mid).max() + 1
+        np.testing.assert_allclose(
+            mid[ban.host_to_chunk] / scale, ref_mid / scale, atol=1e-5
+        )
+        ref_out = oracle.spmm_a(S, B.astype(np.float64))
+        out = np.asarray(kb.spmm_tile(tile_b, vb, jnp.array(B), Mr))
+        scale = np.abs(ref_out).max() + 1
+        np.testing.assert_allclose(out / scale, ref_out / scale, atol=1e-5)
+
+    def test_plain_blocked_tile_falls_through_to_generic(self):
+        data = _uniform()
+        rows, cols, Mr, Nc = data
+        variant = select_variant(Problem(M=Mr, N=Nc, nnz=rows.size, R=32))
+        gen, _, tile_g, _ = _tiles_for(data, variant)
+        vals, A, B = _int_data(rows.size, Mr, Nc, 32)
+        kg = PallasKernel(precision="f32", interpret=True)
+        kb = BankedPallasKernel(variant, precision="f32", interpret=True)
+        vg = _chunked(gen, vals)
+        a, b = jnp.array(A), jnp.array(B)
+        assert np.array_equal(
+            np.asarray(kb.sddmm_tile(tile_g, vg, a, b)),
+            np.asarray(kg.sddmm_tile(tile_g, vg, a, b)),
+        )
+
+
+# --------------------------------------------------------------------- #
+# Distributed bit identity: all four KernelModes + the fused pair,
+# per variant regime
+# --------------------------------------------------------------------- #
+
+
+def _distributed_data():
+    S_rows, S_cols, Mr, Nc = _skewed(Mr=512, Nc=448, seed=9)
+    rng = np.random.default_rng(4)
+    R = 16
+    vals_h = rng.integers(-4, 5, S_rows.size).astype(np.float32)
+    A_h = rng.integers(-3, 4, (Mr, R)).astype(np.float32)
+    B_h = rng.integers(-3, 4, (Nc, R)).astype(np.float32)
+    return HostCOO(S_rows, S_cols, vals_h, Mr, Nc), R, vals_h, A_h, B_h
+
+
+def _run_all_modes(kern):
+    S, R, vals_h, A_h, B_h = _distributed_data()
+    alg = DenseShift15D(S, R=R, c=2, fusion_approach=2, kernel=kern)
+    A = alg.put_a(A_h)
+    B = alg.put_b(B_h)
+    sv = alg.scatter_s_values(vals_h)
+    stv = alg.scatter_st_values(vals_h)
+    out, mid = alg.fused_spmm(A, B, sv)
+    outB, midB = alg.fused_spmm(A, B, stv, mode=MatMode.B)
+    return {
+        # The four KernelModes…
+        "sddmmA": alg.gather_s_values(alg.sddmm_a(A, B, sv)),
+        "sddmmB": alg.gather_st_values(alg.sddmm_b(A, B, stv)),
+        "spmmA": alg.host_a(alg.spmm_a(A, B, sv)),
+        "spmmB": alg.host_b(alg.spmm_b(A, B, stv)),
+        # …plus the fused pair, both output modes.
+        "fused_out": alg.host_a(out),
+        "fused_mid": alg.gather_s_values(mid),
+        "fusedB_out": alg.host_b(outB),
+        "fusedB_mid": alg.gather_st_values(midB),
+    }
+
+
+@functools.lru_cache(maxsize=1)
+def _generic_mode_results():
+    """One generic-kernel baseline shared across the variant params —
+    it does not depend on the variant under test, and each distributed
+    run costs seconds of interpret-mode tracing."""
+    return _run_all_modes(PallasKernel(precision="f32", interpret=True))
+
+
+class TestBankedDistributed:
+    # ``rs`` is deliberately absent: its band geometry is byte-identical
+    # to ``rm`` (``_REGIMES``), so it adds tracing time, not coverage —
+    # the rs regime is exercised at the tile level (R=32 selects it).
+    @pytest.mark.parametrize("vid", ["v1.rb8.rm", "v1.rb4.rl"])
+    def test_all_kernel_modes_match_generic(self, vid):
+        variant = variant_from_id(vid)
+        gen_r = _generic_mode_results()
+        ban_r = _run_all_modes(
+            BankedPallasKernel(variant, precision="f32", interpret=True)
+        )
+        for key in gen_r:
+            assert np.array_equal(gen_r[key], ban_r[key]), key
+
+    def test_banked_tiles_built_and_counted(self):
+        from distributed_sddmm_tpu.obs import metrics as obs_metrics
+
+        S = HostCOO.rmat(log_m=9, edge_factor=4, seed=0)
+        variant = select_variant(Problem.from_coo(S, R=16))
+        before = obs_metrics.GLOBAL.get("codegen_variants_built")
+        alg = DenseShift15D(
+            S, R=16, c=1, fusion_approach=2,
+            kernel=BankedPallasKernel(variant, precision="f32",
+                                      interpret=True),
+        )
+        assert obs_metrics.GLOBAL.get("codegen_variants_built") >= before + 2
+        assert alg.S_tiles.blk_bands is not None
+        assert alg.S_tiles.blk_pad_frac is not None
+        # Gauges surface only once the op dispatches (no phantom rows
+        # for ops a run never executed).
+        assert "fusedSpMM" not in alg.metrics.to_dict()
+        A = alg.dummy_initialize(MatMode.A)
+        B = alg.dummy_initialize(MatMode.B)
+        alg.fused_spmm(A, B, alg.like_s_values(1.0))
+        # The pad gauge landed on the op metrics (scraped via /metrics).
+        gauges = alg.metrics.to_dict()
+        assert gauges["fusedSpMM"]["padded_lane_frac"] == round(
+            alg.S_tiles.blk_pad_frac, 6
+        )
+
+    def test_band_structure_distinguishes_program_keys(self):
+        # The banked program bakes the band tuple (chunk ranges, merged
+        # widths, body upgrades) STATICALLY — all data-dependent — while
+        # the autotune fingerprint only hashes aggregate stats. Two
+        # matrices with identical M/N/nnz/R but different row-degree
+        # skew must therefore produce DIFFERENT program-cache keys, or
+        # one's compiled program could silently serve the other.
+        rng = np.random.default_rng(0)
+        M, N, nnz, R = 1024, 768, 3000, 8
+        flat = (rng.integers(0, M, nnz).astype(np.int64),
+                rng.integers(0, N, nnz).astype(np.int64))
+        skew = (np.concatenate([np.zeros(nnz // 2, np.int64),
+                                rng.integers(0, M, nnz - nnz // 2)]),
+                rng.integers(0, N, nnz).astype(np.int64))
+        keys = []
+        for rows, cols in (flat, skew):
+            S = HostCOO(rows, cols, np.ones(nnz, np.float32), M, N)
+            alg = DenseShift15D(
+                S, R=R, c=1, fusion_approach=2,
+                kernel=BankedPallasKernel("v1.rb2.rs", precision="f32",
+                                          interpret=True),
+            )
+            keys.append(alg._program_cache_key("fused", False))
+        assert keys[0] != keys[1], keys
+        # Same matrix twice -> same key (the digest is deterministic).
+        S = HostCOO(flat[0], flat[1], np.ones(nnz, np.float32), M, N)
+        alg = DenseShift15D(
+            S, R=R, c=1, fusion_approach=2,
+            kernel=BankedPallasKernel("v1.rb2.rs", precision="f32",
+                                      interpret=True),
+        )
+        assert alg._program_cache_key("fused", False) == keys[0]
+
+    def test_replicated_layout_fallback_unlabels_variant(self):
+        # The replicated 2.5D layout cannot bank: the build guard-fells
+        # to the generic encoding, and the REALIZED variant (None) — not
+        # the kernel's identity — is what records and program keys see,
+        # so the run neither pools into the variant gate baseline nor
+        # duplicates the generic program's store entry.
+        from distributed_sddmm_tpu.obs import metrics as obs_metrics
+        from distributed_sddmm_tpu.parallel.cannon_sparse_25d import (
+            CannonSparse25D,
+        )
+
+        S = HostCOO.erdos_renyi(128, 96, 4, seed=0)
+        before = obs_metrics.GLOBAL.get("codegen_generic_fallbacks")
+        alg = CannonSparse25D(
+            S, R=8, c=2,
+            kernel=BankedPallasKernel("v1.rb4.rs", precision="f32",
+                                      interpret=True),
+        )
+        assert obs_metrics.GLOBAL.get("codegen_generic_fallbacks") >= before + 2
+        assert alg.kernel.variant_id == "v1.rb4.rs"
+        assert alg.kernel_variant_realized is None
+        assert not any(
+            str(seg).startswith("variant=")
+            for seg in alg._program_cache_key("fused", False)
+        )
+
+    def test_program_cache_key_carries_variant(self):
+        S = HostCOO.erdos_renyi(96, 80, 4, seed=0)
+        variant = variant_from_id("v1.rb4.rs")
+        alg = DenseShift15D(
+            S, R=8, c=1, fusion_approach=2,
+            kernel=BankedPallasKernel(variant, precision="f32",
+                                      interpret=True),
+        )
+        key = alg._program_cache_key("fused", False)
+        # variant id + realized band-structure digest (.b<hex>)
+        assert any(
+            str(seg).startswith(f"variant={variant.variant_id}.b")
+            for seg in key
+        ), key
+        generic = DenseShift15D(
+            S, R=8, c=1, fusion_approach=2,
+            kernel=PallasKernel(precision="f32", interpret=True),
+        )
+        # Generic keys are UNCHANGED (old store entries keep hitting).
+        assert not any(
+            str(seg).startswith("variant=")
+            for seg in generic._program_cache_key("fused", False)
+        )

@@ -1,0 +1,54 @@
+"""Tier-1 codegen smoke: scripts/codegen_smoke.py in a subprocess.
+
+Pins the PR-9 acceptance surface end to end: variant selection from a
+plan, ProgramStore round-trip with variant-id keys (warm hit, generic
+no-alias, stale-entry evict-and-recompile), >= 2x padded-lane-waste
+reduction with bit-identical results on a skewed CPU-interpreted
+problem, and the bench record's ``kernel_variant`` +
+``padded_lane_frac`` fields.
+"""
+
+import json
+import pathlib
+import subprocess
+import sys
+
+REPO = pathlib.Path(__file__).resolve().parents[1]
+
+
+def test_codegen_smoke(tmp_path):
+    out = tmp_path / "report.json"
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "scripts" / "codegen_smoke.py"),
+         "-o", str(out)],
+        capture_output=True, text=True, timeout=540,
+        env={"PATH": "/usr/bin:/bin:/usr/local/bin", "HOME": "/tmp",
+             "JAX_PLATFORMS": "cpu", "DSDDMM_RUNSTORE": "0",
+             "DSDDMM_PROGRAMS": "0"},
+    )
+    assert proc.returncode == 0, proc.stdout[-2000:] + proc.stderr[-2000:]
+    rep = json.loads(out.read_text())
+
+    # Selection: the variant registered as a candidate and the cost
+    # model discounts it on the skewed problem.
+    assert rep["selection"]["variant_candidates"] >= 1
+    assert rep["selection"]["cost_factor"] < 1.0
+
+    # Acceptance: >= 2x counted padded-lane-waste reduction with
+    # bit-identical results.
+    assert rep["waste"]["reduction_ratio"] >= 2.0
+    assert rep["waste"]["bit_identical"] is True
+
+    # Store: warm start hits, generic plan never aliases, stale entry
+    # evicted and recompiled.
+    assert rep["store"]["cold"]["live_compiles"] >= 1
+    assert rep["store"]["warm"]["hits"] >= 1
+    assert rep["store"]["warm"]["live_compiles"] == 0
+    assert rep["store"]["generic"]["live_compiles"] >= 1
+    assert rep["store"]["evicted"]["live_compiles"] >= 1
+    assert rep["store"]["variant_keys"] >= 1
+
+    # Records carry the variant id and the counted pad metric.
+    assert rep["record"]["kernel_variant"].startswith("v1.")
+    assert 0.0 <= rep["record"]["padded_lane_frac"] < 1.0
+    assert rep["counters"]["codegen_variants_built"] >= 1

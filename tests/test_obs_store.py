@@ -137,6 +137,15 @@ class TestQueries:
         other_app = _doc("other-app")
         other_app["record"]["app"] = "als"
         store.put(other_app)
+        # A codegen-variant run must not pool into the generic kernel's
+        # baseline (kernel_variant is a PR-9 config axis) — and its
+        # index row must carry the variant id.
+        varianted = _doc("banked")
+        varianted["record"]["kernel_variant"] = "v1.rb8.rm"
+        store.put(varianted)
+        assert next(
+            r for r in store.index() if r["run_id"] == "banked"
+        )["kernel_variant"] == "v1.rb8.rm"
         store.put(_doc("judged"))
         base = store.matching(store.get("judged"), limit=10)
         assert {d["run_id"] for d in base} == {"same-cfg"}

@@ -48,6 +48,24 @@ def test_serve_key_roundtrip_and_legacy_grammar():
     assert keys.parse_key(full) == parsed
 
 
+def test_serve_key_variant_segment():
+    """The PR 9 ``v<variant>`` segment: a ladder warmed under one
+    codegen kernel specialization must never answer for another (or for
+    the generic, whose keys stay byte-identical to the old grammar)."""
+    base = keys.serve_program_key("als", 4, 8, 16, "cpu", code="c",
+                                  params="k10-l0.1", sig="s")
+    varianted = keys.serve_program_key("als", 4, 8, 16, "cpu", code="c",
+                                       params="k10-l0.1", sig="s",
+                                       variant="v1.rb32.rm")
+    assert varianted != base
+    assert varianted == base + ":vv1.rb32.rm"
+    parsed = keys.parse_serve_key(varianted)
+    assert parsed["variant"] == "v1.rb32.rm"
+    assert keys.parse_key(varianted) == parsed
+    # Variant-less keys parse exactly as before.
+    assert "variant" not in keys.parse_serve_key(base)
+
+
 def test_serve_key_separates_baked_workload_constants():
     """Two fold-in configurations differing only in trace-time constants
     (top-k size, ridge) must produce distinct keys — the constants are
