@@ -1,0 +1,68 @@
+"""Repo-wide invariant analyzer: pluggable AST checkers, one tag scanner.
+
+Nine PRs of hand-enforced discipline — structured logging, the one-clock
+rule, atomic artifact writes, the single cache-key grammar, exported
+counters, lock-guarded module state, trace purity, declared env knobs —
+machine-checked before multi-controller code multiplies the ways to
+violate them. The framework replaces the three ad-hoc regex lints that
+grew inside ``tests/test_obs_lint.py`` (each with its own divergent
+tag-comment parser) with:
+
+* :mod:`~distributed_sddmm_tpu.analysis.core` — file walker (artifact
+  outputs excluded), per-checker visitor registry, ONE tag-comment
+  scanner for the whole suppression vocabulary, finding records with
+  ``file:line`` + checker id + suppression state;
+* :mod:`~distributed_sddmm_tpu.analysis.baseline` — committed JSON
+  baseline (``LINT_BASELINE.json``): pre-existing findings don't block
+  CI, new ones fail loud; entries are content-hashed so line drift does
+  not invalidate them;
+* :mod:`~distributed_sddmm_tpu.analysis.checkers` — the discipline
+  checkers (the three migrated ``test_obs_lint`` lints plus
+  atomic-write, env-knob, lock-discipline, key-grammar, trace-purity);
+* :mod:`~distributed_sddmm_tpu.analysis.cli` — ``bench lint`` /
+  ``bench env`` surface with the repo's 0/2/3 exit contract.
+
+This package deliberately imports neither jax nor strategy code — the
+analyzer must run in subprocess CI hooks and offline tooling the same
+way ``programs/keys.py`` must (module doc there). The only runtime
+imports are data tables (``utils.envreg``), themselves jax-free.
+"""
+
+from distributed_sddmm_tpu.analysis.core import (
+    CHECKERS,
+    Checker,
+    Finding,
+    SourceFile,
+    parse_tags,
+    repo_root,
+    run,
+)
+from distributed_sddmm_tpu.analysis import checkers as _checkers  # noqa: F401 — registers
+from distributed_sddmm_tpu.analysis.baseline import (
+    apply_baseline,
+    default_baseline_path,
+    fingerprint,
+    load_baseline,
+    write_baseline,
+)
+
+
+def run_repo(checkers=None, baseline="auto"):
+    """Run checkers over this checkout with the committed baseline
+    applied — the call the ``tests/test_obs_lint.py`` thin wrappers and
+    CI make. ``baseline`` may be a path, None (no suppression) or
+    ``"auto"`` (the committed ``LINT_BASELINE.json`` when present)."""
+    findings = run(checkers=checkers)
+    if baseline == "auto":
+        baseline = default_baseline_path()
+    if baseline is not None:
+        apply_baseline(findings, load_baseline(baseline),
+                       checkers=checkers)
+    return findings
+
+
+__all__ = [
+    "CHECKERS", "Checker", "Finding", "SourceFile", "parse_tags",
+    "repo_root", "run", "run_repo", "apply_baseline", "fingerprint",
+    "load_baseline", "write_baseline", "default_baseline_path",
+]

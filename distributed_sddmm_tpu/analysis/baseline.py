@@ -1,0 +1,155 @@
+"""Committed finding baseline: pre-existing debt doesn't block CI.
+
+The baseline is a JSON list of finding fingerprints. A fingerprint is
+``sha256(checker:path:normalized-line-text:ordinal)`` —
+content-addressed, not line-numbered, so adding a function above a
+baselined site does not invalidate the entry, while *editing the
+flagged line itself* does (the edit is exactly the moment the debt
+should be repaid or the entry consciously re-baselined). The ordinal
+counts byte-identical duplicates in line order, so baselining one
+``print('x')`` never covers a second identical one added later;
+snippet-less findings (repo-wide ``finish()`` facts) hash the message
+instead, so two stale declarations never alias.
+
+Workflow (README "Static analysis"):
+
+* ``bench lint`` — committed tree must exit 0: every finding is either
+  tagged at the site or in ``LINT_BASELINE.json``.
+* a new violation → exit 2, CI fails loud.
+* ``bench lint --write-baseline`` — regenerate the file after a
+  deliberate decision to carry new debt (reviewed like any diff).
+
+Stale entries (fingerprints no current finding matches — the debt was
+paid) are reported by ``bench lint`` as a note and dropped on the next
+``--write-baseline``; they never affect the exit code.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import pathlib
+from typing import Iterable, Optional
+
+from distributed_sddmm_tpu.analysis.core import Finding, repo_root
+
+SCHEMA_VERSION = 1
+
+#: The committed baseline, beside the other root-level committed JSON
+#: records (BENCH_r0*.json and friends).
+BASELINE_NAME = "LINT_BASELINE.json"
+
+
+def default_baseline_path() -> Optional[pathlib.Path]:
+    p = repo_root() / BASELINE_NAME
+    return p if p.exists() else None
+
+
+def fingerprint(f: Finding, ordinal: int = 0) -> str:
+    """Content-addressed identity of one finding (see module doc).
+
+    Snippet-less findings (the ``finish()`` cross-file passes anchor
+    whole-repo facts at a file, not a line) fall back to the message so
+    two distinct stale declarations never share one fingerprint, and
+    ``ordinal`` distinguishes byte-identical duplicate lines in one
+    file — baselining the first ``print('x')`` must not silently cover
+    a second one added later."""
+    norm = " ".join(f.snippet.split()) or f.message
+    body = f"{f.checker}:{f.path}:{norm}:{ordinal}"
+    return hashlib.sha256(body.encode()).hexdigest()[:16]
+
+
+def fingerprints(findings: Iterable[Finding]) -> list[str]:
+    """Fingerprints aligned with ``findings``, ordinals assigned to
+    duplicates in line order (stable across unrelated edits: the first
+    occurrence is always ordinal 0)."""
+    findings = list(findings)
+    counts: dict[tuple, int] = {}
+    out = []
+    seen: dict[int, str] = {}
+    for f in sorted(findings, key=lambda f: (f.checker, f.path, f.line)):
+        key = (f.checker, f.path, " ".join(f.snippet.split()) or f.message)
+        n = counts.get(key, 0)
+        counts[key] = n + 1
+        seen[id(f)] = fingerprint(f, n)
+    for f in findings:
+        out.append(seen[id(f)])
+    return out
+
+
+def load_baseline(path) -> dict:
+    """Parse a baseline file. Raises ValueError on schema mismatch or
+    unparseable JSON — the CLI maps that to exit 3 (usage/config error,
+    not a lint verdict)."""
+    path = pathlib.Path(path)
+    try:
+        doc = json.loads(path.read_text())
+    except (OSError, json.JSONDecodeError) as e:
+        raise ValueError(f"unreadable baseline {path}: {e}") from e
+    if not isinstance(doc, dict) or doc.get("schema") != SCHEMA_VERSION:
+        raise ValueError(
+            f"baseline {path} has schema {doc.get('schema')!r}, "
+            f"expected {SCHEMA_VERSION}"
+        )
+    return doc
+
+
+def apply_baseline(findings: Iterable[Finding], baseline: Optional[dict],
+                   checkers: Optional[Iterable[str]] = None) -> dict:
+    """Mark findings whose fingerprint is baselined. Returns
+    ``{"matched": [...], "stale": [...]}`` — stale entries are baseline
+    rows no current finding matches (paid-off debt). ``checkers``
+    scopes the comparison to a partial run's selection: entries for
+    checkers that did not run are out of scope, NOT stale — a
+    ``--checker X`` run must never report another checker's live
+    suppressions as paid-off debt."""
+    findings = list(findings)
+    if not baseline:
+        return {"matched": [], "stale": []}
+    selected = set(checkers) if checkers is not None else None
+    entries = {
+        e["fingerprint"]: e for e in baseline.get("findings", ())
+        if selected is None or e.get("checker") in selected
+    }
+    matched = set()
+    for f, fp in zip(findings, fingerprints(findings)):
+        if f.state != "new":
+            continue
+        if fp in entries:
+            f.state = "baselined"
+            matched.add(fp)
+    return {
+        "matched": sorted(matched),
+        "stale": [e for fp, e in sorted(entries.items())
+                  if fp not in matched],
+    }
+
+
+def write_baseline(path, findings: Iterable[Finding],
+                   keep: Iterable[dict] = ()) -> dict:
+    """Write the current ``new`` findings as the baseline (atomic —
+    the analyzer holds itself to its own atomic-write discipline).
+    ``keep`` carries prior entries to preserve verbatim — a partial
+    ``--checker X --write-baseline`` run passes the unselected
+    checkers' existing entries so regenerating one checker's debt
+    never deletes another's."""
+    from distributed_sddmm_tpu.utils.atomic import atomic_write_json
+
+    findings = list(findings)
+    rows = [
+        {
+            "fingerprint": fp,
+            "checker": f.checker,
+            "path": f.path,
+            "line": f.line,
+            "snippet": " ".join(f.snippet.split())[:90],
+        }
+        for f, fp in zip(findings, fingerprints(findings))
+        if f.state == "new"
+    ]
+    rows.extend(keep)
+    rows.sort(key=lambda e: (e.get("checker", ""), e.get("path", ""),
+                             e.get("line", 0)))
+    doc = {"schema": SCHEMA_VERSION, "findings": rows}
+    atomic_write_json(path, doc)
+    return doc

@@ -1,0 +1,759 @@
+"""The discipline checkers.
+
+Six disciplines, eight checker ids (the three lints migrated from
+``tests/test_obs_lint.py`` count as one group there):
+
+====================  ================================================
+id                    invariant
+====================  ================================================
+``bare-print``        diagnostics go through ``obs.log``; only CLI
+                      modules / ``# cli-output`` lines print
+``monotonic-clock``   serve/ and obs/ read ``obs.clock``, never raw
+                      ``time.*``; ``time.time()`` package-wide must be
+                      ``clock.epoch()`` (one calibration pair)
+``export-completeness``  every ``GLOBAL.add`` name is declared in
+                      ``httpexp.KNOWN_GLOBAL_COUNTERS`` (and no
+                      declaration is stale)
+``atomic-write``      artifact writes route through ``utils/atomic``
+                      (temp-file + ``os.replace``); streams/appends/
+                      lock files carry ``# non-atomic-ok``
+``env-knob``          every ``DSDDMM_*`` access names a knob declared
+                      in ``utils/envreg.py``; registry and README
+                      table agree; no stale registrations
+``lock-discipline``   module-level mutable containers in obs/ and
+                      serve/ are written under a ``with <lock>`` block
+                      (or in a ``*_locked`` function, or annotated)
+``key-grammar``       ``plan:``/``serve:``/``bench:`` cache keys are
+                      built ONLY by ``programs/keys.py`` builders
+``trace-purity``      no wall-clock / ``random`` / GLOBAL-counter
+                      mutation inside jit- or Pallas-traced bodies
+====================  ================================================
+
+Every checker is a pure AST pass (regex only inside comments); the
+suppression vocabulary lives in ``core.TAG_VOCABULARY`` and is parsed
+by the one shared scanner — the divergent per-lint tag regexes this
+replaces are the bug this PR retires.
+"""
+
+from __future__ import annotations
+
+import ast
+import pathlib
+from typing import Iterable, Optional
+
+from distributed_sddmm_tpu.analysis.core import (
+    Analysis,
+    Checker,
+    Finding,
+    SourceFile,
+    call_name,
+    dotted,
+    node_span,
+    register,
+    repo_root,
+    str_const,
+)
+
+PKG = "distributed_sddmm_tpu/"
+
+
+def in_pkg(src: SourceFile) -> bool:
+    return src.rel.startswith(PKG)
+
+
+def pkg_rel(src: SourceFile) -> str:
+    return src.rel[len(PKG):]
+
+
+# --------------------------------------------------------------------- #
+# 1. bare-print (migrated from tests/test_obs_lint.py)
+# --------------------------------------------------------------------- #
+
+
+@register
+class BarePrintChecker(Checker):
+    id = "bare-print"
+    description = ("bare print( in library code — use obs.log, or tag "
+                   "deliberate CLI output '# cli-output'")
+    suppress_tags = ("cli-output",)
+
+    #: Modules whose stdout IS the product (argparse CLIs, table
+    #: printers) — the allowlist the old lint carried, plus the lint
+    #: CLI itself.
+    ALLOWLIST = {
+        "bench/cli.py",        # bench subcommands print JSON records
+        "bench/kernels.py",    # kernel-sweep table printer
+        "tools/costmodel.py",  # cost-model CLI
+        "tools/charts.py",     # chart CLI
+        "tools/tracereport.py",  # trace-report CLI
+        "analysis/cli.py",     # the lint/env CLI: findings ARE stdout
+    }
+
+    def select(self, src):
+        return in_pkg(src) and pkg_rel(src) not in self.ALLOWLIST
+
+    def check(self, src, ctx):
+        for node in ast.walk(src.tree):
+            if (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Name)
+                    and node.func.id == "print"):
+                yield self.finding(
+                    src, node,
+                    "bare print( — route diagnostics through "
+                    "distributed_sddmm_tpu.obs.log",
+                )
+
+
+# --------------------------------------------------------------------- #
+# 2. monotonic-clock (migrated)
+# --------------------------------------------------------------------- #
+
+
+@register
+class MonotonicClockChecker(Checker):
+    id = "monotonic-clock"
+    description = ("raw time.* clock read where obs.clock (the one "
+                   "calibrated pair) is required")
+    suppress_tags = ("wall-clock-ok",)
+
+    #: The clock module IS the abstraction.
+    ALLOWLIST = {"obs/clock.py"}
+    #: Full discipline (no raw clock at all) inside the span layers.
+    SPAN_SUBPACKAGES = ("serve/", "obs/")
+    RAW_CLOCKS = {"time.time", "time.perf_counter", "time.monotonic"}
+
+    def select(self, src):
+        return in_pkg(src) and pkg_rel(src) not in self.ALLOWLIST
+
+    def check(self, src, ctx):
+        rel = pkg_rel(src)
+        span_path = rel.startswith(self.SPAN_SUBPACKAGES)
+        for node in ast.walk(src.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = call_name(node)
+            if name not in self.RAW_CLOCKS:
+                continue
+            if span_path:
+                yield self.finding(
+                    src, node,
+                    f"raw {name}() in a serve/obs span path — read "
+                    "obs.clock (now()/epoch()) so timestamps stay "
+                    "calibrated and mergeable",
+                )
+            elif name == "time.time":
+                # Package-wide: epoch stamps come from clock.epoch()
+                # so created-at metadata shares the process's one
+                # calibration pair (perf_counter stays free outside
+                # the span layers — bench timing is local by design).
+                yield self.finding(
+                    src, node,
+                    "time.time() outside obs/clock — use "
+                    "obs.clock.epoch() for epoch stamps",
+                )
+
+
+# --------------------------------------------------------------------- #
+# 3. export-completeness (migrated)
+# --------------------------------------------------------------------- #
+
+
+def _counter_add_name(call: ast.Call) -> Optional[ast.AST]:
+    """The name-argument node of a ``GLOBAL.add(...)`` /
+    ``_global_counters().add(...)`` bump, else None."""
+    fn = call.func
+    if not (isinstance(fn, ast.Attribute) and fn.attr == "add"):
+        return None
+    owner = fn.value
+    owner_name = dotted(owner)
+    owned = (
+        # GLOBAL.add / metrics.GLOBAL.add / obs_metrics.GLOBAL.add —
+        # the counter registry is always bound as ``GLOBAL``.
+        (owner_name is not None
+         and (owner_name == "GLOBAL" or owner_name.endswith(".GLOBAL")))
+        or (isinstance(owner, ast.Call)
+            and call_name(owner) == "_global_counters")
+    )
+    if not owned or not call.args:
+        return None
+    return call.args[0]
+
+
+def known_global_counters(root: Optional[pathlib.Path] = None) -> set:
+    """Statically extract ``KNOWN_GLOBAL_COUNTERS`` keys from
+    ``obs/httpexp.py`` — no package import, so the analyzer stays
+    importable in jax-free subprocesses."""
+    path = (root or repo_root()) / PKG / "obs" / "httpexp.py"
+    if not path.exists():
+        return set()
+    tree = ast.parse(path.read_text())
+    for node in tree.body:
+        targets = []
+        if isinstance(node, ast.Assign):
+            targets = node.targets
+        elif isinstance(node, ast.AnnAssign) and node.target is not None:
+            targets = [node.target]
+        for t in targets:
+            if (isinstance(t, ast.Name)
+                    and t.id == "KNOWN_GLOBAL_COUNTERS"
+                    and isinstance(node.value, ast.Dict)):
+                return {str_const(k) for k in node.value.keys
+                        if str_const(k) is not None}
+    return set()
+
+
+@register
+class ExportCompletenessChecker(Checker):
+    id = "export-completeness"
+    description = ("GLOBAL counter missing from the /metrics exposition "
+                   "(httpexp.KNOWN_GLOBAL_COUNTERS), or stale declaration")
+    suppress_tags = ("not-exported",)
+
+    def select(self, src):
+        return in_pkg(src)
+
+    def check(self, src, ctx):
+        scratch = ctx.scratch_for(self.id)
+        seen = scratch.setdefault("seen", set())
+        known = scratch.get("known")
+        if known is None:
+            # The SCANNED tree's declarations (a --root worktree's own
+            # httpexp.py), not the running checkout's; a tree without
+            # one (fixture trees) has an empty known set, so every
+            # bump fires.
+            known = scratch["known"] = known_global_counters(ctx.root)
+        for node in ast.walk(src.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            arg = _counter_add_name(node)
+            if arg is None:
+                continue
+            name = str_const(arg)
+            if name is None:
+                yield self.finding(
+                    src, node,
+                    "GLOBAL.add with a non-literal counter name — the "
+                    "exposition cannot verify it is scraped",
+                )
+                continue
+            seen.add(name)
+            if name not in known:
+                yield self.finding(
+                    src, node,
+                    f"GLOBAL counter {name!r} not declared in "
+                    "obs.httpexp.KNOWN_GLOBAL_COUNTERS — it will never "
+                    "appear on /metrics",
+                )
+
+    def finish(self, ctx):
+        if not ctx.is_repo:
+            return
+        scratch = ctx.scratch_for(self.id)
+        seen = scratch.get("seen", set())
+        known = scratch.get("known", known_global_counters(ctx.root))
+        if not seen:
+            yield Finding(
+                self.id, PKG + "obs/httpexp.py", 1,
+                "checker matched no GLOBAL.add sites at all — the "
+                "visitor rotted",
+            )
+            return
+        # Reverse direction: a declared-but-never-bumped counter is a
+        # stale declaration (renamed counter keeps scraping a frozen 0).
+        for name in sorted(known - seen):
+            yield Finding(
+                self.id, PKG + "obs/httpexp.py", 1,
+                f"KNOWN_GLOBAL_COUNTERS declares {name!r} but no "
+                "GLOBAL.add site bumps it (stale declaration)",
+            )
+
+
+# --------------------------------------------------------------------- #
+# 4. atomic-write
+# --------------------------------------------------------------------- #
+
+
+@register
+class AtomicWriteChecker(Checker):
+    id = "atomic-write"
+    description = ("raw file write — route artifact writes through "
+                   "utils/atomic (or tag streams '# non-atomic-ok')")
+    suppress_tags = ("non-atomic-ok",)
+
+    #: The one implementation of the temp-file + os.replace dance.
+    ALLOWLIST = {"utils/atomic.py"}
+    WRITE_MODES = set("wax+")
+
+    def select(self, src):
+        return in_pkg(src) and pkg_rel(src) not in self.ALLOWLIST
+
+    def _open_mode(self, call: ast.Call) -> Optional[str]:
+        if not (isinstance(call.func, ast.Name)
+                and call.func.id == "open"):
+            return None
+        mode = None
+        if len(call.args) >= 2:
+            mode = str_const(call.args[1])
+        for kw in call.keywords:
+            if kw.arg == "mode":
+                mode = str_const(kw.value)
+        return mode
+
+    def check(self, src, ctx):
+        for node in ast.walk(src.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            mode = self._open_mode(node)
+            if mode is not None and set(mode) & self.WRITE_MODES:
+                yield self.finding(
+                    src, node,
+                    f"raw open(..., {mode!r}) — a kill mid-write leaves "
+                    "a torn file; use utils.atomic (atomic_write_text/"
+                    "json/bytes)",
+                )
+                continue
+            name = call_name(node)
+            if name == "json.dump":
+                yield self.finding(
+                    src, node,
+                    "json.dump to a raw handle — use "
+                    "utils.atomic.atomic_write_json",
+                )
+                continue
+            if (isinstance(node.func, ast.Attribute)
+                    and node.func.attr in ("write_text", "write_bytes")):
+                yield self.finding(
+                    src, node,
+                    f".{node.func.attr}() — a kill mid-write leaves a "
+                    "torn file; use utils.atomic",
+                )
+
+
+# --------------------------------------------------------------------- #
+# 5. env-knob
+# --------------------------------------------------------------------- #
+
+
+def _env_access_name(node: ast.AST) -> Optional[ast.AST]:
+    """The name-expression node of an ``os.environ`` access, else None:
+    ``os.environ.get/pop/setdefault(K, ...)``, ``os.getenv(K, ...)``,
+    ``os.environ[K]`` (read, write or del)."""
+    if isinstance(node, ast.Call):
+        name = call_name(node)
+        if name in ("os.environ.get", "os.environ.pop",
+                    "os.environ.setdefault", "os.getenv") and node.args:
+            return node.args[0]
+        return None
+    if isinstance(node, ast.Subscript):
+        if dotted(node.value) == "os.environ":
+            return node.slice
+    return None
+
+
+def registered_knobs(root: pathlib.Path) -> Optional[set]:
+    """Statically extract declared knob names from a tree's
+    ``utils/envreg.py`` (first string argument of each ``_K``/``Knob``
+    call). None when the tree has no registry file."""
+    path = root / PKG / "utils" / "envreg.py"
+    if not path.exists():
+        return None
+    names = set()
+    for node in ast.walk(ast.parse(path.read_text())):
+        if (isinstance(node, ast.Call)
+                and dotted(node.func) in ("_K", "Knob") and node.args):
+            name = str_const(node.args[0])
+            if name is not None:
+                names.add(name)
+    return names
+
+
+@register
+class EnvKnobChecker(Checker):
+    id = "env-knob"
+    description = ("DSDDMM_* env access not declared in utils/envreg.py "
+                   "(or stale registration / README table drift)")
+    suppress_tags = ("env-ok",)
+    PREFIX = "DSDDMM_"
+
+    # Scope: everything walked — the package, scripts/, tests/ and the
+    # root entry points all reach for knobs.
+
+    def _registry(self, ctx) -> set:
+        """Declared knob names — from the SCANNED tree's envreg.py when
+        it has one (a --root worktree validates against its own
+        registry, statically extracted), else the running checkout's
+        (fixture trees reference real knobs)."""
+        scratch = ctx.scratch_for(self.id)
+        if "knobs" not in scratch:
+            names = registered_knobs(ctx.root)
+            if names is None:
+                from distributed_sddmm_tpu.utils import envreg
+
+                names = set(envreg.KNOBS)
+            scratch["knobs"] = names
+        return scratch["knobs"]
+
+    def check(self, src, ctx):
+        if in_pkg(src) and pkg_rel(src) == "utils/envreg.py":
+            return
+        knobs = self._registry(ctx)
+        seen = ctx.scratch_for(self.id).setdefault("seen", set())
+        for node in ast.walk(src.tree):
+            arg = _env_access_name(node)
+            if arg is None:
+                continue
+            name = str_const(arg)
+            if name is None or not name.startswith(self.PREFIX):
+                continue
+            seen.add(name)
+            if name not in knobs:
+                yield self.finding(
+                    src, node,
+                    f"env knob {name!r} is not declared in "
+                    "utils/envreg.py — register it (name, type, "
+                    "default, doc) so `bench env` and the README table "
+                    "stay complete",
+                )
+
+    def finish(self, ctx):
+        if not ctx.is_repo:
+            return
+        from distributed_sddmm_tpu.utils import envreg
+
+        knobs = self._registry(ctx)
+        seen = ctx.scratch_for(self.id).get("seen", set())
+        envreg_rel = PKG + "utils/envreg.py"
+        for name in sorted(set(knobs) - seen):
+            yield Finding(
+                self.id, envreg_rel, envreg.declaration_line(name) or 1,
+                f"registered knob {name!r} has no os.environ access "
+                "site anywhere in the repo (stale registration)",
+            )
+        # README table agreement: the committed block between the
+        # envreg markers must be exactly what the registry renders.
+        readme = ctx.root / "README.md"
+        if not readme.exists():
+            return
+        text = readme.read_text()
+        begin, end = envreg.README_BEGIN, envreg.README_END
+        if begin not in text or end not in text:
+            yield Finding(
+                self.id, "README.md", 1,
+                f"README is missing the env-knob table markers "
+                f"({begin} / {end}) — regenerate with "
+                "`bench env --markdown`",
+            )
+            return
+        block = text.split(begin, 1)[1].split(end, 1)[0].strip()
+        want = envreg.render_markdown().strip()
+        if block != want:
+            line = text[: text.index(begin)].count("\n") + 1
+            yield Finding(
+                self.id, "README.md", line,
+                "README env-knob table does not match utils/envreg.py "
+                "— regenerate the block with `bench env --markdown`",
+            )
+
+
+# --------------------------------------------------------------------- #
+# 6. lock-discipline
+# --------------------------------------------------------------------- #
+
+
+@register
+class LockDisciplineChecker(Checker):
+    id = "lock-discipline"
+    description = ("module-level mutable container written outside a "
+                   "`with <lock>` block in obs/ or serve/")
+    suppress_tags = ("lock", "unlocked-ok")
+
+    SCOPES = ("obs/", "serve/")
+    CONTAINER_CALLS = {
+        "dict", "list", "set", "defaultdict", "collections.defaultdict",
+        "OrderedDict", "collections.OrderedDict", "deque",
+        "collections.deque", "Counter", "collections.Counter",
+    }
+    MUTATORS = {
+        "append", "add", "update", "pop", "popitem", "clear", "extend",
+        "insert", "remove", "discard", "setdefault", "appendleft",
+        "popleft", "rotate",
+    }
+
+    def select(self, src):
+        return in_pkg(src) and pkg_rel(src).startswith(self.SCOPES)
+
+    def _module_containers(self, src) -> set:
+        names = set()
+        for stmt in src.tree.body:
+            targets, value = [], None
+            if isinstance(stmt, ast.Assign):
+                targets, value = stmt.targets, stmt.value
+            elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+                targets, value = [stmt.target], stmt.value
+            if value is None:
+                continue
+            mutable = isinstance(value, (
+                ast.Dict, ast.List, ast.Set, ast.DictComp, ast.ListComp,
+                ast.SetComp,
+            )) or (isinstance(value, ast.Call)
+                   and call_name(value) in self.CONTAINER_CALLS)
+            if not mutable:
+                continue
+            for t in targets:
+                if isinstance(t, ast.Name):
+                    names.add(t.id)
+        return names
+
+    def _is_locked(self, src, node) -> bool:
+        """Held-lock heuristic: an enclosing ``with`` whose context
+        expression mentions a lock (``with self._lock:``, ``with
+        _registry_lock:``, ``with store._flock():``) or an enclosing
+        function named ``*_locked`` (the repo's convention for
+        called-with-lock-held helpers)."""
+        for anc in src.parents(node):
+            if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                if anc.name.endswith("_locked"):
+                    return True
+            if isinstance(anc, (ast.With, ast.AsyncWith)):
+                for item in anc.items:
+                    if "lock" in ast.unparse(item.context_expr).lower():
+                        return True
+        return False
+
+    def _mutations(self, tree, containers):
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.Assign, ast.AugAssign)):
+                targets = (node.targets if isinstance(node, ast.Assign)
+                           else [node.target])
+                for t in targets:
+                    if (isinstance(t, ast.Subscript)
+                            and isinstance(t.value, ast.Name)
+                            and t.value.id in containers):
+                        yield node, t.value.id
+            elif isinstance(node, ast.Delete):
+                for t in node.targets:
+                    if (isinstance(t, ast.Subscript)
+                            and isinstance(t.value, ast.Name)
+                            and t.value.id in containers):
+                        yield node, t.value.id
+            elif isinstance(node, ast.Call):
+                fn = node.func
+                if (isinstance(fn, ast.Attribute)
+                        and fn.attr in self.MUTATORS
+                        and isinstance(fn.value, ast.Name)
+                        and fn.value.id in containers):
+                    yield node, fn.value.id
+
+    def check(self, src, ctx):
+        containers = self._module_containers(src)
+        if not containers:
+            return
+        for node, name in self._mutations(src.tree, containers):
+            # Module-level statements run at import, single-threaded by
+            # the import lock — only function-scope writes race.
+            if not any(isinstance(a, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef))
+                       for a in src.parents(node)):
+                continue
+            if self._is_locked(src, node):
+                continue
+            yield self.finding(
+                src, node,
+                f"module-level container {name!r} written outside a "
+                "`with <lock>` block — a concurrent scrape/serve thread "
+                "can observe a torn update; hold the module's lock or "
+                "annotate `# lock: <name>` / `# unlocked-ok`",
+            )
+
+
+# --------------------------------------------------------------------- #
+# 7. key-grammar
+# --------------------------------------------------------------------- #
+
+
+@register
+class KeyGrammarChecker(Checker):
+    id = "key-grammar"
+    description = ("cache-key-shaped string built outside "
+                   "programs/keys.py builders")
+    suppress_tags = ("key-grammar-ok",)
+
+    #: The one key grammar module (module doc there: three look-alike
+    #: builders diverging is exactly what PR 6 unified).
+    ALLOWLIST = {"programs/keys.py"}
+    PREFIXES = ("plan:", "serve:", "bench:")
+    FAMILIES = {"plan", "serve", "bench"}
+    #: Span/event names share the prefixes (``serve:batch``) but real
+    #: keys are many-segment — require >= this many literal colons.
+    MIN_COLONS = 3
+
+    def select(self, src):
+        return in_pkg(src) and pkg_rel(src) not in self.ALLOWLIST
+
+    def _flag(self, src, node, how):
+        return self.finding(
+            src, node,
+            f"{how} builds a {'/'.join(self.PREFIXES)} cache key "
+            "outside programs/keys.py — use the builders "
+            "(plan_program_key/serve_program_key/bench_aot_key) so the "
+            "one grammar cannot silently fork",
+        )
+
+    def check(self, src, ctx):
+        for node in ast.walk(src.tree):
+            if isinstance(node, ast.JoinedStr):
+                lits = [v.value for v in node.values
+                        if isinstance(v, ast.Constant)
+                        and isinstance(v.value, str)]
+                if not lits or not lits[0].startswith(self.PREFIXES):
+                    continue
+                if sum(s.count(":") for s in lits) >= self.MIN_COLONS:
+                    yield self._flag(src, node, "f-string")
+            elif isinstance(node, ast.Call):
+                fn = node.func
+                # ":".join(("plan", ...))
+                if (isinstance(fn, ast.Attribute) and fn.attr == "join"
+                        and str_const(fn.value) == ":" and node.args):
+                    arg = node.args[0]
+                    if isinstance(arg, (ast.Tuple, ast.List)) and arg.elts:
+                        if str_const(arg.elts[0]) in self.FAMILIES:
+                            yield self._flag(src, node, '":".join')
+                # "plan:{}:{}...".format(...)
+                elif (isinstance(fn, ast.Attribute)
+                      and fn.attr == "format"):
+                    s = str_const(fn.value)
+                    if (s and s.startswith(self.PREFIXES)
+                            and s.count(":") >= self.MIN_COLONS):
+                        yield self._flag(src, node, "str.format")
+            elif isinstance(node, ast.BinOp) and isinstance(node.op, ast.Mod):
+                s = str_const(node.left)
+                if (s and s.startswith(self.PREFIXES)
+                        and s.count(":") >= self.MIN_COLONS):
+                    yield self._flag(src, node, "%-format")
+
+
+# --------------------------------------------------------------------- #
+# 8. trace-purity
+# --------------------------------------------------------------------- #
+
+
+@register
+class TracePurityChecker(Checker):
+    id = "trace-purity"
+    description = ("wall-clock / random / GLOBAL-counter mutation "
+                   "inside a jit- or Pallas-traced function body")
+    suppress_tags = ("trace-impure-ok",)
+
+    JIT_NAMES = {"jit", "jax.jit"}
+    PALLAS_SUFFIX = "pallas_call"
+    IMPURE_CALLS = {
+        "time.time", "time.perf_counter", "time.monotonic",
+        "time.time_ns", "clock.now", "clock.epoch", "obs_clock.now",
+        "obs_clock.epoch",
+    }
+    RANDOM_ROOTS = ("random.", "np.random.", "numpy.random.")
+
+    def select(self, src):
+        return in_pkg(src)
+
+    # -- traced-root discovery ----------------------------------------- #
+
+    def _is_jit_decorator(self, dec: ast.AST) -> bool:
+        name = dotted(dec)
+        if name in self.JIT_NAMES:
+            return True
+        if isinstance(dec, ast.Call):
+            name = call_name(dec)
+            if name in self.JIT_NAMES:
+                return True
+            # @partial(jax.jit, static_argnums=...)
+            if (name in ("partial", "functools.partial") and dec.args
+                    and dotted(dec.args[0]) in self.JIT_NAMES):
+                return True
+        return False
+
+    def _traced_defs(self, src) -> list:
+        defs_by_name: dict[str, list] = {}
+        for node in ast.walk(src.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                defs_by_name.setdefault(node.name, []).append(node)
+
+        roots: list = []
+        for node in ast.walk(src.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                if any(self._is_jit_decorator(d)
+                       for d in node.decorator_list):
+                    roots.append(node)
+            elif isinstance(node, ast.Call):
+                name = call_name(node)
+                traced_call = name in self.JIT_NAMES or (
+                    name is not None and name.endswith(self.PALLAS_SUFFIX)
+                )
+                if not traced_call:
+                    continue
+                # Any Name referenced in the call's arguments that
+                # resolves to a function def in this module is traced
+                # (covers jax.jit(make), shard_map(prog, ...) inside
+                # jit, pl.pallas_call(kernel_body, ...)).
+                for arg in list(node.args) + [kw.value
+                                              for kw in node.keywords]:
+                    for sub in ast.walk(arg):
+                        if isinstance(sub, ast.Name):
+                            roots.extend(defs_by_name.get(sub.id, ()))
+
+        # Same-module reachability: a traced body calling a local
+        # helper by name traces the helper too.
+        traced, queue = [], list(dict.fromkeys(roots))
+        seen_ids = set()
+        while queue:
+            fn = queue.pop()
+            if id(fn) in seen_ids:
+                continue
+            seen_ids.add(id(fn))
+            traced.append(fn)
+            for sub in ast.walk(fn):
+                if (isinstance(sub, ast.Call)
+                        and isinstance(sub.func, ast.Name)):
+                    queue.extend(defs_by_name.get(sub.func.id, ()))
+        return traced
+
+    # -- impurity scan -------------------------------------------------- #
+
+    def check(self, src, ctx):
+        reported = set()
+        for fn in self._traced_defs(src):
+            for node in ast.walk(fn):
+                if id(node) in reported:
+                    continue
+                msg = self._impurity(node)
+                if msg:
+                    reported.add(id(node))
+                    yield self.finding(
+                        src, node,
+                        f"{msg} inside traced function {fn.name!r} — "
+                        "it bakes one trace-time value into the "
+                        "compiled program (or silently no-ops per "
+                        "call); hoist it out or tag "
+                        "'# trace-impure-ok'",
+                    )
+
+    def _impurity(self, node: ast.AST) -> Optional[str]:
+        if isinstance(node, ast.Call):
+            name = call_name(node)
+            if name in self.IMPURE_CALLS:
+                return f"wall-clock read {name}()"
+            if name and name.startswith(self.RANDOM_ROOTS):
+                return f"host RNG call {name}()"
+            if _counter_add_name(node) is not None:
+                return "GLOBAL counter mutation"
+        elif isinstance(node, (ast.Assign, ast.AugAssign)):
+            targets = (node.targets if isinstance(node, ast.Assign)
+                       else [node.target])
+            for t in targets:
+                base = t
+                while isinstance(base, (ast.Attribute, ast.Subscript)):
+                    base = base.value
+                if (isinstance(base, ast.Name) and base.id == "GLOBAL"
+                        and base is not t):
+                    return "GLOBAL counter mutation"
+        return None

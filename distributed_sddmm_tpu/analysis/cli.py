@@ -1,0 +1,202 @@
+"""``bench lint`` / ``bench env``: the analyzer's CLI surface.
+
+Exit contract (the repo's standard one, shared with ``bench gate`` and
+``tracereport``): **0** clean (every finding tagged or baselined),
+**2** new findings, **3** usage/config error (unknown checker id,
+unreadable baseline) — a CI hook can distinguish "the tree regressed"
+from "the lint invocation is broken".
+
+This module's stdout IS its product (finding listings, the env table),
+so it sits on the bare-print allowlist like the other CLI modules.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import pathlib
+import sys
+from typing import Optional
+
+EXIT_CLEAN = 0
+EXIT_FINDINGS = 2
+EXIT_USAGE = 3
+
+
+def build_lint_parser(p: Optional[argparse.ArgumentParser] = None):
+    if p is None:
+        p = argparse.ArgumentParser(
+            prog="bench lint",
+            description="repo-wide invariant analyzer (analysis/)",
+        )
+    p.add_argument(
+        "--checker", action="append", default=None, metavar="ID",
+        help="run only this checker (repeatable; default: all)",
+    )
+    p.add_argument(
+        "--baseline", default=None, metavar="PATH",
+        help="baseline file (default: the committed LINT_BASELINE.json "
+        "when scanning this checkout; 'none' disables)",
+    )
+    p.add_argument(
+        "--root", default=None, metavar="DIR",
+        help="scan root (default: this checkout; repo-wide consistency "
+        "passes only run on the checkout itself)",
+    )
+    p.add_argument("--json", action="store_true",
+                   help="machine-readable findings")
+    p.add_argument(
+        "--write-baseline", action="store_true",
+        help="rewrite the baseline from the current new findings "
+        "(exits 0; review the diff like any other)",
+    )
+    p.add_argument("--list", action="store_true",
+                   help="list registered checkers and exit")
+    return p
+
+
+def run_lint(args) -> int:
+    from distributed_sddmm_tpu import analysis
+    from distributed_sddmm_tpu.analysis import baseline as bl
+
+    if args.list:
+        for cid, checker in sorted(analysis.CHECKERS.items()):
+            print(f"{cid:<20} {checker.description}")
+        return EXIT_CLEAN
+
+    root = pathlib.Path(args.root).resolve() if args.root else None
+    scanning_repo = root is None or root == analysis.repo_root()
+    baseline_path = None
+    if args.baseline and args.baseline != "none":
+        baseline_path = pathlib.Path(args.baseline)
+    elif args.baseline is None and scanning_repo:
+        baseline_path = bl.default_baseline_path()
+
+    # Usage errors (exit 3) surface BEFORE the multi-second repo walk:
+    # a misconfigured CI invocation fails instantly, not after the scan.
+    baseline_doc = None
+    if baseline_path is not None and not args.write_baseline:
+        try:
+            baseline_doc = bl.load_baseline(baseline_path)
+        except ValueError as e:
+            print(f"bench lint: {e}", file=sys.stderr)
+            return EXIT_USAGE
+
+    try:
+        findings = analysis.run(root=root, checkers=args.checker)
+    except KeyError as e:
+        print(f"bench lint: {e.args[0]}", file=sys.stderr)
+        return EXIT_USAGE
+
+    if args.write_baseline:
+        out = baseline_path or (
+            (root or analysis.repo_root()) / bl.BASELINE_NAME
+        )
+        keep = ()
+        if args.checker and out.exists():
+            # Partial regeneration: a --checker X run only re-baselines
+            # X's debt; every other checker's committed entries survive
+            # verbatim (deleting them would make the next FULL run fail
+            # on suppressions nobody decided to drop).
+            try:
+                prior = bl.load_baseline(out)
+            except ValueError as e:
+                print(f"bench lint: {e}", file=sys.stderr)
+                return EXIT_USAGE
+            selected = set(args.checker)
+            keep = [e for e in prior.get("findings", ())
+                    if e.get("checker") not in selected]
+        doc = bl.write_baseline(out, findings, keep=keep)
+        print(f"wrote {out} ({len(doc['findings'])} finding(s)"
+              + (f", {len(keep)} kept from unselected checkers" if keep
+                 else "") + ")")
+        return EXIT_CLEAN
+
+    stale = []
+    if baseline_doc is not None:
+        # Scoped to the selected checkers: a partial run must not call
+        # the unselected checkers' entries stale (see apply_baseline).
+        stale = analysis.apply_baseline(
+            findings, baseline_doc, checkers=args.checker
+        )["stale"]
+
+    new = [f for f in findings if f.state == "new"]
+    if args.json:
+        print(json.dumps({
+            "new": len(new),
+            "tagged": sum(f.state == "tagged" for f in findings),
+            "baselined": sum(f.state == "baselined" for f in findings),
+            "stale_baseline_entries": stale,
+            "findings": [f.to_dict() for f in findings],
+        }, indent=1))
+    else:
+        for f in new:
+            print(f.render())
+        counts = (
+            f"{len(new)} new, "
+            f"{sum(f.state == 'tagged' for f in findings)} tagged, "
+            f"{sum(f.state == 'baselined' for f in findings)} baselined"
+        )
+        print(f"lint: {counts}")
+        if stale:
+            print(
+                f"lint: note — {len(stale)} stale baseline entr"
+                f"{'y' if len(stale) == 1 else 'ies'} (debt paid; drop "
+                "with --write-baseline)"
+            )
+    return EXIT_FINDINGS if new else EXIT_CLEAN
+
+
+def build_env_parser(p: Optional[argparse.ArgumentParser] = None):
+    if p is None:
+        p = argparse.ArgumentParser(
+            prog="bench env",
+            description="the DSDDMM_* env-knob registry (utils/envreg.py)",
+        )
+    fmt = p.add_mutually_exclusive_group()
+    fmt.add_argument("--json", action="store_true")
+    fmt.add_argument(
+        "--markdown", action="store_true",
+        help="emit the README block (paste between the envreg markers)",
+    )
+    p.add_argument(
+        "--scope", choices=("runtime", "test"), default=None,
+        help="filter by knob scope (default: all for the table, "
+        "runtime for --markdown)",
+    )
+    return p
+
+
+def run_env(args) -> int:
+    from distributed_sddmm_tpu.utils import envreg
+
+    if args.json:
+        print(json.dumps(envreg.to_records(scope=args.scope), indent=1))
+    elif args.markdown:
+        # Scope threads through (--scope test audits the test knobs);
+        # the default runtime block is the one the README commits and
+        # the env-knob checker verifies.
+        if args.scope in (None, "runtime"):
+            print(envreg.README_BEGIN)
+            print(envreg.render_markdown())
+            print(envreg.README_END)
+        else:
+            print(envreg.render_markdown(scope=args.scope))
+    else:
+        print(envreg.render_table(scope=args.scope))
+    return EXIT_CLEAN
+
+
+def main(argv=None) -> int:
+    """Standalone entry (``python -m distributed_sddmm_tpu.analysis.cli``)
+    — same surface as ``bench lint`` for jax-free CI hooks."""
+    ap = argparse.ArgumentParser(prog="analysis")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+    build_lint_parser(sub.add_parser("lint"))
+    build_env_parser(sub.add_parser("env"))
+    args = ap.parse_args(argv)
+    return run_lint(args) if args.cmd == "lint" else run_env(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

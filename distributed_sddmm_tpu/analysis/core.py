@@ -1,0 +1,380 @@
+"""Analyzer core: file walker, tag scanner, checker registry, findings.
+
+Three design rules, learned from the ad-hoc lints this replaces:
+
+1. **One tag scanner.** The bare-print and export-completeness lints
+   each grew a private regex for their opt-out comment (``# cli-output``
+   vs ``# not-exported``) and the two had already drifted (one matched
+   anywhere in the line, one only outside docstrings). Here a single
+   tokenizer pass extracts every ``#`` comment once and parses the whole
+   tag vocabulary out of it; checkers declare which tags suppress them
+   and the core applies suppression uniformly over the *statement's*
+   full line range (a tag on any physical line of a multi-line call
+   counts, where the line-based regexes silently missed continuations).
+
+2. **AST, not regex.** Findings anchor to real nodes: a ``print`` in a
+   docstring or a key-grammar prefix in prose can no longer
+   false-positive, and multi-line calls can no longer false-negative.
+
+3. **The walker never scans artifact output.** ``artifacts/`` holds the
+   runstore, program store, checkpoints and committed TPU-run records —
+   generated trees that may contain thousands of files (and .py run
+   scripts whose discipline is the TPU pod's, not this package's).
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import pathlib
+import re
+import tokenize
+from typing import Callable, Iterable, Iterator, Optional
+
+# --------------------------------------------------------------------- #
+# Tags: the unified suppression vocabulary
+# --------------------------------------------------------------------- #
+
+#: Every tag comment the analyzer understands, with the discipline it
+#: opts out of. ``lock`` is parametric (``# lock: <name>`` names the
+#: lock the surrounding code holds by construction).
+TAG_VOCABULARY = {
+    "cli-output": "deliberate stdout product line (bare-print)",
+    "wall-clock-ok": "deliberate raw clock read (monotonic-clock)",
+    "not-exported": "GLOBAL counter deliberately off /metrics "
+                    "(export-completeness)",
+    "non-atomic-ok": "deliberate raw write: stream/append/lock file "
+                     "(atomic-write)",
+    "env-ok": "deliberate unregistered env access (env-knob)",
+    "lock": "module state guarded by the named lock at a coarser "
+            "granularity (lock-discipline)",
+    "unlocked-ok": "deliberately unguarded module-state write "
+                   "(lock-discipline)",
+    "key-grammar-ok": "deliberate key-shaped string outside "
+                      "programs/keys.py (key-grammar)",
+    "trace-impure-ok": "deliberate impurity in a traced body "
+                       "(trace-purity)",
+}
+
+_TAG_RES = {
+    name: re.compile(
+        rf"\b{re.escape(name)}\b" if name != "lock"
+        else r"\block:\s*([A-Za-z_][\w.]*)"
+    )
+    for name in TAG_VOCABULARY
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class Tag:
+    name: str
+    arg: Optional[str] = None  # the lock name for ``lock:``
+
+
+def parse_tags(comment: str) -> list[Tag]:
+    """All tags in one ``#`` comment's text. A comment may carry several
+    (``# lock: _registry_lock  # not-exported``) and prose after a tag
+    (``# wall-clock-ok — the calibration pair``) is fine."""
+    tags = []
+    for name, rx in _TAG_RES.items():
+        m = rx.search(comment)
+        if m:
+            tags.append(Tag(name, m.group(1) if m.groups() else None))
+    return tags
+
+
+def scan_tags(text: str) -> dict[int, list[Tag]]:
+    """Line number -> tags, from ONE tokenizer pass over the file. Falls
+    back to a line regex when the file fails to tokenize (the AST parse
+    will report the syntax error; suppression accuracy is moot then)."""
+    out: dict[int, list[Tag]] = {}
+    try:
+        import io
+
+        for tok in tokenize.generate_tokens(io.StringIO(text).readline):
+            if tok.type == tokenize.COMMENT:
+                tags = parse_tags(tok.string)
+                if tags:
+                    out.setdefault(tok.start[0], []).extend(tags)
+    except (tokenize.TokenError, IndentationError, SyntaxError):
+        for ln, line in enumerate(text.splitlines(), 1):
+            if "#" in line:
+                tags = parse_tags(line[line.index("#"):])
+                if tags:
+                    out.setdefault(ln, []).extend(tags)
+    return out
+
+
+# --------------------------------------------------------------------- #
+# Source files and findings
+# --------------------------------------------------------------------- #
+
+
+class SourceFile:
+    """One parsed source file: text, AST (with parent links), tag map."""
+
+    def __init__(self, path: pathlib.Path, rel: str):
+        self.path = path
+        self.rel = rel  # posix path relative to the scan root
+        self.text = path.read_text(errors="replace")
+        self.lines = self.text.splitlines()
+        self.parse_error: Optional[SyntaxError] = None
+        self._tree: Optional[ast.AST] = None
+        self._tags: Optional[dict[int, list[Tag]]] = None
+
+    @property
+    def tree(self) -> Optional[ast.AST]:
+        if self._tree is None and self.parse_error is None:
+            try:
+                self._tree = ast.parse(self.text)
+            except SyntaxError as e:
+                self.parse_error = e
+                return None
+            for node in ast.walk(self._tree):
+                for child in ast.iter_child_nodes(node):
+                    child._dsddmm_parent = node  # type: ignore[attr-defined]
+        return self._tree
+
+    @property
+    def tags(self) -> dict[int, list[Tag]]:
+        if self._tags is None:
+            self._tags = scan_tags(self.text)
+        return self._tags
+
+    def tags_in_range(self, lo: int, hi: int) -> list[Tag]:
+        """Tags on any physical line of [lo, hi] — the statement span,
+        so a tag on the closing line of a multi-line call counts — plus
+        standalone comment lines immediately ABOVE the statement (the
+        natural place for a tag with a because-clause too long for a
+        trailing comment)."""
+        out = []
+        ln = lo - 1
+        while ln >= 1 and self.line(ln).strip().startswith("#"):
+            out.extend(self.tags.get(ln, ()))
+            ln -= 1
+        for ln in range(lo, hi + 1):
+            out.extend(self.tags.get(ln, ()))
+        return out
+
+    def parents(self, node: ast.AST) -> Iterator[ast.AST]:
+        while True:
+            node = getattr(node, "_dsddmm_parent", None)
+            if node is None:
+                return
+            yield node
+
+    def line(self, ln: int) -> str:
+        return self.lines[ln - 1] if 0 < ln <= len(self.lines) else ""
+
+
+@dataclasses.dataclass
+class Finding:
+    """One checker hit. ``state`` is ``new`` (fails the gate),
+    ``tagged`` (suppressed at the site) or ``baselined`` (suppressed by
+    the committed baseline)."""
+
+    checker: str
+    path: str  # scan-root-relative posix path
+    line: int
+    message: str
+    snippet: str = ""
+    state: str = "new"
+    tag: Optional[str] = None
+
+    def location(self) -> str:
+        return f"{self.path}:{self.line}"
+
+    def render(self) -> str:
+        loc = f"{self.location()}: [{self.checker}] {self.message}"
+        return f"{loc}\n    {self.snippet.strip()[:90]}" if self.snippet else loc
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+# --------------------------------------------------------------------- #
+# Checker registry
+# --------------------------------------------------------------------- #
+
+
+class Checker:
+    """One invariant. Subclasses set ``id``/``description``, the tags
+    that suppress them, and override :meth:`check` (per file) and/or
+    :meth:`finish` (one repo-wide pass after every file, for
+    cross-file consistency like stale-declaration detection)."""
+
+    id: str = ""
+    description: str = ""
+    #: Tag names that mark a finding of this checker deliberate.
+    suppress_tags: tuple[str, ...] = ()
+
+    def select(self, src: SourceFile) -> bool:
+        """Which files this checker reads (default: all walked)."""
+        return True
+
+    def check(self, src: SourceFile, ctx: "Analysis") -> Iterable[Finding]:
+        return ()
+
+    def finish(self, ctx: "Analysis") -> Iterable[Finding]:
+        return ()
+
+    # -- helpers shared by the concrete checkers ----------------------- #
+
+    def finding(self, src: SourceFile, node: ast.AST, message: str) -> Finding:
+        ln = getattr(node, "lineno", 1)
+        f = Finding(self.id, src.rel, ln, message, snippet=src.line(ln))
+        # The node rides along (non-dataclass attr) so the core can
+        # check suppression tags over the statement's full line span.
+        f._node = node  # type: ignore[attr-defined]
+        return f
+
+
+CHECKERS: dict[str, Checker] = {}
+
+
+def register(cls: type) -> type:
+    inst = cls()
+    if not inst.id:
+        raise ValueError(f"checker {cls.__name__} has no id")
+    if inst.id in CHECKERS:
+        raise ValueError(f"duplicate checker id {inst.id!r}")
+    CHECKERS[inst.id] = inst
+    return cls
+
+
+# --------------------------------------------------------------------- #
+# AST utilities
+# --------------------------------------------------------------------- #
+
+
+def dotted(node: ast.AST) -> Optional[str]:
+    """``a.b.c`` for Name/Attribute chains, else None."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def call_name(call: ast.Call) -> Optional[str]:
+    return dotted(call.func)
+
+
+def str_const(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def node_span(node: ast.AST) -> tuple[int, int]:
+    lo = getattr(node, "lineno", 1)
+    hi = getattr(node, "end_lineno", lo) or lo
+    return lo, hi
+
+
+# --------------------------------------------------------------------- #
+# The walker and the run loop
+# --------------------------------------------------------------------- #
+
+#: Directory names the walker never descends into. ``artifacts`` is the
+#: load-bearing one: runstore/program-store/checkpoint/flightrec output
+#: lands there (plus committed TPU-run scripts that are not part of this
+#: package's lint surface).
+EXCLUDE_DIRS = {
+    "artifacts", "__pycache__", ".git", ".venv", "node_modules",
+    "native", ".pytest_cache", "build", "dist",
+}
+
+
+def repo_root() -> pathlib.Path:
+    return pathlib.Path(__file__).resolve().parents[2]
+
+
+def iter_source_paths(root: pathlib.Path) -> Iterator[pathlib.Path]:
+    for path in sorted(root.rglob("*.py")):
+        rel_parts = path.relative_to(root).parts
+        if any(part in EXCLUDE_DIRS for part in rel_parts[:-1]):
+            continue
+        yield path
+
+
+class Analysis:
+    """One run's context: the scan root, whether it IS this checkout
+    (repo-wide consistency passes — stale counters, README agreement —
+    only make sense there, not on seeded fixture trees), and per-checker
+    scratch space for :meth:`Checker.finish`."""
+
+    def __init__(self, root: pathlib.Path):
+        self.root = root
+        self.is_repo = (root == repo_root())
+        self.files: list[SourceFile] = []
+        self.scratch: dict[str, dict] = {}
+
+    def scratch_for(self, checker_id: str) -> dict:
+        return self.scratch.setdefault(checker_id, {})
+
+
+def _apply_tags(checker: Checker, src: SourceFile, finding: Finding,
+                node: Optional[ast.AST]) -> Finding:
+    lo, hi = node_span(node) if node is not None else (finding.line,
+                                                      finding.line)
+    for tag in src.tags_in_range(lo, hi):
+        if tag.name in checker.suppress_tags:
+            finding.state = "tagged"
+            finding.tag = tag.name if tag.arg is None else (
+                f"{tag.name}: {tag.arg}"
+            )
+            break
+    return finding
+
+
+def run(root: Optional[pathlib.Path] = None,
+        checkers: Optional[Iterable[str]] = None) -> list[Finding]:
+    """Walk ``root`` (default: this checkout) and run the selected
+    checkers (default: all registered). Returns every finding —
+    including tagged ones, so ``--json`` output shows the full picture;
+    only ``state == "new"`` findings fail the gate."""
+    # Imported for side effect when core is used directly: the concrete
+    # checkers register on import.
+    from distributed_sddmm_tpu.analysis import checkers as _impl  # noqa: F401
+
+    # Resolve: is_repo must hold for ANY spelling of this checkout's
+    # path (relative, symlinked) or the repo-wide finish() passes would
+    # silently skip.
+    root = (pathlib.Path(root).resolve() if root is not None
+            else repo_root())
+    # Dedupe, order-preserving: a repeated --checker flag must not run
+    # a checker twice (double findings, ordinal-shifted fingerprints).
+    ids = (list(dict.fromkeys(checkers)) if checkers is not None
+           else list(CHECKERS))
+    unknown = [i for i in ids if i not in CHECKERS]
+    if unknown:
+        raise KeyError(
+            f"unknown checker id(s) {unknown}; known: {sorted(CHECKERS)}"
+        )
+    ctx = Analysis(root)
+    findings: list[Finding] = []
+    for path in iter_source_paths(root):
+        src = SourceFile(path, path.relative_to(root).as_posix())
+        ctx.files.append(src)
+        selected = [CHECKERS[i] for i in ids if CHECKERS[i].select(src)]
+        if not selected:
+            continue
+        if src.tree is None:  # SyntaxError: one framework finding
+            e = src.parse_error
+            findings.append(Finding(
+                "parse", src.rel, e.lineno or 1,
+                f"file does not parse: {e.msg}",
+            ))
+            continue
+        for checker in selected:
+            for f in checker.check(src, ctx):
+                node = getattr(f, "_node", None)
+                findings.append(_apply_tags(checker, src, f, node))
+    for i in ids:
+        findings.extend(CHECKERS[i].finish(ctx))
+    findings.sort(key=lambda f: (f.checker, f.path, f.line))
+    return findings

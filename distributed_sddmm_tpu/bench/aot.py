@@ -102,8 +102,10 @@ def save_executable(compiled, out_dir: str | pathlib.Path, name: str,
         # format working rather than silently storing nothing.
         from jax.experimental import serialize_executable as se
 
-        (pathlib.Path(out_dir) / f"{name}_{n}.pkl").write_bytes(
-            pickle.dumps(se.serialize(compiled)))
+        from distributed_sddmm_tpu.utils.atomic import atomic_write_bytes
+
+        atomic_write_bytes(pathlib.Path(out_dir) / f"{name}_{n}.pkl",
+                           pickle.dumps(se.serialize(compiled)))
 
 
 def compile_chain_pair(step_fn, state, trials: int, device,

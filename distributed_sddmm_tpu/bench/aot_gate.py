@@ -22,7 +22,9 @@ from __future__ import annotations
 
 import json
 import pathlib
-import time
+
+from distributed_sddmm_tpu.obs import clock
+from distributed_sddmm_tpu.utils.atomic import atomic_write_text
 
 # Strikes closer together than this are treated as one load episode —
 # a retry loop or a sibling script hitting the same machine-load spike
@@ -95,7 +97,7 @@ def timeout_strike(out_dir: str | pathlib.Path, *,
     out = pathlib.Path(out_dir)
     out.mkdir(parents=True, exist_ok=True)
     f = out / "timeouts"
-    now = time.time()
+    now = clock.epoch()
     times: list[float] = []
     try:
         for tok in f.read_text().split():
@@ -110,5 +112,5 @@ def timeout_strike(out_dir: str | pathlib.Path, *,
     if not full_budget:
         return False
     conclusive = any(now - t >= STRIKE_WINDOW_S for t in times)
-    f.write_text("\n".join(f"{t:.0f}" for t in [*times, now]))
+    atomic_write_text(f, "\n".join(f"{t:.0f}" for t in [*times, now]))
     return conclusive

@@ -45,6 +45,7 @@ def run_baseline(
         "overall_throughput": 2.0 * R * S.nnz * iters / elapsed / 1e9,
     }
     if output_file:
+        # non-atomic-ok: append-only record stream (the -o contract).
         with open(output_file, "a") as f:
             f.write(json.dumps(record) + "\n")
     return record

@@ -43,6 +43,11 @@ layer's store/regress/report half):
   JSONL stream or a live ``--admin-port`` endpoint; ``--serve``
   re-exports a telemetry stream as /metrics (``obs/telemetry.py``,
   ``obs/httpexp.py``)
+* ``lint``        — repo-wide invariant analyzer: the discipline
+  checkers over the package with the committed baseline applied
+  (``analysis/``; exit 0 clean / 2 new findings / 3 usage error)
+* ``env``         — the declared ``DSDDMM_*`` env-knob table
+  (``utils/envreg.py``; ``--markdown`` regenerates the README block)
 
 Benchmark-producing subcommands (``er``/``file``/``heatmap``) persist
 every record into the run store automatically (``--no-runstore`` opts
@@ -515,6 +520,20 @@ def build_parser() -> argparse.ArgumentParser:
         "the admin surface for runs that only wrote --telemetry",
     )
 
+    from distributed_sddmm_tpu.analysis import cli as analysis_cli
+
+    analysis_cli.build_lint_parser(sub.add_parser(
+        "lint",
+        help="repo-wide invariant analyzer: the six discipline "
+        "checkers over the package (analysis/); exit 0 clean, 2 new "
+        "findings, 3 usage error",
+    ))
+    analysis_cli.build_env_parser(sub.add_parser(
+        "env",
+        help="the DSDDMM_* env-knob registry table (utils/envreg.py); "
+        "--markdown regenerates the README block",
+    ))
+
     def _store_arg(p):
         p.add_argument(
             "--store", default=None, metavar="DIR",
@@ -680,6 +699,12 @@ _BENCH_CMDS = ("er", "file", "heatmap", "serve")
 
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
+
+    if args.cmd in ("lint", "env"):
+        from distributed_sddmm_tpu.analysis import cli as analysis_cli
+
+        return (analysis_cli.run_lint(args) if args.cmd == "lint"
+                else analysis_cli.run_env(args))
 
     if args.cmd == "report-trace":
         from distributed_sddmm_tpu.tools import tracereport
@@ -1102,6 +1127,7 @@ def _dispatch_serve(args) -> int:
         "latency_hist_ms": summary.get("latency_hist_ms"),
     }))
     if args.output_file:
+        # non-atomic-ok: append-only record stream (the -o contract).
         with open(args.output_file, "a") as f:
             f.write(json.dumps(record) + "\n")
 

@@ -369,6 +369,7 @@ def benchmark_algorithm(
         # distinguishable from an unmonitored one.
         record["anomalies"] = _watchdog.summary(since=_anomalies_before)
     if output_file:
+        # non-atomic-ok: append-only record stream (the -o contract).
         with open(output_file, "a") as f:
             f.write(json.dumps(record) + "\n")
 

@@ -175,6 +175,7 @@ def run_kernel_benchmark(
                         f"{rec['fused_pair_gflops']:>9.2f}"
                     )
                     if output_file:
+                        # non-atomic-ok: append-only record stream.
                         with open(output_file, "a") as f:
                             f.write(json.dumps(rec) + "\n")
     return records

@@ -118,6 +118,7 @@ def run_overlap_experiment(
         "overlap_speedup": results["serialized"] / max(results["interleaved"], 1e-12),
     }
     if output_file:
+        # non-atomic-ok: append-only record stream (the -o contract).
         with open(output_file, "a") as f:
             f.write(json.dumps(record) + "\n")
     return record
@@ -178,6 +179,7 @@ def hlo_overlap_report(
         **scan_overlap_hlo(hlo),
     }
     if output_file:
+        # non-atomic-ok: append-only record stream (the -o contract).
         with open(output_file, "a") as f:
             f.write(json.dumps(record) + "\n")
     return record
@@ -317,6 +319,7 @@ def fusion_overlap_hlo_report(
         **scan_overlap_hlo(hlo),
     }
     if output_file:
+        # non-atomic-ok: append-only record stream (the -o contract).
         with open(output_file, "a") as f:
             f.write(json.dumps(record) + "\n")
     return record

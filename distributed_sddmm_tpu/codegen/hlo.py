@@ -139,6 +139,7 @@ def banked_hlo_report(
         "is_scheduled": "is_scheduled=true" in hlo_banked,
     }
     if output_file:
+        # non-atomic-ok: append-only record stream (the -o contract).
         with open(output_file, "a") as f:
             f.write(json.dumps(record) + "\n")
     return record

@@ -76,6 +76,9 @@ def _git_info(cwd=None) -> dict:
                     dirty = bool(st.stdout.strip())
         except (OSError, subprocess.SubprocessError):
             pass
+        # unlocked-ok: idempotent memo — racing threads compute and
+        # store the same value; dict item assignment is atomic under
+        # the GIL and a double subprocess probe is harmless.
         _git_info_cache[key] = {"git_rev": rev, "git_dirty": dirty}
     return _git_info_cache[key]
 

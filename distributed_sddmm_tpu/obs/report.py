@@ -28,6 +28,7 @@ import pathlib
 import time
 
 from distributed_sddmm_tpu.obs import regress
+from distributed_sddmm_tpu.utils.atomic import atomic_write_text
 
 _CSS = """
 body { font-family: -apple-system, 'Segoe UI', Roboto, sans-serif;
@@ -345,6 +346,6 @@ def build_html(
         + "".join(sections)
         + "</body></html>"
     )
-    out_path.parent.mkdir(parents=True, exist_ok=True)
-    out_path.write_text(doc)
+    # Atomic: a dashboard refresh must never serve a half-written page.
+    atomic_write_text(out_path, doc)
     return out_path

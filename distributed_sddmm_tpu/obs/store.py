@@ -132,6 +132,7 @@ class RunStore:
             yield
             return
         self.root.mkdir(parents=True, exist_ok=True)
+        # non-atomic-ok: flock target — the file's CONTENT is never read.
         with open(self.root / ".lock", "w") as fh:
             fcntl.flock(fh, fcntl.LOCK_EX)
             try:

@@ -317,6 +317,8 @@ class TelemetrySampler:
     def _emit(self) -> None:
         try:
             line = json.dumps(self.snapshot(), default=str)
+            # non-atomic-ok: append-only snapshot stream (bench top
+            # tails it live; a torn tail line is skipped by the reader).
             with open(self.path, "a") as fh:
                 fh.write(line + "\n")
             self.samples += 1

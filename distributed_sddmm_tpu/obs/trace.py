@@ -225,6 +225,8 @@ class Tracer:
             # LIVE process owns was already rerouted into the shard
             # directory by _resolve_path, so two running processes never
             # share a file.
+            # non-atomic-ok: streaming JSONL — the tracer appends for
+            # the life of the run; readers tolerate a torn tail line.
             self._fh = open(path, "w", buffering=1)  # line-buffered
         # t0_epoch is the wall-clock reading of the monotonic origin —
         # the shard's clock-calibration header trace-merge aligns on.

@@ -35,6 +35,7 @@ import json
 import pathlib
 
 from distributed_sddmm_tpu.tools import tracereport
+from distributed_sddmm_tpu.utils.atomic import atomic_write_text
 
 #: Trace events exported as 1µs marker slices instead of instants so
 #: request flows have slices to bind to.
@@ -237,6 +238,7 @@ def write_chrome(trace_path, out_path=None, strict: bool = True):
         p = pathlib.Path(trace_path)
         out_path = p.with_name(p.stem + ".chrome.json")
     out_path = pathlib.Path(out_path)
-    out_path.parent.mkdir(parents=True, exist_ok=True)
-    out_path.write_text(json.dumps(chrome, default=str))
+    # Atomic: Perfetto rejects truncated JSON with an opaque error — a
+    # kill mid-export must leave the old file or none, never a prefix.
+    atomic_write_text(out_path, json.dumps(chrome, default=str))
     return out_path, chrome

@@ -34,6 +34,7 @@ import json
 import pathlib
 
 from distributed_sddmm_tpu.tools import tracereport
+from distributed_sddmm_tpu.utils.atomic import atomic_write_lines
 
 
 def _is_merged_output(path: pathlib.Path) -> bool:
@@ -185,9 +186,14 @@ def write_merged(paths, out_path=None, strict: bool = True):
             pathlib.Path(paths[0]).parent / f"{merged['begin']['run_id']}.jsonl"
         )
     out_path = pathlib.Path(out_path)
-    out_path.parent.mkdir(parents=True, exist_ok=True)
-    with open(out_path, "w") as fh:
-        fh.write(json.dumps(merged["begin"], default=str) + "\n")
-        for rec in records:
-            fh.write(json.dumps(rec, default=str) + "\n")
+    # Atomic + streaming: a merged trace is a one-shot artifact — a
+    # reader (or a re-run globbing for shards) must never see a
+    # half-written file — and multi-shard serving traces are large, so
+    # records serialize one at a time instead of joining into one
+    # in-memory payload.
+    atomic_write_lines(
+        out_path,
+        (json.dumps(rec, default=str)
+         for rec in [merged["begin"], *records]),
+    )
     return out_path, merged

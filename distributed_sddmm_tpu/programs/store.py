@@ -60,7 +60,6 @@ import os
 import pathlib
 import pickle
 import threading
-import time
 
 from distributed_sddmm_tpu.programs import keys as keys_mod
 from distributed_sddmm_tpu.utils.atomic import atomic_write_bytes, atomic_write_json
@@ -224,6 +223,7 @@ class ProgramStore:
             yield
             return
         self.root.mkdir(parents=True, exist_ok=True)
+        # non-atomic-ok: flock target — the file's CONTENT is never read.
         with open(self.root / ".lock", "w") as fh:
             fcntl.flock(fh, fcntl.LOCK_EX)
             try:
@@ -404,6 +404,7 @@ class ProgramStore:
         executable cannot serialize — the store is an accelerator, and
         the caller already holds a working compiled program.
         """
+        from distributed_sddmm_tpu.obs import clock
         from distributed_sddmm_tpu.obs import log as obs_log
 
         try:
@@ -416,7 +417,7 @@ class ProgramStore:
                 "schema": SCHEMA_VERSION,
                 "key": key,
                 "backend": backend if backend is not None else live_backend(),
-                "created_epoch": time.time(),
+                "created_epoch": clock.epoch(),
                 "meta": dict(meta or {}),
                 "cost": cost,
                 "payload": payload,

@@ -78,7 +78,12 @@ class Backoff:
 
     def __post_init__(self):
         if self.rng is None:
-            self.rng = random.Random(os.getpid() ^ int(time.time() * 1e3))
+            # Lazy import: obs.clock (THE calibrated clock pair) — a
+            # top-level import would cycle through obs/__init__ back
+            # into the resilience package.
+            from distributed_sddmm_tpu.obs import clock
+
+            self.rng = random.Random(os.getpid() ^ int(clock.epoch() * 1e3))
 
     def delay(self, attempt: int) -> float:
         d = min(self.base_s * self.factor ** attempt, self.max_delay_s)

@@ -275,8 +275,10 @@ def main(argv=None) -> int:
 
     winners = heatmap_winner(records)
     if winners:
-        with open(out / "winners.json", "w") as f:
-            json.dump(winners, f, indent=2)
+        from distributed_sddmm_tpu.utils.atomic import atomic_write_json
+
+        atomic_write_json(out / "winners.json", winners,
+                          indent=2, sort_keys=False)
         print(f"wrote {out / 'winners.json'}")
     return 0
 

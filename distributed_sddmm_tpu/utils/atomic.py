@@ -53,6 +53,31 @@ def atomic_write_json(path: str | os.PathLike, obj, **json_kw) -> None:
     atomic_write_text(path, json.dumps(obj, **json_kw))
 
 
+def atomic_write_lines(path: str | os.PathLike, lines) -> None:
+    """Streaming variant for large line-oriented artifacts (merged
+    traces): each line is written to the temp file as produced, so the
+    payload is never materialized as one string in memory, and the
+    ``os.replace`` publish keeps the all-or-nothing contract. No
+    write-fault hook — the garble/truncate hook operates on whole
+    payloads; fault tests target the non-streaming writers."""
+    path = pathlib.Path(path)
+    path.parent.mkdir(parents=True, exist_ok=True)
+    fd, tmp = tempfile.mkstemp(
+        dir=path.parent, prefix=f".{path.name}.", suffix=".tmp"
+    )
+    try:
+        with os.fdopen(fd, "w") as f:
+            for line in lines:
+                f.write(line + "\n")
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+
+
 def atomic_write_bytes(path: str | os.PathLike, data: bytes) -> None:
     """Bytes variant (checkpoint .npz payloads). The write-fault hook
     operates on a latin-1 round-trip so garble/truncate apply bytewise."""

@@ -1,0 +1,170 @@
+"""The DSDDMM_* env-knob registry: every knob, declared once.
+
+Twenty-six runtime knobs grew across nine PRs, each documented (or not)
+wherever it was born; ``bench env`` had no single table to print and
+the README drifted ~7 knobs behind. This module is now the source of
+truth: the ``env-knob`` checker (``analysis/checkers.py``) fails on any
+``os.environ`` access of a ``DSDDMM_*`` name that is not declared here,
+on any declared name with no access site left (stale registration), and
+on a README table that does not match :func:`render_markdown` — so
+registry, code and docs cannot drift apart again.
+
+Declaration fields: name, value type (as the parser treats it), the
+effective default, one-line doc, and scope (``runtime`` for package/
+script knobs, ``test`` for knobs only the test suite reads — those stay
+out of the README's operational table but are registered so the checker
+can vouch for them).
+
+``python -m distributed_sddmm_tpu.bench env`` prints the table;
+``--markdown`` emits the README block between :data:`README_BEGIN` /
+:data:`README_END`; ``--json`` the raw records.
+
+Import discipline: stdlib only (the analyzer and offline tooling import
+this in jax-free subprocesses).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import pathlib
+from typing import Optional
+
+#: Markers delimiting the generated README block (env-knob checker
+#: verifies the block equals ``render_markdown()``).
+README_BEGIN = "<!-- envreg:begin -->"
+README_END = "<!-- envreg:end -->"
+
+
+@dataclasses.dataclass(frozen=True)
+class Knob:
+    name: str
+    type: str      # how the reader parses it: int/float/flag/spec/path/str
+    default: str   # the effective default, human-readable
+    doc: str       # one line
+    scope: str = "runtime"  # or "test"
+
+
+_K = Knob
+#: Every DSDDMM_* knob, alphabetical. Keep docs to one line — this IS
+#: the README table.
+KNOBS: dict[str, Knob] = {k.name: k for k in [
+    _K("DSDDMM_BATCH_STEP", "flag", "0",
+       "batch grid steps in the blocked Pallas kernels (README: step "
+       "batching)"),
+    _K("DSDDMM_BLOCK_COLS", "int", "512",
+       "blocked-kernel column tile size"),
+    _K("DSDDMM_BLOCK_ROWS", "int", "512",
+       "blocked-kernel row tile size"),
+    _K("DSDDMM_CHECKPOINT_DIR", "path", "artifacts/checkpoints",
+       "checkpoint store root (resilience/checkpoint.py)"),
+    _K("DSDDMM_CHUNK", "int", "128",
+       "one-hot chunk width of the blocked kernels"),
+    _K("DSDDMM_CHUNK_GROUP", "int", "4",
+       "chunks fused per grid step in the blocked kernels"),
+    _K("DSDDMM_DONATE", "flag", "1",
+       "donate CG/GAT loop buffers to their compiled programs (0 "
+       "stands donation down)"),
+    _K("DSDDMM_EXEC_RETRIES", "int", "1",
+       "dispatch retries at the parallel/base.py resilience choke "
+       "point"),
+    _K("DSDDMM_EXEC_TIMEOUT", "float", "0 (off)",
+       "per-dispatch timeout in seconds (0 disables)"),
+    _K("DSDDMM_FAULTS", "spec", "off",
+       "fault-injection plan: JSON spec list, @plan.json, or comma "
+       "shorthand (nan,delay,...)"),
+    _K("DSDDMM_FLIGHTREC", "spec", "off",
+       "anomaly-triggered flight recorder: 1 or a dump directory"),
+    _K("DSDDMM_GUARD_MODE", "str", "raise",
+       "NaN/Inf guard behavior: raise or repair"),
+    _K("DSDDMM_GUARDS", "flag", "auto",
+       "force output guards on/off (default: on while a fault plan is "
+       "active)"),
+    _K("DSDDMM_LOG", "str", "info",
+       "structured stderr log level: debug|info|warn|error"),
+    _K("DSDDMM_PLAN_CACHE", "spec", "artifacts/plan_cache",
+       "autotune plan cache: relocate (path) or veto (0)"),
+    _K("DSDDMM_PROFILE", "path", "off",
+       "jax.profiler capture logdir (per-anomaly windows when the "
+       "flight recorder is armed)"),
+    _K("DSDDMM_PROGRAMS", "spec", "artifacts/programs",
+       "AOT program store: relocate (path) or veto (0; tests veto via "
+       "conftest)"),
+    _K("DSDDMM_RUNSTORE", "spec", "artifacts/runstore",
+       "persistent run store: relocate (path) or veto (0/off)"),
+    _K("DSDDMM_SCATTER_FORM", "str", "bt",
+       "scatter formulation of the blocked kernels"),
+    _K("DSDDMM_SERVE_RETRIES", "int", "1",
+       "serving batch-dispatch retries before degrading to the host "
+       "fallback"),
+    _K("DSDDMM_SERVE_TIMEOUT", "float", "0 (off)",
+       "serving per-batch dispatch timeout in seconds"),
+    _K("DSDDMM_SLO", "spec", "none",
+       "serving SLO spec (p50_ms=...,p99_ms=...,shed_rate=...; "
+       "serve/slo.py validates keys)"),
+    _K("DSDDMM_TELEMETRY", "spec", "off",
+       "serving telemetry sampler: 1 or the JSONL output path"),
+    _K("DSDDMM_TRACE", "spec", "off",
+       "span tracing: 1 (default artifacts/traces), a file, or a "
+       "directory; exported as PATH.shards to children"),
+    _K("DSDDMM_WATCHDOG", "str", "off",
+       "in-run anomaly monitor: warn or strict"),
+    _K("DSDDMM_XLA_GATHER_BUDGET", "int", "536870912",
+       "HBM gather budget that routes oversize problems onto the "
+       "chunked XLA kernel"),
+    # -- test-suite knobs (registered so the checker can vouch; not in
+    #    the README operational table) --------------------------------
+    _K("DSDDMM_MP_INIT_TIMEOUT", "int", "300",
+       "jax.distributed init timeout for the two-process test worker",
+       scope="test"),
+    _K("DSDDMM_TPU_BANK_WINDOW", "flag", "0",
+       "declare a live TPU window: banked-record staleness becomes a "
+       "hard failure (test_banked_record.py)", scope="test"),
+]}
+
+
+def get(name: str) -> Knob:
+    return KNOBS[name]
+
+
+def declaration_line(name: str) -> Optional[int]:
+    """Source line of a knob's declaration (finding anchor for the
+    stale-registration check)."""
+    src = pathlib.Path(__file__)
+    for ln, line in enumerate(src.read_text().splitlines(), 1):
+        if f'"{name}"' in line:
+            return ln
+    return None
+
+
+def render_table(scope: Optional[str] = None) -> str:
+    """Aligned text table (the ``bench env`` default view)."""
+    rows = [k for k in KNOBS.values() if scope is None or k.scope == scope]
+    w_name = max(len(k.name) for k in rows)
+    w_type = max(len(k.type) for k in rows)
+    w_dflt = max(len(k.default) for k in rows)
+    out = [f"{'knob':<{w_name}}  {'type':<{w_type}}  "
+           f"{'default':<{w_dflt}}  doc"]
+    for k in rows:
+        out.append(f"{k.name:<{w_name}}  {k.type:<{w_type}}  "
+                   f"{k.default:<{w_dflt}}  {k.doc}")
+    return "\n".join(out)
+
+
+def render_markdown(scope: Optional[str] = "runtime") -> str:
+    """Markdown table. Default ``runtime`` scope IS the README block:
+    regenerate with ``bench env --markdown`` whenever a knob is added —
+    the env-knob checker fails until README and registry agree
+    byte-for-byte. ``scope="test"`` renders the test-suite knobs,
+    ``None`` everything."""
+    out = ["| knob | type | default | what it does |",
+           "| --- | --- | --- | --- |"]
+    for k in KNOBS.values():
+        if scope is not None and k.scope != scope:
+            continue
+        out.append(f"| `{k.name}` | {k.type} | `{k.default}` | {k.doc} |")
+    return "\n".join(out)
+
+
+def to_records(scope: Optional[str] = None) -> list[dict]:
+    return [dataclasses.asdict(k) for k in KNOBS.values()
+            if scope is None or k.scope == scope]

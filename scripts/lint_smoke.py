@@ -1,0 +1,185 @@
+"""Lint smoke: the analyzer's exit contract, end to end, per checker.
+
+Three legs (fast, jax-free; a tier-1 test runs this as a subprocess):
+
+1. **clean tree** — ``bench lint`` over this checkout with the
+   committed baseline exits 0: every discipline holds or is tagged/
+   baselined. This is the CI gate the committed tree must keep.
+2. **seeded violations** — a throwaway tree seeded with ONE violation
+   per checker (mirroring the package layout so path-scoped checkers
+   fire) makes the analyzer exit 2, and each checker id appears among
+   the findings: the visitors cannot silently rot. A tagged variant of
+   each seed is also planted and must be suppressed — the one shared
+   tag scanner works for every checker's vocabulary.
+3. **usage errors** — an unknown ``--checker`` id and an unreadable
+   ``--baseline`` both exit 3, distinct from a lint verdict.
+
+Usage::
+
+    python scripts/lint_smoke.py [-o out.json]
+
+Prints one JSON summary; exits nonzero if any check fails.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import pathlib
+import sys
+import tempfile
+
+REPO = pathlib.Path(__file__).resolve().parents[1]
+sys.path.insert(0, str(REPO))
+
+PKG = "distributed_sddmm_tpu"
+
+#: One violating snippet per checker, placed so its path scope matches,
+#: with a sibling tagged line that must be suppressed by the shared
+#: scanner. Format: (relative path, source).
+SEEDS = {
+    "bare-print": (f"{PKG}/models/seeded.py", (
+        "def f():\n"
+        "    print('leak')\n"
+        "    print('deliberate')  # cli-output\n"
+    )),
+    "monotonic-clock": (f"{PKG}/serve/seeded.py", (
+        "import time\n"
+        "def f():\n"
+        "    t = time.perf_counter()\n"
+        "    u = time.time()  # wall-clock-ok\n"
+        "    return t, u\n"
+    )),
+    "export-completeness": (f"{PKG}/obs/seeded.py", (
+        "from distributed_sddmm_tpu.obs.metrics import GLOBAL\n"
+        "def f():\n"
+        "    GLOBAL.add('totally_bogus_counter')\n"
+        "    GLOBAL.add('also_bogus')  # not-exported\n"
+    )),
+    "atomic-write": (f"{PKG}/obs/seeded2.py", (
+        "def f(path, doc):\n"
+        "    with open(path, 'w') as fh:\n"
+        "        fh.write(doc)\n"
+        "    # non-atomic-ok: seeded stream\n"
+        "    with open(path, 'a') as fh:\n"
+        "        fh.write(doc)\n"
+    )),
+    "env-knob": (f"{PKG}/utils/seeded.py", (
+        "import os\n"
+        "def f():\n"
+        "    a = os.environ.get('DSDDMM_SEEDED_BOGUS_KNOB')\n"
+        "    b = os.environ.get('DSDDMM_OTHER_BOGUS')  # env-ok\n"
+        "    return a, b\n"
+    )),
+    "lock-discipline": (f"{PKG}/serve/seeded2.py", (
+        "import threading\n"
+        "_lock = threading.Lock()\n"
+        "_registry = {}\n"
+        "def unguarded(k, v):\n"
+        "    _registry[k] = v\n"
+        "def guarded(k, v):\n"
+        "    with _lock:\n"
+        "        _registry[k] = v\n"
+        "def annotated(k, v):\n"
+        "    _registry[k] = v  # unlocked-ok\n"
+    )),
+    "key-grammar": (f"{PKG}/serve/seeded3.py", (
+        "def f(fp, op, sig, backend, code):\n"
+        "    bad = f'plan:{fp}:{op}:{sig}:{backend}:{code}'\n"
+        "    ok = f'serve:{op}:b1:i2:r{sig}:{backend}'  # key-grammar-ok\n"
+        "    return bad, ok\n"
+    )),
+    "trace-purity": (f"{PKG}/ops/seeded.py", (
+        "import time\n"
+        "import jax\n"
+        "@jax.jit\n"
+        "def traced(x):\n"
+        "    t = time.time()\n"
+        "    u = time.perf_counter()  # trace-impure-ok\n"
+        "    return x + t + u\n"
+    )),
+}
+
+
+def run_lint(argv, cwd=None):
+    """The analyzer CLI in-process (no jax import needed)."""
+    from distributed_sddmm_tpu.analysis import cli as analysis_cli
+
+    import contextlib
+    import io
+
+    out = io.StringIO()
+    with contextlib.redirect_stdout(out):
+        code = analysis_cli.main(["lint", *argv])
+    return code, out.getvalue()
+
+
+def check_clean_tree() -> dict:
+    code, out = run_lint(["--json"])
+    doc = json.loads(out)
+    return {
+        "ok": code == 0 and doc["new"] == 0,
+        "exit": code,
+        "new": doc["new"],
+        "tagged": doc["tagged"],
+    }
+
+
+def check_seeded(tmp: pathlib.Path) -> dict:
+    root = tmp / "seeded_tree"
+    for rel, src in SEEDS.values():
+        p = root / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(src)
+    code, out = run_lint(["--root", str(root), "--json"])
+    doc = json.loads(out)
+    fired = {f["checker"] for f in doc["findings"] if f["state"] == "new"}
+    suppressed = {f["checker"] for f in doc["findings"]
+                  if f["state"] == "tagged"}
+    missing = sorted(set(SEEDS) - fired)
+    unsuppressed = sorted(set(SEEDS) - suppressed)
+    return {
+        "ok": code == 2 and not missing and not unsuppressed,
+        "exit": code,
+        "fired": sorted(fired),
+        "missing_checkers": missing,
+        "tag_scanner_missed": unsuppressed,
+    }
+
+
+def check_usage_errors(tmp: pathlib.Path) -> dict:
+    bad_checker, _ = run_lint(["--checker", "no-such-checker"])
+    garbled = tmp / "garbled_baseline.json"
+    garbled.write_text("{not json")
+    bad_baseline, _ = run_lint(["--baseline", str(garbled)])
+    return {
+        "ok": bad_checker == 3 and bad_baseline == 3,
+        "unknown_checker_exit": bad_checker,
+        "unreadable_baseline_exit": bad_baseline,
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("-o", "--output-file", default=None)
+    args = ap.parse_args(argv)
+
+    with tempfile.TemporaryDirectory(prefix="lint_smoke_") as tmp:
+        tmp = pathlib.Path(tmp)
+        summary = {
+            "clean_tree": check_clean_tree(),
+            "seeded_violations": check_seeded(tmp),
+            "usage_errors": check_usage_errors(tmp),
+        }
+    summary["ok"] = all(leg["ok"] for leg in summary.values())
+    text = json.dumps(summary, indent=1)
+    print(text)
+    if args.output_file:
+        from distributed_sddmm_tpu.utils.atomic import atomic_write_text
+
+        atomic_write_text(args.output_file, text)
+    return 0 if summary["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,42 @@
+"""Tier-1 lint smoke: scripts/lint_smoke.py in a subprocess.
+
+Pins the analyzer's CI contract: the committed tree lints clean (exit
+0) against the committed baseline, a tree seeded with one violation per
+checker exits 2 with every checker id firing AND every tagged sibling
+suppressed (the one shared tag scanner), and usage errors (unknown
+checker id, unreadable baseline) exit 3 — distinct from a lint verdict.
+"""
+
+import json
+import pathlib
+import subprocess
+import sys
+
+REPO = pathlib.Path(__file__).resolve().parents[1]
+
+
+def test_lint_smoke(tmp_path):
+    out = tmp_path / "report.json"
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "scripts" / "lint_smoke.py"),
+         "-o", str(out)],
+        capture_output=True, text=True, timeout=300,
+        env={"PATH": "/usr/bin:/bin:/usr/local/bin", "HOME": "/tmp"},
+    )
+    assert proc.returncode == 0, proc.stdout[-2000:] + proc.stderr[-2000:]
+    rep = json.loads(out.read_text())
+
+    # The committed tree must hold every discipline (or carry tags /
+    # baseline entries): this is the gate CI runs.
+    assert rep["clean_tree"]["ok"], rep["clean_tree"]
+    assert rep["clean_tree"]["exit"] == 0
+
+    # Every checker fires on its seeded violation — a visitor cannot
+    # silently rot — and every tagged sibling is suppressed.
+    assert rep["seeded_violations"]["exit"] == 2
+    assert rep["seeded_violations"]["missing_checkers"] == []
+    assert rep["seeded_violations"]["tag_scanner_missed"] == []
+
+    # Exit 3 is reserved for usage/config errors.
+    assert rep["usage_errors"]["unknown_checker_exit"] == 3
+    assert rep["usage_errors"]["unreadable_baseline_exit"] == 3

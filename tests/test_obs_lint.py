@@ -1,154 +1,70 @@
-"""Lints: no bare ``print(``; clock discipline; counter export coverage.
+"""Thin wrappers: the three original lints, now framework checkers.
 
-Diagnostics go through ``obs.log`` (structured, level-gated, mirrored
-into traces); only allowlisted CLI modules — whose *product* is stdout
-text — and lines explicitly tagged ``# cli-output`` may print. This is
-what keeps the structured-logging satellite from regressing one stray
-debug print at a time.
+These tests used to BE the lints — three ad-hoc regex scanners (bare
+print, clock discipline, counter-export completeness) with two
+divergent tag-comment parsers between them. The lints now live as
+checkers in ``distributed_sddmm_tpu/analysis/checkers.py`` on the
+shared AST walker + single tag scanner (see MIGRATING: "Static
+analysis"), surfaced as ``bench lint``; what remains here keeps each
+discipline pinned under tier-1 by name, so a regression in any one
+reads as exactly the failure it always did.
 
-The second lint is the same mechanism pointed at clocks: raw
-``time.time()`` / ``time.perf_counter()`` calls are forbidden in
-``serve/`` and ``obs/`` — every span path reads ``obs.clock`` (one
-calibrated monotonic/wall pair per process) so trace timestamps stay
-mergeable across processes and a wall-clock step can never produce a
-negative duration. ``obs/clock.py`` itself is the allowlist, and a line
-tagged ``# wall-clock-ok`` opts out deliberately.
-
-The third lint points it at the scrape surface: every GLOBAL counter
-the package increments must be declared in
-``obs.httpexp.KNOWN_GLOBAL_COUNTERS`` (and therefore rendered — at 0
-if never bumped — in the ``/metrics`` Prometheus exposition) or carry
-an explicit ``# not-exported`` tag at the ``GLOBAL.add`` site. A new
-counter can land in records and smoke reports but silently vanish from
-the live scrape; this is the tripwire.
+Per-checker behavioral fixtures (clean/violating/tagged/baselined)
+live in ``tests/test_analysis.py``.
 """
 
+import functools
 import pathlib
-import re
+import sys
 
-PKG = pathlib.Path(__file__).resolve().parents[1] / "distributed_sddmm_tpu"
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parents[1]))
 
-#: Modules whose stdout IS the product (argparse CLIs, table printers).
-ALLOWLIST = {
-    "bench/cli.py",        # bench subcommands print JSON records
-    "bench/kernels.py",    # kernel-sweep table printer
-    "tools/costmodel.py",  # cost-model CLI
-    "tools/charts.py",     # chart CLI
-    "tools/tracereport.py",  # trace-report CLI
-}
+from distributed_sddmm_tpu import analysis
 
-#: A real print call: not someone_print(, not .print(, not "print(" in a
-#: string... (line-based, so a docstring mention with leading prose is
-#: fine; code examples in docstrings should use ``print`` without parens
-#: or sit in allowlisted modules).
-_PRINT_RE = re.compile(r"(?<![\w.\"'`])print\(")
+MIGRATED = ("bare-print", "monotonic-clock", "export-completeness")
 
 
-def _code_lines(path):
-    """(lineno, line) pairs with docstrings and comment lines skipped —
-    the shared scanner both lints use."""
-    in_doc = False
-    for ln, line in enumerate(path.read_text().splitlines(), 1):
-        stripped = line.strip()
-        # Cheap docstring tracking: toggle on triple quotes so prose
-        # mentioning a forbidden call does not count.
-        if stripped.count('"""') % 2 == 1:
-            in_doc = not in_doc
-            continue
-        if in_doc or stripped.startswith("#"):
-            continue
-        yield ln, line
+@functools.lru_cache(maxsize=1)
+def _findings():
+    """One shared walk for all three wrappers (tier-1 time budget)."""
+    return analysis.run_repo(checkers=list(MIGRATED))
+
+
+def _assert_clean(checker: str, hint: str):
+    new = [f.render() for f in _findings()
+           if f.checker == checker and f.state == "new"]
+    assert not new, f"{hint}:\n" + "\n".join(new)
 
 
 def test_no_bare_print_outside_cli_modules():
-    violations = []
-    for path in sorted(PKG.rglob("*.py")):
-        rel = path.relative_to(PKG).as_posix()
-        if rel in ALLOWLIST:
-            continue
-        for ln, line in _code_lines(path):
-            if "# cli-output" in line:
-                continue
-            if _PRINT_RE.search(line):
-                violations.append(f"{rel}:{ln}: {line.strip()[:70]}")
-    assert not violations, (
+    _assert_clean(
+        "bare-print",
         "bare print( in library code — use distributed_sddmm_tpu.obs.log "
-        "(or tag deliberate CLI output with '# cli-output'):\n"
-        + "\n".join(violations)
+        "(or tag deliberate CLI output with '# cli-output')",
     )
-
-
-#: Modules allowed to touch the raw clocks: the clock module IS the
-#: abstraction (everything else in serve/ and obs/ reads it).
-CLOCK_ALLOWLIST = {"obs/clock.py"}
-
-#: A raw wall/monotonic clock read (time.monotonic included — a third
-#: clock sneaking in would defeat the one-calibration-pair discipline).
-_CLOCK_RE = re.compile(r"\btime\.(time|perf_counter|monotonic)\(")
 
 
 def test_monotonic_clock_discipline_in_span_paths():
-    """serve/ and obs/ span paths read ``obs.clock``, not ``time.*``:
+    """serve/ and obs/ span paths read ``obs.clock``, not ``time.*`` —
     one calibrated clock pair per process is what makes multi-process
-    trace shards offset-alignable and keeps wall-clock steps out of
-    durations. ``# wall-clock-ok`` tags the deliberate exceptions."""
-    violations = []
-    for sub in ("serve", "obs"):
-        for path in sorted((PKG / sub).rglob("*.py")):
-            rel = path.relative_to(PKG).as_posix()
-            if rel in CLOCK_ALLOWLIST:
-                continue
-            for ln, line in _code_lines(path):
-                if "# wall-clock-ok" in line:
-                    continue
-                if _CLOCK_RE.search(line):
-                    violations.append(f"{rel}:{ln}: {line.strip()[:70]}")
-    assert not violations, (
-        "raw clock call in a serve/obs span path — read "
-        "distributed_sddmm_tpu.obs.clock (now()/epoch()) so timestamps "
-        "stay calibrated and mergeable, or tag a deliberate exception "
-        "with '# wall-clock-ok':\n" + "\n".join(violations)
+    trace shards offset-alignable — and package-wide epoch stamps come
+    from ``clock.epoch()``. ``# wall-clock-ok`` tags deliberate
+    exceptions."""
+    _assert_clean(
+        "monotonic-clock",
+        "raw clock call — read distributed_sddmm_tpu.obs.clock "
+        "(now()/epoch()) or tag a deliberate exception '# wall-clock-ok'",
     )
-
-
-#: A GLOBAL counter bump with a literal name: ``GLOBAL.add("x")`` or the
-#: program store's ``_global_counters().add("x")`` indirection.
-_COUNTER_ADD_RE = re.compile(
-    r"(?:\bGLOBAL|_global_counters\(\))\.add\(\s*[\"']([a-z0-9_]+)[\"']"
-)
 
 
 def test_global_counters_exported_to_metrics():
-    """Every ``GLOBAL.add("<name>")`` site in the package names a
-    counter declared in ``httpexp.KNOWN_GLOBAL_COUNTERS`` (so the
-    ``/metrics`` exposition renders it, 0-valued from the first scrape)
-    or carries a ``# not-exported`` tag — new counters cannot silently
-    vanish from the operational surface."""
-    from distributed_sddmm_tpu.obs import httpexp
-
-    known = set(httpexp.KNOWN_GLOBAL_COUNTERS)
-    violations, seen = [], set()
-    for path in sorted(PKG.rglob("*.py")):
-        rel = path.relative_to(PKG).as_posix()
-        for ln, line in _code_lines(path):
-            m = _COUNTER_ADD_RE.search(line)
-            if not m:
-                continue
-            seen.add(m.group(1))
-            if "# not-exported" in line:
-                continue
-            if m.group(1) not in known:
-                violations.append(f"{rel}:{ln}: {line.strip()[:70]}")
-    assert seen, "lint regex matched no GLOBAL.add sites — regex rotted"
-    assert not violations, (
-        "GLOBAL counter missing from the /metrics exposition — add it "
-        "to obs.httpexp.KNOWN_GLOBAL_COUNTERS (with help text) or tag "
-        "the site '# not-exported':\n" + "\n".join(violations)
-    )
-    # The reverse direction: a declared-but-never-bumped counter is a
-    # stale declaration (renamed counter keeps scraping as a frozen 0).
-    stale = known - seen
-    assert not stale, (
-        f"KNOWN_GLOBAL_COUNTERS entries no GLOBAL.add site bumps: "
-        f"{sorted(stale)}"
+    """Every ``GLOBAL.add("<name>")`` site names a counter declared in
+    ``httpexp.KNOWN_GLOBAL_COUNTERS`` (scraped 0-valued from the first
+    request) or carries ``# not-exported``; stale declarations also
+    fail."""
+    _assert_clean(
+        "export-completeness",
+        "GLOBAL counter missing from (or stale in) the /metrics "
+        "exposition — sync obs.httpexp.KNOWN_GLOBAL_COUNTERS or tag "
+        "the site '# not-exported'",
     )
