@@ -275,3 +275,59 @@ def rank_candidates(
     scored = [(cand, model_cost(problem, cand, p, machine)) for cand in cands]
     scored.sort(key=lambda cs: cs[1])
     return scored
+
+
+def rank_candidates_realized(
+    problem: Problem,
+    cands: list[Candidate],
+    p: int,
+    machine: costmodel.Machine | None = None,
+    realized: dict | None = None,
+) -> list[tuple[Candidate, float]]:
+    """Rank with the incumbent's REALIZED serving data folded in — the
+    closed-loop tuner's ordering (``tuner/retune.py``).
+
+    ``realized`` describes what actually ran: ``{"variant": <id or
+    None>, "padded_lane_frac": <counted gauge>}``. When the realized
+    encoding was GENERIC and its counted pad gauge is known, the
+    ranking stops trusting the cost model's pad *estimate* where
+    ground truth exists: every Pallas candidate is re-charged an
+    absolute ``(1 + waste)`` pad overhead — the **realized** gauge for
+    generic-encoding candidates, the model's estimate for banked ones
+    (their realized number is unknown until measured). Banked variants
+    then outrank generic exactly when their estimated waste undercuts
+    the waste the replica is demonstrably paying — which is the trigger
+    condition that started the re-tune. Orders what to MEASURE first,
+    like :func:`rank_candidates`; trials remain the arbiter.
+    """
+    scored = rank_candidates(problem, cands, p, machine)
+    frac = (realized or {}).get("padded_lane_frac")
+    if frac is None or (realized or {}).get("variant") is not None:
+        # No gauge, or a banked incumbent: the realized data describes
+        # an encoding the estimates cannot be re-anchored against.
+        return scored
+    from distributed_sddmm_tpu.codegen import variants as cg_variants
+
+    out = []
+    for cand, t in scored:
+        if cand.kernel == "pallas":
+            base = t / variant_cost_factor_of(problem, cand)
+            if cand.variant:
+                waste = cg_variants.estimated_pad_frac(problem, banked=True)
+            else:
+                waste = float(frac)
+            t = base * (1.0 + waste)
+        out.append((cand, t))
+    out.sort(key=lambda cs: cs[1])
+    return out
+
+
+def variant_cost_factor_of(problem: Problem, cand: Candidate) -> float:
+    """The pad-estimate factor :func:`model_cost` already charged a
+    candidate (1.0 for non-variant candidates) — what realized
+    re-ranking divides back out before re-charging."""
+    if not cand.variant:
+        return 1.0
+    from distributed_sddmm_tpu.codegen import variant_cost_factor
+
+    return variant_cost_factor(problem, cand.variant)

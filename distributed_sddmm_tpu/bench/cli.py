@@ -435,7 +435,51 @@ def build_parser() -> argparse.ArgumentParser:
         help="arm the anomaly flight recorder (see the offline "
         "subcommands' --flightrec; equivalent to DSDDMM_FLIGHTREC)",
     )
+    sv.add_argument(
+        "--tuner", action="store_true",
+        help="run the background closed-loop tuner against the live "
+        "engine (tuner/): mine trigger gauges, re-measure candidates "
+        "off the request path, shadow-validate and hot-swap a winning "
+        "kernel variant mid-load; the record gains tuner/"
+        "time_to_adapt_s fields and `bench gate` regresses the new "
+        "tuner:time_to_adapt axis (equivalent to DSDDMM_TUNER; "
+        "DSDDMM_TUNER_* knobs pace it)",
+    )
     sv.add_argument("--no-runstore", action="store_true")
+
+    tn = sub.add_parser(
+        "tune",
+        help="offline closed-loop re-tune of one problem: mine the "
+        "runstore's realized history for the fingerprint, re-rank and "
+        "re-measure candidates (full plan space — algorithm and c "
+        "included, unlike the live tuner's hot-swappable subset), and "
+        "store the winner into the plan cache for the next warmup "
+        "(tuner/retune.py)",
+    )
+    tn.add_argument("log_m", type=int, help="log2 of matrix side")
+    tn.add_argument("edge_factor", type=int, help="average nnz per row")
+    tn.add_argument("R", type=int)
+    tn.add_argument(
+        "--trial", default="auto", choices=["auto", "counted", "wall"],
+        help="trial mode: wall-clock harness runs, deterministic "
+        "counted padded-lane trials, or auto (wall on TPU else counted)",
+    )
+    tn.add_argument("--trials", type=int, default=1)
+    tn.add_argument("--timeout", type=float, default=60.0,
+                    help="per-trial wall-clock cap in seconds")
+    tn.add_argument("--budget", type=float, default=120.0,
+                    help="whole-retune elapsed cap in seconds")
+    tn.add_argument("--top-k", type=int, default=3)
+    tn.add_argument(
+        "--dry-run", action="store_true",
+        help="report the challenger without writing the plan cache",
+    )
+    tn.add_argument("--json", action="store_true")
+    tn.add_argument(
+        "--store", default=None, metavar="DIR",
+        help="run-store root mined for realized history (default "
+        "artifacts/runstore, or DSDDMM_RUNSTORE)",
+    )
 
     vf = sub.add_parser("verify", help="fingerprint cross-check of algorithms")
     vf.add_argument("--log-m", type=int, default=8)
@@ -728,6 +772,9 @@ def main(argv=None) -> int:
     if args.cmd in ("history", "compare", "gate", "backfill", "report-html"):
         return _dispatch_store(args)
 
+    if args.cmd == "tune":
+        return _dispatch_tune(args)
+
     if getattr(args, "watchdog", None):
         from distributed_sddmm_tpu.obs import watchdog as obs_watchdog
 
@@ -953,6 +1000,100 @@ def _dispatch_top(args) -> int:
             return 0
 
 
+def _dispatch_tune(args) -> int:
+    """``bench tune``: one offline closed-loop pass for one problem.
+
+    Loads the incumbent plan (cache hit or cost-model selection — the
+    same path a warmup takes), mines the runstore's realized history
+    for this fingerprint, re-ranks + re-measures the FULL candidate
+    space, and stores a winning challenger back into the plan cache so
+    the next replica warms straight onto it. Exit 0 either way — "the
+    incumbent stands" is a successful tune."""
+    from distributed_sddmm_tpu.autotune import Problem, get_plan
+    from distributed_sddmm_tpu.autotune.cache import PlanCache
+    from distributed_sddmm_tpu.autotune.plan import Plan
+    from distributed_sddmm_tpu.tuner import TunerConfig
+    # Import the tuner submodules directly (the package deliberately
+    # does not re-export the retune() function — it would shadow the
+    # `tuner.retune` submodule attribute).
+    import distributed_sddmm_tpu.tuner.retune as tuner_retune
+    import distributed_sddmm_tpu.tuner.signals as tuner_signals
+
+    S = HostCOO.rmat(log_m=args.log_m, edge_factor=args.edge_factor, seed=0)
+    problem = Problem.from_coo(S, args.R)
+    if args.dry_run:
+        # get_plan stores its selection on a cache miss; a dry run must
+        # leave the real cache byte-untouched — serve a genuine hit,
+        # else select against a throwaway cache.
+        from distributed_sddmm_tpu.autotune.fingerprint import (
+            machine_signature, make_fingerprint,
+        )
+
+        p_, backend_, kernels_ = machine_signature()
+        hit = PlanCache().load(
+            make_fingerprint(problem, p_, backend_, kernels_).key
+        )
+        if hit is not None:
+            incumbent = Plan.from_dict(hit)
+        else:
+            import tempfile
+
+            with tempfile.TemporaryDirectory() as _td:
+                incumbent = get_plan(
+                    problem, mode="model", cache=PlanCache(_td)
+                )
+    else:
+        incumbent = get_plan(problem, mode="model")
+
+    # _run_store falls back to the default root (artifacts/runstore /
+    # DSDDMM_RUNSTORE) when --store is absent — the documented mining
+    # source; an empty or missing store simply yields no signals.
+    store = _run_store(args)
+    signals = tuner_signals.mine_runstore(
+        store, incumbent.fingerprint_key, problem, incumbent.predicted_ms,
+    )
+
+    # ONE trial-selection rule (TunerConfig.trial_fn): an explicit
+    # --trial wall forces harness trials even off-TPU.
+    trial_fn = TunerConfig(trial=args.trial).trial_fn()
+    challenger = tuner_retune.retune(
+        problem, incumbent, S,
+        top_k=args.top_k, trials=args.trials, timeout_s=args.timeout,
+        max_elapsed_s=args.budget, trial_fn=trial_fn,
+    )
+    promoted = False
+    if challenger is not None and not args.dry_run:
+        PlanCache().store(challenger.fingerprint_key, challenger.to_dict())
+        promoted = True
+    report = {
+        "fingerprint_key": incumbent.fingerprint_key,
+        "signals": [s.to_dict() for s in signals],
+        "incumbent": incumbent.to_dict(),
+        "challenger": challenger.to_dict() if challenger else None,
+        "promoted": promoted,
+        "dry_run": bool(args.dry_run),
+    }
+    if args.json:
+        print(json.dumps(report, indent=1))
+    else:
+        inc, ch = incumbent, challenger
+        print(  # cli-output
+            f"incumbent  {inc.algorithm} c={inc.c} kernel={inc.kernel}"
+            + (f" variant={inc.variant}" if inc.variant else "")
+            + f" [{inc.source}]"
+        )
+        if ch is None:
+            print("challenger none — incumbent stands")  # cli-output
+        else:
+            print(  # cli-output
+                f"challenger {ch.algorithm} c={ch.c} kernel={ch.kernel}"
+                + (f" variant={ch.variant}" if ch.variant else "")
+                + f" measured={ch.measured_gflops:.3f} GFLOP/s"
+                + (" -> plan cache" if promoted else " (dry run)")
+            )
+    return 0
+
+
 def _dispatch_serve(args) -> int:
     """``bench serve``: build a warm engine, drive it open-loop, report
     + persist the serving record. Exit 0 on a clean run, 1 on any
@@ -1039,8 +1180,20 @@ def _dispatch_serve(args) -> int:
             "engine", lambda: obs_telemetry.engine_snapshot(eng, slo=slo)
         )
 
+    # Closed-loop background tuner (--tuner / DSDDMM_TUNER): started
+    # once the ladder is warm, paced by the DSDDMM_TUNER_* knobs.
+    tuner = None
+    tuner_wanted = args.tuner or os.environ.get(
+        "DSDDMM_TUNER", ""
+    ).lower() in ("1", "on", "true", "yes")
     try:
         eng.start()  # compile-ahead warmup of the whole bucket ladder
+        if tuner_wanted:
+            from distributed_sddmm_tpu.tuner import BackgroundTuner
+
+            tuner = BackgroundTuner(eng).start()
+            print("[tuner] background tuner armed "
+                  f"(interval {tuner.config.interval_s}s)", file=sys.stderr)
         if sampler is not None:
             sampler.start()
             print(f"[telemetry] sampling to {sampler.path}",
@@ -1050,6 +1203,8 @@ def _dispatch_serve(args) -> int:
             seed=args.seed, oracle_every=args.oracle_every, slo=slo,
         )
     finally:
+        if tuner is not None:
+            tuner.stop()
         if sampler is not None:
             sampler.stop()
         eng.stop()
@@ -1081,6 +1236,12 @@ def _dispatch_serve(args) -> int:
     }
     if plan is not None:
         record["plan"] = plan.to_dict()
+    if tuner is not None:
+        # The closed-loop fields (MIGRATING): the tuner summary with
+        # its promotions list, and time_to_adapt_s lifted to the top
+        # level — the `tuner:time_to_adapt` gate axis reads it there.
+        record["tuner"] = tuner.summary()
+        record["time_to_adapt_s"] = tuner.time_to_adapt_s
     if sampler is not None:
         record["telemetry_path"] = str(sampler.path)
     if admin is not None:

@@ -210,13 +210,13 @@ def variant_cost_factor(problem, variant_id: str) -> float:
         return 1.0
     if not variant.banked:
         return 1.0
-    waste_g = _estimated_pad_frac(problem, banked=False)
-    waste_b = _estimated_pad_frac(problem, banked=True)
+    waste_g = estimated_pad_frac(problem, banked=False)
+    waste_b = estimated_pad_frac(problem, banked=True)
     factor = (1.0 + waste_b) / (1.0 + waste_g)
     return min(max(factor, 0.6), 1.1)
 
 
-def _estimated_pad_frac(problem, banked: bool) -> float:
+def estimated_pad_frac(problem, banked: bool) -> float:
     """Crude expected pad-lanes-per-real-lane for the generic vs banked
     encodings: every touched (row block, col block) pair rounds its
     chunk list up to CHUNK lanes (~CHUNK/2 expected waste); banking

@@ -80,6 +80,15 @@ KNOWN_GLOBAL_COUNTERS: dict = {
     "serve_shed": "requests shed by admission control",
     "serve_degraded_batches": "serving batches degraded to the serial rung",
     "flightrec_dumps": "flight-recorder snapshots written",
+    "tuner_scans": "closed-loop tuner signal-mining cycles",
+    "tuner_signals": "re-tune trigger signals mined (tuner/signals.py)",
+    "tuner_retunes": "off-path re-measurement cycles run by the tuner",
+    "tuner_shadow_replays":
+        "mirrored request groups replayed on a challenger ladder",
+    "tuner_shadow_mismatches":
+        "shadow replies that diverged from the incumbent (blocks promotion)",
+    "tuner_promotions": "challenger ladders hot-swapped into serving",
+    "tuner_rejects": "challengers abandoned (mismatch, stale, or no better)",
 }
 
 #: Exposition metric-name prefix.

@@ -98,6 +98,15 @@ class OpMetrics:
         with self._lock:
             self._gauges.setdefault(op, {}).update(gauges)
 
+    def gauges(self) -> dict:
+        """Snapshot of every noted per-op gauge, INCLUDING ops that have
+        never dispatched — unlike :meth:`to_dict`, which hides them.
+        The closed-loop tuner mines structural gauges here
+        (``padded_lane_frac`` exists from tile build, long before the
+        first strategy dispatch of a serving replica)."""
+        with self._lock:
+            return {op: dict(g) for op, g in self._gauges.items()}
+
     def record(
         self,
         op: str,

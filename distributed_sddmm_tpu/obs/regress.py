@@ -48,9 +48,14 @@ _SKIP_PHASES = ("bench",)
 
 def _optional_axis(name: str) -> bool:
     """Axes that only exist when optional telemetry ran (SLO burn rate
-    needs an SLO spec; XLA cost needs the program store). Their absence
-    in the judged run is "not measured", never a gate failure."""
-    return name.startswith("xla:") or name == "serve:burn_rate"
+    needs an SLO spec; XLA cost needs the program store; time-to-adapt
+    needs the background tuner to have promoted). Their absence in the
+    judged run is "not measured", never a gate failure."""
+    return (
+        name.startswith("xla:")
+        or name.startswith("tuner:")
+        or name == "serve:burn_rate"
+    )
 
 
 def phase_stats(doc: dict) -> dict[str, dict]:
@@ -121,6 +126,7 @@ def phase_stats(doc: dict) -> dict[str, dict]:
         out[name] = row
     out.update(_serving_rows(doc))
     out.update(_xla_rows(doc))
+    out.update(_tuner_rows(doc))
     return out
 
 
@@ -189,6 +195,22 @@ def _xla_rows(doc: dict) -> dict[str, dict]:
                 calls, (flops / calls) / xla
             )
     return rows
+
+
+def _tuner_rows(doc: dict) -> dict[str, dict]:
+    """The closed-loop tuner's verdict axis: ``tuner:time_to_adapt``,
+    the seconds from trigger detection to challenger promotion
+    (``bench serve --tuner`` records carry ``time_to_adapt_s``). An
+    adaptation that got slower run over run means the loop itself
+    regressed — detection lag, measurement budget, or shadow
+    throughput. OPTIONAL in compare(): records without a promotion
+    (tuner off, or nothing to adapt to) lack the axis entirely."""
+    rec = doc.get("record") or {}
+    v = rec.get("time_to_adapt_s")
+    if v is None:
+        return {}
+    promos = len(((rec.get("tuner") or {}).get("promotions")) or []) or 1
+    return {"tuner:time_to_adapt": _pseudo_row(promos, float(v))}
 
 
 def _band(t_calls: list[float], threshold: float) -> tuple[float, float, float]:
@@ -303,6 +325,10 @@ def compare(
                 # Agreement drifted: either the analytic count or the
                 # compiled program changed — the axis IS the blame.
                 row["attribution"] = "xla-cost"
+            elif name.startswith("tuner:"):
+                # The adaptation loop itself slowed down (detection →
+                # promotion wall); no comm/compute split exists.
+                row["attribution"] = "tuner"
             else:
                 base_row = dict(a)
                 base_row["t_call"] = med

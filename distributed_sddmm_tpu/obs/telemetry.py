@@ -249,6 +249,15 @@ def engine_snapshot(engine, slo=None, run_id: str | None = None) -> dict:
     }
     if slo is not None:
         snap["burn_rate"] = slo.burn_rate(summary)
+    tuner = getattr(engine, "tuner", None)
+    if tuner is not None:
+        # The closed-loop tuner's live state (state machine phase,
+        # promotions, time-to-adapt) — `bench top` and /snapshot show
+        # a replica that is mid-shadow or freshly adapted.
+        try:
+            snap["tuner"] = tuner.snapshot()
+        except Exception:  # noqa: BLE001 — telemetry never fails serving
+            pass
     return snap
 
 
@@ -394,6 +403,15 @@ def render_top(snapshots: list[dict]) -> str:
         lines.append(
             "  programs  "
             + "   ".join(f"{k}={v}" for k, v in sorted(ps.items()))
+        )
+    tun = cur.get("tuner")
+    if tun:
+        adapt = tun.get("time_to_adapt_s")
+        lines.append(
+            f"  tuner     {tun.get('state', '?'):<8} "
+            f"promotions={tun.get('promotions', 0)} "
+            f"rejects={tun.get('rejects', 0)}"
+            + (f"  adapted in {adapt:.2f}s" if adapt is not None else "")
         )
     occ = cur.get("batch_occupancy")
     if occ is not None:

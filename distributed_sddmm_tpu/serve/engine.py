@@ -131,13 +131,35 @@ class ServingEngine:
         self.ever_started = False
         self._thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
+        #: Mirror hook (``tuner/shadow.py``): when attached, the runner
+        #: hands every answered (non-degraded) group to it AFTER the
+        #: replies are out the door — one bounded append on the request
+        #: path, never a dispatch.
+        self._mirror = None
+        #: Challenger hot-swaps applied to this ladder (tuner
+        #: promotions; ``stats()`` surfaces it).
+        self.ladder_swaps = 0
+        #: Backref set by an attached ``BackgroundTuner`` (telemetry
+        #: snapshots read tuner state through it; None = no tuner).
+        self.tuner = None
 
     # ------------------------------------------------------------------ #
     # Warm program cache (autotune-fingerprint-style keys)
     # ------------------------------------------------------------------ #
 
+    #: Sentinel: ``program_key``'s default is "the workload's current
+    #: variant"; an explicit ``variant=None`` means the generic key.
+    _WORKLOAD_VARIANT = object()
+
     def program_key(self, batch_bucket: int, inner_bucket: int,
-                    sig: str | None = None) -> str:
+                    sig: str | None = None,
+                    variant=_WORKLOAD_VARIANT) -> str:
+        """The ladder cell's program-store key. ``variant`` overrides
+        the workload's realized kernel-variant segment — the tuner
+        builds CHALLENGER keys this way, and the ``v<variant>`` segment
+        (plus ``serve_code_hash``) is what guarantees a challenger
+        entry can never alias the incumbent's, nor a stale generation's
+        entry ever resolve (``programs/keys.py``)."""
         from distributed_sddmm_tpu.programs import keys as program_keys
 
         backend = "unknown"
@@ -147,11 +169,13 @@ class ServingEngine:
             backend = jax.default_backend()
         except Exception:  # noqa: BLE001 — key quality, not correctness
             pass
+        if variant is ServingEngine._WORKLOAD_VARIANT:
+            variant = getattr(self.workload, "kernel_variant", None)
         r = getattr(self.workload, "R", getattr(self.workload, "_F", 0))
         return program_keys.serve_program_key(
             self.workload.name, batch_bucket, inner_bucket, r, backend,
             params=self.workload.program_params(), sig=sig,
-            variant=getattr(self.workload, "kernel_variant", None),
+            variant=variant,
         )
 
     def _note_resolve(self, source: str) -> None:
@@ -408,6 +432,20 @@ class ServingEngine:
                            for k, v in req.stage_latencies_s().items()},
                     )
             self.served += len(group)
+            mirror = self._mirror
+            if (
+                mirror is not None and not degraded
+                and all(r is not None for r in replies)
+            ):
+                # AFTER the replies are out: mirroring must never delay
+                # a reply, and a degraded group's serial-rung replies
+                # are not the compiled programs' bits — shadow-compare
+                # would flag the degrade, not the challenger.
+                try:
+                    mirror(group, replies, bb, ib)
+                except Exception as e:  # noqa: BLE001 — best-effort tap
+                    obs_log.warn("serve", "mirror hook failed",
+                                 error=f"{type(e).__name__}: {e}")
             if wd is not None:
                 try:
                     wd.observe(
@@ -514,6 +552,75 @@ class ServingEngine:
         return replies
 
     # ------------------------------------------------------------------ #
+    # Closed-loop tuning hooks (tuner/)
+    # ------------------------------------------------------------------ #
+
+    def attach_mirror(self, mirror) -> None:
+        """Arm the request mirror: ``mirror(payloads, replies,
+        batch_bucket, inner_bucket)`` is called by the runner for every
+        answered, non-degraded group (the shadow session's ``offer``).
+        One hook at a time — attaching over a live one replaces it."""
+        self._mirror = mirror
+
+    def detach_mirror(self) -> None:
+        self._mirror = None
+
+    def swap_ladder(self, cell_programs: dict, variant, key_fn=None) -> None:
+        """Hot-swap the warm bucket ladder onto pre-warmed challenger
+        programs — the tuner's promotion move.
+
+        Atomic under the cache lock: an in-flight dispatch finishes on
+        the incumbent program it already resolved; the next ``_program``
+        lookup serves the challenger. No request is dropped and no
+        request-path compile happens — ``cell_programs`` MUST cover
+        every ladder cell and already be warmed (the shadow session
+        compiles and executes each cell off-path before promotion; a
+        partial ladder is refused here for exactly that reason). The
+        workload's ``kernel_variant`` is restamped so later cache
+        misses (there should be none) and the serve record key on the
+        challenger's variant.
+        """
+        cells = {
+            (bb, ib)
+            for bb in self.batch_buckets
+            for ib in self.workload.inner_buckets
+        }
+        missing = cells - set(cell_programs)
+        if missing:
+            raise ValueError(
+                f"challenger ladder is missing cells {sorted(missing)}; "
+                "promoting it would compile on the request path"
+            )
+        if variant is not None:
+            # A variant id this code generation cannot reconstruct is
+            # stale — it must be unpromotable no matter how it got here
+            # (the shadow session already refuses it at construction).
+            from distributed_sddmm_tpu import codegen
+
+            codegen.variant_from_id(variant)
+        if key_fn is None:
+            key_fn = lambda bb, ib: self.program_key(  # noqa: E731
+                bb, ib, variant=variant
+            )
+        keyed = {key_fn(bb, ib): prog
+                 for (bb, ib), prog in cell_programs.items()}
+        with self._cache_lock:
+            self._cell_programs = {
+                cell: cell_programs[cell] for cell in cells
+            }
+            self._programs = keyed
+            self.workload.kernel_variant = variant
+            self.ladder_swaps += 1
+        obs_trace.event(
+            "serve_ladder_swap", workload=self.workload.name,
+            variant=variant, cells=len(cells),
+        )
+        obs_log.info(
+            "serve", "bucket ladder hot-swapped",
+            variant=variant, cells=len(cells), swaps=self.ladder_swaps,
+        )
+
+    # ------------------------------------------------------------------ #
 
     def stats(self) -> dict:
         with self._cache_lock:
@@ -525,5 +632,6 @@ class ServingEngine:
                 "live_compiles": self.live_compiles,
                 "served": self.served,
                 "degraded_batches": self.degraded_batches,
+                "ladder_swaps": self.ladder_swaps,
                 "queue_shed": self.queue.shed_count,
             }

@@ -1,0 +1,62 @@
+"""Closed-loop production autotuning (PR 12).
+
+Offline autotuning (PRs 1 and 9) selects a plan ONCE — at warmup, or
+whenever the plan cache misses — and serving traffic never feeds back:
+a replica that warmed onto a mediocre variant stays on it for its whole
+life. This package closes the loop (ROADMAP open item 3, the JITSPMM
+thesis from PAPERS.md): specialization pays off precisely when done
+just-in-time against the *observed* workload.
+
+Three stages, three modules:
+
+* :mod:`~distributed_sddmm_tpu.tuner.signals` — **mine** the live
+  telemetry for evidence that realized performance trails the cost
+  model: the per-op ``padded_lane_frac`` gauge (a generic encoding
+  paying the chunk-rounding tax a banked variant would shrink), the
+  watchdog's ``xla_flop_mismatch`` cross-check, and runstore history
+  whose realized GFLOP/s trail the plan's prediction.
+* :mod:`~distributed_sddmm_tpu.tuner.retune` — **re-measure** candidate
+  plans and codegen variants off the request path, reusing the
+  ``autotune/measure.py`` trial machinery under the tuner's own budget
+  and backoff, with candidate ranking recalibrated from the realized
+  data (``autotune.candidates.rank_candidates_realized``).
+* :mod:`~distributed_sddmm_tpu.tuner.shadow` +
+  :mod:`~distributed_sddmm_tpu.tuner.loop` — **promote** by shadow
+  execution: compile the challenger's serve ladder through the program
+  store (challenger keys — the code-hash/variant key grammar already
+  prevents aliasing), mirror a sample of live requests onto it, compare
+  replies bit-for-bit against the incumbent (flight-recorder dump and
+  no-promote on any mismatch), then hot-swap the ladder and the plan
+  cache without dropping a request or compiling on the request path.
+
+The :class:`~distributed_sddmm_tpu.tuner.loop.BackgroundTuner` thread
+(``bench serve --tuner`` / ``DSDDMM_TUNER``) drives the cycle and
+reports ``time_to_adapt_s`` — the new gate axis ``bench gate``
+regresses (``obs/regress.py``).
+"""
+
+from distributed_sddmm_tpu.tuner.loop import (  # noqa: F401
+    BackgroundTuner,
+    TunerConfig,
+)
+# NOTE: the re-measure entry point stays addressed as
+# ``tuner.retune.retune`` — re-exporting the bare function here would
+# shadow (and break imports of) the ``tuner.retune`` submodule itself.
+from distributed_sddmm_tpu.tuner.retune import counted_trial  # noqa: F401
+from distributed_sddmm_tpu.tuner.shadow import (  # noqa: F401
+    ShadowSession,
+    StaleChallenger,
+)
+from distributed_sddmm_tpu.tuner.signals import (  # noqa: F401
+    TuneSignal,
+    engine_problem,
+    mine_engine,
+    mine_runstore,
+    mine_watchdog,
+)
+
+__all__ = [
+    "BackgroundTuner", "ShadowSession", "StaleChallenger", "TuneSignal",
+    "TunerConfig", "counted_trial", "engine_problem", "mine_engine",
+    "mine_runstore", "mine_watchdog",
+]

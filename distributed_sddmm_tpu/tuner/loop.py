@@ -1,0 +1,493 @@
+"""The background tuner: scan → re-measure → shadow → hot-swap.
+
+One :class:`BackgroundTuner` watches one :class:`ServingEngine`. Its
+daemon thread polls a small explicit state machine (:meth:`step` — also
+callable synchronously, which is how the tests drive it
+deterministically):
+
+* ``scan`` — mine trigger signals (``tuner/signals.py``). No signal:
+  go back to sleep; this is the steady state and costs dict snapshots.
+  Signals found: re-measure off-path (``tuner/retune.py``) under the
+  tuner's budget; a challenger that beats the measured incumbent
+  starts a shadow session (``tuner/shadow.py``) and arms the engine's
+  mirror hook.
+* ``shadow`` — drain mirrored requests through the challenger ladder.
+  Mismatch: flight-record dump, challenger rejected, cool down.
+  Enough bit-identical samples: **promote** —
+  ``ServingEngine.swap_ladder`` swaps the pre-warmed challenger
+  programs in atomically (in-flight dispatches finish on the
+  incumbent; no request dropped, no request-path compile), the plan
+  cache is updated under the fingerprint key so the NEXT replica warms
+  straight onto the winner, and the promotion is recorded with its
+  ``time_to_adapt_s`` (detection → promotion) — the new gate axis.
+
+Budget discipline: measurement wall-clock is capped per process
+(``DSDDMM_TUNER_BUDGET``), every promotion/rejection starts a cooldown
+(``DSDDMM_TUNER_COOLDOWN``), and a fingerprint that was already
+re-tuned is not re-tuned again unless NEW signals fire after the swap
+— the loop converges instead of thrashing.
+
+Hot-swap scope: a live swap changes the kernel encoding/variant (the
+ladder's ``v<variant>`` key segment and the workload's specialization
+stamp). Plan-level changes (algorithm, c) cannot be hot-swapped into a
+running replica — they land in the plan cache for the next warmup;
+``bench tune`` is the offline path that explores that full space.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import threading
+from typing import Callable, Optional
+
+from distributed_sddmm_tpu.obs import clock
+from distributed_sddmm_tpu.obs import log as obs_log
+from distributed_sddmm_tpu.obs import metrics as obs_metrics
+from distributed_sddmm_tpu.obs import trace as obs_trace
+# Direct submodule imports (the package deliberately does not
+# re-export the retune() function — it would shadow this submodule).
+import distributed_sddmm_tpu.tuner.retune as retune_mod
+import distributed_sddmm_tpu.tuner.signals as signals_mod
+from distributed_sddmm_tpu.tuner.shadow import ShadowSession, StaleChallenger
+
+
+@dataclasses.dataclass(frozen=True)
+class TunerConfig:
+    """Knobs (all with ``DSDDMM_TUNER_*`` env defaults; see
+    ``utils/envreg.py`` and the README table)."""
+
+    interval_s: float = 2.0
+    lane_frac: float = 0.25
+    shadow_samples: int = 4
+    budget_s: float = 300.0
+    cooldown_s: float = 30.0
+    gap_factor: float = 0.5
+    trial: str = "auto"       # auto | counted | wall
+    trial_timeout_s: float = 60.0
+    top_k: int = 3
+    margin: float = 0.05
+    #: A shadow session that cannot accumulate its samples (traffic
+    #: stopped mid-validation) is abandoned after this long — the
+    #: tuner must return to scanning, not hold the mirror forever.
+    shadow_timeout_s: float = 120.0
+
+    @classmethod
+    def from_env(cls, **overrides) -> "TunerConfig":
+        # Literal env reads, one per knob — the env-knob checker
+        # (analysis/checkers.py) vouches for each registered name by
+        # its access site.
+        kw = dict(
+            interval_s=float(os.environ.get(
+                "DSDDMM_TUNER_INTERVAL", cls.interval_s)),
+            lane_frac=float(os.environ.get(
+                "DSDDMM_TUNER_LANE_FRAC", cls.lane_frac)),
+            shadow_samples=int(float(os.environ.get(
+                "DSDDMM_TUNER_SHADOW_N", cls.shadow_samples))),
+            budget_s=float(os.environ.get(
+                "DSDDMM_TUNER_BUDGET", cls.budget_s)),
+            cooldown_s=float(os.environ.get(
+                "DSDDMM_TUNER_COOLDOWN", cls.cooldown_s)),
+            gap_factor=float(os.environ.get(
+                "DSDDMM_TUNER_GAP", cls.gap_factor)),
+            trial=os.environ.get("DSDDMM_TUNER_TRIAL", cls.trial),
+        )
+        kw.update(overrides)
+        return cls(**kw)
+
+    def trial_fn(self) -> Callable:
+        """The measure_candidates trial function this config selects —
+        delegates to THE mode-dispatch rule
+        (``tuner.retune.select_trial_fn``): an explicit ``wall`` forces
+        the harness trial even off-TPU; ``auto`` picks wall on TPU,
+        counted elsewhere."""
+        return retune_mod.select_trial_fn(self.trial)
+
+
+def factory_name(d_ops) -> Optional[str]:
+    """The bench-harness factory key (``ALGORITHM_FACTORIES``) a live
+    strategy instance was built from — the name Candidate/Plan records
+    speak, where ``algorithm_name`` is the paper's descriptive string.
+    None for an unrecognized strategy class (the tuner then stands
+    down rather than guess)."""
+    cls = type(d_ops).__name__
+    if cls == "DenseShift15D":
+        return (
+            "15d_fusion1"
+            if getattr(d_ops, "fusion_approach", 2) == 1 else "15d_fusion2"
+        )
+    return {
+        "SparseShift15D": "15d_sparse",
+        "CannonDense25D": "25d_dense_replicate",
+        "CannonSparse25D": "25d_sparse_replicate",
+    }.get(cls)
+
+
+class BackgroundTuner:
+    """Closed-loop re-tuning for one live serving engine."""
+
+    def __init__(
+        self,
+        engine,
+        config: Optional[TunerConfig] = None,
+        plan_cache=None,
+        run_store=None,
+        trial_fn: Optional[Callable] = None,
+    ):
+        self.engine = engine
+        self.config = config or TunerConfig.from_env()
+        self._plan_cache = plan_cache
+        if run_store is None:
+            from distributed_sddmm_tpu.obs import store as obs_store
+
+            run_store = obs_store.active()
+        self.run_store = run_store
+        self._trial_fn = trial_fn
+        self.state = "scan"
+        self.shadow: Optional[ShadowSession] = None
+        self.challenger = None
+        self.scans = 0
+        self.last_signals: list[dict] = []
+        self.promotions: list[dict] = []
+        self.rejects: list[dict] = []
+        self.measure_spent_s = 0.0
+        self.t_detect: Optional[float] = None
+        self._wd_cursor = 0
+        self._xla_seen: set = set()
+        self._cool_until = 0.0
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        # The engine's telemetry snapshot / flight-record sources read
+        # tuner state through this backref (``engine_snapshot``).
+        engine.tuner = self
+
+    # ------------------------------------------------------------------ #
+    # Introspection helpers
+    # ------------------------------------------------------------------ #
+
+    @property
+    def problem(self):
+        return signals_mod.engine_problem(self.engine)
+
+    def incumbent_plan(self):
+        """The warm model's plan, or a synthesized stand-in describing
+        what actually runs (models built without ``from_plan`` have no
+        plan object but still have an algorithm/kernel/variant)."""
+        model = getattr(self.engine.workload, "model", None)
+        plan = getattr(model, "plan", None)
+        if plan is not None:
+            return plan
+        d_ops = getattr(model, "d_ops", None)
+        if d_ops is None:
+            return None
+        from distributed_sddmm_tpu.autotune.plan import Plan
+        from distributed_sddmm_tpu.parallel.base import (
+            realized_kernel_variant,
+        )
+
+        algorithm = factory_name(d_ops)
+        if algorithm is None:
+            return None
+        kernel = getattr(d_ops, "kernel", None)
+        name = getattr(kernel, "name", "xla")
+        return Plan(
+            algorithm=algorithm, c=d_ops.c,
+            kernel="pallas" if "pallas" in str(name) else "xla",
+            variant=realized_kernel_variant(d_ops),
+            source="live",
+        )
+
+    def plan_cache(self):
+        if self._plan_cache is None:
+            from distributed_sddmm_tpu.autotune.cache import PlanCache
+
+            self._plan_cache = PlanCache()
+        return self._plan_cache
+
+    # ------------------------------------------------------------------ #
+    # Lifecycle
+    # ------------------------------------------------------------------ #
+
+    def start(self) -> "BackgroundTuner":
+        if self._thread is not None:
+            raise RuntimeError("tuner already started")
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name="tuner"
+        )
+        self._thread.start()
+        return self
+
+    def stop(self, timeout_s: float = 10.0) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout_s)
+            self._thread = None
+        self._detach_shadow()
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.config.interval_s):
+            try:
+                self.step()
+            except Exception as e:  # noqa: BLE001 — the loop must survive
+                obs_log.error(
+                    "tuner", "tuner step failed",
+                    error=f"{type(e).__name__}: {e}",
+                )
+
+    # ------------------------------------------------------------------ #
+    # The state machine (synchronous; the thread just paces it)
+    # ------------------------------------------------------------------ #
+
+    def step(self) -> str:
+        """Advance one poll. Returns the state after the step.
+        ``exhausted`` is terminal: the per-process measurement budget
+        is spent, and structural signals (a pad gauge is a property of
+        the tiles, not of time) would otherwise re-fire every cooldown
+        forever."""
+        if self.state == "scan":
+            self._step_scan()
+        elif self.state == "shadow":
+            self._step_shadow()
+        return self.state
+
+    def _step_scan(self) -> None:
+        if clock.now() < self._cool_until:
+            return
+        problem = self.problem
+        incumbent = self.incumbent_plan()
+        if problem is None or incumbent is None:
+            return
+        with obs_trace.span("tuner:scan"):
+            obs_metrics.GLOBAL.add("tuner_scans")
+            self.scans += 1
+            sigs = signals_mod.mine_engine(
+                self.engine, lane_frac_threshold=self.config.lane_frac
+            )
+            # Live analytic-vs-XLA waste read (the watchdog's own
+            # check_xla_costs only runs at record time, after serving);
+            # _xla_seen dedups structural waste across scans.
+            sigs += signals_mod.mine_xla(self.engine, seen=self._xla_seen)
+            sigs += signals_mod.mine_watchdog(since=self._wd_cursor)
+            from distributed_sddmm_tpu.obs import watchdog as obs_watchdog
+
+            wd = obs_watchdog.active()
+            if wd is not None:
+                self._wd_cursor = len(wd.events)
+            sigs += signals_mod.mine_runstore(
+                self.run_store, incumbent.fingerprint_key, problem,
+                incumbent.predicted_ms, gap_factor=self.config.gap_factor,
+            )
+        if not sigs:
+            return
+        obs_metrics.GLOBAL.add("tuner_signals", len(sigs))
+        self.last_signals = [s.to_dict() for s in sigs]
+        if self.t_detect is None:
+            self.t_detect = clock.now()
+        obs_trace.event(
+            "tuner_signals", count=len(sigs),
+            kinds=sorted({s.kind for s in sigs}),
+        )
+        if self.measure_spent_s >= self.config.budget_s:
+            # Terminal: the budget is per-process and the signals that
+            # got us here are structural — re-firing every cooldown
+            # would append identical rejects for the replica's life.
+            self._reject("measure_budget_exhausted")
+            self.state = "exhausted"
+            obs_log.warn(
+                "tuner", "measurement budget exhausted; tuner retiring",
+                spent_s=round(self.measure_spent_s, 1),
+                budget_s=self.config.budget_s,
+            )
+            return
+        t0 = clock.now()
+        with obs_trace.span("tuner:measure", signals=len(sigs)):
+            obs_metrics.GLOBAL.add("tuner_retunes")
+            challenger = retune_mod.retune(
+                problem, incumbent, self._matrix(),
+                realized=signals_mod.realized_info(self.engine),
+                top_k=self.config.top_k,
+                timeout_s=self.config.trial_timeout_s,
+                max_elapsed_s=max(
+                    self.config.budget_s - self.measure_spent_s, 1.0
+                ),
+                margin=self.config.margin,
+                hot_swappable=True,
+                trial_fn=self._trial_fn or self.config.trial_fn(),
+            )
+        self.measure_spent_s += clock.now() - t0
+        if challenger is None:
+            self._reject("no_better_candidate", cooldown=True)
+            return
+        try:
+            shadow = ShadowSession(self.engine, challenger.variant)
+            with obs_trace.span(
+                "tuner:shadow_arm", variant=challenger.variant or "generic"
+            ):
+                shadow.warm()
+        except StaleChallenger as e:
+            self._reject("stale_challenger", cooldown=True, error=str(e))
+            return
+        self.challenger = challenger
+        self.shadow = shadow
+        self.engine.attach_mirror(shadow.offer)
+        self.state = "shadow"
+        obs_log.info(
+            "tuner", "shadowing challenger",
+            variant=challenger.variant, kernel=challenger.kernel,
+            measured_gflops=challenger.measured_gflops,
+        )
+
+    def _step_shadow(self) -> None:
+        shadow = self.shadow
+        if shadow is None:  # detached externally
+            self.state = "scan"
+            return
+        shadow.drain()
+        if shadow.mismatches:
+            self._reject(
+                "shadow_mismatch", cooldown=True,
+                detail=shadow.mismatch_detail,
+            )
+            return
+        if shadow.clean(self.config.shadow_samples):
+            self._promote()
+            return
+        if clock.now() - shadow.t_start > self.config.shadow_timeout_s:
+            # Mirrored traffic dried up before the sample quota: give
+            # the mirror back and return to scanning — a silent replica
+            # must not hold a half-validated challenger forever.
+            self._reject(
+                "shadow_timeout", cooldown=True,
+                ok=shadow.ok, needed=self.config.shadow_samples,
+            )
+
+    def _matrix(self):
+        model = getattr(self.engine.workload, "model", None)
+        return getattr(model, "S_host", None)
+
+    # ------------------------------------------------------------------ #
+    # Verdicts
+    # ------------------------------------------------------------------ #
+
+    def _detach_shadow(self) -> None:
+        if self.shadow is not None:
+            self.engine.detach_mirror()
+            self.shadow = None
+
+    def _cooldown(self) -> None:
+        self._cool_until = clock.now() + self.config.cooldown_s
+        self.t_detect = None
+
+    def _reject(self, reason: str, cooldown: bool = False, **detail) -> None:
+        self.rejects.append({"reason": reason, **detail})
+        # Bounded: the list rides every serve record via summary(), and
+        # a long-lived replica's repeated rejections must not grow it
+        # (the tuner_rejects counter keeps the full count).
+        del self.rejects[:-32]
+        obs_metrics.GLOBAL.add("tuner_rejects")
+        obs_trace.event("tuner_reject", reason=reason)
+        self._detach_shadow()
+        self.challenger = None
+        self.state = "scan"
+        if cooldown:
+            self._cooldown()
+
+    def _promote(self) -> None:
+        """The hot swap: pre-warmed challenger programs into the ladder,
+        the challenger plan into the plan cache, the promotion (with its
+        time-to-adapt) into the record."""
+        shadow, challenger = self.shadow, self.challenger
+        t_promote = clock.now()
+        time_to_adapt = (
+            t_promote - self.t_detect if self.t_detect is not None else None
+        )
+        with obs_trace.span(
+            "tuner:promote", variant=challenger.variant or "generic"
+        ):
+            self.engine.swap_ladder(
+                shadow.programs, challenger.variant,
+                key_fn=lambda bb, ib: self.engine.program_key(
+                    bb, ib, variant=challenger.variant
+                ),
+            )
+            cache_key = challenger.fingerprint_key
+            if cache_key:
+                try:
+                    self.plan_cache().store(cache_key, challenger.to_dict())
+                except Exception as e:  # noqa: BLE001 — cache is advisory
+                    obs_log.warn("tuner", "plan-cache store failed",
+                                 error=str(e))
+            model = getattr(self.engine.workload, "model", None)
+            if model is not None:
+                # Unconditional (models built without from_plan have no
+                # .plan attribute yet): incumbent_plan() must see the
+                # tuned plan on the next scan, or the loop would keep
+                # re-synthesizing the pre-promotion incumbent and
+                # re-tune the same gap forever.
+                model.plan = challenger
+        promo = {
+            "t_promote_epoch": clock.epoch(),
+            "time_to_adapt_s": (
+                round(time_to_adapt, 6) if time_to_adapt is not None
+                else None
+            ),
+            "plan": challenger.to_dict(),
+            "shadow": shadow.stats(),
+            "signals": self.last_signals,
+        }
+        self.promotions.append(promo)
+        obs_metrics.GLOBAL.add("tuner_promotions")
+        obs_trace.event(
+            "tuner_promoted", variant=challenger.variant,
+            time_to_adapt_s=promo["time_to_adapt_s"],
+            shadow_ok=shadow.ok,
+        )
+        obs_log.info(
+            "tuner", "challenger promoted",
+            variant=challenger.variant,
+            time_to_adapt_s=promo["time_to_adapt_s"],
+        )
+        self._detach_shadow()
+        self.challenger = None
+        self.state = "scan"
+        self._cooldown()
+
+    # ------------------------------------------------------------------ #
+    # Reporting
+    # ------------------------------------------------------------------ #
+
+    @property
+    def time_to_adapt_s(self) -> Optional[float]:
+        """Detection → first promotion, the record/gate axis (None until
+        a promotion lands)."""
+        for p in self.promotions:
+            if p.get("time_to_adapt_s") is not None:
+                return p["time_to_adapt_s"]
+        return None
+
+    def summary(self) -> dict:
+        """The serve record's ``tuner`` field."""
+        out = {
+            "enabled": True,
+            "state": self.state,
+            "scans": self.scans,
+            "signals": self.last_signals,
+            "promotions": self.promotions,
+            "rejects": self.rejects,
+            "measure_spent_s": round(self.measure_spent_s, 3),
+            "time_to_adapt_s": self.time_to_adapt_s,
+        }
+        if self.shadow is not None:
+            out["shadow"] = self.shadow.stats()
+        return out
+
+    def snapshot(self) -> dict:
+        """Compact live view (telemetry sampler / `/snapshot`)."""
+        return {
+            "state": self.state,
+            "scans": self.scans,
+            "promotions": len(self.promotions),
+            "rejects": len(self.rejects),
+            "time_to_adapt_s": self.time_to_adapt_s,
+        }

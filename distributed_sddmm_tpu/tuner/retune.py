@@ -1,0 +1,262 @@
+"""Off-request-path re-measurement: from trigger signals to a challenger.
+
+The tuner never competes with the serve runner for a batch slot: every
+trial here runs on the tuner's own thread through the SAME machinery
+offline autotuning uses (``autotune/measure.measure_candidates`` —
+per-trial timeout, retry with jittered backoff, elapsed cap), just
+under the tuner's own, much tighter budget knobs.
+
+Two trial modes, because this repo runs on two kinds of backend:
+
+* ``wall`` — the real thing: short bench-harness runs
+  (``measure.default_trial``), wall-clock arbitrated. The honest mode
+  on a TPU; on the CPU test mesh the Pallas interpreter's wall-clock
+  says nothing about what a chip would do.
+* ``counted`` (the non-TPU default) — deterministic counted trials:
+  build the candidate's actual chunk-list encoding (generic
+  ``build_blocked`` or the variant's ``build_banded``) over the host
+  matrix and charge the analytic pair time with the *counted*
+  padded-lane overhead. This is exactly how PR 9 banked its variant
+  win on this container (counted padded lanes, bit-identity pinned,
+  structural HLO gated) — realized structure, not interpreter noise,
+  arbitrates. It still runs through ``measure_candidates`` so budget,
+  backoff, tracing and drop accounting behave identically in both
+  modes.
+
+``retune`` is the whole stage: re-rank candidates with the incumbent's
+realized data folded in (``rank_candidates_realized``), measure the
+short list, and return a challenger :class:`Plan` (source ``"tuned"``)
+only when it beats the incumbent's own measured number — "no
+challenger" is a normal, cheap outcome.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import numpy as np
+
+from distributed_sddmm_tpu.autotune import candidates as cand_mod
+from distributed_sddmm_tpu.autotune import measure as measure_mod
+from distributed_sddmm_tpu.autotune.candidates import Candidate
+from distributed_sddmm_tpu.autotune.fingerprint import Problem
+from distributed_sddmm_tpu.autotune.plan import Plan
+from distributed_sddmm_tpu.obs import log as obs_log
+from distributed_sddmm_tpu.tools import costmodel
+
+
+def counted_pad_frac(S, cand: Candidate, p: Optional[int] = None) -> float:
+    """Counted padded-lane fraction of the candidate's chunk-list
+    encoding over the 1.5D block-row distributed layout (one tall-thin
+    ``(M/p) x N`` tile per device — the geometry the shift strategies
+    actually encode, where short skewed rows scatter across many
+    column blocks and pay the generic chunk-rounding tax the banked
+    variants collapse). XLA-kernel candidates have no chunk lanes and
+    count 0."""
+    if cand.kernel != "pallas":
+        return 0.0
+    from distributed_sddmm_tpu.ops import blocked
+
+    if p is None:
+        import jax
+
+        p = len(jax.devices())
+    nnz = int(S.nnz)
+    tile_rows = -(-int(S.M) // max(int(p), 1))
+    rows = np.asarray(S.rows, dtype=np.int64)
+    cols = np.asarray(S.cols, dtype=np.int64)
+    bucket = rows // tile_rows
+    rows = rows % tile_rows
+    if cand.variant:
+        from distributed_sddmm_tpu import codegen
+        from distributed_sddmm_tpu.codegen import banded
+
+        try:
+            variant = codegen.variant_from_id(cand.variant)
+        except ValueError:
+            return counted_pad_frac(
+                S, Candidate(cand.algorithm, cand.c, kernel="pallas"), p=p
+            )
+        meta = banded.build_banded(
+            int(p), bucket, rows, cols, tile_rows, int(S.N), variant
+        )
+    else:
+        br, bc = cand.block or (None, None)
+        meta = blocked.build_blocked(
+            int(p), bucket, rows, cols, tile_rows, int(S.N),
+            block_rows=br, block_cols=bc,
+            # The geometry the generic kernels actually run: grid steps
+            # consume DEFAULT_GROUP chunks, so each row-block group pads
+            # to a group multiple — part of the tax banking removes
+            # (band groups are the variant's own).
+            group=blocked.DEFAULT_GROUP,
+        )
+    return blocked.padded_lane_frac(meta)
+
+
+def counted_trial(
+    S, problem: Problem, cand: Candidate, trials: int, warmup: int,
+) -> dict:
+    """Deterministic counted trial (``measure_candidates`` trial_fn):
+    analytic pair time charged with the candidate's COUNTED padded-lane
+    overhead instead of the cost model's estimate. Returns a harness-
+    shaped record so the measurement plumbing is mode-agnostic."""
+    del trials, warmup  # counted structure does not average
+    machine = costmodel.Machine()
+    rate = costmodel.measured_flops_rate(cand.kernel) or machine.flops_rate
+    m = costmodel.Machine(
+        ici_words_per_s=machine.ici_words_per_s,
+        alpha_s=machine.alpha_s, flops_rate=rate,
+    )
+    import jax
+
+    p = len(jax.devices())
+    t = costmodel.pair_time(
+        cand_mod.ALGORITHM_MODELS[cand.algorithm],
+        problem.M, problem.N, problem.R, problem.nnz, p, cand.c, m,
+    )
+    if cand.chunked:
+        t *= 1.1
+    frac = counted_pad_frac(S, cand)
+    t *= 1.0 + frac
+    flops = 4.0 * problem.nnz * problem.R
+    return {
+        "overall_throughput": flops / t / 1e9,
+        "counted_padded_lane_frac": round(frac, 6),
+        "trial": "counted",
+    }
+
+
+def default_trial_mode() -> str:
+    """``wall`` on a real TPU backend, ``counted`` everywhere else."""
+    try:
+        import jax
+
+        return "wall" if jax.default_backend() == "tpu" else "counted"
+    except Exception:  # noqa: BLE001 — no backend, counted still works
+        return "counted"
+
+
+def select_trial_fn(mode: str = "auto") -> Callable:
+    """THE trial-mode dispatch rule (TunerConfig and ``bench tune``
+    both route here): explicit ``counted``/``wall`` force their trial
+    function; ``auto`` resolves by backend via
+    :func:`default_trial_mode`."""
+    if mode == "auto":
+        mode = default_trial_mode()
+    if mode == "counted":
+        return counted_trial
+    return measure_mod.default_trial
+
+
+def retune(
+    problem: Problem,
+    incumbent: Optional[Plan],
+    S,
+    *,
+    realized: Optional[dict] = None,
+    top_k: int = 3,
+    trials: int = 1,
+    warmup: int = 0,
+    timeout_s: float = 60.0,
+    max_elapsed_s: float = 120.0,
+    margin: float = 0.05,
+    hot_swappable: bool = False,
+    trial_fn: Optional[Callable] = None,
+    devices=None,
+) -> Optional[Plan]:
+    """Re-measure and return a challenger plan, or None when the
+    incumbent stands.
+
+    The candidate short list is the realized-data re-ranking
+    (:func:`~distributed_sddmm_tpu.autotune.candidates.
+    rank_candidates_realized`) of the full enumeration; the incumbent's
+    own configuration is ALWAYS measured alongside it so the verdict is
+    measured-vs-measured, never measured-vs-remembered. A challenger
+    must beat the incumbent's trial by ``margin`` (relative) — swapping
+    a serving ladder for noise is worse than keeping a mediocre plan.
+
+    ``hot_swappable=True`` (the live serving tuner) restricts the
+    space to the incumbent's (algorithm, c, kernel family): a running
+    replica can swap its kernel encoding/variant mid-life (the ladder
+    keys and the plan cache carry it), but a different algorithm,
+    replication factor or kernel family means different tiles, rings
+    and dispatch programs — that is a re-warm, not a hot swap, and
+    belongs to the next replica via the plan cache (``bench tune``
+    explores the full space for exactly that purpose).
+    """
+    from distributed_sddmm_tpu.autotune.fingerprint import (
+        machine_signature, make_fingerprint,
+    )
+
+    p, backend, kernels = machine_signature(devices)
+    # The fingerprint is the MACHINE's (the key the plan cache and the
+    # next replica's get_plan will compute); the search space may be
+    # wider: a replica that IS running a kernel family must have that
+    # family in its re-tune space even where machine_signature would
+    # not offer it cold (the CPU test mesh offers only xla, but an
+    # operator-forced pallas incumbent re-tunes within pallas — banked
+    # variants included).
+    fp = make_fingerprint(problem, p, backend, kernels)
+    if incumbent is not None and incumbent.kernel not in kernels:
+        kernels = tuple(kernels) + (incumbent.kernel,)
+
+    cands = cand_mod.enumerate_candidates(problem, p, kernels)
+    if hot_swappable and incumbent is not None:
+        cands = [
+            cand for cand in cands
+            if cand.algorithm == incumbent.algorithm
+            and cand.c == incumbent.c
+            and cand.kernel == incumbent.kernel
+        ]
+    if not cands:
+        return None
+    ranked = cand_mod.rank_candidates_realized(
+        problem, cands, p, realized=realized
+    )
+    short = [cand for cand, _ in ranked[:top_k]]
+    inc_cand = incumbent.candidate() if incumbent is not None else None
+    if inc_cand is not None and inc_cand not in short:
+        short.append(inc_cand)
+
+    run = trial_fn if trial_fn is not None else select_trial_fn("auto")
+    measured = measure_mod.measure_candidates(
+        S, problem, short,
+        trials=trials, warmup=warmup, timeout_s=timeout_s,
+        max_elapsed_s=max_elapsed_s, trial_fn=run,
+    )
+    if not measured:
+        return None
+    by_cand = {cand: rec for cand, rec in measured}
+    best_cand, best_rec = measured[0]
+    inc_rec = by_cand.get(inc_cand) if inc_cand is not None else None
+    best_g = best_rec.get("overall_throughput") or 0.0
+    inc_g = (inc_rec or {}).get("overall_throughput") or 0.0
+    if inc_cand is not None and best_cand == inc_cand:
+        return None
+    if inc_cand is not None and inc_rec is None:
+        # The incumbent's own trial was dropped (timeout/backoff
+        # budget): without a measured incumbent the verdict would be
+        # measured-vs-nothing — stand pat rather than swap a serving
+        # ladder on one-sided evidence.
+        obs_log.warn(
+            "tuner", "incumbent trial dropped; standing pat",
+            incumbent=f"{inc_cand.algorithm}/{inc_cand.kernel}"
+            f"/{inc_cand.variant}",
+        )
+        return None
+    if inc_g and best_g < inc_g * (1.0 + margin):
+        obs_log.info(
+            "tuner", "challenger within margin of incumbent; standing pat",
+            challenger=best_g, incumbent=inc_g, margin=margin,
+        )
+        return None
+    return Plan(
+        algorithm=best_cand.algorithm, c=best_cand.c,
+        kernel=best_cand.kernel, block=best_cand.block,
+        gather_budget=best_cand.gather_budget, variant=best_cand.variant,
+        source="tuned",
+        predicted_ms=cand_mod.model_cost(problem, best_cand, p) * 1e3,
+        measured_gflops=best_g,
+        fingerprint_key=fp.key,
+    )

@@ -1,0 +1,329 @@
+"""Shadow execution: validate a challenger ladder on mirrored traffic.
+
+A challenger plan that measured well is still not trusted with user
+traffic: the serving contract is *bit-identical replies*, and the only
+evidence that satisfies it is the challenger answering real requests
+with byte-for-byte the incumbent's replies. The shadow protocol:
+
+1. **Compile off-path** (:meth:`ShadowSession.warm`): one challenger
+   program per ladder cell, built through the program store under the
+   challenger's own keys — the ``serve:...:v<variant>`` grammar
+   (``programs/keys.py``) already guarantees a challenger entry can
+   never alias the incumbent's (and a stale entry from another code
+   generation can never resolve at all: the ``serve_code_hash`` segment
+   differs). Warmup executes every cell once with an all-padding batch,
+   so promotion later swaps in programs that are COMPILED AND TRACED —
+   the request path never pays a compile for the swap.
+2. **Mirror** (:meth:`offer`): the engine's runner hands each answered
+   group (payloads + the replies the clients actually received) to the
+   session — one bounded-deque append on the request path, nothing
+   more. A full deque drops the sample (mirroring is best-effort
+   sampling, never backpressure).
+3. **Replay + compare** (:meth:`drain`, tuner thread): each mirrored
+   group is re-padded with the incumbent's exact (batch bucket, inner
+   bucket) cell and dispatched through the challenger program; replies
+   must match **bit for bit** (``np.array_equal`` on every field).
+   Any mismatch poisons the session permanently: the challenger is
+   never promoted, a flight record is dumped when the recorder is
+   armed, and the mismatch detail is kept for the record.
+
+The session never touches the engine's program cache — promotion is the
+caller's move (``ServingEngine.swap_ladder``), taken only on a clean
+verdict with enough samples.
+
+A note on what the swap changes TODAY: the two shipped workloads'
+serving programs (fold-in solve, node scoring) are variant-INVARIANT —
+``build_program`` reads only model state, so a challenger ladder's
+executables are bit-identical to the incumbent's by construction and
+the shadow compare passes trivially when nothing else is wrong. The
+swap's live payload is the key/variant restamp (records, scrapes,
+serve keys), the model's plan, and the plan-cache entry the next
+replica warms from; the strategy-level specialization itself lands at
+that next warmup. The shadow protocol is still the load-bearing gate:
+it validates whatever the challenger ladder actually dispatches, and
+any future workload whose program DOES bake variant-dependent
+structure (or any divergence introduced by compilation, stores, or
+faults — see the mismatch tests) is caught by exactly this path.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+from typing import Optional
+
+import numpy as np
+
+from distributed_sddmm_tpu.obs import clock
+from distributed_sddmm_tpu.obs import log as obs_log
+from distributed_sddmm_tpu.obs import metrics as obs_metrics
+from distributed_sddmm_tpu.obs import trace as obs_trace
+from distributed_sddmm_tpu.resilience import faults
+
+
+class StaleChallenger(ValueError):
+    """A challenger whose variant generation this code cannot
+    reconstruct (or whose ladder no longer covers the engine's cells) —
+    refused at validation, long before any swap."""
+
+
+def _reply_equal(a: dict, b: dict) -> bool:
+    """Bit-for-bit reply equality: same keys, every array/scalar field
+    byte-identical (``array_equal`` with NaN-aware strictness — a NaN
+    anywhere is a mismatch, exactly what the corruption faults inject)."""
+    if set(a.keys()) != set(b.keys()):
+        return False
+    for k, va in a.items():
+        vb = b[k]
+        va_arr, vb_arr = np.asarray(va), np.asarray(vb)
+        if va_arr.shape != vb_arr.shape or va_arr.dtype != vb_arr.dtype:
+            return False
+        if va_arr.dtype.kind == "f":
+            if np.any(np.isnan(va_arr)) or np.any(np.isnan(vb_arr)):
+                return False
+        if not np.array_equal(va_arr, vb_arr):
+            return False
+    return True
+
+
+class ShadowSession:
+    """One challenger's mirrored-traffic validation run."""
+
+    #: Fault-injection site for the challenger replay (``output:`` name
+    #: family, like every other dispatch site): tests and chaos drills
+    #: corrupt the challenger's outputs here to prove a mismatch blocks
+    #: promotion without touching live replies.
+    OP = "tunerShadow"
+
+    def __init__(
+        self,
+        engine,
+        variant: Optional[str],
+        max_pending: int = 32,
+        sample_every: int = 1,
+    ):
+        self.engine = engine
+        self.variant = variant
+        self._validate_variant()
+        self.t_start = clock.now()
+        self.sample_every = max(int(sample_every), 1)
+        self._seen = 0
+        self._pending: collections.deque = collections.deque(
+            maxlen=max_pending
+        )
+        self._lock = threading.Lock()
+        #: Challenger programs per ladder cell (built in :meth:`warm`).
+        self.programs: dict[tuple[int, int], object] = {}
+        self.disk_hits = 0
+        self.live_compiles = 0
+        self.replays = 0
+        self.ok = 0
+        self.mismatches = 0
+        self.dropped = 0
+        self.mismatch_detail: Optional[dict] = None
+        self.warmed = False
+
+    def _validate_variant(self) -> None:
+        """A challenger id the current variant generation cannot
+        reconstruct is stale by definition — refuse it here, so a
+        stale challenger cannot even begin shadowing, let alone be
+        promoted."""
+        if self.variant is None:
+            return
+        from distributed_sddmm_tpu import codegen
+
+        try:
+            codegen.variant_from_id(self.variant)
+        except ValueError as e:
+            raise StaleChallenger(
+                f"challenger variant {self.variant!r} is not "
+                f"reconstructible by this code generation: {e}"
+            ) from e
+
+    # ------------------------------------------------------------------ #
+    # Off-path compilation
+    # ------------------------------------------------------------------ #
+
+    def _note_resolve(self, source: str) -> None:
+        with self._lock:
+            if source == "disk":
+                self.disk_hits += 1
+            else:
+                self.live_compiles += 1
+
+    def warm(self) -> int:
+        """Build + execute every challenger ladder cell once (all-padding
+        batch) on the CALLING (tuner) thread. Returns cells warmed.
+        After this, promotion is a dict swap — zero request-path
+        compiles by construction."""
+        from distributed_sddmm_tpu.utils.platform import force_fetch
+
+        engine, workload = self.engine, self.engine.workload
+        n = 0
+        with obs_trace.span(
+            "tuner:shadow_warm", variant=self.variant or "generic",
+            cells=len(engine.batch_buckets) * len(workload.inner_buckets),
+        ):
+            for bb in engine.batch_buckets:
+                for ib in workload.inner_buckets:
+                    prog = workload.build_program(bb, ib)
+                    if engine.program_store is not None:
+                        from distributed_sddmm_tpu.programs import (
+                            StoredProgram,
+                        )
+
+                        prog = StoredProgram(
+                            prog,
+                            key_fn=lambda sig, b=bb, i=ib: (
+                                engine.program_key(
+                                    b, i, sig=sig, variant=self.variant
+                                )
+                            ),
+                            store=engine.program_store,
+                            meta={"workload": workload.name,
+                                  "challenger": True},
+                            on_resolve=self._note_resolve,
+                        )
+                    else:
+                        self._note_resolve("live")
+                    args = workload.pad_batch([], bb, ib)
+                    force_fetch(prog(*args))
+                    self.programs[(bb, ib)] = prog
+                    n += 1
+        self.warmed = True
+        obs_log.info(
+            "tuner", "challenger ladder warmed off-path",
+            cells=n, variant=self.variant,
+            live_compiles=self.live_compiles, disk_hits=self.disk_hits,
+        )
+        return n
+
+    # ------------------------------------------------------------------ #
+    # Mirroring (request path: one deque append)
+    # ------------------------------------------------------------------ #
+
+    def offer(
+        self, payloads: list[dict], replies: list[dict],
+        batch_bucket: int, inner_bucket: int,
+    ) -> None:
+        """Engine-runner hook: record one answered group for replay.
+        Sampling and bounding both happen here so the request path cost
+        is a modulo and (at most) one append."""
+        self._seen += 1
+        if (self._seen - 1) % self.sample_every:
+            return
+        with self._lock:
+            if len(self._pending) == self._pending.maxlen:
+                self.dropped += 1
+                return
+            self._pending.append(
+                (list(payloads), list(replies), batch_bucket, inner_bucket)
+            )
+
+    # ------------------------------------------------------------------ #
+    # Replay + verdict (tuner thread)
+    # ------------------------------------------------------------------ #
+
+    def drain(self, max_replays: Optional[int] = None) -> int:
+        """Replay pending mirrored groups through the challenger ladder;
+        returns the number replayed. A mismatch marks the session dead
+        (``mismatches > 0``) and stops further replay — one bad bit is
+        a verdict, not a statistic."""
+        if not self.warmed:
+            return 0
+        done = 0
+        while self.mismatches == 0:
+            if max_replays is not None and done >= max_replays:
+                break
+            with self._lock:
+                if not self._pending:
+                    break
+                payloads, replies, bb, ib = self._pending.popleft()
+            self._replay(payloads, replies, bb, ib)
+            done += 1
+        return done
+
+    def _replay(
+        self, payloads: list[dict], replies: list[dict], bb: int, ib: int,
+    ) -> None:
+        from distributed_sddmm_tpu.utils.platform import force_fetch
+
+        workload = self.engine.workload
+        prog = self.programs.get((bb, ib))
+        if prog is None:
+            # A cell the incumbent served that the challenger ladder
+            # does not cover: treat as a mismatch — promoting a partial
+            # ladder would compile on the request path.
+            self._mismatch(bb, ib, reason="missing_cell")
+            return
+        try:
+            with obs_trace.span(
+                "tuner:shadow_replay", batch_bucket=bb, inner_bucket=ib,
+                batch=len(payloads),
+            ):
+                args = workload.pad_batch(payloads, bb, ib)
+                out = prog(*args)
+                out = faults.corrupt_outputs(f"output:{self.OP}", out)
+                force_fetch(out)
+                challenger_replies = workload.unpad(out, payloads)
+        except Exception as e:  # noqa: BLE001 — a raising challenger
+            # is as disqualifying as a diverging one: poison the
+            # session rather than letting the error bubble into the
+            # tuner thread's generic handler (which would leave the
+            # session half-drained but still promotable).
+            self._mismatch(bb, ib, reason="replay_error",
+                           error=f"{type(e).__name__}: {e}")
+            return
+        self.replays += 1
+        obs_metrics.GLOBAL.add("tuner_shadow_replays")
+        for i, (inc, ch) in enumerate(zip(replies, challenger_replies)):
+            if not _reply_equal(inc, ch):
+                self._mismatch(bb, ib, reason="reply_diverged", index=i)
+                return
+        self.ok += len(payloads)
+
+    def _mismatch(self, bb: int, ib: int, **detail) -> None:
+        """Poison the session: record, count, trace, and dump a flight
+        record when the recorder is armed — the post-mortem must show
+        the spans surrounding the divergence."""
+        from distributed_sddmm_tpu.obs import flightrec
+
+        self.mismatches += 1
+        info = {
+            "batch_bucket": bb, "inner_bucket": ib,
+            "variant": self.variant, **detail,
+        }
+        fr = flightrec.active()
+        if fr is not None:
+            path = fr.dump("tuner_shadow_mismatch", self.OP, info)
+            if path:
+                info["snapshot_path"] = path
+        self.mismatch_detail = info
+        obs_metrics.GLOBAL.add("tuner_shadow_mismatches")
+        obs_trace.event("tuner_shadow_mismatch", **info)
+        obs_log.error(
+            "tuner", "shadow mismatch — challenger will not be promoted",
+            **{k: str(v) for k, v in info.items()},
+        )
+
+    # ------------------------------------------------------------------ #
+
+    def clean(self, min_samples: int) -> bool:
+        """True when the session has validated at least ``min_samples``
+        request replies bit-identically with zero mismatches."""
+        return self.mismatches == 0 and self.ok >= min_samples
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "variant": self.variant,
+                "cells": len(self.programs),
+                "replays": self.replays,
+                "ok": self.ok,
+                "mismatches": self.mismatches,
+                "dropped": self.dropped,
+                "pending": len(self._pending),
+                "disk_hits": self.disk_hits,
+                "live_compiles": self.live_compiles,
+                "mismatch_detail": self.mismatch_detail,
+            }

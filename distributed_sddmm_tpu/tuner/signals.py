@@ -1,0 +1,258 @@
+"""Trigger-signal mining: when is a serving replica worth re-tuning?
+
+The tuner must not burn measurement budget (or mirror traffic) on a
+replica that is already well-planned, so every cycle starts by mining
+the telemetry the obs layer already maintains for *evidence of a gap*
+between realized and modeled performance. Three independent signal
+families, each a thing PRs 4–9 already measure:
+
+* ``padded_lanes`` — the strategy's per-op ``padded_lane_frac`` gauge
+  (noted at tile build, scraped as ``dsddmm_op_padded_lane_frac``).
+  A **generic** encoding paying a high chunk-rounding tax on a problem
+  whose fingerprint selects a banked variant is exactly the population
+  PR 9's codegen exists for; the realized gauge is ground truth where
+  the cost model's pad estimate is a guess.
+* ``xla_waste`` — the watchdog's ``xla_flop_mismatch`` anomaly in the
+  ``xla_waste`` direction: XLA's own ``cost_analysis`` of the compiled
+  programs charges far more FLOPs than the counted useful work, i.e.
+  padding/layout blew up the executable — re-tuning territory.
+* ``runstore_gap`` — history: stored runs matching this problem's
+  fingerprint whose realized GFLOP/s trail what the plan's own
+  ``predicted_ms`` implies by more than the gap factor. The model
+  promised and the machine did not deliver — re-measure.
+
+Signals are descriptive, not prescriptive: the re-tune stage
+(``tuner/retune.py``) decides what to do about them. Mining is
+read-only and cheap (dict snapshots, no dispatch, no locks held across
+calls) — it runs on the tuner thread every poll interval.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+from distributed_sddmm_tpu.obs import watchdog as obs_watchdog
+
+
+@dataclasses.dataclass(frozen=True)
+class TuneSignal:
+    """One piece of evidence that realized performance trails the model.
+
+    ``severity`` is a dimensionless ordering hint (bigger = worse):
+    the pad fraction itself for ``padded_lanes``, the compiled/counted
+    FLOP ratio for ``xla_waste``, the modeled/realized throughput ratio
+    for ``runstore_gap``.
+    """
+
+    kind: str       # padded_lanes | xla_waste | runstore_gap
+    op: str
+    severity: float
+    detail: dict
+
+    def to_dict(self) -> dict:
+        return {
+            "kind": self.kind, "op": self.op,
+            "severity": round(self.severity, 4), **self.detail,
+        }
+
+
+def engine_problem(engine):
+    """The autotune :class:`Problem` a serving engine's warm model
+    executes, or None when the engine's workload does not expose the
+    host matrix (a tuner cannot re-measure what it cannot build)."""
+    from distributed_sddmm_tpu.autotune.fingerprint import Problem
+
+    model = getattr(engine.workload, "model", None)
+    d_ops = getattr(model, "d_ops", None)
+    S = getattr(model, "S_host", None)
+    if d_ops is None or S is None:
+        return None
+    return Problem.from_coo(S, d_ops.R)
+
+
+def realized_info(engine) -> dict:
+    """The incumbent's realized execution facts, in the shape
+    ``autotune.candidates.rank_candidates_realized`` consumes:
+    kernel family, realized variant (None = generic, the shared
+    ``parallel.base.realized_kernel_variant`` rule) and the worst
+    per-op ``padded_lane_frac`` gauge."""
+    from distributed_sddmm_tpu.parallel.base import realized_kernel_variant
+
+    model = getattr(engine.workload, "model", None)
+    d_ops = getattr(model, "d_ops", None)
+    if d_ops is None:
+        return {}
+    frac = None
+    metrics = getattr(d_ops, "metrics", None)
+    if metrics is not None and hasattr(metrics, "gauges"):
+        fracs = [
+            g.get("padded_lane_frac")
+            for g in metrics.gauges().values()
+            if g.get("padded_lane_frac") is not None
+        ]
+        if fracs:
+            frac = max(fracs)
+    # The SERVING variant stamp wins over the strategy's realized
+    # variant: a promotion restamps ``workload.kernel_variant`` (the
+    # strategy's tiles stay as built), and the trigger must read what
+    # serving now runs under or the same padded_lanes signal would
+    # re-fire forever after a successful swap.
+    variant = getattr(engine.workload, "kernel_variant", None)
+    if variant is None:
+        variant = realized_kernel_variant(d_ops)
+    return {
+        "kernel": getattr(
+            getattr(d_ops, "kernel", None), "name",
+            type(getattr(d_ops, "kernel", None)).__name__,
+        ),
+        "variant": variant,
+        "padded_lane_frac": frac,
+    }
+
+
+def mine_engine(
+    engine, lane_frac_threshold: float = 0.25,
+) -> list[TuneSignal]:
+    """``padded_lanes`` signals from the live engine's strategy gauges.
+
+    Fires only when (a) the realized encoding is generic, (b) the gauge
+    exceeds the threshold, and (c) the problem's fingerprint actually
+    selects a specialized variant — a gap the candidate space can close.
+    A banked incumbent's residual padding is not a signal: the variant
+    space has nothing further to offer it."""
+    problem = engine_problem(engine)
+    if problem is None:
+        return []
+    info = realized_info(engine)
+    frac = info.get("padded_lane_frac")
+    if frac is None or frac < lane_frac_threshold:
+        return []
+    if info.get("variant") is not None:
+        return []
+    from distributed_sddmm_tpu.codegen import variant_ids_for
+
+    if not variant_ids_for(problem):
+        return []
+    return [TuneSignal(
+        kind="padded_lanes", op="fusedSpMM", severity=float(frac),
+        detail={
+            "padded_lane_frac": round(float(frac), 6),
+            "threshold": lane_frac_threshold,
+            "realized_variant": None,
+        },
+    )]
+
+
+def mine_xla(
+    engine, waste_factor: float = 32.0, seen: Optional[set] = None,
+) -> list[TuneSignal]:
+    """Live ``xla_waste`` check over the warm model's dispatched ops.
+
+    The watchdog's own ``check_xla_costs`` runs at record-assembly
+    time — after a serving window ends — so a LIVE loop needs its own
+    read of the same evidence: analytic counted FLOPs per call vs
+    XLA's ``cost_analysis`` of the resolved programs (the program
+    store's cost log), flagged with the watchdog's waste band. Pure
+    read — no anomaly is recorded, no event emitted; ``seen`` (a set
+    the caller owns) dedups ops across scans so a structural waste
+    signal fires once, not every poll."""
+    model = getattr(engine.workload, "model", None)
+    d_ops = getattr(model, "d_ops", None)
+    if d_ops is None:
+        return []
+    from distributed_sddmm_tpu import programs
+
+    metrics = d_ops.metrics.to_dict()
+    xla = programs.xla_cost_summary(metrics, since=0)
+    if not xla:
+        return []
+    out = []
+    for op, cost in (xla.get("ops") or {}).items():
+        if seen is not None and op in seen:
+            continue
+        m = metrics.get(op) or {}
+        calls, flops = m.get("calls") or 0, m.get("flops") or 0.0
+        x = cost.get("flops_per_call") or 0.0
+        if not (calls and flops and x):
+            continue
+        counted = flops / calls
+        if x > counted * waste_factor:
+            if seen is not None:
+                seen.add(op)
+            out.append(TuneSignal(
+                kind="xla_waste", op=op, severity=x / counted,
+                detail={"xla_flops": x,
+                        "counted_flops": round(counted, 2)},
+            ))
+    return out
+
+
+def mine_watchdog(watchdog=None, since: int = 0) -> list[TuneSignal]:
+    """``xla_waste`` signals from the watchdog's analytic-vs-XLA FLOP
+    cross-check (``xla_flop_mismatch`` anomalies in the waste
+    direction). ``since`` is an event cursor so a long-lived tuner does
+    not re-signal on anomalies it already acted on."""
+    wd = watchdog if watchdog is not None else obs_watchdog.active()
+    if wd is None:
+        return []
+    out = []
+    for ev in list(wd.events[since:]):
+        if ev.get("kind") != "xla_flop_mismatch":
+            continue
+        if ev.get("direction") != "xla_waste":
+            continue
+        ratio = ev.get("ratio") or 0.0
+        sev = 1.0 / ratio if ratio else 0.0  # ratio = counted/xla (< 1)
+        out.append(TuneSignal(
+            kind="xla_waste", op=str(ev.get("op", "?")), severity=sev,
+            detail={"ratio": ratio},
+        ))
+    return out
+
+
+def mine_runstore(
+    store,
+    fingerprint_key: str,
+    problem,
+    predicted_ms: Optional[float],
+    gap_factor: float = 0.5,
+    last: int = 5,
+) -> list[TuneSignal]:
+    """``runstore_gap`` signals: the last ``last`` stored runs matching
+    this fingerprint realize less than ``gap_factor`` of the
+    throughput the plan's own ``predicted_ms`` implies. Uses the
+    store's index rows only (no document loads) — mining must stay
+    cheap enough to run every poll."""
+    if store is None or not fingerprint_key or not predicted_ms:
+        return []
+    try:
+        rows = store.history(key=fingerprint_key, limit=last)
+    except Exception:  # noqa: BLE001 — mining never fails the tuner
+        return []
+    realized = [
+        r.get("overall_throughput") for r in rows
+        if r.get("overall_throughput")
+    ]
+    if not realized:
+        return []
+    import statistics
+
+    got = statistics.median(realized)
+    # predicted_ms is the modeled seconds per fused pair * 1e3; the
+    # harness throughput convention is 4*nnz*R useful FLOPs per pair.
+    model_gflops = (4.0 * problem.nnz * problem.R) / (
+        predicted_ms / 1e3
+    ) / 1e9
+    if model_gflops <= 0 or got >= gap_factor * model_gflops:
+        return []
+    return [TuneSignal(
+        kind="runstore_gap", op="fusedSpMM",
+        severity=model_gflops / max(got, 1e-12),
+        detail={
+            "realized_gflops": round(got, 3),
+            "modeled_gflops": round(model_gflops, 3),
+            "gap_factor": gap_factor,
+            "runs": len(realized),
+        },
+    )]

@@ -106,6 +106,27 @@ KNOBS: dict[str, Knob] = {k.name: k for k in [
     _K("DSDDMM_TRACE", "spec", "off",
        "span tracing: 1 (default artifacts/traces), a file, or a "
        "directory; exported as PATH.shards to children"),
+    _K("DSDDMM_TUNER", "flag", "0",
+       "background closed-loop tuner on `bench serve` (same as "
+       "--tuner; tuner/)"),
+    _K("DSDDMM_TUNER_BUDGET", "float", "300",
+       "per-process wall-clock cap on tuner re-measurement seconds"),
+    _K("DSDDMM_TUNER_COOLDOWN", "float", "30",
+       "seconds the tuner idles after a promotion or rejection"),
+    _K("DSDDMM_TUNER_GAP", "float", "0.5",
+       "runstore trigger: realized GFLOP/s below this fraction of the "
+       "plan's prediction signals a re-tune"),
+    _K("DSDDMM_TUNER_INTERVAL", "float", "2",
+       "tuner poll period in seconds (scan/shadow state machine)"),
+    _K("DSDDMM_TUNER_LANE_FRAC", "float", "0.25",
+       "padded_lane_frac gauge at/above which a generic encoding "
+       "triggers a re-tune"),
+    _K("DSDDMM_TUNER_SHADOW_N", "int", "4",
+       "bit-identical shadow replies required before a challenger "
+       "promotes"),
+    _K("DSDDMM_TUNER_TRIAL", "str", "auto",
+       "tuner trial mode: wall (harness runs), counted (deterministic "
+       "padded-lane trials), auto (wall on TPU else counted)"),
     _K("DSDDMM_WATCHDOG", "str", "off",
        "in-run anomaly monitor: warn or strict"),
     _K("DSDDMM_XLA_GATHER_BUDGET", "int", "536870912",

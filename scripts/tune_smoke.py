@@ -1,0 +1,267 @@
+"""Closed-loop tuner smoke: detect → re-measure → shadow → hot-swap.
+
+The PR-12 acceptance demo on the CPU test mesh, end to end (a tier-1
+test runs this as a subprocess):
+
+1. **adapt** — a deliberately slow incumbent (generic Pallas encoding
+   forced onto a skewed R-mat whose fingerprint selects a banked
+   variant; its bad plan seeded into a scratch plan cache) serves an
+   open-loop faulted load with the background tuner armed. The tuner
+   must detect the gap from the live ``padded_lane_frac`` gauge,
+   re-measure candidates off the request path (deterministic counted
+   trials — PR 9's own arbitration currency on this container),
+   shadow-validate the banked challenger bit-for-bit on mirrored
+   requests, and hot-swap it mid-load: replies stay bit-identical
+   through the swap, the request path performs ZERO live compiles
+   during the serving window, a finite ``time_to_adapt_s`` is
+   reported, and the plan cache now holds the banked plan for the next
+   replica.
+2. **mismatch** — the same shadow protocol with a NaN fault installed
+   at the challenger replay site (``output:tunerShadow``): promotion
+   must be BLOCKED, the ladder untouched, and a flight record dumped.
+
+Usage::
+
+    python scripts/tune_smoke.py [-o out.json]
+
+Prints one JSON report; exit 0 when every check passes, 2 otherwise
+(the 0/2 contract ``tests/test_tune_smoke.py`` pins).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import pathlib
+import sys
+import tempfile
+import time
+
+REPO = pathlib.Path(__file__).resolve().parents[1]
+sys.path.insert(0, str(REPO))
+
+
+def exit_code(report: dict) -> int:
+    """The smoke's exit contract: 0 all checks green, 2 otherwise."""
+    return 0 if report.get("ok") else 2
+
+
+def _build_bad_incumbent():
+    """A warm ALS serving stack whose strategy pays the generic
+    chunk-rounding tax the banked variants exist to remove."""
+    from distributed_sddmm_tpu.models.als import DistributedALS
+    from distributed_sddmm_tpu.ops.pallas_kernels import PallasKernel
+    from distributed_sddmm_tpu.parallel.dense_shift_15d import DenseShift15D
+    from distributed_sddmm_tpu.serve import ALSFoldInTopK, ServingEngine
+    from distributed_sddmm_tpu.utils.coo import HostCOO
+
+    # Skewed (R-mat) with a small nnz/row bucket: the population whose
+    # short rows pay one mostly-empty chunk per touched column block
+    # under the generic geometry — the fingerprint selects a banked
+    # variant here, and the counted win is >10%.
+    S = HostCOO.rmat(log_m=10, edge_factor=4, seed=0)
+    alg = DenseShift15D(
+        S, R=8, c=1, fusion_approach=2,
+        kernel=PallasKernel(precision="f32", interpret=True),
+    )
+    model = DistributedALS(alg, S_host=S)
+    model.initialize_embeddings()
+    # ingest_rows=False pins the problem fingerprint for the demo — a
+    # growing live matrix would re-key the plan cache mid-run.
+    workload = ALSFoldInTopK(model, k=5, item_buckets=(8,),
+                             ingest_rows=False)
+    engine = ServingEngine(workload, max_batch=2, max_depth=32,
+                           max_wait_ms=2.0)
+    return S, model, workload, engine
+
+
+def check_adapt(tmp: pathlib.Path) -> dict:
+    """The headline: detection, off-path re-measure, shadow, hot-swap
+    mid-load, all under an injected fault storm."""
+    import numpy as np
+
+    from distributed_sddmm_tpu.autotune.cache import PlanCache
+    from distributed_sddmm_tpu.autotune.fingerprint import Problem
+    from distributed_sddmm_tpu.resilience import FaultPlan, fault_plan
+    from distributed_sddmm_tpu.serve import run_load
+    from distributed_sddmm_tpu.tuner import BackgroundTuner, TunerConfig
+    from distributed_sddmm_tpu.tuner.loop import factory_name
+
+    S, model, workload, engine = _build_bad_incumbent()
+    cache = PlanCache(tmp / "plan_cache")
+    tuner = BackgroundTuner(
+        engine,
+        config=TunerConfig(
+            interval_s=0.1, lane_frac=0.25, shadow_samples=2,
+            cooldown_s=60.0, trial="counted",
+        ),
+        plan_cache=cache,
+    )
+    # Seed the deliberately bad plan under the problem's REAL
+    # fingerprint key (the one get_plan and the tuner's retune both
+    # compute): the generic encoding, stored as if a previous
+    # (mis)selection had committed to it — the entry the promotion
+    # must overturn in place.
+    from distributed_sddmm_tpu.autotune.fingerprint import (
+        machine_signature, make_fingerprint,
+    )
+
+    incumbent = tuner.incumbent_plan()
+    problem = Problem.from_coo(S, model.d_ops.R)
+    p, backend, kernels = machine_signature()
+    fp_key = make_fingerprint(problem, p, backend, kernels).key
+    bad = incumbent.to_dict()
+    bad["fingerprint_key"] = fp_key
+    cache.store(fp_key, bad)
+    assert cache.load(fp_key)["variant"] is None  # the bad plan is live
+
+    engine.start()
+    stats_warm = engine.stats()
+    rng = np.random.default_rng(7)
+    probes = [workload.sample_payload(rng) for _ in range(6)]
+    before = [engine.execute_now([p])[0] for p in probes]
+
+    plan = FaultPlan.from_spec("delay")
+    tuner.start()
+    try:
+        with fault_plan(plan):
+            summary = run_load(
+                engine, duration_s=6.0, rate_hz=30, seed=3, oracle_every=4,
+            )
+            # Keep draining until the promotion lands or patience runs
+            # out (the load window above usually suffices).
+            t0 = time.perf_counter()
+            while not tuner.promotions and time.perf_counter() - t0 < 20.0:
+                for p in probes:
+                    try:
+                        engine.submit(p)
+                    except Exception:  # noqa: BLE001 — shed is fine
+                        pass
+                time.sleep(0.3)
+    finally:
+        tuner.stop()
+        engine.stop()
+
+    after = [engine.execute_now([p])[0] for p in probes]
+    bit_identical = all(
+        np.array_equal(a["items"], b["items"])
+        and np.array_equal(a["scores"], b["scores"])
+        for a, b in zip(before, after)
+    )
+    stats_end = engine.stats()
+    promoted = len(tuner.promotions)
+    tta = tuner.time_to_adapt_s
+    # The promotion must land on the SAME fingerprint key the bad plan
+    # was seeded under — overturning the entry, not writing a sibling.
+    overturned = (
+        promoted
+        and tuner.promotions[0]["plan"]["fingerprint_key"] == fp_key
+    )
+    cached = cache.load(fp_key) if promoted else None
+    swapped_variant = workload.kernel_variant
+    return {
+        "name": "adapt",
+        "ok": bool(
+            promoted >= 1
+            and overturned
+            and swapped_variant is not None
+            and tta is not None and tta > 0.0
+            and bit_identical
+            and stats_end["live_compiles"] == stats_warm["live_compiles"]
+            and stats_end["ladder_swaps"] >= 1
+            and summary["oracle_failures"] == 0
+            and cached is not None
+            and cached.get("variant") == swapped_variant
+            and cached.get("algorithm") == factory_name(model.d_ops)
+        ),
+        "promotions": promoted,
+        "plan_overturned": bool(overturned),
+        "variant": swapped_variant,
+        "time_to_adapt_s": tta,
+        "bit_identical_across_swap": bit_identical,
+        "request_path_compiles": (
+            stats_end["live_compiles"] - stats_warm["live_compiles"]
+        ),
+        "ladder_swaps": stats_end["ladder_swaps"],
+        "completed": summary["completed"],
+        "oracle_failures": summary["oracle_failures"],
+        "faults_fired": len(plan.events),
+        "plan_cached": cached is not None,
+    }
+
+
+def check_mismatch(tmp: pathlib.Path) -> dict:
+    """Shadow-mismatch safety: a corrupted challenger replay must block
+    promotion and dump a flight record; the serving ladder stays on the
+    incumbent."""
+    import numpy as np
+
+    from distributed_sddmm_tpu.obs import flightrec
+    from distributed_sddmm_tpu.resilience import FaultPlan, fault_plan
+    from distributed_sddmm_tpu.tuner import ShadowSession
+    from distributed_sddmm_tpu.tuner.signals import engine_problem
+
+    S, model, workload, engine = _build_bad_incumbent()
+    from distributed_sddmm_tpu.codegen import variant_ids_for
+
+    vid = variant_ids_for(engine_problem(engine))[0]
+    engine.warmup()
+    fr = flightrec.enable(tmp / "flightrec")
+    try:
+        shadow = ShadowSession(engine, vid)
+        shadow.warm()
+        engine.attach_mirror(shadow.offer)
+        rng = np.random.default_rng(11)
+        payloads = [workload.sample_payload(rng) for _ in range(4)]
+        replies = engine.execute_now(payloads[:2])
+        shadow.offer(payloads[:2], replies, 2, 8)
+        plan = FaultPlan.from_spec(
+            '[{"site": "output:tunerShadow", "kind": "nan", "prob": 1.0}]'
+        )
+        with fault_plan(plan):
+            shadow.drain()
+        blocked = shadow.mismatches >= 1 and not shadow.clean(1)
+        dumped = len(fr.paths) >= 1
+    finally:
+        engine.detach_mirror()
+        flightrec.disable()
+    return {
+        "name": "mismatch",
+        "ok": bool(
+            blocked and dumped and engine.stats()["ladder_swaps"] == 0
+            and workload.kernel_variant is None
+        ),
+        "mismatches": shadow.mismatches,
+        "flight_records": len(fr.paths),
+        "ladder_swaps": engine.stats()["ladder_swaps"],
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("-o", "--output-file", default=None)
+    args = ap.parse_args(argv)
+
+    from distributed_sddmm_tpu.utils.platform import force_cpu_platform
+
+    force_cpu_platform(n_devices=8, replace=True)
+
+    t0 = time.perf_counter()
+    with tempfile.TemporaryDirectory() as tmpdir:
+        tmp = pathlib.Path(tmpdir)
+        checks = [check_adapt(tmp), check_mismatch(tmp)]
+
+    report = {
+        "ok": all(c["ok"] for c in checks),
+        "elapsed_s": round(time.perf_counter() - t0, 2),
+        "checks": checks,
+    }
+    text = json.dumps(report, indent=1)
+    print(text)
+    if args.output_file:
+        pathlib.Path(args.output_file).write_text(text)
+    return exit_code(report)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

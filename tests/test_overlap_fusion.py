@@ -52,11 +52,25 @@ def _check_all_modes(seq, ov):
         assert np.array_equal(np.asarray(m1), np.asarray(m2)), "fused mid"
 
 
-@pytest.mark.parametrize("unroll", [True, False])
+# Rolled (unroll=False) rows beyond one representative per strategy
+# are slow-marked: the rolled ring build is one code path whose
+# overlap/bit-identity class each strategy keeps fast at its first
+# config (plus test_rolled_overlap_als_end_to_end); every (c, fusion)
+# combo keeps its fast UNROLLED row.
 @pytest.mark.parametrize(
-    "kw", [dict(c=1, fusion_approach=2), dict(c=2, fusion_approach=2),
-           dict(c=2, fusion_approach=1)],
-    ids=["c1-f2", "c2-f2", "c2-f1"],
+    "kw,unroll",
+    [
+        (dict(c=1, fusion_approach=2), True),
+        (dict(c=2, fusion_approach=2), True),
+        (dict(c=2, fusion_approach=1), True),
+        (dict(c=1, fusion_approach=2), False),
+        pytest.param(dict(c=2, fusion_approach=2), False,
+                     marks=pytest.mark.slow),
+        pytest.param(dict(c=2, fusion_approach=1), False,
+                     marks=pytest.mark.slow),
+    ],
+    ids=["c1-f2-unrolled", "c2-f2-unrolled", "c2-f1-unrolled",
+         "c1-f2-rolled", "c2-f2-rolled", "c2-f1-rolled"],
 )
 def test_dense_shift_overlap_bit_identical(kw, unroll):
     S = _S()
@@ -64,8 +78,13 @@ def test_dense_shift_overlap_bit_identical(kw, unroll):
     _check_all_modes(seq, ov)
 
 
-@pytest.mark.parametrize("unroll", [True, False])
-@pytest.mark.parametrize("c", [1, 2])
+@pytest.mark.parametrize(
+    "c,unroll",
+    [
+        (1, True), (2, True), (1, False),
+        pytest.param(2, False, marks=pytest.mark.slow),
+    ],
+)
 def test_sparse_shift_overlap_bit_identical(c, unroll):
     S = _S()
     seq, ov = _pair(SparseShift15D, S, unroll, c=c)

@@ -30,8 +30,21 @@ def corner_matrix(n=256, nnz=600, seed=0) -> HostCOO:
     return HostCOO(rows, cols, vals, n, n).deduplicated()
 
 
-@pytest.mark.parametrize("name", sorted(ALGORITHM_FACTORIES))
-@pytest.mark.parametrize("kernel_name", ["xla", "pallas"])
+# (15d_fusion1, pallas) is slow-marked: fusion1 and fusion2 share the
+# dense-shift tile build, so the empty-tile x blocked-encoding class it
+# covers stays covered fast by (15d_fusion2, pallas); fusion1's own
+# ring structure keeps its fast xla row here and its pallas identity
+# in test_pallas_kernels.
+_CORNER_CASES = [
+    pytest.param(name, kernel_name, marks=pytest.mark.slow)
+    if (name == "15d_fusion1" and kernel_name == "pallas")
+    else (name, kernel_name)
+    for kernel_name in ("xla", "pallas")
+    for name in sorted(ALGORITHM_FACTORIES)
+]
+
+
+@pytest.mark.parametrize("name,kernel_name", _CORNER_CASES)
 def test_corner_matrix_fingerprints(name, kernel_name):
     S = corner_matrix()
     R, c = 16, 2

@@ -1,0 +1,64 @@
+"""Tier-1 smoke for the closed-loop tuner (ISSUE 12 satellite).
+
+Runs ``scripts/tune_smoke.py`` as a subprocess — the end-to-end adapt
+demo: a deliberately bad (generic-encoding) incumbent under a faulted
+serve load with the background tuner armed must detect the gap from the
+live gauges, shadow-validate the banked challenger bit-identically, and
+hot-swap it mid-load with ZERO request-path compiles and a finite
+``time_to_adapt_s``; a corrupted shadow replay must block promotion and
+dump a flight record. Exit contract 0 (all green) / 2 (any check red).
+"""
+
+import json
+import pathlib
+import subprocess
+import sys
+
+REPO = pathlib.Path(__file__).resolve().parents[1]
+SCRIPT = REPO / "scripts" / "tune_smoke.py"
+
+
+def test_tune_smoke_script(tmp_path):
+    out = tmp_path / "tune_smoke.json"
+    proc = subprocess.run(
+        [sys.executable, str(SCRIPT), "-o", str(out)],
+        capture_output=True, text=True, timeout=600, cwd=REPO,
+        env={
+            "PATH": "/usr/bin:/bin",
+            "HOME": "/tmp",
+            "JAX_PLATFORMS": "cpu",
+            "DSDDMM_RUNSTORE": "0",
+            "DSDDMM_PROGRAMS": "0",
+        },
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    report = json.loads(out.read_text())
+    assert report["ok"] is True
+    checks = {c["name"]: c for c in report["checks"]}
+
+    adapt = checks["adapt"]
+    assert adapt["promotions"] >= 1
+    assert adapt["variant"]  # the banked challenger landed
+    assert adapt["time_to_adapt_s"] > 0.0
+    assert adapt["bit_identical_across_swap"] is True
+    assert adapt["request_path_compiles"] == 0
+    assert adapt["oracle_failures"] == 0
+    assert adapt["faults_fired"] > 0  # the load really was faulted
+    assert adapt["plan_cached"] is True
+
+    mismatch = checks["mismatch"]
+    assert mismatch["mismatches"] >= 1
+    assert mismatch["flight_records"] >= 1
+    assert mismatch["ladder_swaps"] == 0  # promotion blocked
+
+
+def test_exit_code_contract():
+    """The 0/2 contract without a second subprocess run."""
+    sys.path.insert(0, str(REPO / "scripts"))
+    try:
+        import tune_smoke
+    finally:
+        sys.path.pop(0)
+    assert tune_smoke.exit_code({"ok": True}) == 0
+    assert tune_smoke.exit_code({"ok": False}) == 2
+    assert tune_smoke.exit_code({}) == 2

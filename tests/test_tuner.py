@@ -1,0 +1,615 @@
+"""Closed-loop tuner tests (PR 12): signal mining, realized re-ranking,
+counted trials, shadow safety, and the hot-swap contract.
+
+The hot-swap safety pins (ISSUE 12 satellite):
+
+* a shadow mismatch blocks promotion and dumps a flight record;
+* a swapped-in ladder serves bit-identical replies;
+* a stale/evicted challenger program can never be promoted (variant
+  generation refused at session construction AND at swap; challenger
+  store keys carry the ``v<variant>`` segment so they can never alias
+  the incumbent's entries).
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from distributed_sddmm_tpu.autotune.candidates import (
+    Candidate,
+    enumerate_candidates,
+    rank_candidates,
+    rank_candidates_realized,
+)
+from distributed_sddmm_tpu.autotune.cache import PlanCache
+from distributed_sddmm_tpu.autotune.fingerprint import Problem
+from distributed_sddmm_tpu.models.als import DistributedALS
+from distributed_sddmm_tpu.ops.pallas_kernels import PallasKernel
+from distributed_sddmm_tpu.parallel.dense_shift_15d import DenseShift15D
+from distributed_sddmm_tpu.serve import ALSFoldInTopK, ServingEngine
+from distributed_sddmm_tpu.tuner import (
+    BackgroundTuner,
+    ShadowSession,
+    StaleChallenger,
+    TunerConfig,
+    counted_trial,
+    mine_engine,
+    mine_watchdog,
+    mine_runstore,
+)
+from distributed_sddmm_tpu.tuner.loop import factory_name
+from distributed_sddmm_tpu.tuner.retune import counted_pad_frac, retune
+from distributed_sddmm_tpu.tuner.signals import engine_problem, realized_info
+from distributed_sddmm_tpu.utils.coo import HostCOO
+
+#: The smoke scenario: skewed R-mat, small nnz/row bucket — the
+#: fingerprint selects a banked variant and the counted win is >10%.
+LOG_M, EDGE_FACTOR, R = 10, 4, 8
+
+
+@pytest.fixture(scope="module")
+def stack():
+    """One warm generic-Pallas ALS serving stack shared by the module
+    (strategy build + ladder warmup dominate this suite's cost)."""
+    S = HostCOO.rmat(log_m=LOG_M, edge_factor=EDGE_FACTOR, seed=0)
+    alg = DenseShift15D(
+        S, R=R, c=1, fusion_approach=2,
+        kernel=PallasKernel(precision="f32", interpret=True),
+    )
+    model = DistributedALS(alg, S_host=S)
+    model.initialize_embeddings()
+    workload = ALSFoldInTopK(model, k=5, item_buckets=(8,),
+                             ingest_rows=False)
+    engine = ServingEngine(workload, max_batch=2, max_depth=32,
+                           max_wait_ms=2.0)
+    engine.warmup()
+    yield S, model, workload, engine
+    engine.detach_mirror()
+
+
+@pytest.fixture()
+def problem(stack):
+    S, model, _w, _e = stack
+    return Problem.from_coo(S, model.d_ops.R)
+
+
+# --------------------------------------------------------------------- #
+# Signals
+# --------------------------------------------------------------------- #
+
+
+class TestSignals:
+    def test_generic_incumbent_with_high_gauge_signals(self, stack):
+        _S, _m, _w, engine = stack
+        info = realized_info(engine)
+        assert info["variant"] is None
+        assert info["padded_lane_frac"] > 0.25
+        sigs = mine_engine(engine, lane_frac_threshold=0.25)
+        assert len(sigs) == 1
+        assert sigs[0].kind == "padded_lanes"
+        assert sigs[0].severity == pytest.approx(
+            info["padded_lane_frac"]
+        )
+
+    def test_threshold_respected(self, stack):
+        _S, _m, _w, engine = stack
+        assert mine_engine(engine, lane_frac_threshold=0.99) == []
+
+    def test_engine_problem_resolves(self, stack):
+        S, _m, _w, engine = stack
+        prob = engine_problem(engine)
+        assert (prob.M, prob.nnz, prob.R) == (S.M, S.nnz, R)
+
+    def test_watchdog_waste_anomalies_signal(self):
+        from distributed_sddmm_tpu.obs.watchdog import Watchdog
+
+        wd = Watchdog(mode="warn")
+        wd.check_xla_costs(
+            {"fusedSpMM": {"calls": 4, "flops": 4.0}},
+            {"fusedSpMM": {"flops_per_call": 64.0}},  # 64x waste
+        )
+        sigs = mine_watchdog(wd)
+        assert [s.kind for s in sigs] == ["xla_waste"]
+        # The cursor suppresses already-acted-on anomalies.
+        assert mine_watchdog(wd, since=len(wd.events)) == []
+
+    def test_mine_xla_live_waste_signal(self, monkeypatch):
+        """The live xla_waste read: flags compiled-FLOPs blowup over
+        dispatched ops without recording watchdog anomalies, and the
+        caller-owned `seen` set dedups across scans."""
+        from types import SimpleNamespace
+
+        from distributed_sddmm_tpu import programs
+        from distributed_sddmm_tpu.obs.metrics import OpMetrics
+        from distributed_sddmm_tpu.tuner.signals import mine_xla
+
+        m = OpMetrics()
+        m.record("fusedSpMM", kernel_s=0.01, flops=100.0)
+        eng = SimpleNamespace(workload=SimpleNamespace(
+            model=SimpleNamespace(d_ops=SimpleNamespace(metrics=m))
+        ))
+        monkeypatch.setattr(
+            programs, "xla_cost_summary",
+            lambda ops, since=0: {
+                "ops": {"fusedSpMM": {"flops_per_call": 1e9}}
+            },
+        )
+        seen = set()
+        sigs = mine_xla(eng, seen=seen)
+        assert [s.kind for s in sigs] == ["xla_waste"]
+        assert sigs[0].op == "fusedSpMM"
+        assert mine_xla(eng, seen=seen) == []  # deduped
+        # Under the waste band: silent.
+        monkeypatch.setattr(
+            programs, "xla_cost_summary",
+            lambda ops, since=0: {
+                "ops": {"fusedSpMM": {"flops_per_call": 200.0}}
+            },
+        )
+        assert mine_xla(eng, seen=set()) == []
+
+    def test_runstore_gap_signal(self, problem, tmp_path):
+        from distributed_sddmm_tpu.obs.store import RunStore
+
+        store = RunStore(tmp_path / "rs")
+        rec = {
+            "app": "vanilla", "algorithm": "15d_fusion2", "R": problem.R,
+            "c": 1, "fused": True, "elapsed": 1.0,
+            "overall_throughput": 0.5, "metrics": {},
+            "alg_info": {"m": problem.M, "n": problem.N,
+                         "nnz": problem.nnz, "p": 8},
+        }
+        doc = store.ingest_record(dict(rec), source="er")
+        doc["key"] = "fp-under-test"
+        # predicted 1 GFLOP/s-equivalent pair time; realized 0.5 -> gap.
+        flops = 4.0 * problem.nnz * problem.R
+        predicted_ms = flops / (10.0 * 1e9) * 1e3  # model says 10 GF/s
+        rows = store.history()
+        assert rows  # the store indexed the record
+        sigs = mine_runstore(
+            store, rows[0]["key"], problem, predicted_ms, gap_factor=0.5
+        )
+        assert sigs and sigs[0].kind == "runstore_gap"
+        # A realized number at/over the gap threshold stays silent.
+        assert mine_runstore(
+            store, rows[0]["key"], problem, predicted_ms, gap_factor=0.01
+        ) == []
+
+
+# --------------------------------------------------------------------- #
+# Realized re-ranking + counted trials (autotune/ + tuner/retune.py)
+# --------------------------------------------------------------------- #
+
+
+class TestRetune:
+    def test_counted_banked_beats_generic_on_skewed(self, stack, problem):
+        S = stack[0]
+        gen = Candidate("15d_fusion2", 1, kernel="pallas")
+        from distributed_sddmm_tpu.codegen import variant_ids_for
+
+        vid = variant_ids_for(problem)[0]
+        banked = Candidate("15d_fusion2", 1, kernel="pallas", variant=vid)
+        assert counted_pad_frac(S, banked) < counted_pad_frac(S, gen)
+        tg = counted_trial(S, problem, gen, 1, 0)["overall_throughput"]
+        tb = counted_trial(S, problem, banked, 1, 0)["overall_throughput"]
+        assert tb > tg * 1.05
+
+    def test_xla_candidates_count_zero_lanes(self, stack, problem):
+        S = stack[0]
+        assert counted_pad_frac(S, Candidate("15d_fusion2", 1)) == 0.0
+
+    def test_realized_reranking_prefers_banked(self, problem):
+        cands = enumerate_candidates(problem, 8, ("pallas",))
+        cands = [c for c in cands if c.algorithm == "15d_fusion2"
+                 and c.c == 1]
+        assert any(c.variant for c in cands)
+        # Without realized data: identical to the model ranking.
+        plain = rank_candidates_realized(problem, cands, 8)
+        assert [c for c, _ in plain] == [
+            c for c, _ in rank_candidates(problem, cands, 8)
+        ]
+        # With a high realized generic pad gauge, the banked variant
+        # must lead the measure-first ordering.
+        ranked = rank_candidates_realized(
+            problem, cands, 8,
+            realized={"variant": None, "padded_lane_frac": 0.9},
+        )
+        assert ranked[0][0].variant is not None
+
+    def test_realized_data_for_banked_incumbent_is_ignored(self, problem):
+        cands = enumerate_candidates(problem, 8, ("pallas",))
+        a = rank_candidates_realized(
+            problem, cands, 8,
+            realized={"variant": "v1.rb4.rs", "padded_lane_frac": 0.9},
+        )
+        b = rank_candidates(problem, cands, 8)
+        assert [c for c, _ in a] == [c for c, _ in b]
+
+    def test_retune_returns_banked_challenger(self, stack, problem):
+        S, model, _w, engine = stack
+        tuner = BackgroundTuner(
+            engine, config=TunerConfig(trial="counted"),
+            plan_cache=PlanCache("/nonexistent-never-written"),
+        )
+        incumbent = tuner.incumbent_plan()
+        assert incumbent.algorithm == "15d_fusion2"
+        assert incumbent.kernel == "pallas"
+        ch = retune(
+            problem, incumbent, S,
+            realized=realized_info(engine),
+            hot_swappable=True, trial_fn=counted_trial,
+        )
+        assert ch is not None and ch.variant is not None
+        assert ch.source == "tuned"
+        # Hot-swappable space: same algorithm, c, kernel family.
+        assert (ch.algorithm, ch.c, ch.kernel) == (
+            incumbent.algorithm, incumbent.c, incumbent.kernel
+        )
+
+    def test_factory_name_round_trip(self, stack):
+        assert factory_name(stack[1].d_ops) == "15d_fusion2"
+
+
+# --------------------------------------------------------------------- #
+# Shadow safety + hot-swap contract
+# --------------------------------------------------------------------- #
+
+
+def _mirror_one_group(engine, workload, n=2, seed=5):
+    rng = np.random.default_rng(seed)
+    payloads = [workload.clamp(workload.sample_payload(rng))
+                for _ in range(n)]
+    replies = engine.execute_now(payloads)
+    return payloads, replies
+
+
+class TestShadowSafety:
+    def test_clean_shadow_validates_bit_identically(self, stack, problem):
+        from distributed_sddmm_tpu.codegen import variant_ids_for
+
+        _S, _m, workload, engine = stack
+        vid = variant_ids_for(problem)[0]
+        shadow = ShadowSession(engine, vid)
+        assert shadow.warm() == 2
+        payloads, replies = _mirror_one_group(engine, workload)
+        shadow.offer(payloads, replies, 2, 8)
+        assert shadow.drain() == 1
+        assert shadow.mismatches == 0 and shadow.ok == len(payloads)
+        assert shadow.clean(len(payloads))
+
+    def test_mismatch_blocks_promotion_and_dumps_flight_record(
+        self, stack, problem, tmp_path,
+    ):
+        from distributed_sddmm_tpu.codegen import variant_ids_for
+        from distributed_sddmm_tpu.obs import flightrec
+        from distributed_sddmm_tpu.resilience import FaultPlan, fault_plan
+
+        _S, _m, workload, engine = stack
+        swaps_before = engine.stats()["ladder_swaps"]
+        vid = variant_ids_for(problem)[0]
+        fr = flightrec.enable(tmp_path / "fr")
+        try:
+            shadow = ShadowSession(engine, vid)
+            shadow.warm()
+            payloads, replies = _mirror_one_group(engine, workload)
+            shadow.offer(payloads, replies, 2, 8)
+            plan = FaultPlan.from_spec(
+                '[{"site": "output:tunerShadow", "kind": "nan", '
+                '"prob": 1.0}]'
+            )
+            with fault_plan(plan):
+                shadow.drain()
+        finally:
+            flightrec.disable()
+        assert shadow.mismatches == 1
+        assert not shadow.clean(1)
+        assert shadow.mismatch_detail["reason"] == "reply_diverged"
+        # The flight record landed and is valid JSON naming the anomaly.
+        assert len(fr.paths) == 1
+        dump = json.loads(
+            open(fr.paths[0]).read()  # noqa: SIM115
+        )
+        assert dump["anomaly"]["kind"] == "tuner_shadow_mismatch"
+        # Promotion blocked: the live ladder was never touched.
+        assert engine.stats()["ladder_swaps"] == swaps_before
+        assert workload.kernel_variant is None
+
+    def test_stale_variant_refused_at_session_and_swap(self, stack):
+        _S, _m, _w, engine = stack
+        with pytest.raises(StaleChallenger):
+            ShadowSession(engine, "v99.rb8.rm")
+        cells = {
+            (bb, ib): object()
+            for bb in engine.batch_buckets
+            for ib in engine.workload.inner_buckets
+        }
+        with pytest.raises(ValueError):
+            engine.swap_ladder(cells, "v99.rb8.rm")
+        assert engine.stats()["ladder_swaps"] == 0
+
+    def test_partial_challenger_ladder_refused(self, stack, problem):
+        from distributed_sddmm_tpu.codegen import variant_ids_for
+
+        _S, _m, _w, engine = stack
+        vid = variant_ids_for(problem)[0]
+        with pytest.raises(ValueError, match="missing cells"):
+            engine.swap_ladder({(1, 8): object()}, vid)
+
+    def test_challenger_keys_never_alias_incumbent(self, stack, problem):
+        from distributed_sddmm_tpu.codegen import variant_ids_for
+        from distributed_sddmm_tpu.programs.keys import parse_serve_key
+
+        _S, _m, _w, engine = stack
+        vid = variant_ids_for(problem)[0]
+        inc = engine.program_key(2, 8, sig="abc")
+        ch = engine.program_key(2, 8, sig="abc", variant=vid)
+        assert inc != ch
+        parsed = parse_serve_key(ch)
+        assert parsed["variant"] == vid
+        assert "variant" not in parse_serve_key(inc)
+
+    def test_challenger_store_entries_isolated(self, stack, problem,
+                                               tmp_path):
+        """Through a real program store: incumbent and challenger warm
+        under disjoint keys; evicting the challenger's entries can only
+        ever force a recompile under its own key, never a foreign hit."""
+        from distributed_sddmm_tpu.codegen import variant_ids_for
+        from distributed_sddmm_tpu.programs import ProgramStore
+        from distributed_sddmm_tpu.programs.keys import parse_serve_key
+
+        S, model, _w, _e = stack
+        store = ProgramStore(tmp_path / "programs")
+        workload = ALSFoldInTopK(model, k=5, item_buckets=(8,),
+                                 ingest_rows=False)
+        engine = ServingEngine(workload, max_batch=2, max_depth=8,
+                               max_wait_ms=2.0, program_store=store)
+        engine.warmup()
+        vid = variant_ids_for(problem)[0]
+        shadow = ShadowSession(engine, vid)
+        shadow.warm()
+        keys = [row["key"] for row in store.index()]
+        inc_keys = {k for k in keys
+                    if "variant" not in (parse_serve_key(k) or {})}
+        ch_keys = {k for k in keys
+                   if (parse_serve_key(k) or {}).get("variant") == vid}
+        assert inc_keys and ch_keys and not (inc_keys & ch_keys)
+        # Evicted challenger entries disappear from the store without
+        # touching the incumbent's.
+        for k in ch_keys:
+            store.evict(k)
+        left = {row["key"] for row in store.index()}
+        assert inc_keys <= left and not (ch_keys & left)
+
+
+# --------------------------------------------------------------------- #
+# The full loop: detect -> measure -> shadow -> promote
+# --------------------------------------------------------------------- #
+
+
+class TestFullCycle:
+    def test_promotion_is_bit_identical_and_compile_free(self, tmp_path):
+        """A dedicated stack (the swap mutates workload/engine state the
+        shared fixture must keep pristine)."""
+        S = HostCOO.rmat(log_m=LOG_M, edge_factor=EDGE_FACTOR, seed=0)
+        alg = DenseShift15D(
+            S, R=R, c=1, fusion_approach=2,
+            kernel=PallasKernel(precision="f32", interpret=True),
+        )
+        model = DistributedALS(alg, S_host=S)
+        model.initialize_embeddings()
+        workload = ALSFoldInTopK(model, k=5, item_buckets=(8,),
+                                 ingest_rows=False)
+        engine = ServingEngine(workload, max_batch=2, max_depth=32,
+                               max_wait_ms=2.0)
+        cache = PlanCache(tmp_path / "plans")
+        tuner = BackgroundTuner(
+            engine,
+            config=TunerConfig(interval_s=0.01, lane_frac=0.25,
+                               shadow_samples=2, cooldown_s=0.0,
+                               trial="counted"),
+            plan_cache=cache,
+        )
+        engine.warmup()
+        stats0 = engine.stats()
+        rng = np.random.default_rng(3)
+        probes = [workload.sample_payload(rng) for _ in range(4)]
+        before = [engine.execute_now([p])[0] for p in probes]
+
+        assert tuner.step() == "shadow"  # scan -> measure -> shadow arm
+        assert tuner.challenger.variant is not None
+        # Mirror traffic through the real serve path, then drain.
+        engine.start(warmup=False)
+        try:
+            import time
+
+            for _ in range(40):
+                for p in probes:
+                    engine.submit(p)
+                time.sleep(0.05)
+                if tuner.step() == "scan":
+                    break
+        finally:
+            engine.stop()
+        assert len(tuner.promotions) == 1, tuner.rejects
+        promo = tuner.promotions[0]
+        assert promo["time_to_adapt_s"] > 0
+        assert tuner.time_to_adapt_s == promo["time_to_adapt_s"]
+        # Bit-identical replies across the swap; no request-path
+        # compiles; the ladder swap is recorded.
+        after = [engine.execute_now([p])[0] for p in probes]
+        assert all(
+            np.array_equal(a["items"], b["items"])
+            and np.array_equal(a["scores"], b["scores"])
+            for a, b in zip(before, after)
+        )
+        stats1 = engine.stats()
+        assert stats1["live_compiles"] == stats0["live_compiles"]
+        assert stats1["ladder_swaps"] == 1
+        assert workload.kernel_variant == promo["plan"]["variant"]
+        # The plan cache now serves the tuned plan to the next replica.
+        cached = cache.load(promo["plan"]["fingerprint_key"])
+        assert cached["variant"] == promo["plan"]["variant"]
+        assert cached["source"] == "tuned"
+        # Telemetry snapshot exposes the tuner state.
+        from distributed_sddmm_tpu.obs.telemetry import engine_snapshot
+
+        snap = engine_snapshot(engine)
+        assert snap["tuner"]["promotions"] == 1
+        assert snap["tuner"]["time_to_adapt_s"] == promo["time_to_adapt_s"]
+        # The serve-record summary carries the promotions list.
+        summary = tuner.summary()
+        assert summary["promotions"] and summary["time_to_adapt_s"]
+        # Convergence: with the workload restamped and model.plan set,
+        # the same gap must NOT re-trigger — the next scan finds no
+        # signal and arms nothing (cooldown zeroed to prove it is the
+        # signal logic, not the timer, that stops the loop).
+        tuner._cool_until = 0.0
+        assert tuner.step() == "scan"
+        assert tuner.challenger is None
+        assert len(tuner.promotions) == 1
+        assert tuner.incumbent_plan().variant == promo["plan"]["variant"]
+
+    def test_no_signal_stays_idle(self, stack):
+        _S, _m, _w, engine = stack
+        tuner = BackgroundTuner(
+            engine,
+            config=TunerConfig(lane_frac=0.99, cooldown_s=0.0,
+                               trial="counted", gap_factor=0.0),
+            plan_cache=PlanCache("/nonexistent-never-written"),
+        )
+        assert tuner.step() == "scan"
+        assert tuner.challenger is None and not tuner.promotions
+
+    def test_budget_exhaustion_is_terminal(self, stack):
+        """Structural signals re-fire every scan; once the measurement
+        budget is gone the tuner retires instead of appending an
+        identical reject every cooldown for the replica's life."""
+        _S, _m, _w, engine = stack
+        tuner = BackgroundTuner(
+            engine,
+            config=TunerConfig(lane_frac=0.25, cooldown_s=0.0,
+                               budget_s=0.0, trial="counted"),
+            plan_cache=PlanCache("/nonexistent-never-written"),
+        )
+        assert tuner.step() == "exhausted"
+        assert tuner.rejects[-1]["reason"] == "measure_budget_exhausted"
+        n = len(tuner.rejects)
+        assert tuner.step() == "exhausted"  # terminal: a no-op
+        assert len(tuner.rejects) == n
+
+    def test_shadow_timeout_returns_mirror(self, stack, problem):
+        """A shadow session whose mirrored traffic dries up must be
+        abandoned, not held (with the mirror attached) forever."""
+        from distributed_sddmm_tpu.codegen import variant_ids_for
+
+        _S, _m, _w, engine = stack
+        tuner = BackgroundTuner(
+            engine,
+            config=TunerConfig(cooldown_s=0.0, trial="counted",
+                               shadow_timeout_s=0.0, shadow_samples=99),
+            plan_cache=PlanCache("/nonexistent-never-written"),
+        )
+        shadow = ShadowSession(engine, variant_ids_for(problem)[0])
+        tuner.shadow = shadow
+        tuner.state = "shadow"
+        engine.attach_mirror(shadow.offer)
+        assert tuner.step() == "scan"
+        assert tuner.rejects[-1]["reason"] == "shadow_timeout"
+        assert engine._mirror is None  # mirror handed back
+        assert not tuner.promotions
+
+
+# --------------------------------------------------------------------- #
+# Config, gate axis, CLI
+# --------------------------------------------------------------------- #
+
+
+class TestConfigAndSurfaces:
+    def test_config_from_env(self, monkeypatch):
+        monkeypatch.setenv("DSDDMM_TUNER_INTERVAL", "0.5")
+        monkeypatch.setenv("DSDDMM_TUNER_LANE_FRAC", "0.4")
+        monkeypatch.setenv("DSDDMM_TUNER_SHADOW_N", "9")
+        monkeypatch.setenv("DSDDMM_TUNER_BUDGET", "12")
+        monkeypatch.setenv("DSDDMM_TUNER_COOLDOWN", "3")
+        monkeypatch.setenv("DSDDMM_TUNER_GAP", "0.7")
+        monkeypatch.setenv("DSDDMM_TUNER_TRIAL", "counted")
+        cfg = TunerConfig.from_env()
+        assert (cfg.interval_s, cfg.lane_frac, cfg.shadow_samples,
+                cfg.budget_s, cfg.cooldown_s, cfg.gap_factor,
+                cfg.trial) == (0.5, 0.4, 9, 12.0, 3.0, 0.7, "counted")
+        assert cfg.trial_fn() is counted_trial
+
+    def test_time_to_adapt_gate_axis(self):
+        from distributed_sddmm_tpu.obs import regress
+
+        doc = {"record": {
+            "requests": 10, "time_to_adapt_s": 2.5,
+            "tuner": {"promotions": [{"time_to_adapt_s": 2.5}]},
+        }}
+        rows = regress.phase_stats(doc)
+        assert rows["tuner:time_to_adapt"]["t_call"] == 2.5
+        # Optional axis: a doc without the field compares as
+        # not-measured, never "missing".
+        report = regress.compare(
+            {"record": {"requests": 10}}, doc_a=doc
+        )
+        assert (report["phases"]["tuner:time_to_adapt"]["verdict"]
+                == "not-measured")
+        assert report["verdict"] != "regression"
+        # A slower adaptation regresses with tuner attribution.
+        slow = {"record": {
+            "requests": 10, "time_to_adapt_s": 25.0,
+            "tuner": {"promotions": [{"time_to_adapt_s": 25.0}]},
+        }}
+        report = regress.compare(slow, doc_a=doc)
+        row = report["phases"]["tuner:time_to_adapt"]
+        assert row["verdict"] == "regression"
+        assert row["attribution"] == "tuner"
+
+    def test_tuner_counters_declared_for_export(self):
+        from distributed_sddmm_tpu.obs.httpexp import KNOWN_GLOBAL_COUNTERS
+
+        for name in ("tuner_scans", "tuner_signals", "tuner_retunes",
+                     "tuner_shadow_replays", "tuner_shadow_mismatches",
+                     "tuner_promotions", "tuner_rejects"):
+            assert name in KNOWN_GLOBAL_COUNTERS
+
+    def test_bench_tune_cli(self, monkeypatch, tmp_path, capsys):
+        from distributed_sddmm_tpu.bench import cli
+
+        monkeypatch.setenv("DSDDMM_PLAN_CACHE", str(tmp_path / "plans"))
+        rc = cli.main([
+            "tune", "6", "4", "8", "--trial", "counted", "--json",
+        ])
+        assert rc == 0
+        report = json.loads(capsys.readouterr().out)
+        assert report["incumbent"]["algorithm"]
+        assert "promoted" in report
+
+    def test_bench_tune_dry_run_writes_nothing(self, monkeypatch,
+                                               tmp_path, capsys):
+        """--dry-run must leave the plan cache byte-untouched — even
+        get_plan's store-on-miss goes to a throwaway cache."""
+        from distributed_sddmm_tpu.bench import cli
+
+        cache_dir = tmp_path / "plans-dry"
+        monkeypatch.setenv("DSDDMM_PLAN_CACHE", str(cache_dir))
+        rc = cli.main([
+            "tune", "6", "4", "8", "--trial", "counted", "--json",
+            "--dry-run",
+        ])
+        assert rc == 0
+        report = json.loads(capsys.readouterr().out)
+        assert report["dry_run"] is True and report["promoted"] is False
+        assert not (cache_dir.exists() and list(cache_dir.glob("*.json")))
+
+    def test_tuner_knobs_registered(self):
+        from distributed_sddmm_tpu.utils import envreg
+
+        for name in ("DSDDMM_TUNER", "DSDDMM_TUNER_INTERVAL",
+                     "DSDDMM_TUNER_LANE_FRAC", "DSDDMM_TUNER_SHADOW_N",
+                     "DSDDMM_TUNER_BUDGET", "DSDDMM_TUNER_COOLDOWN",
+                     "DSDDMM_TUNER_GAP", "DSDDMM_TUNER_TRIAL"):
+            assert name in envreg.KNOBS
