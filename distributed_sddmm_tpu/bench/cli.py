@@ -177,6 +177,10 @@ def _run_configs(S, alg_names, args, r_values=None):
                             checkpoint_every=getattr(args, "checkpoint_every", 1),
                             resume=getattr(args, "resume", False),
                             overlap=getattr(args, "fusion", None) == "overlap",
+                            mask=(
+                                getattr(args, "mask", None)
+                                if args.app == "attention" else None
+                            ),
                         )
                 except ValueError as e:
                     # Divisibility constraints differ per algorithm
@@ -200,7 +204,19 @@ def _run_configs(S, alg_names, args, r_values=None):
 
 
 def _add_common(p: argparse.ArgumentParser) -> None:
-    p.add_argument("--app", default="vanilla", choices=["vanilla", "gat", "als"])
+    p.add_argument(
+        "--app", default="vanilla",
+        choices=["vanilla", "gat", "als", "attention"],
+    )
+    p.add_argument(
+        "--mask", default="window:16", metavar="SPEC",
+        help="with --app attention: the block-sparse mask family — "
+        "window:<w> (sliding window), bigbird:w=..,g=..,r=.. "
+        "(window + global + random), or graph (the generated/loaded "
+        "matrix's pattern, the GAT adjacency path); the benchmark "
+        "matrix becomes the mask and the spec rides into records as a "
+        "gate config axis (distributed_sddmm_tpu/masks.py)",
+    )
     p.add_argument("--trials", type=int, default=5)
     p.add_argument("--warmup", type=int, default=1)
     p.add_argument("--kernel", default="auto", help="xla | pallas | auto")
@@ -372,7 +388,19 @@ def build_parser() -> argparse.ArgumentParser:
         "SLO-gated latency report (serve/); the record persists to the "
         "run store so `bench gate` regresses p99/shed-rate",
     )
-    sv.add_argument("--app", default="als", choices=["als", "gat"])
+    sv.add_argument("--app", default="als",
+                    choices=["als", "gat", "attention"])
+    sv.add_argument(
+        "--mask", default="window:16", metavar="SPEC",
+        help="with --app attention: block-sparse mask family for the "
+        "warm context (window:<w> | bigbird:w=..,g=..,r=.. | graph — "
+        "graph uses the generated R-mat's pattern)",
+    )
+    sv.add_argument(
+        "--window", type=int, default=None, metavar="W",
+        help="with --app attention: per-request sliding-window "
+        "half-width (default DSDDMM_ATTN_SERVE_WINDOW)",
+    )
     sv.add_argument("--log-m", type=int, default=8, help="log2 matrix side")
     sv.add_argument("--edge-factor", type=int, default=8)
     sv.add_argument("--R", type=int, default=16)
@@ -1103,10 +1131,13 @@ def _dispatch_serve(args) -> int:
     from distributed_sddmm_tpu.obs import watchdog as obs_watchdog
     from distributed_sddmm_tpu.resilience import faults
     from distributed_sddmm_tpu.serve import (
-        SLOSpec, build_als_engine, build_gat_engine, run_load,
+        SLOSpec, build_als_engine, build_attention_engine,
+        build_gat_engine, run_load,
     )
 
     S = HostCOO.rmat(log_m=args.log_m, edge_factor=args.edge_factor, seed=0)
+    if args.app == "attention":
+        S = _maybe_mask(S, args)
     slo = SLOSpec.parse(args.slo) if args.slo else SLOSpec.from_env()
     engine_kw = dict(
         max_batch=args.max_batch, max_depth=args.max_depth,
@@ -1123,6 +1154,11 @@ def _dispatch_serve(args) -> int:
         eng = build_als_engine(
             S, R=args.R, train_steps=args.train_steps, k=args.k,
             plan_mode=args.plan_mode, **engine_kw,
+        )
+    elif args.app == "attention":
+        eng = build_attention_engine(
+            S, R=args.R, window=args.window, plan_mode=args.plan_mode,
+            seed=args.seed, **engine_kw,
         )
     else:
         eng = build_gat_engine(
@@ -1214,6 +1250,7 @@ def _dispatch_serve(args) -> int:
     record = {
         "app": f"serve-{args.app}",
         "algorithm": plan.algorithm if plan else d_ops.algorithm_name,
+        "mask": args.mask if args.app == "attention" else None,
         "R": args.R,
         "c": plan.c if plan else d_ops.c,
         "fused": True,
@@ -1309,25 +1346,38 @@ def _dispatch_serve(args) -> int:
     return 0
 
 
+def _maybe_mask(S, args):
+    """With ``--app attention`` the benchmark matrix IS the mask: build
+    it from the --mask spec over the generated/loaded matrix's token
+    count (``graph`` keeps the matrix's own pattern — the GAT adjacency
+    path)."""
+    if getattr(args, "app", None) != "attention":
+        return S
+    from distributed_sddmm_tpu import masks
+
+    return masks.from_spec(args.mask, n=max(S.M, S.N), graph=S)
+
+
 def _dispatch(args) -> int:
     if args.cmd == "serve":
         return _dispatch_serve(args)
 
     if args.cmd == "er":
         S = HostCOO.rmat(log_m=args.log_m, edge_factor=args.edge_factor, seed=0)
-        _run_configs(S, _resolve_algs(args.alg), args)
+        _run_configs(_maybe_mask(S, args), _resolve_algs(args.alg), args)
         return 0
 
     if args.cmd == "file":
         S = HostCOO.load_mtx(args.path)
         if args.permute:
             S = S.random_permuted(seed=0)
-        _run_configs(S, _resolve_algs(args.alg), args)
+        _run_configs(_maybe_mask(S, args), _resolve_algs(args.alg), args)
         return 0
 
     if args.cmd == "heatmap":
         S = HostCOO.rmat(log_m=args.log_m, edge_factor=args.edge_factor, seed=0)
-        _run_configs(S, _resolve_algs(args.alg), args, r_values=args.r_values)
+        _run_configs(_maybe_mask(S, args), _resolve_algs(args.alg), args,
+                     r_values=args.r_values)
         return 0
 
     if args.cmd == "permute":

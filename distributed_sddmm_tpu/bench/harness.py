@@ -63,6 +63,15 @@ GAT_REFERENCE_LAYERS = [(256, 256, 4), (1024, 256, 4), (1536, 256, 6)]
 #: configuration error the sweep driver's skip logic reports.
 OVERLAP_CAPABLE = ("15d_fusion1", "15d_fusion2", "15d_sparse")
 
+#: Strategies with a fused block-sparse attention program (``--app
+#: attention``): the 1.5D DENSE-shift pair only. The softmax row
+#: denominator needs every logit of its row before any SpMM
+#: contribution flows, which the dense-shift layout satisfies between
+#: its two ring passes; the sparse-shift and Cannon layouts move the
+#: values/structure with the ring, so the denominator cannot ride the
+#: traveling accumulator — same gating pattern as ``--fusion overlap``.
+ATTENTION_CAPABLE = ("15d_fusion1", "15d_fusion2")
+
 
 def make_algorithm(
     name: str,
@@ -72,11 +81,13 @@ def make_algorithm(
     kernel=None,
     devices=None,
     overlap: bool = False,
+    attention: bool = False,
     **kw,
 ) -> DistributedSparse:
     """Instantiate one of the five named algorithm configurations.
     ``overlap=True`` selects the double-buffered local-kernel-overlap
-    ring programs (shift strategies only)."""
+    ring programs (shift strategies only); ``attention=True`` asserts
+    the strategy can run the fused block-sparse attention pair."""
     if name not in ALGORITHM_FACTORIES:
         raise ValueError(
             f"unknown algorithm {name!r}; available: {sorted(ALGORITHM_FACTORIES)}"
@@ -89,6 +100,12 @@ def make_algorithm(
                 "double-buffered variant"
             )
         kw["overlap"] = True
+    if attention and name not in ATTENTION_CAPABLE:
+        raise ValueError(
+            f"fused attention is implemented for the 1.5D dense-shift "
+            f"strategies {ATTENTION_CAPABLE}; {name} cannot carry the "
+            "softmax row denominator on its traveling accumulator"
+        )
     return ALGORITHM_FACTORIES[name](S, R, c, kernel=kernel, devices=devices, **kw)
 
 
@@ -132,6 +149,72 @@ def _run_vanilla(alg: DistributedSparse, fused: bool, trials: int, warmup: int):
     force_fetch(out)
     elapsed = time.perf_counter() - t0
     return elapsed, {}
+
+
+def _array_bytes(*arrays) -> int:
+    """Total bytes of device arrays (shape x itemsize — the unit one
+    HBM read or write of the buffer costs)."""
+    total = 0
+    for a in arrays:
+        total += int(a.size) * int(a.dtype.itemsize)
+    return total
+
+
+def _attention_hbm_bytes(alg, s_vals, A=None, B=None) -> dict:
+    """Counted HBM traffic at the program I/O boundary, fused vs
+    unfused (PR 9 counted-metric precedent: structural bytes, not
+    wall-clock). Every compiled program reads its inputs from HBM and
+    writes its outputs back once per dispatch; the unfused
+    SDDMM → softmax → SpMM sequence is three programs, so the logits
+    and weights round-trip through HBM between stages and the dense
+    moving operand plus tile structure are re-read per stage. The fused
+    program reads everything once and writes only (out, probs) — the
+    strict cut the acceptance gate asserts. Pass the trial loop's
+    ``A``/``B`` when they already exist; only shape/itemsize is read."""
+    if A is None:
+        A = alg.dummy_initialize(MatMode.A)
+    if B is None:
+        B = alg.dummy_initialize(MatMode.B)
+    targs = alg._tile_args(alg.S_tiles, s_vals)
+    dense_out = A  # output rides A's sharding/shape
+    fused = _array_bytes(A, B, *targs) + _array_bytes(dense_out, s_vals)
+    sddmm = _array_bytes(A, B, *targs) + _array_bytes(s_vals)
+    softmax = _array_bytes(*targs, s_vals) + _array_bytes(s_vals)
+    spmm = _array_bytes(B, *targs) + _array_bytes(dense_out)
+    unfused = sddmm + softmax + spmm
+    return {
+        "fused_bytes": fused,
+        "unfused_bytes": unfused,
+        "savings_frac": 1.0 - fused / max(unfused, 1),
+    }
+
+
+def _run_attention(alg: DistributedSparse, fused: bool, trials: int,
+                   warmup: int):
+    """Fused block-sparse attention trials (or the three-program
+    unfused baseline with ``fused=False``); the stats carry the counted
+    HBM-traffic comparison either way."""
+    A = alg.dummy_initialize(MatMode.A)
+    B = alg.dummy_initialize(MatMode.B)
+    s_vals = alg.like_s_values(1.0)
+
+    def one_trial():
+        if fused:
+            return alg.fused_attention(A, B, s_vals)
+        return alg.attention_unfused(A, B, s_vals)
+
+    for _ in range(warmup):
+        force_fetch(one_trial())
+    alg.reset_performance_timers()
+    t0 = time.perf_counter()
+    out = None
+    for _ in range(trials):
+        out = one_trial()
+    force_fetch(out)
+    elapsed = time.perf_counter() - t0
+    return elapsed, {
+        "attention_hbm": _attention_hbm_bytes(alg, s_vals, A=A, B=B)
+    }
 
 
 def _run_gat(alg: DistributedSparse, trials: int, warmup: int, num_layers: int):
@@ -200,6 +283,7 @@ def benchmark_algorithm(
     checkpoint_every: int = 1,
     resume: bool = False,
     overlap: bool = False,
+    mask: Optional[str] = None,
 ) -> dict:
     """Run one benchmark configuration; append a JSON record to
     ``output_file`` (if given) and return it.
@@ -217,8 +301,10 @@ def benchmark_algorithm(
     from distributed_sddmm_tpu.obs import watchdog as obs_watchdog
     from distributed_sddmm_tpu.resilience import faults
 
-    if app not in ("vanilla", "gat", "als"):
-        raise ValueError(f"unknown app {app!r}; expected vanilla | gat | als")
+    if app not in ("vanilla", "gat", "als", "attention"):
+        raise ValueError(
+            f"unknown app {app!r}; expected vanilla | gat | als | attention"
+        )
     # Snapshot the plan's event cursor: the events list is cumulative and
     # process-wide, and a sweep emits many records — each must carry only
     # the faults that fired during ITS run.
@@ -252,7 +338,8 @@ def benchmark_algorithm(
     _cost_cursor = program_store_mod.cost_log_len()
 
     alg = make_algorithm(algorithm_name, S, R, c, kernel=kernel,
-                         devices=devices, overlap=overlap)
+                         devices=devices, overlap=overlap,
+                         attention=app == "attention")
     # Bind the strategy (and the app chains built on it) to the active
     # persistent program store under the problem fingerprint — the
     # strategy-config tag in the key keeps sweep cells apart. No active
@@ -282,6 +369,8 @@ def benchmark_algorithm(
     ):
         if app == "vanilla":
             elapsed, app_stats = _run_vanilla(alg, fused, trials, warmup)
+        elif app == "attention":
+            elapsed, app_stats = _run_attention(alg, fused, trials, warmup)
         elif app == "gat":
             elapsed, app_stats = _run_gat(alg, trials, warmup, num_layers=3)
         else:
@@ -319,6 +408,9 @@ def benchmark_algorithm(
         "c": c,
         "fused": bool(fused),
         "fusion": "overlap" if overlap else "sequential",
+        # Attention runs only: the --mask spec (a runstore config axis —
+        # mask families must not pool into each other's baselines).
+        "mask": mask if app == "attention" else None,
         "num_trials": trials,
         "elapsed": elapsed,
         "overall_throughput": throughput,

@@ -179,6 +179,7 @@ def build_banded(
         _, inv, cnt = np.unique(key, return_inverse=True, return_counts=True)
         row_nnz = cnt[inv]
     else:
+        cnt = np.zeros(0, dtype=np.int64)
         row_nnz = np.zeros(0, dtype=np.int64)
 
     band_of = np.full(nnz, len(specs) - 1, dtype=np.int64)
@@ -189,6 +190,33 @@ def build_banded(
         m = unassigned & (row_nnz <= spec.npr_max)
         band_of[m] = i
         unassigned &= ~m
+
+    # Structured-mask degeneration guard: banding pays by splitting a
+    # SKEWED degree distribution (power-law R-mat rows) so the short-row
+    # majority stops paying one mostly-empty chunk per touched column
+    # block. A near-UNIFORM distribution — sliding-window and other
+    # structured attention masks, where only edge rows dip below the
+    # interior degree — can STRADDLE a pow2 band threshold and split
+    # near-identical rows across two full-frame chunk lists: double the
+    # per-row-block chunk rounding for zero density win. When the max
+    # populated row degree sits within 2x of the median (one octave of
+    # the shared pow2 ladder — no band boundary separates meaningfully
+    # different populations) AND the assignment actually split, collapse
+    # every row into the band holding the most nonzeros: one chunk list
+    # with that band's (density-targeted or generic) geometry instead of
+    # a pathological split (ROADMAP item 5's "degenerate gracefully").
+    # A uniform population that already lands in ONE band — e.g. all-
+    # short degree-1 rows, where full-width banding is a real win — is
+    # untouched; the realized band tuple (and its program-key digest)
+    # honestly reports whatever was built.
+    if (
+        len(specs) > 1
+        and cnt.size
+        and cnt.max() <= 2 * np.median(cnt)
+    ):
+        per_band = np.bincount(band_of, minlength=len(specs))
+        if (per_band > 0).sum() > 1:
+            band_of[:] = int(per_band.argmax())
 
     # Drop empty bands (their chunk lists would be pure padding — one
     # pad chunk per row block per bucket); a zero-nnz tile set keeps

@@ -33,6 +33,53 @@ def count_pallas_calls(hlo: str) -> int:
     return len(_PALLAS_CALL.findall(hlo))
 
 
+def _topology(topology_name: str, min_chips: int):
+    """AOT topology lookup with the chip-count check both gates share."""
+    from jax.experimental import topologies
+
+    topo = topologies.get_topology_desc(
+        platform="tpu", topology_name=topology_name
+    )
+    if len(topo.devices) < min_chips:
+        raise ValueError(
+            f"topology {topology_name} has {len(topo.devices)} < "
+            f"{min_chips} chips"
+        )
+    return topo
+
+
+def _aot_compile_ops(alg, args, topo, ops) -> dict:
+    """Retarget one live-mesh strategy at the AOT topology and compile
+    the named program ops with ShapeDtypeStruct operands.
+
+    The strategy is constructed on the live (CPU test) mesh — tile
+    ingest needs real buffers — then its grid is swapped for a mesh
+    over the topology's AOT devices and the program cache cleared so
+    every op re-traces against the TPU mesh. Returns ``{op: hlo_text}``.
+    """
+    from distributed_sddmm_tpu.parallel.mesh import GridSpec, make_grid
+
+    g = alg.grid
+    tpu_grid = make_grid(g.nr, g.nc, g.nh, adjacency=g.adjacency,
+                         devices=list(topo.devices)[: alg.p])
+    alg.grid = GridSpec(mesh=tpu_grid.mesh, nr=g.nr, nc=g.nc, nh=g.nh,
+                        adjacency=g.adjacency)
+    alg._programs.clear()
+    mesh = alg.grid.mesh
+
+    def sds_like(x):
+        return jax.ShapeDtypeStruct(
+            x.shape, x.dtype,
+            sharding=jax.sharding.NamedSharding(mesh, x.sharding.spec),
+        )
+
+    sds = [sds_like(a) for a in args]
+    return {
+        op: alg._program(op, use_st=False).lower(*sds).compile().as_text()
+        for op in ops
+    }
+
+
 def banked_hlo_report(
     topology_name: str = "v5e:2x4",
     log_m: int = 12,
@@ -51,35 +98,21 @@ def banked_hlo_report(
     R=1024 regime (``rl``) so the banked compile doubles as the
     R >= 1024 Pallas compile point.
     """
-    from jax.experimental import topologies
-
     from distributed_sddmm_tpu.autotune.fingerprint import Problem
     from distributed_sddmm_tpu.codegen.kernel import BankedPallasKernel
     from distributed_sddmm_tpu.codegen.variants import select_variant
     from distributed_sddmm_tpu.common import MatMode
     from distributed_sddmm_tpu.ops.pallas_kernels import PallasKernel
     from distributed_sddmm_tpu.parallel.dense_shift_15d import DenseShift15D
-    from distributed_sddmm_tpu.parallel.mesh import GridSpec, make_grid
     from distributed_sddmm_tpu.utils.coo import HostCOO
 
-    devices = jax.devices()
-    topo = topologies.get_topology_desc(
-        platform="tpu", topology_name=topology_name
-    )
-    if len(topo.devices) < len(devices):
-        raise ValueError(
-            f"topology {topology_name} has {len(topo.devices)} < "
-            f"{len(devices)} chips"
-        )
+    topo = _topology(topology_name, len(jax.devices()))
 
     S = HostCOO.rmat(log_m=log_m, edge_factor=edge_factor, seed=0)
     problem = Problem.from_coo(S, R=R)
     variant = select_variant(problem)
 
     def compile_for(kernel):
-        # Construct on the live (CPU test) mesh — tile ingest needs real
-        # buffers — then retarget program construction at the TPU
-        # topology mesh and AOT-compile with ShapeDtypeStruct operands.
         alg = DenseShift15D(
             S, R=R, c=c, fusion_approach=2, kernel=kernel, unroll=unroll
         )
@@ -89,22 +122,7 @@ def banked_hlo_report(
             alg.dummy_initialize(MatMode.B),
             *alg._tile_args(alg.S_tiles, vals),
         )
-        g = alg.grid
-        tpu_grid = make_grid(g.nr, g.nc, g.nh, adjacency=g.adjacency,
-                             devices=list(topo.devices)[: alg.p])
-        alg.grid = GridSpec(mesh=tpu_grid.mesh, nr=g.nr, nc=g.nc, nh=g.nh,
-                            adjacency=g.adjacency)
-        alg._programs.clear()
-        mesh = alg.grid.mesh
-
-        def sds_like(x):
-            return jax.ShapeDtypeStruct(
-                x.shape, x.dtype,
-                sharding=jax.sharding.NamedSharding(mesh, x.sharding.spec),
-            )
-
-        prog = alg._program("fused", use_st=False)
-        hlo = prog.lower(*(sds_like(a) for a in args)).compile().as_text()
+        hlo = _aot_compile_ops(alg, args, topo, ("fused",))["fused"]
         return alg, hlo
 
     banked_kernel = BankedPallasKernel(
@@ -137,6 +155,92 @@ def banked_hlo_report(
         "pallas_calls_banked": count_pallas_calls(hlo_banked),
         "pallas_calls_generic": count_pallas_calls(hlo_generic),
         "is_scheduled": "is_scheduled=true" in hlo_banked,
+    }
+    if output_file:
+        # non-atomic-ok: append-only record stream (the -o contract).
+        with open(output_file, "a") as f:
+            f.write(json.dumps(record) + "\n")
+    return record
+
+
+def attention_hlo_report(
+    topology_name: str = "v5e:2x4",
+    log_m: int = 11,
+    edge_factor: int = 4,
+    R: int = 128,
+    p: int = 2,
+    unroll: bool = False,
+    output_file: str | None = None,
+) -> dict:
+    """Compile the banked fused-ATTENTION program for a TPU topology and
+    report the per-module Pallas launch counts vs the plain fused pair.
+
+    The attention module must carry the masked-softmax epilogue as REAL
+    Mosaic launches fused into the one compiled program: with the rolled
+    ring (``unroll=False``) the SDDMM and SpMM passes contribute one
+    launch per band per loop body exactly like ``banked_hlo_report``'s
+    pair, and the epilogue adds ``2 × n_tiles × n_bands`` launches (one
+    streaming reduce + one normalize per tile per band) — so the count
+    delta over the twopass pair module is a structural proof the
+    epilogue compiled into the banked v5e module, not an interpreter
+    artifact. The graph-derived (skewed R-mat) mask keeps banking live;
+    ``p=2`` keeps the ring small so the module is cheap to compile.
+    """
+    from distributed_sddmm_tpu import masks
+    from distributed_sddmm_tpu.autotune.fingerprint import Problem
+    from distributed_sddmm_tpu.codegen.kernel import BankedPallasKernel
+    from distributed_sddmm_tpu.codegen.variants import select_variant
+    from distributed_sddmm_tpu.common import MatMode
+    from distributed_sddmm_tpu.parallel.dense_shift_15d import DenseShift15D
+    from distributed_sddmm_tpu.utils.coo import HostCOO
+
+    topo = _topology(topology_name, p)
+
+    S = masks.graph_mask(
+        HostCOO.rmat(log_m=log_m, edge_factor=edge_factor, seed=0)
+    )
+    variant = select_variant(Problem.from_coo(S, R=R))
+    kernel = BankedPallasKernel(variant, precision="bf16", interpret=False)
+
+    alg = DenseShift15D(
+        S, R=R, c=1, fusion_approach=1, kernel=kernel, unroll=unroll,
+        devices=jax.devices()[:p],
+    )
+    vals = alg.like_s_values(1.0)
+    args = (
+        alg.dummy_initialize(MatMode.A),
+        alg.dummy_initialize(MatMode.B),
+        *alg._tile_args(alg.S_tiles, vals),
+    )
+    hlos = _aot_compile_ops(alg, args, topo, ("attn", "fused_twopass"))
+    hlo_attn = hlos["attn"]
+    hlo_pair = hlos["fused_twopass"]
+    bands = alg.S_tiles.blk_bands or ()
+    n_tiles = alg.S_tiles.n_tiles
+    attn_calls = count_pallas_calls(hlo_attn)
+    pair_calls = count_pallas_calls(hlo_pair)
+
+    record = {
+        "experiment": "attention-hlo",
+        "topology": topology_name,
+        "p": alg.p,
+        "M": S.M,
+        "nnz": S.nnz,
+        "R": R,
+        "mask": "graph",
+        "variant": variant.variant_id,
+        "unrolled": bool(unroll),
+        "n_tiles": n_tiles,
+        "bands": [
+            {"body": b.body, "bm": b.bm, "bn": b.bn,
+             "chunks": b.c1 - b.c0, "group": b.group}
+            for b in bands
+        ],
+        "pallas_calls_attn": attn_calls,
+        "pallas_calls_pair": pair_calls,
+        "epilogue_calls": attn_calls - pair_calls,
+        "epilogue_calls_expected": 2 * n_tiles * len(bands),
+        "is_scheduled": "is_scheduled=true" in hlo_attn,
     }
     if output_file:
         # non-atomic-ok: append-only record stream (the -o contract).

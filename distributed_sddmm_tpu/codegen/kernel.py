@@ -33,8 +33,9 @@ from distributed_sddmm_tpu.codegen.banded import Band
 from distributed_sddmm_tpu.codegen.variants import (
     KernelVariant, variant_from_id,
 )
+from distributed_sddmm_tpu.ops.kernels import attn_merge_stats
 from distributed_sddmm_tpu.ops.pallas_kernels import (
-    PallasKernel, _fused_op, _sddmm_op, _spmm_op,
+    PallasKernel, _attn_call, _fused_op, _sddmm_op, _spmm_op,
 )
 
 
@@ -148,6 +149,52 @@ class BankedPallasKernel(PallasKernel):
             outT = o if outT is None else outT + o
             mids.append(mid.reshape(-1))
         return outT, jnp.concatenate(mids).astype(out_dtype)
+
+    # -------------- masked-softmax attention epilogue ----------------- #
+    #
+    # Per-band launches over the shared rows_pad frame: every band's
+    # chunk list covers every row block (>= 1 chunk each, flags
+    # included), so each band's (m, d) is a full-frame PARTIAL with
+    # ATTN_NEG/0 at rows it does not own, and partials merge by the
+    # online-softmax rule exactly like tiles do. Bands whose metadata
+    # proved the single-step property get the provably-one-pass reduce
+    # body (no scratch, no flags).
+
+    def attn_stats_tile_t(self, blk, gate_vals, logit_vals):
+        if not isinstance(blk, BankedTile):
+            return super().attn_stats_tile_t(blk, gate_vals, logit_vals)
+        gv = self._chunk_vals(blk, gate_vals)
+        zv = self._chunk_vals(blk, logit_vals)
+        stats = []
+        for band in blk.bands:
+            meta, lr, _ = self._band_slices(blk, band)
+            stats.append(_attn_call(
+                meta, lr, gv[band.c0:band.c1], zv[band.c0:band.c1],
+                None, None, op="attn_reduce", bm=band.bm,
+                gr_blocks=band.gr_blocks, group=band.group,
+                interpret=self.interpret,
+                single_step=band.body == "single",
+            ))
+        return attn_merge_stats(stats)
+
+    def attn_norm_tile_t(self, blk, gate_vals, logit_vals, m, d, out_dtype):
+        if not isinstance(blk, BankedTile):
+            return super().attn_norm_tile_t(
+                blk, gate_vals, logit_vals, m, d, out_dtype
+            )
+        gv = self._chunk_vals(blk, gate_vals)
+        zv = self._chunk_vals(blk, logit_vals)
+        probs = []
+        for band in blk.bands:
+            meta, lr, _ = self._band_slices(blk, band)
+            p = _attn_call(
+                meta, lr, gv[band.c0:band.c1], zv[band.c0:band.c1],
+                m, d, op="attn_norm", bm=band.bm,
+                gr_blocks=band.gr_blocks, group=band.group,
+                interpret=self.interpret,
+            )
+            probs.append(p.reshape(-1))
+        return jnp.concatenate(probs).astype(out_dtype)
 
 
 def make_banked_kernel(variant: KernelVariant | str, **kw) -> BankedPallasKernel:

@@ -1,0 +1,133 @@
+"""Structured attention-mask generators: block-sparse patterns as HostCOO.
+
+SDDMM ⊙ masked-softmax → SpMM *is* sparse attention, and the mask IS
+the sparse matrix: every generator here returns a unit-valued
+:class:`~distributed_sddmm_tpu.utils.coo.HostCOO` whose pattern is the
+attention mask (``vals == 1`` at attended positions — the ``gate != 0``
+indicator the softmax kernels read; callers may rescale values to carry
+per-edge logit weights or temperature). Three families, the structured
+regimes the codegen band selector must degenerate gracefully on
+(ROADMAP item 5 / NeutronSparse-style structure routing):
+
+* :func:`sliding_window` — each token attends to its ±w neighborhood
+  (near-uniform nnz/row: the anti-power-law stress case for banding);
+* :func:`bigbird` — sliding window ∪ global tokens (attend/attended
+  everywhere) ∪ seeded random links, the BigBird recipe;
+* :func:`graph_mask` — the pattern of an existing sparse matrix (the
+  GAT adjacency path: attention over graph edges).
+
+:func:`from_spec` parses the ``--mask`` CLI grammar
+(``window:8``, ``bigbird:w=8,g=2,r=2``, ``graph``) so bench records can
+carry the mask as one printable config axis.
+
+Import discipline: numpy + HostCOO only (no jax) — mask construction is
+host-side ingest work, usable from offline tooling.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from distributed_sddmm_tpu.utils.coo import HostCOO
+
+
+def _dedup(rows: np.ndarray, cols: np.ndarray, n: int) -> HostCOO:
+    key = rows.astype(np.int64) * n + cols.astype(np.int64)
+    key = np.unique(key)
+    return HostCOO(
+        rows=key // n, cols=key % n, vals=np.ones(key.size), M=n, N=n
+    )
+
+
+def sliding_window(n: int, window: int = 8) -> HostCOO:
+    """Each row ``i`` attends to columns ``[i-window, i+window]``
+    (clipped at the edges), diagonal included — near-uniform
+    ``2*window+1`` nnz/row."""
+    if window < 0:
+        raise ValueError(f"window must be >= 0, got {window}")
+    offs = np.arange(-window, window + 1, dtype=np.int64)
+    rows = np.repeat(np.arange(n, dtype=np.int64), offs.size)
+    cols = rows + np.tile(offs, n)
+    keep = (cols >= 0) & (cols < n)
+    return HostCOO(
+        rows=rows[keep], cols=cols[keep], vals=np.ones(int(keep.sum())),
+        M=n, N=n,
+    )
+
+
+def bigbird(
+    n: int,
+    window: int = 8,
+    n_global: int = 2,
+    n_random: int = 2,
+    seed: int = 0,
+) -> HostCOO:
+    """BigBird-style mask: sliding window ∪ ``n_global`` global tokens
+    (their full rows AND columns) ∪ ``n_random`` seeded random columns
+    per row. Deduplicated union; deterministic for a given seed."""
+    base = sliding_window(n, window)
+    parts_r = [base.rows]
+    parts_c = [base.cols]
+    if n_global:
+        g = np.arange(min(n_global, n), dtype=np.int64)
+        full = np.arange(n, dtype=np.int64)
+        # Global rows: g attends everywhere; global cols: everyone
+        # attends g.
+        parts_r += [np.repeat(g, n), np.repeat(full, g.size)]
+        parts_c += [np.tile(full, g.size), np.tile(g, n)]
+    if n_random:
+        rng = np.random.default_rng(seed)
+        rr = np.repeat(np.arange(n, dtype=np.int64), n_random)
+        rc = rng.integers(0, n, size=n * n_random).astype(np.int64)
+        parts_r.append(rr)
+        parts_c.append(rc)
+    return _dedup(np.concatenate(parts_r), np.concatenate(parts_c), n)
+
+
+def graph_mask(S: HostCOO) -> HostCOO:
+    """Attention mask from an existing sparse pattern (the GAT path:
+    attend over graph edges). Unit values; duplicate edges collapse."""
+    n = max(S.M, S.N)
+    return _dedup(S.rows, S.cols, n)
+
+
+def from_spec(
+    spec: str,
+    n: int,
+    graph: HostCOO | None = None,
+    seed: int = 0,
+) -> HostCOO:
+    """Parse one ``--mask`` spec into a mask matrix over ``n`` tokens.
+
+    Grammar (printable, colon-free after the family tag — the spec rides
+    into bench records and the runstore config axes verbatim):
+
+    * ``window:<w>`` — :func:`sliding_window` with half-width ``w``;
+    * ``bigbird:w=<w>,g=<g>,r=<r>`` — :func:`bigbird` (all keys
+      optional, defaults ``w=8,g=2,r=2``);
+    * ``graph`` — :func:`graph_mask` over ``graph`` (the benchmark's
+      generated/loaded matrix; required).
+    """
+    fam, _, rest = spec.partition(":")
+    if fam == "window":
+        return sliding_window(n, int(rest or "8"))
+    if fam == "bigbird":
+        kw = {"w": 8, "g": 2, "r": 2}
+        for part in filter(None, rest.split(",")):
+            k, _, v = part.partition("=")
+            if k not in kw:
+                raise ValueError(
+                    f"unknown bigbird key {k!r} in mask spec {spec!r}"
+                )
+            kw[k] = int(v)
+        return bigbird(
+            n, window=kw["w"], n_global=kw["g"], n_random=kw["r"], seed=seed
+        )
+    if fam == "graph":
+        if graph is None:
+            raise ValueError("mask spec 'graph' needs a source matrix")
+        return graph_mask(graph)
+    raise ValueError(
+        f"unknown mask spec {spec!r}; expected window:<w>, "
+        "bigbird:w=..,g=..,r=.., or graph"
+    )

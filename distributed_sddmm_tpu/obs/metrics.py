@@ -198,6 +198,11 @@ class OpMetrics:
 OP_PAIRS = {
     "fusedSpMM": 1.0,
     "fusedSpMMB": 1.0,
+    # Fused block-sparse attention: one SDDMM + one SpMM pass (the
+    # masked-softmax epilogue between them is O(nnz) VPU work, charged
+    # as zero model FLOPs like every other elementwise stage).
+    "fusedAttn": 1.0,
+    "fusedAttnB": 1.0,
     "cgStep": 1.0,
     "cgStepB": 1.0,
     "gatLayer": 1.0,

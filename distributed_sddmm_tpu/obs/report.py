@@ -232,6 +232,43 @@ def _burn_series(rows: list[dict]) -> dict:
     return series
 
 
+def _attention_rows(store, rows: list[dict]) -> list[tuple]:
+    """(run_id, mask, R, fused, throughput, hbm fused/unfused/savings)
+    for every attention run (``app == "attention"``); the HBM columns
+    come from the run doc's counted ``attention_hbm`` record."""
+    out = []
+    for r in rows:
+        if r.get("app") != "attention":
+            continue
+        doc = store.get(r["run_id"]) or {}
+        hbm = (doc.get("record") or {}).get("attention_hbm") or {}
+        out.append((
+            r.get("run_id"), r.get("mask"), r.get("R"), r.get("fused"),
+            r.get("overall_throughput"), hbm.get("fused_bytes"),
+            hbm.get("unfused_bytes"), hbm.get("savings_frac"),
+        ))
+    return out
+
+
+def _attention_table(rows: list[tuple]) -> str:
+    head = (
+        "<tr><th class=l>run</th><th class=l>mask</th><th>R</th>"
+        "<th>fused</th><th>GFLOP/s</th><th>HBM fused</th>"
+        "<th>HBM unfused</th><th>HBM cut</th></tr>"
+    )
+    body = []
+    for run, mask, R, fused, gf, fb, ub, sf in rows:
+        body.append(
+            f"<tr><td class=l>{_esc((run or '')[:24])}</td>"
+            f"<td class=l>{_esc(mask or '-')}</td><td>{_fmt(R, 0)}</td>"
+            f"<td>{'yes' if fused else 'no'}</td><td>{_fmt(gf)}</td>"
+            f"<td>{_fmt(fb, 0)}</td><td>{_fmt(ub, 0)}</td>"
+            f"<td>{_fmt(sf * 100, 1) + '%' if sf is not None else '-'}"
+            f"</td></tr>"
+        )
+    return f"<table>{head}{''.join(body)}</table>"
+
+
 def _trend_series(store, rows: list[dict]) -> tuple[dict, dict]:
     """(per-phase t/call series, headline series) across ``rows``."""
     per_phase: dict[str, list] = {}
@@ -299,6 +336,17 @@ def build_html(
     if png:
         sections += ["<h2>Headline throughput (focus key)</h2>",
                      f'<img src="{png}" alt="throughput trend">']
+
+    attn = _attention_rows(store, all_rows)
+    if attn:
+        sections += [
+            "<h2>Sparse attention (all attention runs)</h2>",
+            "<p class=meta>Fused SDDMM → masked-softmax → SpMM runs per "
+            "mask family; the HBM columns are the counted program-I/O "
+            "traffic of the fused pair vs the three-program unfused "
+            "sequence.</p>",
+            _attention_table(attn),
+        ]
 
     lat_series = _latency_series(store, all_rows)
     png = _chart_png(

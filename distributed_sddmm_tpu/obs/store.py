@@ -52,6 +52,10 @@ DEFAULT_ROOT = _REPO / "artifacts" / "runstore"
 _INDEX_FIELDS = (
     "run_id", "created_epoch", "key", "backend", "code_hash",
     "algorithm", "app", "R", "c", "fused", "kernel", "kernel_variant",
+    # Attention records (`--app attention`) only; None elsewhere. The
+    # mask spec is a config axis: a sliding-window run must never pool
+    # into a BigBird (or SDDMM) baseline.
+    "mask",
     "elapsed", "overall_throughput", "source", "anomaly_count",
     # Serving records (`bench serve`) only; None elsewhere.
     "latency_p99_ms", "shed_count",
@@ -74,8 +78,14 @@ _INDEX_FIELDS = (
 # ``kernel_variant`` joined in PR 9 — a banked-variant run must not
 # pool into the generic kernel's baseline (both directions would poison
 # the noise bands); pre-PR-9 docs carry None, which matches every other
-# None-variant run, so history stays comparable.
-_CONFIG_AXES = ("algorithm", "app", "c", "fused", "kernel", "kernel_variant")
+# None-variant run, so history stays comparable. ``mask`` joined with
+# the attention app (PR 13): the ``app`` axis already keeps attention
+# runs out of SDDMM baselines, and the mask spec keeps the mask
+# families apart from each other; non-attention docs carry None, which
+# matches None.
+_CONFIG_AXES = (
+    "algorithm", "app", "c", "fused", "kernel", "kernel_variant", "mask",
+)
 
 
 class RunStore:
@@ -314,6 +324,7 @@ def _index_row(doc: dict) -> dict:
         "fused": rec.get("fused"),
         "kernel": rec.get("kernel"),
         "kernel_variant": rec.get("kernel_variant"),
+        "mask": rec.get("mask"),
         "elapsed": rec.get("elapsed"),
         "overall_throughput": rec.get("overall_throughput"),
         "source": doc.get("source"),

@@ -42,6 +42,24 @@ import jax.numpy as jnp
 # single-pass path.
 XLA_GATHER_BUDGET = int(os.environ.get("DSDDMM_XLA_GATHER_BUDGET", str(1 << 29)))
 
+# Element budget for the one-shot masked-softmax row statistics of the
+# attention epilogue (``attn_stats``). Past it the stats switch to the
+# streaming max/denominator scan (the classic online-softmax
+# recurrence), which holds one [out_rows] running max and denominator
+# instead of [nnz] temporaries. The value array is 1-D, so this budget
+# is far larger than the gather budget's per-R accounting.
+ATTN_STREAM_BUDGET = int(
+    os.environ.get("DSDDMM_ATTN_STREAM_BUDGET", str(1 << 24))
+)
+
+#: Finite stand-in for -inf in the masked-softmax passes: segment maxima
+#: over empty/masked rows must stay finite (``-inf - -inf`` would NaN the
+#: streaming rescale ``exp(m_old - m_new)``), and ``exp(z - NEG)`` of a
+#: real logit still overflows to +inf, which every consumer guards with
+#: a select. All softmax implementations (XLA flat, Pallas chunk, f64
+#: oracle) share this constant so fused/unfused paths stay bit-aligned.
+ATTN_NEG = -1e30
+
 
 class LocalKernel(Protocol):
     """Local kernel plugin boundary (reference `sparse_kernels.h:15-79`)."""
@@ -141,6 +159,101 @@ class XlaKernel:
             (rows_p, cols_p, vals_p),
         )
         return out
+
+
+    # ------------------------------------------------------------------ #
+    # Masked-softmax attention epilogue (flat COO layout)
+    #
+    # SDDMM ⊙ masked-softmax → SpMM *is* block-sparse attention: the
+    # SDDMM values are the row-sparse logits, and these passes turn them
+    # into row-stochastic attention weights without ever materializing a
+    # dense [rows, cols] logit matrix. The mask indicator is ``gate !=
+    # 0`` — the tile value vector doubles as the mask (pad lanes carry
+    # 0 by the TileSet contract, and a zero-valued mask entry means
+    # "present in the pattern but masked out"), so fully masked rows
+    # degrade to an all-zero output row, never NaN.
+    # ------------------------------------------------------------------ #
+
+    def attn_stats(self, rows, gate, logits, out_rows: int):
+        """Per-row masked max and sum-of-exp: ``(m [out_rows],
+        d [out_rows])`` with ``m = ATTN_NEG`` and ``d = 0`` for rows
+        with no unmasked entries. Beyond :data:`ATTN_STREAM_BUDGET`
+        elements the computation streams: a scan over fixed-size
+        segments carries the running max and a rescaled denominator
+        (``d ← d·exp(m_old − m_new) + Σ exp(z − m_new)``) — the online
+        softmax recurrence, so peak memory is one segment plus two
+        [out_rows] vectors."""
+        n = rows.shape[0]
+        dt = logits.dtype
+        neg = jnp.asarray(ATTN_NEG, dt)
+
+        def seg_stats(r, g, z, m_floor):
+            zsafe = jnp.where(g != 0, z, neg)
+            cm = jax.ops.segment_max(zsafe, r, num_segments=out_rows)
+            cm = jnp.maximum(cm, neg)  # empty segments: -inf -> finite
+            m_new = jnp.maximum(m_floor, cm)
+            e = jnp.where(g != 0, jnp.exp(z - m_new[r]), jnp.asarray(0, dt))
+            cs = jax.ops.segment_sum(e, r, num_segments=out_rows)
+            return m_new, cs
+
+        budget = ATTN_STREAM_BUDGET
+        if n <= budget:
+            m0 = jnp.full((out_rows,), neg, dt)
+            return seg_stats(rows, gate, logits, m0)
+        seg = max(1, budget)
+        n_seg = -(-n // seg)
+        pad = n_seg * seg - n
+        rows_p = jnp.pad(rows, (0, pad)).reshape(n_seg, seg)
+        gate_p = jnp.pad(gate, (0, pad)).reshape(n_seg, seg)  # pads gate=0
+        z_p = jnp.pad(logits, (0, pad)).reshape(n_seg, seg)
+
+        def step(carry, rgz):
+            m_run, d_run = carry
+            r, g, z = rgz
+            m_new, cs = seg_stats(r, g, z, m_run)
+            d_new = d_run * jnp.exp(m_run - m_new) + cs
+            return (m_new, d_new), None
+
+        init = (jnp.full((out_rows,), neg, dt), jnp.zeros((out_rows,), dt))
+        (m, d), _ = jax.lax.scan(step, init, (rows_p, gate_p, z_p))
+        return m, d
+
+    def attn_normalize(self, rows, gate, logits, m, d):
+        """Masked-softmax weights from the row stats: ``exp(z − m[row]) /
+        d[row]`` at unmasked entries, exactly 0 at masked entries, pad
+        lanes, and fully masked rows (``d == 0``)."""
+        dt = logits.dtype
+        sel = (gate != 0) & (d[rows] > 0)
+        # exp on the selected-safe argument: an unmasked overflow
+        # (z - ATTN_NEG) would manufacture inf before the select.
+        e = jnp.exp(jnp.where(sel, logits - m[rows], jnp.asarray(0, dt)))
+        return jnp.where(sel, e / jnp.where(sel, d[rows], 1.0), 0.0).astype(dt)
+
+    def attn_softmax(self, rows, gate, logits, out_rows: int):
+        """Row-wise masked softmax over flat COO values (stats +
+        normalize in one call — the single-tile convenience form; the
+        distributed programs call the two halves around their cross-
+        device max/denominator merge)."""
+        m, d = self.attn_stats(rows, gate, logits, out_rows)
+        return self.attn_normalize(rows, gate, logits, m, d)
+
+
+def attn_merge_stats(stats):
+    """Combine per-partition masked-softmax row stats into one frame.
+
+    ``stats`` is a sequence of ``(m, d)`` pairs over the SAME row frame
+    (per tile, per band, or per device after a gather): the merged max
+    is the elementwise maximum and each partial denominator is rescaled
+    into it — the online-softmax merge rule. Empty partitions
+    (``m == ATTN_NEG, d == 0``) are absorbed exactly: ``exp(m_b − m)``
+    underflows to 0 against any real max and its ``d_b`` is 0 against
+    another empty one.
+    """
+    import functools
+
+    m = functools.reduce(jnp.maximum, [s[0] for s in stats])
+    d = sum(s[1] * jnp.exp(s[0] - m) for s in stats)
+    return m, d
 
 
 _REGISTRY = {"xla": XlaKernel}

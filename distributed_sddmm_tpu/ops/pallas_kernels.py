@@ -49,7 +49,7 @@ from distributed_sddmm_tpu.ops import blocked
 from distributed_sddmm_tpu.ops.blocked import (
     CHUNK, _GC_SHIFT, _GR_SHIFT, MAX_BLOCKS, unpack_meta,
 )
-from distributed_sddmm_tpu.ops.kernels import XlaKernel
+from distributed_sddmm_tpu.ops.kernels import ATTN_NEG, XlaKernel
 
 
 @jax.tree_util.register_dataclass
@@ -362,6 +362,194 @@ def _make_spmm_body(G, form):
                 out_ref[:] = acc_ref[:]
 
     return body
+
+
+# ------------------------------------------------------------------ #
+# Masked-softmax attention epilogue kernels (chunk-list layout).
+#
+# The SDDMM mid values ARE the sparse attention logits; these kernels
+# turn them into row-stochastic weights between the SDDMM and SpMM
+# stages without materializing any dense [rows, cols] intermediate.
+# Two launches ride the SAME chunk-list metadata the pair kernels use:
+#
+# * ``attn_reduce`` — streaming per-row max + denominator over each
+#   (bucket, row block) group's chunks: two (bm, 1) VMEM scratches
+#   carry the running max ``m`` and the rescaled denominator
+#   ``d ← d·exp(m_old − m_new) + Σ exp(z − m_new)`` (the online-softmax
+#   recurrence), zeroed/flushed on the group's first/last flags exactly
+#   like the pair accumulator. Bands whose metadata proves one grid
+#   step per row-block group get the PROVABLY-ONE-PASS body: no
+#   scratch, no flags — each step computes its window's stats from its
+#   own lanes and writes them once, unconditionally (the epilogue
+#   counterpart of the conditional-free single-step pair bodies).
+# * ``attn_norm`` — a pure map: gather each lane's row stats from the
+#   (bm, 1) blocks via the one-hot row selector and emit
+#   ``exp(z − m) / d`` (0 at masked lanes, pads, and d == 0 rows).
+#
+# Everything is VPU work in the [bm, W] orientation (lane-axis chunk
+# entries vs sublane-axis rows): sublane/lane reductions and broadcasts
+# only — no transposes, no MXU passes, so Mosaic lowers it next to the
+# pair kernels it fuses with. The mask indicator is ``gate != 0`` where
+# ``gate`` is the ORIGINAL value vector (pad lanes carry 0 by the
+# TileSet contract; a zero mask value means "masked out" — logits that
+# are legitimately 0.0 stay in).
+# ------------------------------------------------------------------ #
+
+
+def _attn_sel(lr_all, gv, bm):
+    """One-hot row selector [bm, W] and its mask-gated refinement."""
+    ohT = (
+        jax.lax.broadcasted_iota(jnp.int32, (bm, lr_all.shape[1]), 0)
+        == lr_all
+    )
+    return ohT, ohT & (gv != 0)
+
+
+def _attn_chunk_stats(sel, zv, m_prev):
+    """Streaming update from one grid step's lanes: returns
+    ``(m_new [bm, 1], csum [bm, 1])`` where ``csum`` sums
+    ``exp(z − m_new)`` over the step's selected lanes per row."""
+    neg = jnp.float32(ATTN_NEG)
+    zb = jnp.where(sel, zv, neg)                      # [bm, W]
+    m_new = jnp.maximum(m_prev, jnp.max(zb, axis=1, keepdims=True))
+    e = jnp.where(sel, jnp.exp(zb - m_new), 0.0)
+    return m_new, jnp.sum(e, axis=1, keepdims=True)
+
+
+def _make_attn_reduce_body(G):
+    def body(meta_ref, lr_ref, gv_ref, zv_ref, m_out, d_out, m_acc, d_acc):
+        t = pl.program_id(0)
+        bm = m_out.shape[0]
+
+        @pl.when((meta_ref[t * G] & 1) == 1)
+        def _():
+            m_acc[:] = jnp.full_like(m_acc, jnp.float32(ATTN_NEG))
+            d_acc[:] = jnp.zeros_like(d_acc)
+
+        last = ((meta_ref[t * G + G - 1] >> 1) & 1) == 1
+        lr_all = _lane_concat(lr_ref, G)
+        _, sel = _attn_sel(lr_all, _lane_concat(gv_ref, G), bm)
+        m_old = m_acc[:]
+        m_new, csum = _attn_chunk_stats(sel, _lane_concat(zv_ref, G), m_old)
+        d_acc[:] = d_acc[:] * jnp.exp(m_old - m_new) + csum
+        m_acc[:] = m_new
+
+        @pl.when(last)
+        def _():
+            m_out[:] = m_acc[:]
+            d_out[:] = d_acc[:]
+
+    return body
+
+
+def _make_attn_reduce_body_single(G):
+    """One-pass epilogue variant: the band's metadata proves every
+    (bucket, row block) group spans exactly ONE grid step with no
+    trailing pad chunks (``codegen.banded._single_step_provable``), so
+    the running-stat scratch and the zero/flush conditionals vanish —
+    each step derives its window's max/denominator from its own lanes
+    and writes both outputs once, unconditionally."""
+
+    def body(meta_ref, lr_ref, gv_ref, zv_ref, m_out, d_out):
+        bm = m_out.shape[0]
+        lr_all = _lane_concat(lr_ref, G)
+        _, sel = _attn_sel(lr_all, _lane_concat(gv_ref, G), bm)
+        m0 = jnp.full((bm, 1), jnp.float32(ATTN_NEG))
+        m_new, csum = _attn_chunk_stats(sel, _lane_concat(zv_ref, G), m0)
+        m_out[:] = m_new
+        d_out[:] = csum
+
+    return body
+
+
+def _make_attn_norm_body(G):
+    def body(meta_ref, lr_ref, gv_ref, zv_ref, m_ref, d_ref, p_out):
+        bm = m_ref.shape[0]
+        neg = jnp.float32(ATTN_NEG)
+        lr_all = _lane_concat(lr_ref, G)
+        gv = _lane_concat(gv_ref, G)
+        zv = _lane_concat(zv_ref, G)
+        ohT, _ = _attn_sel(lr_all, gv, bm)
+        # Per-lane row-stat gather via the one-hot selector: each lane
+        # belongs to exactly one row, so a masked sublane max/sum pulls
+        # its m/d into lane orientation without any transpose.
+        m_g = jnp.max(jnp.where(ohT, m_ref[:], neg), axis=0, keepdims=True)
+        d_g = jnp.sum(jnp.where(ohT, d_ref[:], 0.0), axis=0, keepdims=True)
+        ok = (gv != 0) & (d_g > 0)                         # [1, W]
+        # exp on the select-guarded argument: a masked lane's raw
+        # ``z − m`` can overflow to +inf before the select otherwise.
+        e = jnp.exp(jnp.where(ok, zv - m_g, 0.0))
+        p = jnp.where(ok, e / jnp.where(ok, d_g, 1.0), 0.0)
+        _write_mid(p_out, p, G)
+
+    return body
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("op", "bm", "gr_blocks", "group", "interpret",
+                     "single_step"),
+)
+def _attn_call(
+    meta, lr, gv, zv, m, d, op, bm, gr_blocks, group, interpret,
+    single_step=False,
+):
+    """Launch one attention-epilogue kernel over a chunk list.
+
+    ``gv`` is the ORIGINAL (mask) value vector and ``zv`` the SDDMM
+    logits, both in chunk layout [C, CHUNK]; ``m``/``d`` are the merged
+    (rows_pad, 1) row stats (``attn_norm`` only). Returns ``(m, d)``
+    for ``attn_reduce``, the normalized chunk values for ``attn_norm``.
+    """
+    C = lr.shape[0]
+    G = group
+    if C % G:
+        raise ValueError(f"chunk count {C} not a multiple of group {G}")
+    steps = C // G
+    lr3 = lr.reshape(steps, G, CHUNK)
+    gv3 = gv.reshape(steps, G, CHUNK)
+    zv3 = zv.reshape(steps, G, CHUNK)
+
+    chunk_spec = pl.BlockSpec((1, G, CHUNK), lambda t, mm: (t, 0, 0))
+    md_spec = pl.BlockSpec((bm, 1), lambda t, mm: (_meta_gr(mm, t * G), 0))
+    md_shape = jax.ShapeDtypeStruct((gr_blocks * bm, 1), jnp.float32)
+
+    if op == "attn_reduce":
+        if single_step:
+            body, scratch = _make_attn_reduce_body_single(G), []
+        else:
+            body = _make_attn_reduce_body(G)
+            scratch = [pltpu.VMEM((bm, 1), jnp.float32),
+                       pltpu.VMEM((bm, 1), jnp.float32)]
+        in_specs = [chunk_spec, chunk_spec, chunk_spec]
+        operands = (lr3, gv3, zv3)
+        out_specs, out_shapes = [md_spec, md_spec], [md_shape, md_shape]
+    elif op == "attn_norm":
+        body, scratch = _make_attn_norm_body(G), []
+        in_specs = [chunk_spec, chunk_spec, chunk_spec, md_spec, md_spec]
+        operands = (lr3, gv3, zv3, m, d)
+        out_specs = [chunk_spec]
+        out_shapes = [jax.ShapeDtypeStruct((steps, G, CHUNK), jnp.float32)]
+    else:
+        raise ValueError(op)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(steps,),
+        in_specs=in_specs,
+        out_specs=out_specs,
+        scratch_shapes=scratch,
+    )
+    outs = pl.pallas_call(
+        body,
+        grid_spec=grid_spec,
+        out_shape=out_shapes,
+        compiler_params=compat.pallas_tpu_compiler_params(
+            dimension_semantics=("arbitrary",)
+        ),
+        interpret=interpret,
+    )(meta, *operands)
+    return outs if op == "attn_reduce" else outs[0]
 
 
 @functools.partial(
@@ -725,3 +913,45 @@ class PallasKernel:
             self._chunk_vals(blk, vals), at, bt,
         )
         return outT, self._unchunk(blk, mid, out_dtype)
+
+    # ---------------- masked-softmax attention epilogue ---------------- #
+
+    def attn_stats_tile_t(self, blk: BlockedTile, gate_vals, logit_vals):
+        """Per-row masked-softmax stats ``(m, d)``, each
+        ``[rows_pad, 1]`` f32, for one blocked tile's chunk values
+        (``gate_vals`` = the original mask values, ``logit_vals`` = the
+        SDDMM output). Partial by construction — tiles/devices merge
+        via :func:`ops.kernels.attn_merge_stats`."""
+        return _attn_call(
+            blk.meta, blk.lr,
+            self._chunk_vals(blk, gate_vals),
+            self._chunk_vals(blk, logit_vals),
+            None, None, op="attn_reduce", bm=blk.bm,
+            gr_blocks=blk.gr_blocks, group=blk.group,
+            interpret=self.interpret,
+        )
+
+    def attn_norm_tile_t(self, blk: BlockedTile, gate_vals, logit_vals,
+                         m, d, out_dtype):
+        """Normalized attention weights (flat [max_nnz]) from the MERGED
+        row stats."""
+        probs = _attn_call(
+            blk.meta, blk.lr,
+            self._chunk_vals(blk, gate_vals),
+            self._chunk_vals(blk, logit_vals),
+            m, d, op="attn_norm", bm=blk.bm,
+            gr_blocks=blk.gr_blocks, group=blk.group,
+            interpret=self.interpret,
+        )
+        return self._unchunk(blk, probs, out_dtype)
+
+    # Flat-protocol attention softmax (XLA fallback, like sddmm/spmm).
+
+    def attn_stats(self, rows, gate, logits, out_rows: int):
+        return self._xla.attn_stats(rows, gate, logits, out_rows)
+
+    def attn_normalize(self, rows, gate, logits, m, d):
+        return self._xla.attn_normalize(rows, gate, logits, m, d)
+
+    def attn_softmax(self, rows, gate, logits, out_rows: int):
+        return self._xla.attn_softmax(rows, gate, logits, out_rows)

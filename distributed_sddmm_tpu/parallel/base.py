@@ -279,6 +279,34 @@ class DistributedSparse(abc.ABC):
         mid = self.sddmm_b(A, B, s_vals)
         return self.spmm_b(A, B, mid), mid
 
+    def fused_attention(
+        self,
+        A: jax.Array,
+        B: jax.Array,
+        s_vals: jax.Array,
+        mode: MatMode = MatMode.A,
+    ) -> tuple[jax.Array, jax.Array]:
+        """Fused block-sparse attention: SDDMM → row-wise masked softmax
+        → SpMM in ONE compiled program, no dense logits materialized.
+        Returns ``(new_dense, attention_weights)``.
+
+        Base implementation: NOT supported. The row denominator must see
+        every logit of its row before any SpMM contribution flows, which
+        the 1.5D dense-shift layout satisfies between its two ring
+        passes (the device's tiles plus a [rows]-vector merge over the
+        replication axis cover each row exactly); the sparse-shift and
+        Cannon layouts move values/structure with the ring, so the
+        denominator cannot ride the traveling accumulator — requesting
+        attention on them is a configuration error (same gating pattern
+        as ``--fusion overlap``), not a silent fallback.
+        """
+        raise NotImplementedError(
+            f"fused attention is not implemented for "
+            f"{self.algorithm_name or type(self).__name__}: the softmax "
+            "row denominator cannot ride this strategy's traveling "
+            "accumulator (use the 1.5D dense-shift strategies)"
+        )
+
     def _unskew_cols(self, X: jax.Array, mode: MatMode):
         """Resident layout -> global column order (identity unless the
         strategy skews its resident R layout)."""

@@ -166,6 +166,19 @@ class DenseShift15D(DistributedSparse):
 
         if op in ("fusedSpMM", "cgStep", "gatLayer", "fusedSpMMB", "cgStepB"):
             return [repl, ring(n_pass), reduce_]
+        if op in ("fusedAttn", "fusedAttnB"):
+            # Attention is structurally the twopass pair (the softmax
+            # needs the complete SDDMM rotation) plus one [rows]-vector
+            # max/denominator merge over the replication axis — tiny
+            # next to the dense traffic, counted but out of model like
+            # the reduce-scatter.
+            merge = {
+                "collective": "pmax+psum", "axis": "cols",
+                "count": (2 if c > 1 else 0) * pairs,
+                "words": 2 * (c - 1) * stat_rows * pairs,
+                "in_model": False,
+            }
+            return [repl, ring(2), merge, reduce_]
         if op in ("sddmmA", "sddmmB"):
             return [repl, ring(1)]
         if op in ("spmmA", "spmmB"):
@@ -389,6 +402,48 @@ class DenseShift15D(DistributedSparse):
             in_specs = (dense_spec, dense_spec, _TILE_SPEC, _TILE_SPEC, _TILE_SPEC)
             out_specs = (dense_spec, _TILE_SPEC)
 
+        elif op == "attn":
+            # Fused block-sparse attention: SDDMM ring pass (complete
+            # rotation — every logit of the device's rows lands before
+            # any weight is formed), masked-softmax epilogue (segment
+            # stats + a [rows]-vector merge over the replication axis),
+            # SpMM ring pass over the normalized weights — ONE compiled
+            # program, no dense logits materialized.
+
+            def prog(stat, mov, t_rows, t_cols, t_vals):
+                t_rows, t_cols, t_vals = squeeze(t_rows), squeeze(t_cols), squeeze(t_vals)
+                stat_rep = replicate(stat)
+                out_vals = dvary(jnp.zeros((T, max_nnz), t_vals.dtype))
+                logits, mov = sddmm_pass(
+                    stat_rep, mov, t_rows, t_cols, t_vals, out_vals,
+                    complete_rotation=True,
+                )
+                probs = self._softmax_flat(
+                    kern, t_rows, t_vals, logits, stat_rows
+                )
+                acc = dvary(jnp.zeros((stat_rows, mov.shape[1]), mov.dtype))
+                acc, _ = spmm_pass(mov, t_rows, t_cols, probs, acc)
+                return reduce_out(acc), probs.reshape(1, 1, 1, T, max_nnz)
+
+            in_specs = (dense_spec, dense_spec, _TILE_SPEC, _TILE_SPEC, _TILE_SPEC)
+            out_specs = (dense_spec, _TILE_SPEC)
+
+        elif op == "attn_softmax":
+            # Standalone masked softmax over tile-layout logits — the
+            # middle stage of the UNFUSED baseline; shares the exact
+            # softmax closure with the fused program so the two paths
+            # stay bit-aligned.
+
+            def prog(t_rows, t_cols, t_vals, t_logits):
+                t_rows, t_vals = squeeze(t_rows), squeeze(t_vals)
+                probs = self._softmax_flat(
+                    kern, t_rows, t_vals, squeeze(t_logits), stat_rows
+                )
+                return probs.reshape(1, 1, 1, T, max_nnz)
+
+            in_specs = (_TILE_SPEC, _TILE_SPEC, _TILE_SPEC, _TILE_SPEC)
+            out_specs = _TILE_SPEC
+
         else:
             raise ValueError(op)
 
@@ -401,6 +456,61 @@ class DenseShift15D(DistributedSparse):
         )
         self._programs[key] = fn
         return fn
+
+    # ------------------------------------------------------------------ #
+    # Masked-softmax epilogue helpers (shared by the fused and the
+    # standalone-softmax programs of both kernel families).
+    # ------------------------------------------------------------------ #
+
+    def _merge_stats_cols(self, m, d):
+        """Cross-device online-softmax merge over the replication axis:
+        with c > 1 a row's nonzeros are column-cyclic across the
+        ``cols`` devices (which share one stationary row frame), so the
+        global max is a pmax and each local denominator rescales into
+        it before the psum. Identity at c == 1."""
+        if self.c == 1:
+            return m, d
+        mg = lax.pmax(m, "cols")
+        dg = lax.psum(d * jnp.exp(m - mg), "cols")
+        return mg, dg
+
+    def _softmax_flat(self, kern, t_rows, gate_t, logits_t, stat_rows):
+        """Row-wise masked softmax over flat tile-layout values: local
+        segment stats over ALL tiles (the SDDMM rotation completed, so
+        the device holds every logit it owns), cross-device merge,
+        normalize. Returns probs in tile layout [T, max_nnz]."""
+        shape = gate_t.shape
+        rows_f = t_rows.reshape(-1)
+        gate_f = gate_t.reshape(-1)
+        z_f = logits_t.reshape(-1)
+        m, d = kern.attn_stats(rows_f, gate_f, z_f, stat_rows)
+        m, d = self._merge_stats_cols(m, d)
+        return kern.attn_normalize(rows_f, gate_f, z_f, m, d).reshape(shape)
+
+    def _softmax_blk(self, kern, make_tile, fields, gate_t, logits_t):
+        """Blocked-path softmax: per-tile Pallas reduce launches riding
+        the chunk-list metadata, tile merge, cross-device merge, then
+        per-tile Pallas normalize launches. The tile loop is static
+        (one specialized launch pair per tile, exactly like the banked
+        per-band launches)."""
+        from distributed_sddmm_tpu.ops.kernels import attn_merge_stats
+
+        blr, blc, bmeta = fields
+        T = gate_t.shape[0]
+        tiles = [make_tile(blr[s], blc[s], bmeta[s]) for s in range(T)]
+        stats = [
+            kern.attn_stats_tile_t(tiles[s], gate_t[s], logits_t[s])
+            for s in range(T)
+        ]
+        m, d = attn_merge_stats(stats)
+        m, d = self._merge_stats_cols(m, d)
+        probs = [
+            kern.attn_norm_tile_t(
+                tiles[s], gate_t[s], logits_t[s], m, d, gate_t.dtype
+            )
+            for s in range(T)
+        ]
+        return jnp.stack(probs)
 
     # ------------------------------------------------------------------ #
     # Blocked (Pallas) shard_map programs — same ring/collective skeleton,
@@ -605,6 +715,45 @@ class DenseShift15D(DistributedSparse):
             in_specs = (dense_spec, dense_spec) + blk_specs + (_TILE_SPEC,)
             out_specs = (dense_spec, _TILE_SPEC)
 
+        elif op == "attn":
+            # Fused block-sparse attention, blocked: the masked-softmax
+            # epilogue rides the SAME chunk-list metadata between the
+            # SDDMM and SpMM ring passes — per-tile Pallas reduce/
+            # normalize launches, a tile merge, and the cols-axis merge,
+            # all inside ONE compiled program.
+
+            def prog(stat, mov, blr, blc, bmeta, t_vals):
+                fields = squeeze_blk(blr, blc, bmeta)
+                t_vals = t_vals.reshape(T, max_nnz)
+                at = kern.prep(replicate(stat), rows_pad)
+                out_vals = dvary(jnp.zeros((T, max_nnz), t_vals.dtype))
+                logits, mov = sddmm_pass(
+                    at, mov, fields, t_vals, out_vals, complete_rotation=True
+                )
+                probs = self._softmax_blk(
+                    kern, make_tile, fields, t_vals, logits
+                )
+                accT = dvary(jnp.zeros((mov.shape[-1], rows_pad), jnp.float32))
+                accT, _ = spmm_pass(mov, fields, probs, accT)
+                return finish(accT, mov), probs.reshape(1, 1, 1, T, max_nnz)
+
+            in_specs = (dense_spec, dense_spec) + blk_specs + (_TILE_SPEC,)
+            out_specs = (dense_spec, _TILE_SPEC)
+
+        elif op == "attn_softmax":
+
+            def prog(blr, blc, bmeta, t_vals, t_logits):
+                fields = squeeze_blk(blr, blc, bmeta)
+                t_vals = t_vals.reshape(T, max_nnz)
+                probs = self._softmax_blk(
+                    kern, make_tile, fields, t_vals,
+                    t_logits.reshape(T, max_nnz),
+                )
+                return probs.reshape(1, 1, 1, T, max_nnz)
+
+            in_specs = blk_specs + (_TILE_SPEC, _TILE_SPEC)
+            out_specs = _TILE_SPEC
+
         else:
             raise ValueError(op)
 
@@ -700,3 +849,66 @@ class DenseShift15D(DistributedSparse):
             _comm_op="fusedSpMMB",
         )
         return out, mid
+
+    # ------------------------------------------------------------------ #
+    # Fused block-sparse attention (SDDMM → masked softmax → SpMM)
+    # ------------------------------------------------------------------ #
+
+    def fused_attention(self, A, B, s_vals, mode: MatMode = MatMode.A):
+        """One compiled program: SDDMM logits at the mask pattern, a
+        numerically-stable row-wise masked softmax over the sparse
+        values (``s_vals != 0`` is the mask indicator; fully masked
+        rows come back all-zero), and the SpMM aggregation — no dense
+        logit matrix ever exists. Returns ``(new_dense, probs)`` with
+        ``probs`` the attention weights in tile layout. Independent of
+        ``fusion_approach`` (the softmax forces the twopass structure:
+        a row's denominator needs its complete logit set)."""
+        if mode == MatMode.A:
+            prog = self._program("attn", use_st=False)
+            return self._timed(
+                "fusedAttn", prog, A, B,
+                *self._tile_args(self.S_tiles, s_vals),
+            )
+        prog = self._program("attn", use_st=True)
+        return self._timed(
+            "fusedAttn", prog, B, A,
+            *self._tile_args(self.ST_tiles, s_vals),
+            _comm_op="fusedAttnB",
+        )
+
+    def attention_softmax(self, s_vals, logits, mode: MatMode = MatMode.A):
+        """Standalone masked softmax over tile-layout logit values — the
+        middle dispatch of the unfused baseline (same softmax code the
+        fused program inlines, so fused and unfused agree bitwise)."""
+        use_st = mode == MatMode.B
+        tiles = self.ST_tiles if use_st else self.S_tiles
+        prog = self._program("attn_softmax", use_st)
+        return self._timed(
+            "attnSoftmax", prog, *self._tile_args(tiles, s_vals), logits
+        )
+
+    def attention_unfused(self, A, B, s_vals, mode: MatMode = MatMode.A):
+        """The three-program baseline: SDDMM, softmax, SpMM as separate
+        dispatches — the logits and weights round-trip through HBM
+        twice, which is exactly the counted traffic the fused op
+        eliminates (``bench er --app attention`` records both)."""
+        mid = (self.sddmm_a if mode == MatMode.A else self.sddmm_b)(
+            A, B, s_vals
+        )
+        probs = self.attention_softmax(s_vals, mid, mode=mode)
+        out = (self.spmm_a if mode == MatMode.A else self.spmm_b)(
+            A, B, probs
+        )
+        return out, probs
+
+    def attention_program(self, s_vals, mode: MatMode = MatMode.A):
+        """Raw-program accessor: ``f(A, B) -> (out, probs)`` for one
+        compiled fused-attention dispatch (no host-side timing wrappers
+        — serving and AOT compiles chain this)."""
+        use_st = mode == MatMode.B
+        tiles = self.ST_tiles if use_st else self.S_tiles
+        prog = self._program("attn", use_st)
+        args = self._tile_args(tiles, s_vals)
+        if use_st:
+            return lambda A, B: prog(B, A, *args)
+        return lambda A, B: prog(A, B, *args)

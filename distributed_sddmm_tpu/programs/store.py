@@ -127,6 +127,8 @@ def cost_log_len() -> int:
 _OP_KEY_TOKENS = {
     "fusedSpMM": ("fused", "fused_twopass"),
     "fusedSpMMB": ("fused", "fused_twopass"),
+    "fusedAttn": ("attn",), "fusedAttnB": ("attn",),
+    "attnSoftmax": ("attn_softmax",),
     "sddmmA": ("sddmm",), "sddmmB": ("sddmm",),
     "spmmA": ("spmm",), "spmmB": ("spmm",),
 }

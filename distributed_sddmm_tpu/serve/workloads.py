@@ -42,6 +42,7 @@ from distributed_sddmm_tpu.utils.coo import HostCOO
 #: program count logarithmic in the supported range).
 ALS_ITEM_BUCKETS = (8, 16, 32, 64)
 GAT_NODE_BUCKETS = (1, 4, 16, 64)
+ATTN_TOKEN_BUCKETS = (1, 4, 16, 64)
 
 # Rung selection is the SHARED power-of-two bucketing rule
 # (``utils/buckets.py``) — the same module the autotune fingerprint's
@@ -394,6 +395,205 @@ class ALSFoldInTopK(ServingWorkload):
                 [np.asarray(p["ratings"], dtype=np.float64) for p in payloads],
                 mode="repair",
             )
+
+
+# --------------------------------------------------------------------- #
+# Attention: token scoring over cached context embeddings
+# --------------------------------------------------------------------- #
+
+
+class AttentionTokenScore(ServingWorkload):
+    """Score requested tokens by local attention over cached context.
+
+    The expensive whole-sequence half — the fused block-sparse
+    SDDMM → masked-softmax → SpMM pair — runs once at engine warmup
+    (``build_attention_engine``) and its output rows are the cached
+    context matrix ``K``. A request asks for scores of a token batch:
+    per token ``i``, attend over its ±w sliding-window neighborhood of
+    ``K`` with a numerically stable masked softmax and emit the
+    attention-weighted value score through a fixed head (seeded, so
+    replies are reproducible across processes).
+
+    Every per-dispatch op is batch-dim-invariant BY CONSTRUCTION:
+    gathers, elementwise math, and fixed-size LAST-AXIS max/sum
+    reductions only — no gemm whose accumulation order depends on the
+    batch dimension (the ``_chol_solve`` lesson) — so a reply is
+    bit-identical across arrival order, micro-batch composition, batch
+    bucket, and padding.
+
+    Payload: ``{"tokens": int array}``.
+    Reply:   ``{"tokens": int array, "scores": float array}``.
+    """
+
+    name = "attention"
+
+    def __init__(
+        self,
+        context: np.ndarray,
+        d_ops=None,
+        window: Optional[int] = None,
+        token_buckets: tuple[int, ...] = ATTN_TOKEN_BUCKETS,
+        head_seed: int = 0,
+        kernel_variant: Optional[str] = None,
+    ):
+        import os
+
+        import jax.numpy as jnp
+
+        if kernel_variant is None and d_ops is not None:
+            from distributed_sddmm_tpu.parallel.base import (
+                realized_kernel_variant,
+            )
+
+            kernel_variant = realized_kernel_variant(d_ops)
+        self.kernel_variant = kernel_variant
+        self.d_ops = d_ops
+        if window is None:
+            window = int(os.environ.get("DSDDMM_ATTN_SERVE_WINDOW", "16"))
+        if window < 0:
+            raise ValueError(f"window must be >= 0, got {window}")
+        self.window = int(window)
+        self.inner_buckets = tuple(sorted(int(b) for b in token_buckets))
+        self._K_host = np.ascontiguousarray(context, dtype=np.float32)
+        self.n_ctx, self.R = self._K_host.shape
+        self._K_dev = jnp.asarray(self._K_host)
+        rng = np.random.default_rng(head_seed)
+        self._w_host = (
+            rng.standard_normal(self.R) / np.sqrt(self.R)
+        ).astype(np.float32)
+        self._w_dev = jnp.asarray(self._w_host)
+
+    # -- payload shaping ----------------------------------------------- #
+
+    def inner_size(self, payload: dict) -> int:
+        return int(len(payload["tokens"]))
+
+    def clamp(self, payload: dict) -> dict:
+        cap = self.inner_buckets[-1]
+        if len(payload["tokens"]) <= cap:
+            return payload
+        return {"tokens": np.asarray(payload["tokens"])[:cap]}
+
+    def sample_payload(self, rng: np.random.Generator) -> dict:
+        n = int(min(1 + rng.poisson(2), self.inner_buckets[-1]))
+        return {
+            "tokens": rng.choice(
+                self.n_ctx, size=n, replace=False
+            ).astype(np.int64)
+        }
+
+    def program_params(self) -> str:
+        # The window width is a trace-time constant of the scoring
+        # program; the context matrix and head vector ride in as
+        # arguments (shapes covered by avals), so a refreshed context
+        # never invalidates the ladder.
+        return f"w{self.window}"
+
+    # -- device program ------------------------------------------------ #
+
+    def build_program(self, batch_bucket: int, inner_bucket: int):
+        import jax
+        import jax.numpy as jnp
+
+        from distributed_sddmm_tpu.ops.kernels import ATTN_NEG
+
+        w = self.window
+        n_ctx = self.n_ctx
+        inv_sqrt_r = 1.0 / float(np.sqrt(self.R))
+
+        def score(K, head, tokens, mask):
+            # (b, L, 2w+1) sliding-window neighborhood, edge-clipped via
+            # a validity mask (clip keeps the gather in range; the mask
+            # keeps the softmax honest).
+            offs = jnp.arange(-w, w + 1, dtype=jnp.int32)
+            nb = tokens[..., None] + offs
+            valid = (nb >= 0) & (nb < n_ctx)
+            nb = jnp.clip(nb, 0, n_ctx - 1)
+            q = K[tokens]                                  # (b, L, R)
+            kn = K[nb]                                     # (b, L, W, R)
+            logits = (
+                jnp.sum(q[..., None, :] * kn, axis=-1) * inv_sqrt_r
+            )
+            zsafe = jnp.where(valid, logits, jnp.asarray(ATTN_NEG, K.dtype))
+            m = jnp.max(zsafe, axis=-1, keepdims=True)     # last-axis ops:
+            e = jnp.where(valid, jnp.exp(zsafe - m), 0.0)  # batch-invariant
+            d = jnp.sum(e, axis=-1)
+            vals = jnp.sum(kn * head, axis=-1)             # (b, L, W)
+            num = jnp.sum(e * vals, axis=-1)
+            # The token itself is always in-window, so d > 0 at every
+            # real row; padded rows divide by 1 and are masked to 0.
+            return num / jnp.where(d > 0, d, 1.0) * mask
+
+        return jax.jit(score)
+
+    def pad_batch(
+        self, payloads: list[dict], batch_bucket: int, inner_bucket: int
+    ) -> tuple:
+        b, L = batch_bucket, inner_bucket
+        tokens = np.zeros((b, L), dtype=np.int32)
+        mask = np.zeros((b, L), dtype=np.float32)
+        for i, p in enumerate(payloads):
+            n = len(p["tokens"])
+            tokens[i, :n] = p["tokens"]
+            mask[i, :n] = 1.0
+        return (self._K_dev, self._w_dev, tokens, mask)
+
+    def unpad(self, outputs, payloads: list[dict]) -> list[dict]:
+        scores = np.asarray(outputs)[: len(payloads)]
+        return [
+            {
+                "tokens": np.asarray(p["tokens"], dtype=np.int64),
+                "scores": scores[i][: len(p["tokens"])],
+            }
+            for i, p in enumerate(payloads)
+        ]
+
+    # -- host paths ---------------------------------------------------- #
+
+    def _scores_host(self, payload: dict, K: np.ndarray) -> np.ndarray:
+        from distributed_sddmm_tpu.ops.kernels import ATTN_NEG
+
+        head = self._w_host.astype(K.dtype)
+        tokens = np.asarray(payload["tokens"], dtype=np.int64)
+        offs = np.arange(-self.window, self.window + 1, dtype=np.int64)
+        nb = tokens[:, None] + offs
+        valid = (nb >= 0) & (nb < self.n_ctx)
+        nb = np.clip(nb, 0, self.n_ctx - 1)
+        q = K[tokens]
+        kn = K[nb]
+        logits = np.sum(q[:, None, :] * kn, axis=-1) / np.sqrt(
+            K.dtype.type(self.R)
+        )
+        zsafe = np.where(valid, logits, K.dtype.type(ATTN_NEG))
+        m = np.max(zsafe, axis=-1, keepdims=True)
+        e = np.where(valid, np.exp(zsafe - m), 0.0).astype(K.dtype)
+        d = np.sum(e, axis=-1)
+        vals = np.sum(kn * head, axis=-1)
+        return np.sum(e * vals, axis=-1) / np.where(d > 0, d, 1.0)
+
+    def serial(self, payload: dict) -> dict:
+        tokens = np.asarray(payload["tokens"], dtype=np.int64)
+        return {
+            "tokens": tokens,
+            "scores": self._scores_host(payload, self._K_host).astype(
+                np.float32
+            ),
+        }
+
+    def oracle(self, payload: dict) -> dict:
+        tokens = np.asarray(payload["tokens"], dtype=np.int64)
+        return {
+            "tokens": tokens,
+            "scores": self._scores_host(
+                payload, self._K_host.astype(np.float64)
+            ),
+        }
+
+    def check_reply(self, payload: dict, reply: dict) -> bool:
+        want = self.oracle(payload)["scores"]
+        got = np.asarray(reply["scores"], dtype=np.float64)[: len(want)]
+        scale = max(float(np.max(np.abs(want))) if want.size else 0.0, 1.0)
+        return bool(np.all(np.abs(got - want) <= 1e-3 * scale))
 
 
 # --------------------------------------------------------------------- #

@@ -48,6 +48,12 @@ _K = Knob
 #: Every DSDDMM_* knob, alphabetical. Keep docs to one line — this IS
 #: the README table.
 KNOBS: dict[str, Knob] = {k.name: k for k in [
+    _K("DSDDMM_ATTN_SERVE_WINDOW", "int", "16",
+       "attention token-scoring endpoint's sliding-window half-width "
+       "(serve/workloads.py)"),
+    _K("DSDDMM_ATTN_STREAM_BUDGET", "int", "16777216",
+       "element budget past which the masked-softmax row stats switch "
+       "to the streaming max/denominator scan (ops/kernels.py)"),
     _K("DSDDMM_BATCH_STEP", "flag", "0",
        "batch grid steps in the blocked Pallas kernels (README: step "
        "batching)"),

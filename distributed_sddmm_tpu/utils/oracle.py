@@ -56,6 +56,41 @@ def fused_spmm_b(S: HostCOO, A: np.ndarray, B: np.ndarray) -> np.ndarray:
     return spmm_b(S.with_values(mid), A)
 
 
+def masked_softmax(S: HostCOO, logits: np.ndarray) -> np.ndarray:
+    """Row-wise masked softmax over the sparse logit values (float64).
+
+    Entries with ``S.vals == 0`` are masked out (the same ``gate != 0``
+    indicator the device kernels use); a row whose entries are all
+    masked — or that has no entries at all — gets exactly-zero weights,
+    never NaN. The max subtraction matches the device kernels' stable
+    formulation so f32 comparisons are apples-to-apples.
+    """
+    from distributed_sddmm_tpu.ops.kernels import ATTN_NEG
+
+    z = np.asarray(logits, dtype=np.float64)
+    gate = S.vals != 0
+    m = np.full(S.M, ATTN_NEG)
+    np.maximum.at(m, S.rows[gate], z[gate])
+    e = np.zeros_like(z)
+    e[gate] = np.exp(z[gate] - m[S.rows[gate]])
+    d = np.zeros(S.M)
+    np.add.at(d, S.rows, e)
+    out = np.zeros_like(z)
+    ok = gate & (d[S.rows] > 0)
+    out[ok] = e[ok] / d[S.rows[ok]]
+    return out
+
+
+def fused_attention_a(
+    S: HostCOO, A: np.ndarray, B: np.ndarray
+) -> tuple[np.ndarray, np.ndarray]:
+    """Block-sparse attention reference: SDDMM logits → row-wise masked
+    softmax → SpMM aggregation, all float64. Returns ``(out [M, R],
+    probs [nnz])`` in S's nonzero order."""
+    probs = masked_softmax(S, sddmm(S, A, B))
+    return spmm_a(S.with_values(probs), B), probs
+
+
 def dummy_dense(n_rows: int, R: int, dtype=np.float64) -> np.ndarray:
     """Deterministic fill ``value = row * R + col``.
 

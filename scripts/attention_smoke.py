@@ -1,0 +1,212 @@
+"""Attention smoke: masks, fused pair, counted HBM cut, serving.
+
+One process, four sections, JSON report (the tier-1 test
+``tests/test_attention_smoke.py`` asserts on it):
+
+* **masks** — the three structured families build over one token count
+  with sane degree profiles; the spec grammar round-trips.
+* **oracle** — the fused SDDMM → masked-softmax → SpMM pair matches the
+  float64 oracle on every mask family (fully-masked rows come back
+  exactly zero, never NaN), on the XLA path AND the banked Pallas
+  interpreter path, and the attention weights are row-stochastic.
+* **fusion** — fused vs the three-program unfused baseline agree
+  BIT-FOR-BIT on integer-exact data, the fused run dispatches ONE
+  program, and counted HBM traffic is strictly below unfused on the
+  headline configs (sliding-window and BigBird, R in {128, 1024}).
+* **serve** — the token-scoring endpoint built on a fused-attention
+  warm context replies bit-identically across batch composition and
+  matches its float64 oracle.
+
+Exit contract: 0 clean, 2 on any failed check.
+
+Usage::
+
+    python scripts/attention_smoke.py [-o out.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import pathlib
+import sys
+
+REPO = pathlib.Path(__file__).resolve().parents[1]
+sys.path.insert(0, str(REPO))
+
+
+def run() -> dict:
+    from distributed_sddmm_tpu.utils.platform import force_cpu_platform
+
+    force_cpu_platform(n_devices=8, replace=True)
+
+    import numpy as np
+
+    from distributed_sddmm_tpu import codegen, masks
+    from distributed_sddmm_tpu.autotune.fingerprint import Problem
+    from distributed_sddmm_tpu.bench.harness import _attention_hbm_bytes
+    from distributed_sddmm_tpu.parallel.dense_shift_15d import DenseShift15D
+    from distributed_sddmm_tpu.serve import build_attention_engine
+    from distributed_sddmm_tpu.utils import oracle
+    from distributed_sddmm_tpu.utils.coo import HostCOO
+
+    report: dict = {}
+    rng = np.random.default_rng(0)
+
+    # ------------------------------------------------------------------ #
+    # 1. Masks
+    # ------------------------------------------------------------------ #
+    n = 192
+    graph_src = HostCOO.rmat(log_m=8, edge_factor=4, seed=0)
+    fams = {
+        "window:5": masks.from_spec("window:5", n),
+        "bigbird:w=3,g=2,r=2": masks.from_spec("bigbird:w=3,g=2,r=2", n),
+        "graph": masks.from_spec("graph", n, graph=graph_src),
+    }
+    report["masks"] = {
+        spec: {
+            "n": S.M, "nnz": S.nnz,
+            "max_deg": int(np.bincount(S.rows, minlength=S.M).max()),
+        }
+        for spec, S in fams.items()
+    }
+    assert fams["window:5"].nnz == masks.sliding_window(n, 5).nnz
+
+    # ------------------------------------------------------------------ #
+    # 2. Oracle across families (+ fully masked rows), XLA and banked
+    # ------------------------------------------------------------------ #
+    R = 16
+    oracle_report = {}
+    for spec, S0 in fams.items():
+        vals = np.ones(S0.nnz)
+        vals[rng.random(S0.nnz) < 0.1] = 0.0
+        vals[S0.rows == 2] = 0.0  # fully masked row
+        S = S0.with_values(vals)
+        A = rng.standard_normal((S.M, R))
+        B = rng.standard_normal((S.N, R))
+        want_out, want_probs = oracle.fused_attention_a(S, A, B)
+        errs = {}
+        for kname, kern in (
+            ("xla", None),
+            ("banked", codegen.BankedPallasKernel(
+                codegen.select_variant(Problem.from_coo(S, R=R)),
+                precision="f32", interpret=True,
+            )),
+        ):
+            alg = DenseShift15D(S, R=R, c=1, fusion_approach=2, kernel=kern)
+            out, probs = alg.fused_attention(
+                alg.put_a(A.astype(np.float32)),
+                alg.put_b(B.astype(np.float32)),
+                alg.scatter_s_values(vals.astype(np.float32)),
+            )
+            out_h = alg.host_a(out)
+            p_h = alg.gather_s_values(probs)
+            errs[kname] = {
+                "out": float(np.max(np.abs(out_h - want_out))),
+                "probs": float(np.max(np.abs(p_h - want_probs))),
+            }
+            assert errs[kname]["out"] < 1e-4, (spec, kname, errs)
+            assert errs[kname]["probs"] < 1e-5, (spec, kname, errs)
+            assert np.all(out_h[2] == 0.0), (spec, kname)  # dead row
+            assert np.isfinite(out_h).all(), (spec, kname)
+            sums = np.zeros(S.M)
+            np.add.at(sums, S.rows, p_h)
+            live = np.zeros(S.M, dtype=bool)
+            live[S.rows[vals != 0]] = True
+            assert np.allclose(sums[live], 1.0, atol=1e-5), (spec, kname)
+        oracle_report[spec] = errs
+    report["oracle"] = oracle_report
+
+    # ------------------------------------------------------------------ #
+    # 3. Fusion: bit agreement, one program, counted HBM cut
+    # ------------------------------------------------------------------ #
+    S0 = masks.bigbird(160, 3, 2, 2)
+    vals = np.ones(S0.nnz)
+    vals[rng.random(S0.nnz) < 0.1] = 0.0
+    S = S0.with_values(vals)
+    alg = DenseShift15D(S, R=8, c=1, fusion_approach=2)
+    A = alg.put_a(rng.integers(-3, 4, (S.M, 8)).astype(np.float32))
+    B = alg.put_b(rng.integers(-3, 4, (S.N, 8)).astype(np.float32))
+    sv = alg.scatter_s_values(vals.astype(np.float32))
+    out_f, p_f = alg.fused_attention(A, B, sv)
+    calls = alg.metrics.calls_view()
+    out_u, p_u = alg.attention_unfused(A, B, sv)
+    bit_identical = bool(
+        np.array_equal(np.asarray(out_f), np.asarray(out_u))
+        and np.array_equal(np.asarray(p_f), np.asarray(p_u))
+    )
+    hbm = {}
+    for spec in ("window:8", "bigbird:w=4,g=2,r=2"):
+        for R_h in (128, 1024):
+            Sm = masks.from_spec(spec, 256)
+            alg_h = DenseShift15D(Sm, R=R_h, c=1, fusion_approach=2)
+            h = _attention_hbm_bytes(alg_h, alg_h.like_s_values(1.0))
+            hbm[f"{spec}@R{R_h}"] = h
+            assert h["fused_bytes"] < h["unfused_bytes"], (spec, R_h, h)
+    report["fusion"] = {
+        "bit_identical": bit_identical,
+        "fused_dispatches": calls.get("fusedAttn"),
+        "hbm": hbm,
+    }
+    assert bit_identical, report["fusion"]
+    assert calls.get("fusedAttn") == 1, calls
+
+    # ------------------------------------------------------------------ #
+    # 4. Serving: batch-composition bit identity + oracle
+    # ------------------------------------------------------------------ #
+    eng = build_attention_engine(
+        masks.sliding_window(128, 6), R=8, window=4,
+        max_batch=8, max_depth=16, token_buckets=(2, 4),
+    )
+    eng.warmup()
+    wl = eng.workload
+    payloads = [wl.sample_payload(rng) for _ in range(5)]
+    base = eng.execute_now(payloads)
+
+    def eq(a, b):
+        return set(a) == set(b) and all(
+            np.array_equal(np.asarray(a[k]), np.asarray(b[k])) for k in a
+        )
+
+    order_ok = all(
+        eq(eng.execute_now([payloads[i] for i in perm])[where], base[i])
+        for perm in ([4, 2, 0, 3, 1],)
+        for where, i in enumerate(perm)
+    )
+    solo_ok = all(
+        eq(eng.execute_now([p])[0], base[i])
+        for i, p in enumerate(payloads)
+    )
+    oracle_ok = all(
+        wl.check_reply(p, base[i]) and wl.check_reply(p, wl.serial(p))
+        for i, p in enumerate(payloads)
+    )
+    report["serve"] = {
+        "arrival_order_bit_identical": order_ok,
+        "padding_bit_identical": solo_ok,
+        "oracle_ok": oracle_ok,
+        "kernel_variant": wl.kernel_variant,
+        "window": wl.window,
+    }
+    assert order_ok and solo_ok and oracle_ok, report["serve"]
+    return report
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("-o", "--output-file", default=None)
+    args = ap.parse_args()
+    try:
+        report = run()
+    except AssertionError as e:
+        print(json.dumps({"failed": str(e)[:2000]}))  # cli-output
+        return 2
+    out = json.dumps(report, indent=2, default=str)
+    print(out)  # cli-output
+    if args.output_file:
+        pathlib.Path(args.output_file).write_text(out)  # non-atomic-ok: smoke artifact
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,67 @@
+"""Structural HLO gate for the fused-attention epilogue (tier-1
+acceptance, ``test_codegen_gate.py`` style): the banked fused-attention
+program — SDDMM ring pass, masked-softmax epilogue, SpMM ring pass in
+ONE compiled program — AOT-compiled for a real v5e TPU topology must
+carry the epilogue as genuine Mosaic launches: exactly
+``2 x n_tiles x n_bands`` more ``tpu_custom_call`` sites than the
+fused_twopass pair module compiled from the same strategy (one
+streaming reduce + one normalize per tile per band), proving the
+epilogue fuses into the banked v5e module rather than living only in
+the CPU interpreter. The committed ``ATTENTION_HLO.json`` is this
+probe's banked record.
+
+Subprocess + ``TPU_SKIP_MDS_QUERY=1`` for the same libtpu metadata
+reason as the codegen gate.
+"""
+
+import json
+import os
+import pathlib
+import subprocess
+import sys
+
+REPO = pathlib.Path(__file__).resolve().parents[1]
+
+_PROBE = """
+import json, sys
+sys.path.insert(0, {repo!r})
+from distributed_sddmm_tpu.utils.platform import force_cpu_platform
+force_cpu_platform(n_devices=8, replace=True)
+from distributed_sddmm_tpu.codegen.hlo import attention_hlo_report
+print("RESULT " + json.dumps(attention_hlo_report()))
+"""
+
+
+def test_attention_epilogue_v5e_hlo_gate():
+    env = dict(os.environ)
+    env.update({
+        "TPU_SKIP_MDS_QUERY": "1",
+        "DSDDMM_PROGRAMS": "0",
+        "DSDDMM_RUNSTORE": "0",
+        "PYTHONPATH": str(REPO),
+    })
+    proc = subprocess.run(
+        [sys.executable, "-c", _PROBE.format(repo=str(REPO))],
+        capture_output=True, text=True, timeout=540, env=env,
+    )
+    assert proc.returncode == 0, proc.stdout[-2000:] + proc.stderr[-2000:]
+    line = [l for l in proc.stdout.splitlines() if l.startswith("RESULT ")]
+    assert line, proc.stdout[-2000:]
+    rec = json.loads(line[0][len("RESULT "):])
+    assert rec["topology"] == "v5e:2x4" and rec["mask"] == "graph"
+    assert rec["is_scheduled"] is True
+    # The skewed graph mask must keep banking live (the uniform-mask
+    # degeneration guard must NOT fire here).
+    assert len(rec["bands"]) >= 2, rec
+    # The epilogue fused into the module as real Mosaic launches: one
+    # streaming-reduce + one normalize launch per tile per band beyond
+    # the plain pair's launches, nothing silently elided or duplicated.
+    assert rec["pallas_calls_pair"] >= 1, rec
+    assert rec["epilogue_calls"] == rec["epilogue_calls_expected"] == (
+        2 * rec["n_tiles"] * len(rec["bands"])
+    ), rec
+    # Matches the committed banked record on every structural field.
+    committed = json.loads((REPO / "ATTENTION_HLO.json").read_text())
+    for field in ("topology", "variant", "n_tiles", "pallas_calls_attn",
+                  "pallas_calls_pair", "epilogue_calls"):
+        assert rec[field] == committed[field], (field, rec, committed)

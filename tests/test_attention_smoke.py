@@ -1,0 +1,83 @@
+"""Tier-1 attention smoke: scripts/attention_smoke.py in a subprocess.
+
+Pins the fused-attention acceptance surface end to end: the three mask
+families vs the float64 oracle on the XLA AND banked-Pallas paths
+(fully masked rows exactly zero, weights row-stochastic), fused ==
+unfused bit-for-bit on integer-exact data with the fused pair
+dispatching ONE program, counted HBM traffic strictly below the
+three-program unfused sequence on the headline configs (sliding-window
+and BigBird at R in {128, 1024}), and the token-scoring serve endpoint
+bit-identical across batch composition. Exit contract 0/2.
+"""
+
+import json
+import pathlib
+import subprocess
+import sys
+
+REPO = pathlib.Path(__file__).resolve().parents[1]
+
+
+def test_attention_smoke(tmp_path):
+    out = tmp_path / "report.json"
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "scripts" / "attention_smoke.py"),
+         "-o", str(out)],
+        capture_output=True, text=True, timeout=540,
+        env={"PATH": "/usr/bin:/bin:/usr/local/bin", "HOME": "/tmp",
+             "JAX_PLATFORMS": "cpu", "DSDDMM_RUNSTORE": "0",
+             "DSDDMM_PROGRAMS": "0"},
+    )
+    assert proc.returncode == 0, proc.stdout[-2000:] + proc.stderr[-2000:]
+    rep = json.loads(out.read_text())
+
+    # All three mask families built and checked against the oracle on
+    # both kernel paths.
+    assert set(rep["oracle"]) == {
+        "window:5", "bigbird:w=3,g=2,r=2", "graph"
+    }
+    for errs in rep["oracle"].values():
+        for k in ("xla", "banked"):
+            assert errs[k]["out"] < 1e-4 and errs[k]["probs"] < 1e-5
+
+    # Acceptance: one program, bit identity, counted HBM cut on every
+    # headline config.
+    assert rep["fusion"]["bit_identical"] is True
+    assert rep["fusion"]["fused_dispatches"] == 1
+    assert set(rep["fusion"]["hbm"]) == {
+        "window:8@R128", "window:8@R1024",
+        "bigbird:w=4,g=2,r=2@R128", "bigbird:w=4,g=2,r=2@R1024",
+    }
+    for h in rep["fusion"]["hbm"].values():
+        assert h["fused_bytes"] < h["unfused_bytes"]
+        assert h["savings_frac"] > 0.0
+
+    # Serving contract.
+    assert rep["serve"]["arrival_order_bit_identical"] is True
+    assert rep["serve"]["padding_bit_identical"] is True
+    assert rep["serve"]["oracle_ok"] is True
+
+
+def test_attention_smoke_fails_loud(tmp_path):
+    """The 0/2 contract's failure half: a poisoned check exits 2 with a
+    JSON failure line, never a silent 0."""
+    script = str(REPO / "scripts" / "attention_smoke.py")
+    probe = (
+        "import importlib.util, sys\n"
+        "spec = importlib.util.spec_from_file_location('asmoke', {s!r})\n"
+        "sm = importlib.util.module_from_spec(spec)\n"
+        "spec.loader.exec_module(sm)\n"
+        "def bad():\n"
+        "    raise AssertionError('seeded-failure')\n"
+        "sm.run = bad\n"
+        "sys.argv = ['attention_smoke.py']\n"
+        "sys.exit(sm.main())\n"
+    ).format(s=script)
+    proc = subprocess.run(
+        [sys.executable, "-c", probe],
+        capture_output=True, text=True, timeout=60,
+        env={"PATH": "/usr/bin:/bin:/usr/local/bin", "HOME": "/tmp",
+             "JAX_PLATFORMS": "cpu"},
+    )
+    assert proc.returncode == 2, proc.stdout + proc.stderr
+    assert "seeded-failure" in proc.stdout

@@ -140,6 +140,8 @@ def test_als_resume_with_empty_store_is_fresh_start(tmp_path):
     assert als.A is not None
 
 
+@pytest.mark.slow  # kill-and-resume's bit-identity assertion subsumes
+# the step bookkeeping this pins; kept for -m slow runs.
 def test_als_mid_cg_crash_resumes_from_last_step(tmp_path):
     """Crash INSIDE the CG inner loop (not between steps): the interrupted
     step never checkpoints, resume re-runs it from the last completed one."""

@@ -75,6 +75,8 @@ class TestGradients:
                 scale = np.abs(x).max() + 1
                 np.testing.assert_allclose(x / scale, y / scale, atol=1e-5)
 
+    @pytest.mark.slow  # the fused VJP (kept above) composes the same
+    # formulas; the individual-op rows ride in -m slow runs.
     def test_pallas_unfused_op_grads(self):
         # sddmm and spmm custom VJPs individually (the fused VJP composes
         # them and is covered above).
