@@ -285,8 +285,17 @@ def node_span(node: ast.AST) -> tuple[int, int]:
 #: package's lint surface).
 EXCLUDE_DIRS = {
     "artifacts", "__pycache__", ".git", ".venv", "node_modules",
-    "native", ".pytest_cache", "build", "dist",
+    ".pytest_cache",
 }
+
+#: Excluded only as REPO-ROOT directories: packaging output (``build/``,
+#: ``dist/``) and the native C++ tree live at the checkout root, while
+#: ``distributed_sddmm_tpu/dist/`` (the multi-host subsystem, PR 14) is
+#: real package source the checkers must scan. Anchored to
+#: :func:`repo_root`, NOT the scan root — ``--root
+#: distributed_sddmm_tpu`` must see the same files a repo-root scan
+#: sees for that subtree.
+EXCLUDE_TOP_DIRS = {"native", "build", "dist"}
 
 
 def repo_root() -> pathlib.Path:
@@ -294,9 +303,13 @@ def repo_root() -> pathlib.Path:
 
 
 def iter_source_paths(root: pathlib.Path) -> Iterator[pathlib.Path]:
+    excluded_roots = {repo_root() / name for name in EXCLUDE_TOP_DIRS}
     for path in sorted(root.rglob("*.py")):
         rel_parts = path.relative_to(root).parts
         if any(part in EXCLUDE_DIRS for part in rel_parts[:-1]):
+            continue
+        if any(parent in excluded_roots
+               for parent in path.resolve().parents):
             continue
         yield path
 

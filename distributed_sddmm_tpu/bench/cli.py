@@ -62,6 +62,7 @@ import json
 import os
 import sys
 
+from distributed_sddmm_tpu.bench import harness
 from distributed_sddmm_tpu.bench.harness import (
     ALGORITHM_FACTORIES,
     benchmark_algorithm,
@@ -1256,6 +1257,9 @@ def _dispatch_serve(args) -> int:
         "fused": True,
         "kernel": getattr(d_ops.kernel, "name", type(d_ops.kernel).__name__),
         "kernel_variant": eng.workload.kernel_variant,
+        # Pod identity (runstore index + gate config axis) — serving
+        # records must split across pod shapes like offline ones.
+        **harness.pod_record_fields(),
         "num_trials": summary["completed"],
         "elapsed": summary["duration_s"],
         "overall_throughput": None,

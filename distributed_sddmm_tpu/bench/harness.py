@@ -264,6 +264,15 @@ def _run_als(
     return elapsed, stats
 
 
+def pod_record_fields() -> dict:
+    """Pod identity for bench/serve records — ONE shape, owned by
+    :meth:`dist.init.PodContext.record_fields` (the manifest resolves
+    through the same method, so records and manifests cannot drift)."""
+    from distributed_sddmm_tpu.dist.init import pod_info
+
+    return pod_info().record_fields()
+
+
 def benchmark_algorithm(
     S: HostCOO,
     algorithm_name: str,
@@ -416,6 +425,10 @@ def benchmark_algorithm(
         "overall_throughput": throughput,
         "kernel": getattr(alg.kernel, "name", type(alg.kernel).__name__),
         "kernel_variant": realized_kernel_variant(alg),
+        # Pod identity: the runstore indexes these and gates on
+        # num_processes, so a future multi-host record can never pool
+        # into a single-process baseline.
+        **pod_record_fields(),
         "alg_info": alg.json_algorithm_info(),
         "perf_stats": perf_stats,
         "metrics": alg.metrics.to_dict(),

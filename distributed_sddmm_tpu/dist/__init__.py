@@ -1,0 +1,38 @@
+"""Multi-host (pod-scale) execution layer.
+
+The paper's communication-avoiding algorithms exist for the
+distributed-memory regime — p processes, no rank holding the whole
+matrix — and this package is the controller-side half of that regime
+for TPU pods: one OS process per host, connected by
+``jax.distributed.initialize``, every strategy program compiled
+per-process with GLOBAL semantics over a process-spanning mesh.
+
+Modules:
+
+* :mod:`~distributed_sddmm_tpu.dist.init` — coordinator resolution,
+  ``jax.distributed`` initialization, pod identity (``pod_info``), and
+  the cross-process ``device_put`` capability probe the pod tests key
+  their strictness on.
+* :mod:`~distributed_sddmm_tpu.dist.ingest` — the partitioned HostCOO
+  loader: each host parses/sanitizes/ingests only its own block rows,
+  so no host ever materializes the full matrix (peak host bytes
+  O(nnz/p) + constants, pinned by test).
+* :mod:`~distributed_sddmm_tpu.dist.elastic` — elastic membership on
+  the resilience layer: a lost worker becomes checkpoint scan-back
+  recovery at reduced ``p``, not a dead run.
+* :mod:`~distributed_sddmm_tpu.dist.hlo` — the offline v5e multi-host
+  AOT structural gate (``MULTIHOST_HLO.json``): the fused-pair module
+  compiled for a 2-host topology must carry collectives whose replica
+  groups span the host boundary.
+* :mod:`~distributed_sddmm_tpu.dist.run` — the pod runner promoted
+  from ``scripts/run_pod.py`` (per-worker metrics ports, per-worker
+  trace shards, end-of-run trace merge).
+"""
+
+from distributed_sddmm_tpu.dist.init import (  # noqa: F401
+    PodContext,
+    cross_process_probe,
+    initialize,
+    pod_info,
+    resolve_init_kwargs,
+)

@@ -1,0 +1,281 @@
+"""Elastic pod membership on the resilience layer.
+
+A lost worker must turn into **checkpoint scan-back recovery at reduced
+p**, not a dead run. This module is the controller-of-controllers: an
+:class:`ElasticSupervisor` launches one OS process per pod slot,
+watches for deaths (the resilience layer's ``kill`` faults exit with
+``faults.KILL_EXIT_CODE``; real crashes exit nonzero or die on a
+signal), and on loss relaunches the survivors' work as a new
+*generation* at reduced process count. Recovery workers resume from the
+shared :class:`~distributed_sddmm_tpu.resilience.checkpoint.
+CheckpointStore` via its scan-back ladder — the supervisor passes no
+state, only identity: generation number, new ``p``, and which fixed
+data shards each worker now owns.
+
+Shard-vs-worker split: the DATA partition is fixed at the original pod
+size (``nshards``), independent of the live worker count — worker ``w``
+of a ``live_p``-worker generation owns shards ``{s : s % live_p == w}``.
+A 2-worker run that loses worker 1 recovers as a 1-worker generation
+owning both shards, resuming shard 1 from whatever step its dead owner
+last checkpointed (scan-back) and shard 0 from its own completed
+checkpoints — the final state is bit-identical to an uninterrupted run
+because the checkpoint store round-trips float bits and the per-shard
+step programs are deterministic.
+
+Fault plans and recovery: firing is a pure function of (seed, spec,
+site, call#) *per process*, so a relaunched worker would re-trigger the
+very kill that felled its predecessor. Recovery generations therefore
+drop ``DSDDMM_FAULTS`` by default (``drop_faults_on_recovery``) — the
+semantic being modeled is "the faulty host left the pod", not "the
+fault chases the work".
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import socket
+import subprocess
+import sys
+import time
+from typing import Callable, Optional
+
+from distributed_sddmm_tpu.obs import log as obs_log
+from distributed_sddmm_tpu.resilience.faults import KILL_EXIT_CODE
+
+
+def free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+@dataclasses.dataclass
+class GenerationResult:
+    """One generation's outcome.
+
+    ``lost`` holds workers that died on their OWN (fault kill, crash);
+    ``reaped`` holds survivors the supervisor killed after the grace
+    window (blocked on a barrier their dead peer never reached, or a
+    generation timeout). Only ``lost`` shrinks the next generation's
+    ``p`` — a reaped worker's host is healthy and must stay in the pod.
+    """
+
+    generation: int
+    live_p: int
+    returncodes: list
+    records: list  # last-JSON-line per worker (None when unparsable)
+    lost: list    # workers that died on their own
+    reaped: list = dataclasses.field(default_factory=list)
+
+    @property
+    def ok(self) -> bool:
+        return (not self.lost and not self.reaped
+                and all(rc == 0 for rc in self.returncodes))
+
+
+@dataclasses.dataclass
+class ElasticResult:
+    generations: list
+    #: True only when a WORKER LOSS drove a reduced-p recovery
+    #: generation — a pure-timeout retry at unchanged p is not a
+    #: recovery (no membership change happened).
+    recovered: bool
+
+    @property
+    def ok(self) -> bool:
+        return bool(self.generations) and self.generations[-1].ok
+
+    @property
+    def records(self) -> list:
+        return self.generations[-1].records if self.generations else []
+
+
+class ElasticSupervisor:
+    """Launch, watch, and elastically relaunch a pod's worker processes.
+
+    ``worker_argv(generation, live_p, worker, port)`` builds one
+    worker's command line (the test drill points it at
+    ``tests/_mp_worker.py --elastic``; a real pod points it at
+    ``scripts/run_pod.py``). ``worker_env(generation, live_p, worker)``
+    overlays per-worker environment — the hook that aims a ``kill``
+    fault at ONE worker instead of the whole (deterministically
+    identical) fleet.
+    """
+
+    def __init__(
+        self,
+        worker_argv: Callable[[int, int, int, int], list],
+        nprocs: int,
+        *,
+        worker_env: Optional[Callable[[int, int, int], dict]] = None,
+        max_recoveries: int = 1,
+        generation_timeout_s: float = 300.0,
+        grace_s: float = 10.0,
+        drop_faults_on_recovery: bool = True,
+        on_loss: Optional[Callable[[GenerationResult], None]] = None,
+        cwd: Optional[str] = None,
+    ):
+        if nprocs < 1:
+            raise ValueError("nprocs must be >= 1")
+        self.worker_argv = worker_argv
+        self.nprocs = nprocs
+        self.worker_env = worker_env
+        self.max_recoveries = max_recoveries
+        self.generation_timeout_s = generation_timeout_s
+        self.grace_s = grace_s
+        self.drop_faults_on_recovery = drop_faults_on_recovery
+        #: Called with the failed GenerationResult before the recovery
+        #: generation launches — the re-provisioning hook (and the test
+        #: drill's lever for corrupting a checkpoint pointer so recovery
+        #: demonstrably rides the scan-back ladder).
+        self.on_loss = on_loss
+        self.cwd = cwd
+
+    # ------------------------------------------------------------------ #
+
+    def _spawn(self, generation: int, live_p: int) -> list:
+        import tempfile
+
+        port = free_port()
+        procs = []
+        for w in range(live_p):
+            env = dict(os.environ)
+            if generation > 0 and self.drop_faults_on_recovery:
+                env.pop("DSDDMM_FAULTS", None)
+            if self.worker_env is not None:
+                env.update(self.worker_env(generation, live_p, w))
+            # Temp files, not PIPEs: the watch loop does not drain
+            # output until exit, and a chatty worker (DSDDMM_LOG=debug
+            # writes structured logs to stderr) would fill a ~64KB pipe
+            # buffer, block in write(), and read as hung/lost.
+            out_f = tempfile.TemporaryFile(mode="w+")
+            err_f = tempfile.TemporaryFile(mode="w+")
+            proc = subprocess.Popen(
+                [sys.executable, *self.worker_argv(
+                    generation, live_p, w, port
+                )],
+                stdout=out_f, stderr=err_f, text=True,
+                env=env, cwd=self.cwd,
+            )
+            proc._elastic_out, proc._elastic_err = out_f, err_f
+            procs.append(proc)
+        return procs
+
+    def _watch(self, procs: list, generation: int, live_p: int
+               ) -> GenerationResult:
+        """Wait for the generation, detecting a death promptly: once any
+        worker exits nonzero, survivors get ``grace_s`` to finish (their
+        local work may be complete) and are then killed — a worker
+        blocked on a barrier its dead peer will never reach must not
+        stall recovery for the full generation timeout."""
+        deadline = time.monotonic() + self.generation_timeout_s
+        death_seen_at = None
+        reaped: set = set()
+        while True:
+            rcs = [p.poll() for p in procs]
+            if all(rc is not None for rc in rcs):
+                break
+            now = time.monotonic()
+            if death_seen_at is None and any(
+                rc is not None and rc != 0 for rc in rcs
+            ):
+                death_seen_at = now
+            if now > deadline or (
+                death_seen_at is not None and now > death_seen_at + self.grace_s
+            ):
+                for w, p in enumerate(procs):
+                    if p.poll() is None:
+                        reaped.add(w)
+                        p.kill()
+            time.sleep(0.05)
+        records, rcs = [], []
+        lost = []
+        for w, p in enumerate(procs):
+            p.wait()
+            out, err = "", ""
+            for fh, slot in ((p._elastic_out, "out"),
+                             (p._elastic_err, "err")):
+                try:
+                    fh.seek(0)
+                    text = fh.read()
+                finally:
+                    fh.close()
+                if slot == "out":
+                    out = text
+                else:
+                    err = text
+            rc = p.returncode
+            rcs.append(rc)
+            rec = None
+            for line in reversed(out.strip().splitlines() or []):
+                try:
+                    rec = json.loads(line)
+                    break
+                except ValueError:
+                    continue
+            records.append(rec)
+            if rc != 0 and w not in reaped:
+                lost.append(w)
+                obs_log.warn(
+                    "elastic", "worker lost",
+                    generation=generation, worker=w, rc=rc,
+                    killed=rc == KILL_EXIT_CODE,
+                    stderr_tail=(err or "")[-300:],
+                )
+            elif w in reaped:
+                obs_log.warn(
+                    "elastic", "survivor reaped (blocked past grace)",
+                    generation=generation, worker=w, rc=rc,
+                )
+        return GenerationResult(
+            generation=generation, live_p=live_p, returncodes=rcs,
+            records=records, lost=lost, reaped=sorted(reaped),
+        )
+
+    def run(self) -> ElasticResult:
+        """Run to completion or exhaustion: generation 0 at full
+        ``nprocs``; each loss spawns the next generation at
+        ``live_p - len(lost)`` (floor 1) until a generation completes
+        clean or ``max_recoveries`` is spent."""
+        generations = []
+        live_p = self.nprocs
+        for generation in range(self.max_recoveries + 1):
+            from distributed_sddmm_tpu.obs import trace as obs_trace
+
+            obs_trace.event(
+                "elastic:generation", generation=generation, live_p=live_p,
+            )
+            result = self._watch(
+                self._spawn(generation, live_p), generation, live_p
+            )
+            generations.append(result)
+            if result.ok:
+                break
+            if self.on_loss is not None:
+                self.on_loss(result)
+            if generation >= self.max_recoveries:
+                # Recoveries exhausted — no further generation launches;
+                # logging "recovering" here would claim one is in flight.
+                break
+            # Only SELF-dead workers shrink p: a reaped survivor's host
+            # is healthy and rejoins the next generation (a pure
+            # timeout, everyone reaped, retries at the same p).
+            live_p = max(live_p - len(result.lost), 1)
+            obs_log.warn(
+                "elastic",
+                "recovering at reduced p" if result.lost
+                else "retrying at unchanged p after stall",
+                generation=generation + 1, live_p=live_p,
+                lost=result.lost, reaped=result.reaped,
+            )
+        return ElasticResult(
+            generations=generations,
+            recovered=any(g.lost for g in generations[:-1]),
+        )
+
+
+def run_elastic(worker_argv, nprocs: int, **kw) -> ElasticResult:
+    """One-call form of :class:`ElasticSupervisor`."""
+    return ElasticSupervisor(worker_argv, nprocs, **kw).run()

@@ -1,0 +1,634 @@
+"""Partitioned HostCOO ingest: each host parses only its own block rows.
+
+The whole-matrix loaders (``utils/coo.py``) materialize every triplet on
+every host — fine for one controller, fatal at pod scale, where the
+paper's regime is "p processes, no rank holding the whole matrix". This
+module is the partition-aware ingest path:
+
+* :func:`row_range` fixes the canonical block-row partition (first
+  ``M % p`` hosts take one extra row, so ``p ∤ M`` is first-class);
+* :func:`load_mtx_partitioned` streams a ``.mtx`` file in byte-range
+  chunks (parsed in parallel by a thread pool), keeping only the
+  entries whose row falls in this host's range — peak host memory is
+  ``O(nnz/p)`` for the kept triplets plus ``O(threads × chunk)`` for
+  in-flight parse buffers, never ``O(nnz)`` (accounted live in the
+  report's ``peak_bytes`` and pinned by test);
+* :func:`erdos_renyi_partitioned` / :func:`rmat_partitioned` are the
+  chunked generator equivalents: edges are generated in fixed-size
+  chunks with per-chunk seed streams, so the assembled matrix is a
+  pure function of ``(seed, chunk_edges)`` — **independent of p** —
+  and each host keeps only its rows;
+* :func:`assemble` concatenates shards back into one
+  :class:`~distributed_sddmm_tpu.utils.coo.HostCOO` (the test oracle:
+  assembled partitioned ingest must bit-match the whole-matrix loader
+  after canonical row sort).
+
+Sanitization agreement with the whole-matrix path: duplicates share a
+``(row, col)`` coordinate, hence a row, hence a shard — so per-shard
+keep-first dedup (file order preserved within a shard) equals the
+whole-matrix dedup restricted to the shard. Every host scans every
+line, so out-of-range and non-finite entries are tallied globally and
+``mode="strict"`` raises on EVERY host (a lone raising worker with
+p−1 proceeding into a collective would hang the pod); in repair mode
+each shard's own :func:`~distributed_sddmm_tpu.utils.coo.sanitize_coo`
+drops its local bad entries, with row-out-of-range entries (owned by
+no shard) routed to shard 0 so drop accounting counts them exactly
+once. Strict duplicate detection is the one shard-local check —
+global detection would need O(nnz) state per host, and a duplicate
+always lands on the shard that owns its row.
+
+Parser strictness: blank and interior ``%``-comment lines are skipped
+(like the whole loader); a non-comment line that does not parse into
+its fields raises on BOTH parser paths (native and pure-python — their
+acceptance rules are mirrored line for line and pinned by test). The
+one deliberate divergence from the whole loader: a garbage line the
+whole loader would silently skip raises here — at pod scale
+fail-loudly wins over bug-for-bug tolerance of corrupt bytes.
+
+Generator-stream note: the chunked generators draw per-chunk RNG
+streams, so they are *self-consistent across p* but intentionally NOT
+bit-identical to the single-shot ``HostCOO.erdos_renyi`` /
+``HostCOO.rmat`` streams (those draw all edges in one RNG call, which
+cannot be resumed mid-stream); the ``.mtx`` path — fixed file content —
+is bit-identical to the whole loader and is where the cross-loader
+oracle lives.
+"""
+
+from __future__ import annotations
+
+import concurrent.futures
+import dataclasses
+import os
+import threading
+from typing import Optional
+
+import numpy as np
+
+from distributed_sddmm_tpu.utils.coo import HostCOO, sanitize_coo
+
+#: Triplet bytes per entry in the accumulation buffers (int64 row +
+#: int64 col + float64 val) — the unit the peak-bytes bound is stated in.
+ENTRY_BYTES = 24
+
+_DEF_CHUNK = 4 << 20
+
+
+def _ingest_threads() -> int:
+    env = os.environ.get("DSDDMM_DIST_INGEST_THREADS")
+    if env:
+        return max(int(env), 1)
+    return min(os.cpu_count() or 1, 8)
+
+
+def _ingest_chunk_bytes() -> int:
+    env = os.environ.get("DSDDMM_DIST_INGEST_CHUNK")
+    return max(int(env), 4096) if env else _DEF_CHUNK
+
+
+def row_range(M: int, nproc: int, proc_id: int) -> tuple[int, int]:
+    """Canonical block-row partition ``[r0, r1)`` of host ``proc_id``.
+
+    The first ``M % nproc`` hosts take ``M // nproc + 1`` rows; hosts
+    beyond ``M`` (more hosts than rows) get empty ranges — an empty
+    shard is a valid shard.
+    """
+    if nproc <= 0:
+        raise ValueError(f"nproc must be positive, got {nproc}")
+    if not (0 <= proc_id < nproc):
+        raise ValueError(f"proc_id {proc_id} out of range [0, {nproc})")
+    base, rem = divmod(M, nproc)
+    r0 = proc_id * base + min(proc_id, rem)
+    r1 = r0 + base + (1 if proc_id < rem else 0)
+    return r0, r1
+
+
+class _PeakAccounting:
+    """Live peak-byte accounting of the loader's host buffers.
+
+    ``charge``/``release`` bracket transient buffers (raw chunk bytes,
+    per-chunk parse arrays); ``grow`` tracks the monotone accumulation
+    of kept triplets. The recorded ``peak`` is what the memory-bound
+    test pins against ``O(nnz/p) + O(threads × chunk)``.
+    """
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self.current = 0
+        self.peak = 0
+
+    def charge(self, n: int) -> None:
+        with self._lock:
+            self.current += int(n)
+            if self.current > self.peak:
+                self.peak = self.current
+
+    def release(self, n: int) -> None:
+        with self._lock:
+            self.current -= int(n)
+
+    def grow(self, n: int) -> None:
+        self.charge(n)  # accumulation is never released while loading
+
+
+@dataclasses.dataclass
+class COOShard:
+    """One host's block-row partition of a global sparse matrix.
+
+    ``coo`` holds GLOBAL coordinates (a valid
+    :class:`~distributed_sddmm_tpu.utils.coo.HostCOO` over the global
+    ``M × N`` frame) restricted to rows in ``[row0, row1)`` — the form
+    the block-row 1.5D layouts ingest directly.
+    """
+
+    coo: HostCOO
+    row0: int
+    row1: int
+    nproc: int
+    proc_id: int
+    report: dict = dataclasses.field(default_factory=dict)
+
+    @property
+    def nnz(self) -> int:
+        return self.coo.nnz
+
+    @property
+    def M(self) -> int:
+        return self.coo.M
+
+    @property
+    def N(self) -> int:
+        return self.coo.N
+
+    def append_rows(self, cols_per_row, vals_per_row, *,
+                    mode: str = "strict") -> tuple[int, dict]:
+        """Fold-in ingest on a partitioned shard (``HostCOO.append_rows``
+        semantics). New rows are appended at the global growth edge
+        (row index ``M``), which by the block-row partition belongs to
+        the LAST shard — appending anywhere else would silently create
+        rows this host does not own. Extends the shard's row range and
+        the global ``M`` in place."""
+        if self.proc_id != self.nproc - 1:
+            raise ValueError(
+                f"fold-in rows land on the last row shard "
+                f"({self.nproc - 1}); this is shard {self.proc_id}"
+            )
+        first, report = self.coo.append_rows(
+            cols_per_row, vals_per_row, mode=mode
+        )
+        self.row1 = self.coo.M
+        return first, report
+
+
+def assemble(shards) -> HostCOO:
+    """Concatenate shards (proc order) back into one global HostCOO —
+    the test oracle; a real pod never calls this."""
+    shards = sorted(shards, key=lambda s: s.proc_id)
+    if not shards:
+        raise ValueError("no shards to assemble")
+    M = max(s.M for s in shards)
+    N = shards[0].N
+    return HostCOO(
+        np.concatenate([s.coo.rows for s in shards]),
+        np.concatenate([s.coo.cols for s in shards]),
+        np.concatenate([s.coo.vals for s in shards]),
+        M, N,
+    )
+
+
+# --------------------------------------------------------------------- #
+# Streaming .mtx partition reader
+# --------------------------------------------------------------------- #
+
+
+def _mtx_header(path) -> tuple[int, int, int, str, str, int]:
+    """Parse banner + size line; returns ``(M, N, nnz_declared, field,
+    symmetry, data_offset)``. Only coordinate real/integer/pattern
+    files stream; array/complex steer to the whole-matrix loader."""
+    with open(path, "rb") as fh:
+        banner = fh.readline()
+        parts = banner.decode("ascii", "replace").strip().split()
+        if len(parts) < 5 or not parts[0].startswith("%%MatrixMarket"):
+            raise ValueError(f"{path}: not a MatrixMarket file")
+        fmt, field, symmetry = (
+            parts[2].lower(), parts[3].lower(), parts[4].lower()
+        )
+        if fmt != "coordinate" or field in ("complex",):
+            raise ValueError(
+                f"{path}: {fmt}/{field} files do not stream; use "
+                "HostCOO.load_mtx"
+            )
+        while True:
+            line = fh.readline()
+            if not line:
+                raise ValueError(f"{path}: missing size line")
+            s = line.strip()
+            if not s or s.startswith(b"%"):
+                continue
+            dims = s.split()
+            if len(dims) != 3:
+                raise ValueError(f"{path}: bad size line {s!r}")
+            M, N, nnz = (int(x) for x in dims)
+            return M, N, nnz, field, symmetry, fh.tell()
+
+
+def _chunk_ranges(path, start: int, chunk_bytes: int) -> list[tuple[int, int]]:
+    size = os.path.getsize(path)
+    if start >= size:
+        return []
+    edges = list(range(start, size, chunk_bytes)) + [size]
+    return list(zip(edges[:-1], edges[1:]))
+
+
+def _read_chunk_lines(fh, lo: int, hi: int, data_start: int) -> bytes:
+    """The bytes of every line that STARTS in ``[lo, hi)`` — the
+    standard byte-range split: a chunk that does not begin at the data
+    start discards its leading partial line (the previous chunk reads
+    through the boundary)."""
+    if lo > data_start:
+        # A line starting exactly at `lo` (previous byte is the
+        # newline) is fresh and belongs to this chunk; otherwise the
+        # leading partial line belongs to the chunk it started in.
+        fh.seek(lo - 1)
+        fresh = fh.read(1) == b"\n"
+    else:
+        fresh = True
+    fh.seek(lo)
+    buf = fh.read(hi - lo)
+    if not fresh:
+        cut = buf.find(b"\n")
+        buf = buf[cut + 1:] if cut >= 0 else b""
+    # Read the line crossing the upper boundary to completion. An empty
+    # buf means NO line starts in this chunk (a single line spans it and
+    # belongs to the chunk it started in) — nothing to extend.
+    if buf and hi < os.fstat(fh.fileno()).st_size and buf[-1:] != b"\n":
+        buf += fh.readline()
+    return buf
+
+
+import re as _re
+
+#: What C strtol accepts as one whole index field (post-split, so no
+#: leading whitespace): optional sign + decimal digits. Excludes
+#: Python-only forms like '1_0'.
+_INT_RE = _re.compile(r"^[+-]?[0-9]+$")
+#: What C strtod accepts: decimal/exponent floats, hex floats,
+#: inf/infinity/nan — the fallback must accept the same set.
+_FLT_RE = _re.compile(
+    r"^[+-]?([0-9]+\.?[0-9]*|\.[0-9]+)([eE][+-]?[0-9]+)?$"
+    r"|^[+-]?0[xX][0-9a-fA-F]*\.?[0-9a-fA-F]*([pP][+-]?[0-9]+)?$"
+    r"|^[+-]?(inf(inity)?|nan)$",
+    _re.IGNORECASE,
+)
+
+
+def _strtod(token: str) -> float:
+    """``float()`` restricted (and extended) to strtod's charset."""
+    if not _FLT_RE.match(token):
+        raise ValueError(f"bad float field {token!r}")
+    low = token.lower()
+    if "x" in low:
+        return float.fromhex(token)
+    return float(token)
+
+
+def _parse_chunk(buf: bytes, pattern: bool):
+    """One chunk of data lines → 0-based ``(rows, cols, vals)``.
+
+    Native path first (``native.parse_triplets`` — a GIL-releasing C
+    parser, so the thread pool's chunks parse in genuine parallel);
+    numpy ``np.loadtxt`` fallback when no toolchain built the native
+    layer. Both produce correctly-rounded doubles, so the paths are
+    bit-identical on valid files.
+    """
+    if not buf.strip():
+        empty = np.empty(0, dtype=np.int64)
+        return empty, empty.copy(), np.empty(0, dtype=np.float64)
+    from distributed_sddmm_tpu import native
+
+    parsed = native.parse_triplets(buf, pattern=pattern)
+    if parsed is not None:
+        return parsed
+    # Pure-python fallback that mirrors the native parser's acceptance
+    # rules EXACTLY (blank/'%'-comment lines skipped; whole-integer
+    # index fields so '1.5' is malformed, not truncated; extra NUMERIC
+    # trailing fields legal; anything else raises) — a pod where some
+    # hosts built the native layer and some did not must agree
+    # line-for-line on what loads, or one worker raises into its
+    # peers' collective. Tokens are charset-validated against what
+    # strtol/strtod accept BEFORE int()/float() convert: Python's
+    # literals diverge from C's in both directions ('1_0' underscore
+    # separators are Python-only; '0x10' hex floats and bare 'inf'/
+    # 'nan' are strtod-accepted), and both converters produce
+    # correctly-rounded doubles once the charset agrees.
+    width = 2 if pattern else 3
+    rows_l, cols_l, vals_l = [], [], []
+    for ln, line in enumerate(buf.decode("ascii", "replace").splitlines()):
+        t = line.split()
+        if not t:
+            continue
+        if t[0].startswith("%"):
+            continue  # interior comment line — legal, skipped like the
+            # whole loader and the native parser
+        try:
+            if len(t) < width:
+                raise ValueError("missing fields")
+            if not (_INT_RE.match(t[0]) and _INT_RE.match(t[1])):
+                raise ValueError("bad index field")
+            r, c = int(t[0]), int(t[1])
+            v = 1.0 if pattern else _strtod(t[2])
+            for extra in t[width:]:
+                _strtod(extra)
+        except ValueError:
+            raise ValueError(
+                f"malformed matrix-market data line {ln + 1} of chunk: "
+                f"{line[:60]!r}"
+            ) from None
+        rows_l.append(r - 1)
+        cols_l.append(c - 1)
+        vals_l.append(v)
+    return (np.asarray(rows_l, dtype=np.int64),
+            np.asarray(cols_l, dtype=np.int64),
+            np.asarray(vals_l, dtype=np.float64))
+
+
+def load_mtx_partitioned(
+    path,
+    nproc: int,
+    proc_id: int,
+    *,
+    mode: str = "strict",
+    threads: Optional[int] = None,
+    chunk_bytes: Optional[int] = None,
+) -> COOShard:
+    """Stream one ``.mtx`` file, keeping only this host's block rows.
+
+    Bit-identical to ``HostCOO.load_mtx`` + :func:`sanitize_coo` at the
+    assembly level (see module doc for the dedup/oob argument), with
+    peak host bytes ``O(nnz/p) + O(threads × chunk_bytes)`` — the
+    ``report["peak_bytes"]`` accounting the memory-bound test pins.
+    Symmetric headers are expanded on the fly: a mirror entry
+    ``(j, i)`` is kept by the shard owning row ``j``, so both sides of
+    the expansion land on their owning hosts without any host seeing
+    the full expansion.
+    """
+    threads = threads if threads is not None else _ingest_threads()
+    chunk_bytes = (
+        chunk_bytes if chunk_bytes is not None else _ingest_chunk_bytes()
+    )
+    M, N, nnz_declared, field, symmetry, data_start = _mtx_header(path)
+    r0, r1 = row_range(M, nproc, proc_id)
+    pattern = field == "pattern"
+    mirror_sign = -1.0 if symmetry == "skew-symmetric" else 1.0
+    symmetric = symmetry in ("symmetric", "skew-symmetric", "hermitian")
+
+    acct = _PeakAccounting()
+    ranges = _chunk_ranges(path, data_start, chunk_bytes)
+    n_chunks = len(ranges)
+    base_parts: list = [None] * n_chunks
+    mirror_parts: list = [None] * n_chunks
+    parsed_counts = [0] * n_chunks  # pre-filter entries per chunk
+    # Whole-file corruption counters, tallied by EVERY host (each scans
+    # every line): strict mode must fail on every worker of a pod, not
+    # only on the shard that owns the bad entry — one raising worker
+    # with p-1 proceeding into a collective is a hang, not an error.
+    seen = {"row_out_of_range": 0, "col_out_of_range": 0,
+            "non_finite": 0}
+    seen_lock = threading.Lock()
+
+    def one_chunk(idx: int) -> None:
+        lo, hi = ranges[idx]
+        # One file handle per task: seeks must not race.
+        with open(path, "rb") as fh:
+            buf = _read_chunk_lines(fh, lo, hi, data_start)
+        acct.charge(len(buf))
+        rows, cols, vals = _parse_chunk(buf, pattern)
+        parsed_counts[idx] = int(rows.size)
+        parsed_bytes = rows.nbytes + cols.nbytes + vals.nbytes
+        acct.charge(parsed_bytes)
+        acct.release(len(buf))
+        del buf
+        row_oob = (rows < 0) | (rows >= M)
+        counts = {
+            "row_out_of_range": int(row_oob.sum()),
+            "col_out_of_range": int(((cols < 0) | (cols >= N)).sum()),
+            "non_finite": int((~np.isfinite(vals)).sum()),
+        }
+        if any(counts.values()):
+            with seen_lock:
+                for k, v in counts.items():
+                    seen[k] += v
+        # Row-oob entries belong to no shard; shard 0 claims them so
+        # repair-mode drop accounting counts them exactly once, like
+        # the whole loader.
+        keep = ((rows >= r0) & (rows < r1)) | (row_oob if proc_id == 0
+                                               else np.zeros_like(row_oob))
+        # Typed per-field parts, no float64 round trip: indices stay
+        # int64 end to end (exact past 2^53, zero conversion copies).
+        local = (rows[keep], cols[keep], vals[keep])
+        acct.grow(sum(a.nbytes for a in local))
+        base_parts[idx] = local
+        if symmetric:
+            off = rows != cols
+            mrows, mcols = cols[off], rows[off]
+            mkeep = (mrows >= r0) & (mrows < r1)
+            mirror = (mrows[mkeep], mcols[mkeep],
+                      mirror_sign * vals[off][mkeep])
+            acct.grow(sum(a.nbytes for a in mirror))
+            mirror_parts[idx] = mirror
+        acct.release(parsed_bytes)
+
+    if n_chunks:
+        with concurrent.futures.ThreadPoolExecutor(
+            max_workers=max(min(threads, n_chunks), 1)
+        ) as pool:
+            list(pool.map(one_chunk, range(n_chunks)))
+    # Every host scans every line, so each can validate the declared
+    # entry count — the whole loader's truncation check
+    # (native.mtx_read: "expected N entries, parsed M"). A truncated
+    # download must fail loudly in EVERY mode, not load as a silently
+    # smaller matrix.
+    total_parsed = sum(parsed_counts)
+    if total_parsed != nnz_declared:
+        raise IOError(
+            f"{path}: header declares {nnz_declared} entries, parsed "
+            f"{total_parsed} (truncated or corrupt file)"
+        )
+    if mode == "strict" and any(seen.values()):
+        # Every host raises, not just the owning shard (duplicates are
+        # the one shard-local strict check: detecting them globally
+        # would need O(nnz) state on every host, and they always share
+        # a row — the owning shard's sanitize raises).
+        issues = {k: v for k, v in seen.items() if v}
+        raise ValueError(
+            f"corrupt COO ingest ({M}x{N}, file {path}): "
+            + ", ".join(f"{v} {k}" for k, v in issues.items())
+            + "; re-ingest with mode='repair' to drop"
+        )
+
+    def _cat(parts, field):
+        live = [p for p in parts if p is not None and p[field].size]
+        if not live:
+            return np.empty(0, dtype=np.int64 if field < 2 else np.float64)
+        return np.concatenate([p[field] for p in live])
+
+    # Shard order = [base entries in file order, mirror entries in file
+    # order] — the whole loader's (base..., mirror...) order restricted
+    # to this shard, so keep-first dedup agrees (module doc).
+    def _field(field):
+        if not symmetric:
+            return _cat(base_parts, field)  # one copy, no re-wrap
+        return np.concatenate(
+            [_cat(base_parts, field), _cat(mirror_parts, field)]
+        )
+
+    rows_l, cols_l, vals_l = _field(0), _field(1), _field(2)
+    del base_parts, mirror_parts
+    # The concatenation transiently doubles the kept triplets; charge it
+    # so peak_bytes stays an honest upper bound of live host bytes.
+    acct.charge(rows_l.nbytes + cols_l.nbytes + vals_l.nbytes)
+
+    coo, report = sanitize_coo(rows_l, cols_l, vals_l, M, N, mode=mode)
+    report.update(
+        row_out_of_range_seen=int(seen["row_out_of_range"]),
+        nnz_local=coo.nnz,
+        peak_bytes=acct.peak,
+        chunks=n_chunks,
+        threads=threads,
+        chunk_bytes=chunk_bytes,
+        row_range=[r0, r1],
+    )
+    return COOShard(coo=coo, row0=r0, row1=r1, nproc=nproc,
+                    proc_id=proc_id, report=report)
+
+
+# --------------------------------------------------------------------- #
+# Chunked partitioned generators
+# --------------------------------------------------------------------- #
+
+
+def _chunk_seed(seed: int, chunk: int) -> list:
+    """Per-chunk seed-sequence key: pure function of (seed, chunk), so
+    the edge stream is independent of p and thread scheduling."""
+    return [int(seed) & 0x7FFFFFFF, int(chunk)]
+
+
+def erdos_renyi_partitioned(
+    M: int,
+    N: int,
+    nnz_per_row: int,
+    nproc: int,
+    proc_id: int,
+    *,
+    seed: int = 0,
+    values: str = "ones",
+    chunk_edges: int = 1 << 18,
+) -> COOShard:
+    """Chunked Erdos-Renyi generator, block-row partitioned.
+
+    Draws edges in ``chunk_edges``-sized chunks (per-chunk RNG streams,
+    see :func:`_chunk_seed`), keeping only rows in this host's range;
+    keep-first dedup runs on the kept entries (duplicates are
+    row-colocated, so shard-local dedup equals global dedup). Peak host
+    bytes: ``O(M·npr/p)`` kept + one chunk in flight.
+    """
+    if values not in ("ones", "normal"):
+        raise ValueError(f"values must be 'ones' or 'normal', got {values!r}")
+    r0, r1 = row_range(M, nproc, proc_id)
+    n_edges = M * nnz_per_row
+    acct = _PeakAccounting()
+    parts = []
+    for ci, lo in enumerate(range(0, n_edges, chunk_edges)):
+        n = min(chunk_edges, n_edges - lo)
+        rng = np.random.default_rng(_chunk_seed(seed, ci))
+        rows = rng.integers(0, M, size=n, dtype=np.int64)
+        cols = rng.integers(0, N, size=n, dtype=np.int64)
+        vals = (
+            rng.standard_normal(n) if values == "normal" else np.ones(n)
+        )
+        acct.charge(rows.nbytes + cols.nbytes + vals.nbytes)
+        keep = (rows >= r0) & (rows < r1)
+        block = (rows[keep], cols[keep], vals[keep])  # typed, no casts
+        acct.grow(sum(a.nbytes for a in block))
+        parts.append(block)
+        acct.release(rows.nbytes + cols.nbytes + vals.nbytes)
+    return _finish_generated(parts, M, N, nproc, proc_id, r0, r1, acct)
+
+
+def rmat_partitioned(
+    log_m: int,
+    edge_factor: int,
+    nproc: int,
+    proc_id: int,
+    *,
+    a: float = 0.25,
+    b: float = 0.25,
+    c: float = 0.25,
+    d: float = 0.25,
+    seed: int = 0,
+    chunk_edges: int = 1 << 18,
+) -> COOShard:
+    """Chunked R-mat generator, block-row partitioned over the FINAL
+    (permuted) row space.
+
+    Mirrors ``HostCOO.rmat``'s pipeline — generate, dedup keep-first,
+    Graph500 vertex-rename permutation — except edges are generated in
+    per-chunk streams and the permutation is applied per chunk so each
+    host filters on its final rows immediately. The two ``O(M)``
+    permutation arrays are the documented constant (``M ≤ nnz`` for
+    ``edge_factor ≥ 1``).
+    """
+    if not np.isclose(a + b + c + d, 1.0):
+        raise ValueError("initiator probabilities must sum to 1")
+    from distributed_sddmm_tpu import native
+
+    M = 1 << log_m
+    n_edges = M * edge_factor
+    # The same rename permutations HostCOO.rmat applies (seed + 1).
+    perm_rng = np.random.default_rng(seed + 1)
+    row_perm = perm_rng.permutation(M)
+    col_perm = perm_rng.permutation(M)
+    r0, r1 = row_range(M, nproc, proc_id)
+    acct = _PeakAccounting()
+    acct.grow(row_perm.nbytes + col_perm.nbytes)
+    parts = []
+    for ci, lo in enumerate(range(0, n_edges, chunk_edges)):
+        n = min(chunk_edges, n_edges - lo)
+        cseed = int(
+            np.random.default_rng(_chunk_seed(seed, ci)).integers(1 << 62)
+        )
+        rows, cols = native.rmat_edges(log_m, n, a, b, c, d, cseed)
+        acct.charge(rows.nbytes + cols.nbytes)
+        prows = row_perm[rows]
+        pcols = col_perm[cols]
+        acct.charge(prows.nbytes + pcols.nbytes)
+        keep = (prows >= r0) & (prows < r1)
+        block = (prows[keep].astype(np.int64),
+                 pcols[keep].astype(np.int64),
+                 np.ones(int(keep.sum())))
+        acct.grow(sum(a.nbytes for a in block))
+        parts.append(block)
+        acct.release(rows.nbytes + cols.nbytes + prows.nbytes + pcols.nbytes)
+    return _finish_generated(parts, M, M, nproc, proc_id, r0, r1, acct)
+
+
+def _finish_generated(parts, M, N, nproc, proc_id, r0, r1,
+                      acct: _PeakAccounting) -> COOShard:
+    live = [p for p in parts if p[0].size]
+    if live:
+        rows = np.concatenate([p[0] for p in live])
+        cols = np.concatenate([p[1] for p in live])
+        vals = np.concatenate([p[2] for p in live])
+    else:
+        rows = np.empty(0, dtype=np.int64)
+        cols = np.empty(0, dtype=np.int64)
+        vals = np.empty(0, dtype=np.float64)
+    acct.charge(rows.nbytes + cols.nbytes + vals.nbytes)
+    coo = HostCOO(rows, cols, vals, M, N).deduplicated()
+    report = {
+        "nnz_local": coo.nnz,
+        "peak_bytes": acct.peak,
+        "chunks": len(parts),
+        "row_range": [r0, r1],
+    }
+    return COOShard(coo=coo, row0=r0, row1=r1, nproc=nproc,
+                    proc_id=proc_id, report=report)

@@ -1,0 +1,261 @@
+"""``jax.distributed`` initialization layer + pod identity.
+
+Promoted from ``scripts/run_pod.py`` so the coordinator-resolution
+rules live in the package (importable by the bench CLI, the elastic
+supervisor, the program-store key builder and the manifest) instead of
+in a script. The script is now a thin wrapper over
+:mod:`distributed_sddmm_tpu.dist.run`.
+
+Three layers of identity resolution, strongest first:
+
+1. **Live distributed runtime** — when a jax backend is already up,
+   ``jax.process_count()`` / ``jax.process_index()`` are authoritative.
+   (Single-process backends report 1/0; the env layer below may then
+   still label the process, see 2.)
+2. **Pod launcher env** — ``DSDDMM_DIST_COORDINATOR`` /
+   ``DSDDMM_DIST_NPROCS`` / ``DSDDMM_DIST_PROC_ID``: the knobs a pod
+   launcher exports to every worker. They both feed
+   :func:`initialize` *and* let offline tooling (key builders,
+   manifests, a worker that deliberately runs CPU-local) know which
+   pod slot this process is, even before — or without — a distributed
+   backend. When the live runtime reports multiple processes it wins;
+   a single-process backend defers to the env labels so that
+   pod-keyed artifacts (ProgramStore entries, records) can be
+   produced and tested off-pod.
+3. **Single process** — no runtime, no env: ``(1, 0, None)``.
+
+Nothing in this module ever *initializes* a backend implicitly (the
+``obs/manifest.py`` discipline): :func:`pod_info` only reads an
+already-up backend, and only :func:`initialize` — an explicit call —
+touches ``jax.distributed``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import sys
+from typing import Optional
+
+
+@dataclasses.dataclass(frozen=True)
+class PodContext:
+    """Resolved pod identity of this controller process."""
+
+    num_processes: int
+    process_index: int
+    coordinator: Optional[str] = None
+
+    @property
+    def is_multi_host(self) -> bool:
+        return self.num_processes > 1
+
+    def as_dict(self) -> dict:
+        return {
+            "num_processes": self.num_processes,
+            "process_index": self.process_index,
+            "coordinator": self.coordinator,
+        }
+
+    def record_fields(self) -> dict:
+        """THE pod-identity shape records and manifests embed:
+        ``num_processes``/``process_index`` always, ``coordinator``
+        only when one exists (single-controller artifacts must not
+        grow a null field relative to the pre-pod schema). Bench
+        records, serve records and manifests all resolve through here
+        so the three can never drift apart."""
+        return {k: v for k, v in self.as_dict().items() if v is not None}
+
+
+def resolve_init_kwargs(
+    coordinator: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+    initialization_timeout: Optional[int] = None,
+) -> dict:
+    """The ``jax.distributed.initialize`` kwargs for one worker.
+
+    Explicit arguments win over the ``DSDDMM_DIST_*`` env knobs. On
+    Cloud TPU the coordinator/topology are auto-discovered, so an empty
+    dict (no coordinator anywhere) is the valid "let jax discover"
+    resolution; ``num_processes``/``process_id`` without a coordinator
+    is the one illegal combination (auto-discovery ignores them — the
+    same rule ``scripts/run_pod.py`` has enforced since round 5).
+    """
+    if coordinator is None:
+        coordinator = os.environ.get("DSDDMM_DIST_COORDINATOR") or None
+    if num_processes is None:
+        env = os.environ.get("DSDDMM_DIST_NPROCS")
+        num_processes = int(env) if env else None
+    if process_id is None:
+        env = os.environ.get("DSDDMM_DIST_PROC_ID")
+        process_id = int(env) if env else None
+    if coordinator is None and (
+        num_processes is not None or process_id is not None
+    ):
+        raise ValueError(
+            "num_processes/process_id require a coordinator address "
+            "(without one, Cloud TPU auto-discovery ignores them); set "
+            "--coordinator or DSDDMM_DIST_COORDINATOR"
+        )
+    if coordinator is None:
+        return {}
+    kwargs = dict(
+        coordinator_address=coordinator,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    if initialization_timeout is not None:
+        kwargs["initialization_timeout"] = int(initialization_timeout)
+    return kwargs
+
+
+_initialized = False
+
+
+def initialize(
+    coordinator: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+    initialization_timeout: Optional[int] = None,
+) -> PodContext:
+    """Connect this process to the pod (idempotent).
+
+    Resolves kwargs via :func:`resolve_init_kwargs`, calls
+    ``jax.distributed.initialize`` (auto-discovery on Cloud TPU when no
+    coordinator resolves anywhere), and returns the live
+    :class:`PodContext`. A second call in one process returns the live
+    context without re-initializing — jax raises on double init, and a
+    supervisor retrying a worker must not die on it.
+    """
+    global _initialized
+    kwargs = resolve_init_kwargs(
+        coordinator, num_processes, process_id, initialization_timeout
+    )
+    import jax
+
+    if not _initialized:
+        try:
+            jax.distributed.initialize(**kwargs)
+        except RuntimeError as e:
+            # Already-initialized (another layer beat us to it) is the
+            # one RuntimeError that means success; anything else is a
+            # genuine coordination failure and must surface. Modern jax
+            # says "already initialized", 0.4.x says
+            # "distributed.initialize should only be called once".
+            msg = str(e).lower()
+            if ("already initialized" not in msg
+                    and "only be called once" not in msg):
+                raise
+        _initialized = True
+    ctx = PodContext(
+        num_processes=int(jax.process_count()),
+        process_index=int(jax.process_index()),
+        coordinator=kwargs.get("coordinator_address"),
+    )
+    # Export the RESOLVED identity so every downstream pod_info — this
+    # process's records/manifests/store keys AND child processes it
+    # spawns — agrees with what initialize actually wired, even when
+    # the coordinator arrived as a CLI flag rather than via env (the
+    # tracer's shard-dir export precedent).
+    if ctx.coordinator:
+        os.environ["DSDDMM_DIST_COORDINATOR"] = ctx.coordinator
+    if ctx.num_processes > 1:
+        os.environ["DSDDMM_DIST_NPROCS"] = str(ctx.num_processes)
+        os.environ["DSDDMM_DIST_PROC_ID"] = str(ctx.process_index)
+    return ctx
+
+
+def _live_process_info() -> Optional[tuple[int, int]]:
+    """(process_count, process_index) of an already-up backend, never
+    initializing one (the manifest's never-initialize discipline)."""
+    jax = sys.modules.get("jax")
+    if jax is None:
+        return None
+    try:
+        backends = getattr(jax._src.xla_bridge, "_backends", None)
+        if backends:
+            return int(jax.process_count()), int(jax.process_index())
+    except Exception:  # noqa: BLE001 — identity is best-effort
+        pass
+    return None
+
+
+def pod_info() -> PodContext:
+    """This process's pod identity, without ever initializing a backend.
+
+    Precedence (module doc): a live MULTI-process runtime is
+    authoritative; otherwise the ``DSDDMM_DIST_NPROCS`` /
+    ``DSDDMM_DIST_PROC_ID`` launcher labels apply (they let off-pod
+    tooling produce and test pod-keyed artifacts); otherwise a live
+    single-process backend or nothing at all both read as ``(1, 0)``.
+    """
+    coordinator = os.environ.get("DSDDMM_DIST_COORDINATOR") or None
+    live = _live_process_info()
+    if live is not None and live[0] > 1:
+        return PodContext(live[0], live[1], coordinator)
+    nprocs = os.environ.get("DSDDMM_DIST_NPROCS")
+    if nprocs:
+        # Empty string means unset (every env read here treats it so) —
+        # it must hit the guard below, not int("" or 0) into slot 0.
+        proc_id = os.environ.get("DSDDMM_DIST_PROC_ID") or None
+        if int(nprocs) > 1 and proc_id is None:
+            # Silently defaulting the slot to 0 would make EVERY worker
+            # of a misconfigured launcher claim d<N>.p0 — aliasing the
+            # per-slot store entries/records the label exists to keep
+            # apart. Mirror the nprocs-without-coordinator rule: fail
+            # loudly at the first identity query.
+            raise ValueError(
+                "DSDDMM_DIST_NPROCS is set without DSDDMM_DIST_PROC_ID; "
+                "a pod launcher must export the per-worker slot or "
+                "every worker would claim process 0"
+            )
+        n, k = int(nprocs), int(proc_id or 0)
+        if not (0 <= k < n):
+            # A slot outside the pod (launch-script off-by-one, or two
+            # workers copy-pasting one PROC_ID past the range) would
+            # label artifacts under a nonexistent slot — same aliasing
+            # class the missing-slot guard catches.
+            raise ValueError(
+                f"DSDDMM_DIST_PROC_ID={k} out of range [0, {n}) "
+                f"(DSDDMM_DIST_NPROCS={n})"
+            )
+        return PodContext(n, k, coordinator)
+    return PodContext(1, 0, coordinator)
+
+
+def cross_process_probe() -> tuple[bool, Optional[str]]:
+    """Can THIS backend place a global array spanning processes?
+
+    Attempts the exact primitive multi-host ingest rides — an
+    addressable-shard-only global placement over every device of every
+    process, followed by a jitted global reduction fetch. Returns
+    ``(True, None)`` when it works (trivially true single-process) and
+    ``(False, "<error>")`` when the backend rejects it — e.g. this
+    container's jax 0.4.x CPU backend ("Multiprocess computations
+    aren't implemented on the CPU backend"). The pod tests key their
+    strictness on this probe instead of an unconditional xfail, so the
+    day the backend supports it the tests run strict with no edit.
+    """
+    import numpy as np
+
+    import jax
+
+    try:
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+        devs = np.asarray(jax.devices())
+        mesh = Mesh(devs, ("x",))
+        sharding = NamedSharding(mesh, P("x"))
+        n = len(devs.reshape(-1))
+        host = np.arange(4 * n, dtype=np.float32)
+        arr = jax.make_array_from_callback(
+            host.shape, sharding, lambda idx: host[idx]
+        )
+        total = float(jax.jit(lambda x: x.sum())(arr))
+        expect = float(host.sum())
+        if abs(total - expect) > 1e-3:
+            return False, f"global reduction mismatch: {total} != {expect}"
+        return True, None
+    except Exception as e:  # noqa: BLE001 — the probe's whole job
+        return False, f"{type(e).__name__}: {e}"

@@ -1,0 +1,188 @@
+"""The pod runner: one process per host, bench CLI semantics unchanged.
+
+Promoted from ``scripts/run_pod.py`` (the script is now a thin wrapper)
+so the pod wiring is a package capability:
+
+* coordinator resolution + ``jax.distributed`` init via
+  :mod:`distributed_sddmm_tpu.dist.init` (explicit flags > the
+  ``DSDDMM_DIST_*`` env knobs > Cloud TPU auto-discovery);
+* **per-worker admin surface**: ``DSDDMM_POD_ADMIN_BASE=P`` gives
+  worker ``k`` its own ``/metrics``/``/healthz`` endpoint on port
+  ``P + k`` (injected as ``--admin-port`` when the forwarded command is
+  ``serve`` and none was passed);
+* **per-worker trace shards**: a file-valued ``DSDDMM_TRACE`` is
+  rewritten to its sibling ``.shards/`` directory before any worker
+  traces, so each process writes its own shard (the PR 7 layout
+  ``bench trace-merge`` consumes) instead of fighting over one file;
+* **pod timeline merge**: worker 0 offset-aligns every shard back into
+  one trace after the run (``DSDDMM_POD_TRACE_MERGE=0`` opts out).
+
+Run THIS on every host of the pod, e.g. with::
+
+    gcloud compute tpus tpu-vm ssh $TPU_NAME --worker=all \\
+      --command="cd ~/distributed_sddmm_tpu && python scripts/run_pod.py \\
+                 er 20 32 15d_fusion2 128 4 -o results.jsonl"
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import pathlib
+from typing import Optional
+
+
+def build_parser() -> argparse.ArgumentParser:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--coordinator", default=None,
+                    help="host:port (omit on Cloud TPU: auto-discovered; "
+                    "DSDDMM_DIST_COORDINATOR is the env equivalent)")
+    ap.add_argument("--num-processes", type=int, default=None)
+    ap.add_argument("--process-id", type=int, default=None)
+    ap.add_argument("--dry-run", action="store_true",
+                    help="print the resolved initialize()/bench invocation "
+                    "and exit (testable without a pod)")
+    ap.add_argument("bench_args", nargs=argparse.REMAINDER,
+                    help="arguments forwarded to distributed_sddmm_tpu.bench")
+    return ap
+
+
+def _trace_shard_candidate() -> Optional[pathlib.Path]:
+    """The shard directory the current ``DSDDMM_TRACE`` value implies
+    (pure function of the env, no mutation): a ``.jsonl`` file spec
+    maps to its ``.shards/`` sibling, a non-flag path IS the directory
+    (the trace layer mkdirs it on first write), flag/off specs have
+    none."""
+    from distributed_sddmm_tpu.obs.trace import FLAG_VALUES
+
+    spec = os.environ.get("DSDDMM_TRACE")
+    if not spec or spec in FLAG_VALUES:
+        return None
+    p = pathlib.Path(spec)
+    return p.with_suffix(".shards") if p.suffix == ".jsonl" else p
+
+
+def _shardify_trace_env() -> Optional[pathlib.Path]:
+    """Rewrite a file-valued ``DSDDMM_TRACE`` to its ``.shards/``
+    sibling (every worker computes the same rewrite — pure function of
+    the env), returning the shard dir for the end-of-run merge.
+    Directory specs already shard naturally (per-process run-id files)
+    and pass through unmutated."""
+    shards = _trace_shard_candidate()
+    if shards is None:
+        return None
+    if pathlib.Path(os.environ["DSDDMM_TRACE"]).suffix == ".jsonl":
+        os.environ["DSDDMM_TRACE"] = str(shards)
+    return shards
+
+
+def _inject_admin_port(bench_args: list, process_index: int) -> list:
+    base = os.environ.get("DSDDMM_POD_ADMIN_BASE")
+    if (
+        not base or int(base) <= 0
+        or bench_args[:1] != ["serve"]
+        or any(a == "--admin-port" or a.startswith("--admin-port=")
+               for a in bench_args)
+    ):
+        return bench_args
+    return [*bench_args, "--admin-port", str(int(base) + process_index)]
+
+
+def main(argv=None) -> int:
+    ap = build_parser()
+    args = ap.parse_args(argv)
+
+    from distributed_sddmm_tpu.dist.init import initialize, resolve_init_kwargs
+
+    try:
+        init_kwargs = resolve_init_kwargs(
+            args.coordinator, args.num_processes, args.process_id
+        )
+    except ValueError as e:
+        ap.error(str(e))
+    if args.dry_run:
+        # Validate the forwarded bench arguments parse, without touching
+        # any backend or coordinator.
+        from distributed_sddmm_tpu.bench.cli import build_parser as bench_parser
+
+        bench_parser().parse_args(args.bench_args)
+        print(  # cli-output
+            f"dry-run ok: initialize({init_kwargs}) -> bench {args.bench_args}"
+        )
+        return 0
+
+    # Snapshot prior-run shards BEFORE joining the init rendezvous: no
+    # peer can write a trace until every worker (this one included) has
+    # passed initialize, so everything in the dir now is a previous
+    # run's — glob later and a fast peer's fresh shard would be
+    # misclassified as stale.
+    pre_shard_dir = _trace_shard_candidate()
+    pre_existing = (
+        {str(f) for f in pre_shard_dir.glob("*.jsonl")}
+        if pre_shard_dir is not None and pre_shard_dir.is_dir() else set()
+    )
+    ctx = initialize(args.coordinator, args.num_processes, args.process_id)
+
+    import jax
+
+    if ctx.process_index == 0:
+        print(  # cli-output
+            f"pod up: {ctx.num_processes} hosts, "
+            f"{jax.device_count()} chips ({jax.local_device_count()}/host)"
+        )
+    shard_dir = _shardify_trace_env() if ctx.is_multi_host else None
+    bench_args = _inject_admin_port(list(args.bench_args), ctx.process_index)
+
+    from distributed_sddmm_tpu.bench.cli import main as bench_main
+
+    rc = bench_main(bench_args)
+
+    if (
+        shard_dir is not None
+        and ctx.process_index == 0
+        and os.environ.get("DSDDMM_POD_TRACE_MERGE", "1") not in ("0", "off")
+    ):
+        # Best-effort pod-timeline merge: a failed merge (straggler
+        # shard mid-write) must not fail the run — the shards remain
+        # and `bench trace-merge` re-runs offline.
+        try:
+            from distributed_sddmm_tpu.obs import trace as obs_trace
+            from distributed_sddmm_tpu.obs import tracemerge
+
+            obs_trace.disable()  # flush our own shard first
+            # A merge over fewer shards than workers would SUCCEED on
+            # an incomplete timeline and read as complete — wait for
+            # every worker's shard to appear (they flush at exit;
+            # stragglers get a bounded grace window), else leave the
+            # shards for an offline `bench trace-merge`.
+            import time
+
+            def _this_runs_shards():
+                return [
+                    f for f in tracemerge.discover(shard_dir)
+                    if str(f) not in pre_existing
+                ]
+
+            deadline = time.monotonic() + 30.0
+            shards = _this_runs_shards()
+            while (
+                len(shards) < ctx.num_processes
+                and time.monotonic() < deadline
+            ):
+                time.sleep(0.25)
+                shards = _this_runs_shards()
+            if len(shards) < ctx.num_processes:
+                raise RuntimeError(
+                    f"only {len(shards)} of {ctx.num_processes} worker "
+                    "shards present; merge deferred to `bench "
+                    "trace-merge`"
+                )
+            out, merged = tracemerge.write_merged(shards)
+            print(f"pod trace merged: {out} "  # cli-output
+                  f"({len(merged['begin']['shards'])} shards)")
+        except Exception as e:  # noqa: BLE001
+            from distributed_sddmm_tpu.obs import log as obs_log
+
+            obs_log.warn("dist", "pod trace merge skipped",
+                         error=f"{type(e).__name__}: {e}")
+    return rc

@@ -113,6 +113,11 @@ def _load():
             _i64p, _i64p, _f64p,
         ]
         lib.hnh_mtx_write.restype = ctypes.c_int64
+        lib.hnh_parse_triplets.argtypes = [
+            ctypes.c_char_p, ctypes.c_int64, ctypes.c_int, ctypes.c_int64,
+            _i64p, _i64p, _f64p, ctypes.POINTER(ctypes.c_int64),
+        ]
+        lib.hnh_parse_triplets.restype = ctypes.c_int64
         lib.hnh_num_threads.restype = ctypes.c_int
         _lib = lib
         return _lib
@@ -236,6 +241,43 @@ def mtx_read(path: str):
         cols = np.concatenate([cols, mirror_c])
         vals = np.concatenate([vals, mirror_v])
     return rows, cols, vals, M.value, N.value
+
+
+def parse_triplets(buf: bytes, pattern: bool = False):
+    """Parse an in-memory chunk of matrix-market data lines ->
+    ``(rows_1based-1, cols-1, vals)`` — or None when the native layer is
+    unavailable (the caller falls back to a numpy text reader).
+
+    The ctypes call releases the GIL, which is what makes the
+    partitioned loader's thread-pool chunk parse genuinely parallel;
+    ``strtol``/``strtod`` produce the same correctly-rounded doubles as
+    numpy's tokenizer, so the two paths are bit-identical on valid
+    files — and strictness-identical on corrupt ones: a non-blank line
+    that does not parse raises ``ValueError`` here exactly where
+    ``np.loadtxt`` would in the fallback, instead of silently dropping
+    entries.
+    """
+    import ctypes as _ct
+
+    lib = _load()
+    if lib is None:
+        return None
+    cap = buf.count(b"\n") + 1
+    rows = np.empty(cap, np.int64)
+    cols = np.empty(cap, np.int64)
+    vals = np.empty(cap, np.float64)
+    n_bad = _ct.c_int64(0)
+    n = lib.hnh_parse_triplets(
+        buf, len(buf), 1 if pattern else 0, cap, rows, cols, vals,
+        _ct.byref(n_bad),
+    )
+    if n < 0:
+        return None
+    if n_bad.value:
+        raise ValueError(
+            f"{n_bad.value} malformed matrix-market data line(s) in chunk"
+        )
+    return rows[:n], cols[:n], vals[:n]
 
 
 def mtx_write(path: str, rows, cols, vals, M: int, N: int) -> None:

@@ -39,6 +39,8 @@ _ENV_KEYS = (
     "DSDDMM_EXEC_RETRIES", "DSDDMM_EXEC_TIMEOUT",
     "DSDDMM_PLAN_CACHE", "DSDDMM_CHECKPOINT_DIR",
     "DSDDMM_WATCHDOG", "DSDDMM_RUNSTORE",
+    "DSDDMM_DIST_COORDINATOR", "DSDDMM_DIST_NPROCS",
+    "DSDDMM_DIST_PROC_ID",
     "JAX_PLATFORMS", "XLA_FLAGS",
 )
 
@@ -109,6 +111,24 @@ def _jax_info() -> dict:
     return info
 
 
+def _dist_info() -> dict:
+    """Pod identity (num_processes / process_index / coordinator) via
+    ``dist.init.pod_info`` — which shares this module's never-initialize
+    discipline: a live multi-process backend is authoritative, launcher
+    env labels apply otherwise, and nothing boots a backend. Multi-host
+    records must never pool into single-process baselines, so these
+    fields ride every manifest (and the run-store index)."""
+    try:
+        from distributed_sddmm_tpu.dist.init import pod_info
+
+        # The ONE record shape (PodContext.record_fields): coordinator
+        # only when present, so single-controller manifests keep the
+        # pre-PR-14 schema and can never drift from bench records.
+        return pod_info().record_fields()
+    except Exception:  # noqa: BLE001 — manifest is best-effort
+        return {}
+
+
 def build(run_id: str, extra: dict | None = None) -> dict:
     m = {
         "schema": SCHEMA_VERSION,
@@ -121,6 +141,7 @@ def build(run_id: str, extra: dict | None = None) -> dict:
         "env": {k: os.environ[k] for k in _ENV_KEYS if k in os.environ},
     }
     m.update(_jax_info())
+    m.update(_dist_info())
     if extra:
         m["extra"] = extra
     return m

@@ -67,6 +67,12 @@ _INDEX_FIELDS = (
     # None on every earlier doc — readers must treat absence as
     # "not measured", never as a verdict.
     "hist_p50_ms", "hist_p95_ms", "hist_p99_ms", "burn_rate",
+    # Pod identity (PR 14): controller-process count and this record's
+    # process slot. Absent on pre-pod docs; the config-axis matcher
+    # normalizes absence to single-process (1) so history stays
+    # comparable while future multi-host records never pool into
+    # single-process baselines.
+    "num_processes", "process_index",
 )
 
 #: Configuration axes (beyond the fingerprint key) two runs must share
@@ -83,9 +89,24 @@ _INDEX_FIELDS = (
 # runs out of SDDMM baselines, and the mask spec keeps the mask
 # families apart from each other; non-attention docs carry None, which
 # matches None.
+# ``num_processes`` joined in PR 14: a pod record's timings include DCN
+# collectives a single-controller run never pays — pooling either way
+# would poison the noise bands. Pre-pod docs carry None, which the
+# matcher normalizes to 1 (single-process) so existing history keeps
+# comparing.
 _CONFIG_AXES = (
     "algorithm", "app", "c", "fused", "kernel", "kernel_variant", "mask",
+    "num_processes",
 )
+
+
+def _axis_value(row: dict, axis: str):
+    """Config-axis value with absence normalization: ``num_processes``
+    None (every pre-PR-14 row) means single-process."""
+    v = row.get(axis)
+    if axis == "num_processes" and v is None:
+        return 1
+    return v
 
 
 class RunStore:
@@ -230,7 +251,8 @@ class RunStore:
         rows = [
             r for r in self.history(key=key, backend=doc.get("backend"))
             if r.get("run_id") != doc.get("run_id")
-            and all(r.get(a) == cfg.get(a) for a in _CONFIG_AXES)
+            and all(_axis_value(r, a) == _axis_value(cfg, a)
+                    for a in _CONFIG_AXES)
         ]
         docs = [self.get(r["run_id"]) for r in rows[-limit:]]
         return [d for d in docs if d]
@@ -335,6 +357,8 @@ def _index_row(doc: dict) -> dict:
         "hist_p95_ms": (rec.get("latency_hist_ms") or {}).get("p95"),
         "hist_p99_ms": (rec.get("latency_hist_ms") or {}).get("p99"),
         "burn_rate": rec.get("burn_rate"),
+        "num_processes": rec.get("num_processes"),
+        "process_index": rec.get("process_index"),
         # Offline records carry the GLOBAL counter delta; serving
         # records the engine's own ladder attribution.
         "live_compiles": (

@@ -279,6 +279,12 @@ class Tracer:
 # --------------------------------------------------------------------- #
 
 
+#: DSDDMM_TRACE values meaning "on at the default location" (not a
+#: path). Shared with dist/run.py's shard-dir resolution so the two
+#: can never disagree about what counts as a path spec.
+FLAG_VALUES = ("1", "on", "true", "yes")
+
+
 def _env_activate() -> None:
     global _env_checked
     with _registry_lock:
@@ -287,7 +293,7 @@ def _env_activate() -> None:
         _env_checked = True
         spec = os.environ.get("DSDDMM_TRACE")
         if spec:
-            _enable_locked(None if spec in ("1", "on", "true", "yes") else spec)
+            _enable_locked(None if spec in FLAG_VALUES else spec)
 
 
 def _owning_pid(path: pathlib.Path) -> Optional[int]:

@@ -171,6 +171,68 @@ class GridSpec:
         return ok
 
 
+def pod_device_order(devices=None) -> list:
+    """Global device list in pod-canonical order: grouped by owning
+    process (host), then by device id within the host.
+
+    ``jax.devices()`` on a multi-controller pod already returns every
+    process's devices, but its ordering is backend-defined; the mesh
+    adjacency math (``_flat_rank``) assumes the device list's
+    contiguity structure is known. Host-major order makes the
+    fastest-varying grid axis ride intra-host ICI first and puts the
+    host boundary at a fixed stride, so :func:`process_spans` can
+    report exactly which named axes cross hosts.
+    """
+    import jax
+
+    if devices is None:
+        devices = jax.devices()
+    return sorted(devices, key=lambda d: (d.process_index, d.id))
+
+
+def make_pod_grid(
+    nr: int,
+    nc: int,
+    nh: int = 1,
+    adjacency: int = 3,
+    devices=None,
+) -> GridSpec:
+    """A process-spanning grid over every host's devices.
+
+    :func:`make_grid` over :func:`pod_device_order`: the same adjacency
+    semantics as the single-controller path (the reference's
+    ``FlexibleGrid`` rank ordering), now with the device list spanning
+    ``jax.process_count()`` hosts in host-major order. Every process
+    must build the IDENTICAL grid (SPMD contract) — which this
+    guarantees, since the sorted device order and the adjacency
+    permutation are pure functions of the global device set.
+    """
+    return make_grid(nr, nc, nh, adjacency=adjacency,
+                     devices=pod_device_order(devices))
+
+
+def process_spans(grid: GridSpec) -> dict:
+    """Which named mesh axes cross a process (host) boundary.
+
+    For each axis, True when two devices differing only in that axis
+    coordinate live on different processes — i.e. collectives over the
+    axis travel DCN, not just ICI. The multi-host HLO gate and the pod
+    runbook both read this to say where the host boundary landed.
+    """
+    devs = grid.mesh.devices
+    spans = {}
+    for ax, name in enumerate((ROWS, COLS, LAYERS)):
+        crossing = False
+        moved = np.moveaxis(devs, ax, 0)
+        procs = np.vectorize(lambda d: d.process_index)(moved.reshape(
+            moved.shape[0], -1
+        )) if moved.size else np.zeros((0, 0))
+        if procs.size and (procs != procs[0]).any():
+            crossing = True
+        spans[name] = crossing
+    return spans
+
+
 def make_grid(
     nr: int,
     nc: int,
@@ -186,7 +248,14 @@ def make_grid(
     if adjacency not in _ADJACENCY_PERMUTATIONS:
         raise ValueError(f"adjacency must be 1..6, got {adjacency}")
     if devices is None:
-        devices = jax.devices()
+        # Multi-controller: default to the pod-canonical host-major
+        # order, so every strategy built with devices=None gets the
+        # adjacency/host-boundary structure the pod runbook documents
+        # (single-process jax.devices() is already id-ordered — the two
+        # paths are identical there).
+        devices = (
+            pod_device_order() if jax.process_count() > 1 else jax.devices()
+        )
     devices = list(devices)
     if nr * nc * nh != len(devices):
         raise ValueError(

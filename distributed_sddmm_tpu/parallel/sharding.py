@@ -30,6 +30,29 @@ from distributed_sddmm_tpu.utils.coo import HostCOO
 TILE_SPEC = P("rows", "cols", "layers", None, None)
 
 
+def put_sharded(host: np.ndarray, sharding) -> jax.Array:
+    """Place a host array as a global sharded ``jax.Array``,
+    materializing ONLY the addressable shards.
+
+    Single-process: plain ``device_put`` (bit-identical, no callback
+    overhead). Multi-controller: ``jax.make_array_from_callback`` — the
+    runtime asks this process for exactly its addressable shards'
+    index slices, so a host never uploads (or pins device-side) the
+    non-addressable remainder of the global array. Under the SPMD
+    ingest contract the host array passed here covers every index the
+    callback can request (identical host data per process, or a
+    partition-backed array whose rows cover this host's devices — see
+    ``dist/ingest.py``).
+    """
+    import jax as _jax
+
+    if _jax.process_count() == 1:
+        return _jax.device_put(host, sharding)
+    return _jax.make_array_from_callback(
+        host.shape, sharding, lambda idx: host[idx]
+    )
+
+
 @dataclasses.dataclass
 class TileSet:
     """Sharded, padded, struct-of-arrays sparse tiles.
@@ -102,7 +125,7 @@ class TileSet:
             raise ValueError(f"expected ({self.nnz},) values, got {host_vals.shape}")
         buf = np.zeros(int(np.prod(self.shape)), dtype=self.mask.dtype)
         buf[self.scatter_index] = host_vals
-        return jax.device_put(buf.reshape(self.shape), self._sharding())
+        return put_sharded(buf.reshape(self.shape), self._sharding())
 
     def gather_values(self, dev_vals: jax.Array) -> np.ndarray:
         """Extract values back to the original host nonzero order."""
@@ -173,7 +196,7 @@ class ReplicatedTiles:
         shape = self.mask_owned.shape
         buf = np.zeros(int(np.prod(shape)), dtype=self.mask.dtype)
         buf[self.scatter_index] = host_vals
-        return jax.device_put(
+        return put_sharded(
             buf.reshape(shape), NamedSharding(self.grid.mesh, self.VALUES_SPEC)
         )
 
@@ -275,13 +298,13 @@ def build_replicated_tiles(
         chunk_spec = NamedSharding(grid.mesh, P("rows", "cols", None, None))
         meta_spec = NamedSharding(grid.mesh, P("rows", "cols", None))
         blocked_fields = dict(
-            blk_lr=jax.device_put(
+            blk_lr=put_sharded(
                 blocked.lr.reshape(nr, nc, C, blocked.lr.shape[-1]), chunk_spec
             ),
-            blk_lc=jax.device_put(
+            blk_lc=put_sharded(
                 blocked.lc.reshape(nr, nc, C, blocked.lc.shape[-1]), chunk_spec
             ),
-            blk_meta=jax.device_put(blocked.meta.reshape(nr, nc, C), meta_spec),
+            blk_meta=put_sharded(blocked.meta.reshape(nr, nc, C), meta_spec),
             blk_geom=(
                 blocked.bm, blocked.bn, blocked.gr_blocks, blocked.gc_blocks,
                 blocked.group,
@@ -292,10 +315,10 @@ def build_replicated_tiles(
         )
 
     return ReplicatedTiles(
-        rows=jax.device_put(rows_flat.reshape(struct_shape), struct_sharding),
-        cols=jax.device_put(cols_flat.reshape(struct_shape), struct_sharding),
-        mask=jax.device_put(mask_flat.reshape(struct_shape), struct_sharding),
-        mask_owned=jax.device_put(
+        rows=put_sharded(rows_flat.reshape(struct_shape), struct_sharding),
+        cols=put_sharded(cols_flat.reshape(struct_shape), struct_sharding),
+        mask=put_sharded(mask_flat.reshape(struct_shape), struct_sharding),
+        mask_owned=put_sharded(
             mask_flat.reshape(values_shape), values_sharding
         ),
         scatter_index=scatter_index,
@@ -422,9 +445,9 @@ def build_tiles(
         meta_spec = NamedSharding(grid.mesh, P("rows", "cols", "layers", None, None))
         shape6 = (nr, nc, nh, T, C, blocked.lr.shape[-1])
         blocked_fields = dict(
-            blk_lr=jax.device_put(blocked.lr.reshape(shape6), chunk_spec),
-            blk_lc=jax.device_put(blocked.lc.reshape(shape6), chunk_spec),
-            blk_meta=jax.device_put(
+            blk_lr=put_sharded(blocked.lr.reshape(shape6), chunk_spec),
+            blk_lc=put_sharded(blocked.lc.reshape(shape6), chunk_spec),
+            blk_meta=put_sharded(
                 blocked.meta.reshape(nr, nc, nh, T, C), meta_spec
             ),
             blk_geom=(
@@ -438,9 +461,9 @@ def build_tiles(
         )
 
     return TileSet(
-        rows=jax.device_put(rows_flat.reshape(shape), sharding),
-        cols=jax.device_put(cols_flat.reshape(shape), sharding),
-        mask=jax.device_put(mask_flat.reshape(shape), sharding),
+        rows=put_sharded(rows_flat.reshape(shape), sharding),
+        cols=put_sharded(cols_flat.reshape(shape), sharding),
+        mask=put_sharded(mask_flat.reshape(shape), sharding),
         scatter_index=scatter_index,
         tile_rows=tile_rows,
         tile_cols=tile_cols,

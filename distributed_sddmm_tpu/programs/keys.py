@@ -63,12 +63,55 @@ def sig_for_args(args) -> str:
 # --------------------------------------------------------------------- #
 
 
+def dist_segment(num_processes: int | None = None,
+                 process_index: int | None = None) -> str:
+    """The multi-controller key segment: ``dN.pK`` for worker ``K`` of
+    an ``N``-process pod, ``""`` single-process.
+
+    Single-process keys stay byte-identical to the PR 6–13 grammar (no
+    trailing segment at all), so every existing store keeps hitting. On
+    a pod both halves matter: the compiled program has GLOBAL semantics
+    shaped by the process count (collectives span hosts), and
+    ``serialize_executable`` payloads are per-process (each worker's
+    executable binds its own addressable devices) — worker K of an
+    N-pod must only ever warm-start from entries worker K of an N-pod
+    wrote. Defaults resolve from :func:`dist.init.pod_info`.
+    """
+    if num_processes is None:
+        from distributed_sddmm_tpu.dist.init import pod_info
+
+        ctx = pod_info()
+        num_processes, process_index = ctx.num_processes, ctx.process_index
+    if not num_processes or int(num_processes) <= 1:
+        return ""
+    if process_index is None:
+        # Defaulting the slot would hand every caller 'dN.p0' — the
+        # cross-worker store aliasing this segment exists to prevent
+        # (same guard as pod_info's NPROCS-without-PROC_ID rule).
+        raise ValueError(
+            "dist_segment: multi-process segment needs an explicit "
+            "process_index"
+        )
+    return f"d{int(num_processes)}.p{int(process_index)}"
+
+
+def parse_dist_segment(seg: str) -> dict | None:
+    """``dN.pK`` -> ``{"num_processes", "process_index"}`` (None when
+    the segment is not dist-shaped)."""
+    m = re.match(r"^d(\d+)\.p(\d+)$", seg)
+    if not m:
+        return None
+    return {"num_processes": int(m.group(1)),
+            "process_index": int(m.group(2))}
+
+
 def plan_program_key(
     fingerprint_key: str,
     op: str,
     sig: str,
     backend: str,
     code: str | None = None,
+    dist: str | None = None,
 ) -> str:
     """Key for one compiled strategy program under an autotune plan.
 
@@ -78,26 +121,40 @@ def plan_program_key(
     :func:`sig_for_args` over the concrete call arguments. ``code``
     defaults to the live ``autotune.fingerprint.code_hash()`` — baked in
     even though the fingerprint already covers it, so a key parsed out
-    of the store is self-describing about its generation.
+    of the store is self-describing about its generation. ``dist`` is
+    the :func:`dist_segment` of the compiling worker — appended only
+    when multi-process (single-process keys are byte-identical to the
+    pre-pod grammar), so a pod worker's per-process executables never
+    alias single-controller entries or another worker's.
     """
     if code is None:
         from distributed_sddmm_tpu.autotune.fingerprint import code_hash
 
         code = code_hash()
-    return ":".join(
+    key = ":".join(
         ("plan", _seg(fingerprint_key), _seg(op), _seg(sig),
          _seg(backend), _seg(code))
     )
+    if dist:
+        key += f":{_seg(dist)}"
+    return key
 
 
 def parse_plan_key(key: str) -> dict | None:
     parts = key.split(":")
-    if len(parts) != 6 or parts[0] != "plan":
+    if len(parts) not in (6, 7) or parts[0] != "plan":
         return None
-    return dict(zip(
+    out = dict(zip(
         ("family", "fingerprint_key", "op", "sig", "backend", "code_hash"),
-        parts,
+        parts[:6],
     ))
+    if len(parts) == 7:
+        dist = parse_dist_segment(parts[6])
+        if dist is None:
+            return None
+        out["dist"] = parts[6]
+        out.update(dist)
+    return out
 
 
 # --------------------------------------------------------------------- #
@@ -115,6 +172,7 @@ def serve_program_key(
     params: str | None = None,
     sig: str | None = None,
     variant: str | None = None,
+    dist: str | None = None,
 ) -> str:
     """Cache key for one serving bucket cell — the grammar the engine
     has used since PR 5 (``serve:<workload>:b<bb>:i<ib>:r<R>:<backend>:
@@ -127,7 +185,11 @@ def serve_program_key(
     ``v<variant>`` (the warm model's codegen kernel-variant id, PR 9 —
     a ladder warmed under one kernel specialization never answers for
     another; variant-less keys are byte-identical to the PR 5-8
-    grammar, so existing stores keep hitting)."""
+    grammar, so existing stores keep hitting). ``dist`` is the
+    :func:`dist_segment` of the compiling worker (PR 14) — serving
+    executables are per-process exactly like plan programs, so a pod
+    worker's ladder entries must never answer for another slot's;
+    single-process keys append nothing and stay byte-identical."""
     if code is None:
         from distributed_sddmm_tpu.autotune.fingerprint import serve_code_hash
 
@@ -142,12 +204,14 @@ def serve_program_key(
         key += f":s{_seg(sig)}"
     if variant:
         key += f":v{_seg(variant)}"
+    if dist:
+        key += f":{_seg(dist)}"
     return key
 
 
 def parse_serve_key(key: str) -> dict | None:
     parts = key.split(":")
-    if not (7 <= len(parts) <= 10) or parts[0] != "serve":
+    if not (7 <= len(parts) <= 11) or parts[0] != "serve":
         return None
     if not (parts[2].startswith("b") and parts[3].startswith("i")
             and parts[4].startswith("r")):
@@ -162,7 +226,11 @@ def parse_serve_key(key: str) -> dict | None:
         "code_hash": parts[6],
     }
     for extra in parts[7:]:
-        if extra.startswith("p"):
+        dist = parse_dist_segment(extra)
+        if dist is not None:
+            out["dist"] = extra
+            out.update(dist)
+        elif extra.startswith("p"):
             out["params"] = extra[1:]
         elif extra.startswith("s"):
             out["sig"] = extra[1:]

@@ -678,23 +678,31 @@ def bind_strategy(alg, fingerprint_key: str,
         return False
     backend = live_backend() or "unknown"
     cfg = strategy_config_tag(alg)
+    # Pod identity is resolved ONCE at bind time (the worker's slot in
+    # the pod cannot change mid-run): multi-process workers key their
+    # per-process executables under a trailing ``dN.pK`` segment so a
+    # worker warm-starts from exactly the entries its own slot wrote;
+    # single-process binds append nothing and stay byte-identical to
+    # the PR 6-13 grammar.
+    dist = keys_mod.dist_segment()
 
     def binder(op_key: str, jit_fn):
         def key_fn(sig: str) -> str:
             return keys_mod.plan_program_key(
-                fingerprint_key, f"{cfg}-{op_key}", sig, backend
+                fingerprint_key, f"{cfg}-{op_key}", sig, backend,
+                dist=dist,
             )
 
         return StoredProgram(
             jit_fn, key_fn, store,
             meta={"fingerprint_key": fingerprint_key, "op": op_key,
-                  "config": cfg},
+                  "config": cfg, **({"dist": dist} if dist else {})},
         )
 
     alg.bind_program_store(binder)
     alg._program_store_meta = {
         "store": store, "fingerprint_key": fingerprint_key,
-        "config": cfg, "backend": backend,
+        "config": cfg, "backend": backend, "dist": dist,
         # Matrix-content digest (:func:`matrix_content_key`), consumed
         # by :func:`chained_program` — see there for why the chains
         # need it and the strategy programs do not.
@@ -728,13 +736,14 @@ def chained_program(alg, op: str, jit_fn):
     def key_fn(sig: str) -> str:
         return keys_mod.plan_program_key(
             meta["fingerprint_key"], f"{meta['config']}-{op}", sig,
-            meta["backend"],
+            meta["backend"], dist=meta.get("dist"),
         )
 
     return StoredProgram(
         jit_fn, key_fn, meta["store"],
         meta={"fingerprint_key": meta["fingerprint_key"], "op": op,
-              "config": meta["config"]},
+              "config": meta["config"],
+              **({"dist": meta["dist"]} if meta.get("dist") else {})},
     )
 
 

@@ -176,6 +176,10 @@ class ServingEngine:
             self.workload.name, batch_bucket, inner_bucket, r, backend,
             params=self.workload.program_params(), sig=sig,
             variant=variant,
+            # Serving executables are per-process like plan programs:
+            # on a pod each worker's ladder keys carry its dN.pK slot
+            # (empty single-process — keys byte-identical to PR 5-13).
+            dist=program_keys.dist_segment(),
         )
 
     def _note_resolve(self, source: str) -> None:

@@ -67,6 +67,20 @@ KNOBS: dict[str, Knob] = {k.name: k for k in [
        "one-hot chunk width of the blocked kernels"),
     _K("DSDDMM_CHUNK_GROUP", "int", "4",
        "chunks fused per grid step in the blocked kernels"),
+    _K("DSDDMM_DIST_COORDINATOR", "str", "unset (auto-discover)",
+       "jax.distributed coordinator host:port a pod launcher exports "
+       "to every worker (dist/init.py)"),
+    _K("DSDDMM_DIST_INGEST_CHUNK", "int", "4194304",
+       "partitioned-loader streaming chunk size in bytes "
+       "(dist/ingest.py)"),
+    _K("DSDDMM_DIST_INGEST_THREADS", "int", "min(cpus, 8)",
+       "parallel parse workers of the partitioned .mtx loader"),
+    _K("DSDDMM_DIST_NPROCS", "int", "unset",
+       "pod process count label/override (requires the coordinator; "
+       "also keys offline pod tooling)"),
+    _K("DSDDMM_DIST_PROC_ID", "int", "unset",
+       "this worker's pod process index (pairs with "
+       "DSDDMM_DIST_NPROCS)"),
     _K("DSDDMM_DONATE", "flag", "1",
        "donate CG/GAT loop buffers to their compiled programs (0 "
        "stands donation down)"),
@@ -89,6 +103,12 @@ KNOBS: dict[str, Knob] = {k.name: k for k in [
        "structured stderr log level: debug|info|warn|error"),
     _K("DSDDMM_PLAN_CACHE", "spec", "artifacts/plan_cache",
        "autotune plan cache: relocate (path) or veto (0)"),
+    _K("DSDDMM_POD_ADMIN_BASE", "int", "0 (off)",
+       "pod runner: worker k serves its admin /metrics on port "
+       "base + k (dist/run.py)"),
+    _K("DSDDMM_POD_TRACE_MERGE", "flag", "1",
+       "pod runner: worker 0 merges every worker's trace shard into "
+       "one pod timeline at run end"),
     _K("DSDDMM_PROFILE", "path", "off",
        "jax.profiler capture logdir (per-anomaly windows when the "
        "flight recorder is armed)"),

@@ -178,15 +178,119 @@ int64_t hnh_mtx_read(const char* path, int64_t nnz, int pattern, int64_t* rows,
   int64_t k = 0;
   while (k < nnz && fgets(line, sizeof line, f)) {
     char* p = line;
-    const long r = strtol(p, &p, 10);
-    const long c = strtol(p, &p, 10);
-    if (p == line) continue;  // blank line
+    char* q = p;
+    const long r = strtol(q, &q, 10);
+    if (q == p) continue;  // blank/comment line
+    if (*q && !isspace((unsigned char)*q)) continue;  // '2.5'-style index
+    char* q2 = q;
+    const long c = strtol(q2, &q2, 10);
+    if (q2 == q) continue;  // missing column field
+    if (*q2 && !isspace((unsigned char)*q2)) continue;
+    double v = 1.0;
+    if (!pattern) {
+      // A malformed value field ("bogus", missing) used to load
+      // silently as 0.0; skipping it instead makes the parsed count
+      // fall short of the header and the caller raise -- the same
+      // fail-loudly contract the partitioned loader enforces.
+      char* q3 = q2;
+      v = strtod(q3, &q3);
+      if (q3 == q2) continue;
+    }
     rows[k] = r - 1;
     cols[k] = c - 1;
-    vals[k] = pattern ? 1.0 : strtod(p, &p);
+    vals[k] = v;
     ++k;
   }
   fclose(f);
+  return k;
+}
+
+// Parse whitespace-separated coordinate triplets (or pairs, for
+// pattern files) from an in-memory buffer: one line per entry, 1-based
+// indices on disk -> 0-based out. Blank (whitespace-only) lines are
+// skipped; a NON-blank line that does not parse into the expected
+// fields within its own newline counts into *n_bad and is skipped --
+// the Python layer raises on n_bad like np.loadtxt would, so the
+// native and numpy chunk parsers stay strictness-identical. Returns
+// entries written (<= cap).
+//
+// This is the partitioned loader's chunk parser (dist/ingest.py): the
+// ctypes call releases the GIL, so a thread pool over byte-range
+// chunks parses in genuine parallel -- the numpy text readers hold the
+// GIL and cannot.
+int64_t hnh_parse_triplets(const char* buf, int64_t len, int pattern,
+                           int64_t cap, int64_t* rows, int64_t* cols,
+                           double* vals, int64_t* n_bad) {
+  const char* p = buf;
+  const char* end = buf + len;
+  int64_t k = 0;
+  int64_t bad = 0;
+  while (p < end && k < cap) {
+    const char* nl = (const char*)memchr(p, '\n', (size_t)(end - p));
+    const char* line_end = nl ? nl : end;
+    int blank = 1;
+    for (const char* s = p; s < line_end; ++s) {
+      if (!isspace((unsigned char)*s)) { blank = 0; break; }
+    }
+    const char* first = p;
+    while (first < line_end && isspace((unsigned char)*first)) ++first;
+    if (first < line_end && *first == '%') {
+      // Interior comment line -- legal in the wild and skipped by the
+      // whole-matrix loader; not data, not malformed.
+      p = nl ? nl + 1 : end;
+      continue;
+    }
+    if (!blank) {
+      int ok = 0;
+      char* q = (char*)p;
+      const long r = strtol(q, &q, 10);
+      // Index fields must end at a whitespace boundary: '2.5' must
+      // not truncate-parse as 2 with '.5' bleeding into the next
+      // field (the python fallback rejects such lines; the two
+      // parsers must agree line for line).
+      if (q != p && q <= line_end
+          && (q == line_end || isspace((unsigned char)*q))) {
+        char* q2 = q;
+        const long c = strtol(q2, &q2, 10);
+        if (q2 != q && q2 <= line_end
+            && (q2 == line_end || isspace((unsigned char)*q2))) {
+          double v = 1.0;
+          int vok = 1;
+          if (!pattern) {
+            char* q3 = q2;
+            v = strtod(q3, &q3);
+            vok = (q3 != q2 && q3 <= line_end);
+            q2 = vok ? q3 : q2;
+          }
+          if (vok) {
+            // Extra NUMERIC fields are legal (the numpy fallback
+            // slices them away); non-numeric residue (e.g. "3.5xx"
+            // leaves "xx") is what numpy would reject.
+            int trailing = 0;
+            char* s = q2;
+            while (s < line_end) {
+              while (s < line_end && isspace((unsigned char)*s)) ++s;
+              if (s >= line_end) break;
+              char* s2 = s;
+              strtod(s, &s2);
+              if (s2 == s || s2 > line_end) { trailing = 1; break; }
+              s = s2;
+            }
+            if (!trailing) {
+              rows[k] = r - 1;
+              cols[k] = c - 1;
+              vals[k] = v;
+              ++k;
+              ok = 1;
+            }
+          }
+        }
+      }
+      if (!ok) ++bad;
+    }
+    p = nl ? nl + 1 : end;
+  }
+  if (n_bad) *n_bad = bad;
   return k;
 }
 

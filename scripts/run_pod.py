@@ -1,76 +1,27 @@
-"""Multi-host TPU pod runner.
+"""Multi-host TPU pod runner (thin wrapper).
 
-The reference scaled with SLURM jobscripts over MPI ranks
-(`/root/reference/jobscript.sh`); on TPU pods the analog is one process per
-host, connected by ``jax.distributed.initialize()``, with every algorithm in
-this framework unchanged — the 3-D ``Mesh`` simply spans all pod chips and
-the shift/replication axes ride ICI (and DCN across slices).
-
-Run THIS SAME script on every host of the pod, e.g. with
+The pod wiring — coordinator resolution, ``jax.distributed`` init,
+per-worker admin ports, per-worker trace shards, end-of-run pod
+timeline merge — lives in :mod:`distributed_sddmm_tpu.dist.run` since
+PR 14 (it used to live here); this script remains the operational entry
+point the runbook invokes on every host:
 
     gcloud compute tpus tpu-vm ssh $TPU_NAME --worker=all \
       --command="cd ~/distributed_sddmm_tpu && python scripts/run_pod.py \
                  er 20 32 15d_fusion2 128 4 -o results.jsonl"
 
-JAX's TPU backend discovers coordinator/topology automatically on Cloud TPU;
-pass --coordinator for other clusters.
+JAX's TPU backend discovers coordinator/topology automatically on Cloud
+TPU; pass --coordinator (or DSDDMM_DIST_COORDINATOR) for other clusters.
 """
 
 from __future__ import annotations
 
-import argparse
 import pathlib
 import sys
 
 sys.path.insert(0, str(pathlib.Path(__file__).resolve().parents[1]))
 
-
-def main(argv=None) -> int:
-    ap = argparse.ArgumentParser(description=__doc__)
-    ap.add_argument("--coordinator", default=None,
-                    help="host:port (omit on Cloud TPU: auto-discovered)")
-    ap.add_argument("--num-processes", type=int, default=None)
-    ap.add_argument("--process-id", type=int, default=None)
-    ap.add_argument("--dry-run", action="store_true",
-                    help="print the resolved initialize()/bench invocation "
-                    "and exit (testable without a pod)")
-    ap.add_argument("bench_args", nargs=argparse.REMAINDER,
-                    help="arguments forwarded to distributed_sddmm_tpu.bench")
-    args = ap.parse_args(argv)
-
-    if args.coordinator is None and (
-        args.num_processes is not None or args.process_id is not None
-    ):
-        ap.error("--num-processes/--process-id require --coordinator "
-                 "(without it, Cloud TPU auto-discovery ignores them)")
-    init_kwargs = (
-        dict(coordinator_address=args.coordinator,
-             num_processes=args.num_processes, process_id=args.process_id)
-        if args.coordinator else {}
-    )
-    if args.dry_run:
-        # Validate the forwarded bench arguments parse, without touching any
-        # backend or coordinator.
-        from distributed_sddmm_tpu.bench.cli import build_parser
-
-        build_parser().parse_args(args.bench_args)
-        print(f"dry-run ok: initialize({init_kwargs}) -> bench {args.bench_args}")
-        return 0
-
-    import jax
-
-    jax.distributed.initialize(**init_kwargs)  # Cloud TPU: auto-discovery
-
-    if jax.process_index() == 0:
-        print(
-            f"pod up: {jax.process_count()} hosts, "
-            f"{jax.device_count()} chips ({jax.local_device_count()}/host)"
-        )
-
-    from distributed_sddmm_tpu.bench.cli import main as bench_main
-
-    return bench_main(args.bench_args)
-
+from distributed_sddmm_tpu.dist.run import main  # noqa: E402
 
 if __name__ == "__main__":
     sys.exit(main())

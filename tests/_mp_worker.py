@@ -1,16 +1,35 @@
-"""Worker for the two-process jax.distributed test (test_multiprocess.py).
+"""Worker for the multi-process pod tests (test_multiprocess.py) and
+the elastic kill-and-recover drill (test_dist_elastic.py).
 
-Each invocation is one "host" of a 2-process CPU pod: it initializes
+**Pod mode** (default): one "host" of a 2-process CPU pod — initializes
 jax.distributed against the shared coordinator (the same wiring
-`scripts/run_pod.py` performs on a real pod), builds a strategy over the
-GLOBAL 4-device mesh (2 processes x 2 local devices), runs distributed ops,
-and prints device-computed fingerprints as one JSON line. The parent test
-compares the two processes' fingerprints against each other and against the
-same strategy program run on a single-process 4-device mesh.
+``scripts/run_pod.py`` / ``dist/run.py`` performs on a real pod), runs
+the cross-process ``device_put`` capability probe
+(``dist.init.cross_process_probe``) and EMITS THE PROBE RESULT in its
+record; when the backend supports cross-process placement it continues
+into the full distributed computation (strategy over the global
+4-device mesh) and reports device-computed fingerprints. The parent
+test keys its strictness on the probe instead of an unconditional
+xfail, so the pod test runs strict the day the jax backend supports it.
+
+**Elastic mode** (``--elastic``): one worker generation of the elastic
+drill. The DATA partition is fixed at ``--nshards`` (the original pod
+size); this worker owns shards ``{s : s % nprocs == pid}`` and runs
+``--steps`` deterministic damped-iteration steps per shard over the
+PARTITIONED ingest path (``dist.ingest.erdos_renyi_partitioned`` — no
+worker materializes the full matrix), checkpointing each shard's state
+per step (``resilience.checkpoint.CheckpointStore``) and resuming from
+scan-back when checkpoints exist. The kill sites (``mp_worker:start``,
+``mp_worker:post_compute`` — fired once per step, AFTER compute and
+BEFORE the checkpoint save) model losing a worker between a completed
+step and its checkpoint.
 
 Usage: python tests/_mp_worker.py <process_id> <coordinator_port>
+         [--elastic --nprocs K --nshards S --steps N
+          --checkpoint-dir DIR --generation G]
 """
 
+import argparse
 import json
 import os
 import pathlib
@@ -32,9 +51,89 @@ import jax
 jax.config.update("jax_platforms", "cpu")
 
 
-def main() -> None:
-    pid = int(sys.argv[1])
-    port = int(sys.argv[2])
+def _parse_argv():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("pid", type=int)
+    ap.add_argument("port", type=int)
+    ap.add_argument("--elastic", action="store_true")
+    ap.add_argument("--nprocs", type=int, default=2,
+                    help="live workers this generation (elastic mode)")
+    ap.add_argument("--nshards", type=int, default=2,
+                    help="fixed data-partition count (original pod size)")
+    ap.add_argument("--steps", type=int, default=6)
+    ap.add_argument("--checkpoint-dir", default=None)
+    ap.add_argument("--generation", type=int, default=0)
+    return ap.parse_args()
+
+
+def elastic_main(args) -> None:
+    """One elastic generation: partitioned ingest, per-shard step loop
+    with scan-back resume, per-step checkpoint, kill sites live."""
+    import numpy as np
+
+    from distributed_sddmm_tpu.dist import ingest
+    from distributed_sddmm_tpu.obs import trace as obs_trace
+    from distributed_sddmm_tpu.resilience import faults
+    from distributed_sddmm_tpu.resilience.checkpoint import CheckpointStore
+
+    faults.maybe_kill("mp_worker:start")
+    obs_trace.event(
+        "mp_worker:start", process=args.pid, pid_os=os.getpid(),
+        generation=args.generation, elastic=True,
+    )
+
+    import jax.numpy as jnp
+
+    step_fn = jax.jit(lambda x, r: 0.5 * x + r)
+
+    shards = [s for s in range(args.nshards) if s % args.nprocs == args.pid]
+    fingerprints = {}
+    for s in shards:
+        # Partitioned ingest: this worker parses ONLY shard s's block
+        # rows of the (deterministic, p-invariant) generated matrix.
+        shard = ingest.erdos_renyi_partitioned(
+            96, 80, 4, args.nshards, s, seed=5, values="normal",
+            chunk_edges=64,
+        )
+        rows_local = shard.row1 - shard.row0
+        drive = np.zeros(max(rows_local, 1))
+        if shard.nnz:
+            np.add.at(drive, shard.coo.rows - shard.row0, shard.coo.vals)
+        store = CheckpointStore(
+            pathlib.Path(args.checkpoint_dir) / f"shard{s}"
+        )
+        latest = store.load_latest()
+        if latest is not None:
+            start, arrays, _meta = latest
+            x = jnp.asarray(arrays["x"])
+            start += 1  # the checkpoint holds the COMPLETED step
+        else:
+            start, x = 0, jnp.zeros_like(jnp.asarray(drive))
+        r = jnp.asarray(drive)
+        for t in range(start, args.steps):
+            with obs_trace.span(
+                "elastic:step", shard=s, step=t, process=args.pid,
+                generation=args.generation,
+            ):
+                x = step_fn(x, r)
+                x.block_until_ready()
+            # Post-compute, pre-checkpoint: a kill here loses exactly
+            # this step's checkpoint — scan-back recovery recomputes it.
+            faults.maybe_kill("mp_worker:post_compute")
+            store.save(t, {"x": np.asarray(x)})
+        fingerprints[str(s)] = float(np.sum(np.asarray(x, np.float64) ** 2))
+
+    obs_trace.event("mp_worker:done", process=args.pid,
+                    generation=args.generation)
+    obs_trace.disable()  # flush the shard before the result line
+    print(json.dumps({
+        "pid": args.pid, "generation": args.generation,
+        "shards": fingerprints, "steps": args.steps,
+    }), flush=True)
+
+
+def pod_main(args) -> None:
+    pid, port = args.pid, args.port
 
     # Fault hooks (env-activated via DSDDMM_FAULTS, e.g. a "kill" spec at
     # site mp_worker:start) — the resilience fault-matrix test preempts one
@@ -53,11 +152,32 @@ def main() -> None:
 
     obs_trace.event("mp_worker:start", process=pid, pid_os=os.getpid())
 
-    jax.distributed.initialize(
-        coordinator_address=f"127.0.0.1:{port}", num_processes=2, process_id=pid,
-        initialization_timeout=int(os.environ.get("DSDDMM_MP_INIT_TIMEOUT", 300)),
+    from distributed_sddmm_tpu.dist.init import cross_process_probe, initialize
+
+    initialize(
+        coordinator=f"127.0.0.1:{port}", num_processes=2, process_id=pid,
+        initialization_timeout=int(
+            os.environ.get("DSDDMM_MP_INIT_TIMEOUT", 300)
+        ),
     )
     assert jax.device_count() == 4 and jax.local_device_count() == 2
+
+    # Capability probe: can THIS backend place a cross-process global
+    # array? The verdict is emitted IMMEDIATELY (its own line, before
+    # any strategy code runs) so the parent can tell "died before the
+    # probe" (environment xfail) from "probe passed, strategy code
+    # crashed" (a hard regression once a backend supports the pod
+    # path); the final record carries it again.
+    probe_ok, probe_err = cross_process_probe()
+    print(json.dumps({"pid": pid, "probe": True, "probe_ok": probe_ok}),
+          flush=True)
+    if not probe_ok:
+        obs_trace.event("mp_worker:done", process=pid, probe_ok=False)
+        obs_trace.disable()
+        print(json.dumps({
+            "pid": pid, "probe_ok": False, "probe_error": probe_err,
+        }), flush=True)
+        return
 
     import jax.numpy as jnp
 
@@ -66,7 +186,8 @@ def main() -> None:
     from distributed_sddmm_tpu.utils.coo import HostCOO
 
     # Identical host data on every process (SPMD ingest contract: the same
-    # seed everywhere, device_put places only the addressable shards).
+    # seed everywhere; parallel/sharding.put_sharded places only the
+    # addressable shards).
     S = HostCOO.erdos_renyi(96, 80, 4, seed=5, values="normal")
     alg = DenseShift15D(S, R=16, c=2, fusion_approach=2)
     A = alg.dummy_initialize(MatMode.A)
@@ -84,8 +205,17 @@ def main() -> None:
     faults.maybe_kill("mp_worker:post_compute")
     obs_trace.event("mp_worker:done", process=pid)
     obs_trace.disable()  # flush the shard before the result line
-    print(json.dumps({"pid": pid, "fp_out": fp_out, "fp_mid": fp_mid}),
-          flush=True)
+    print(json.dumps({
+        "pid": pid, "probe_ok": True, "fp_out": fp_out, "fp_mid": fp_mid,
+    }), flush=True)
+
+
+def main() -> None:
+    args = _parse_argv()
+    if args.elastic:
+        elastic_main(args)
+    else:
+        pod_main(args)
 
 
 if __name__ == "__main__":

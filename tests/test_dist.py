@@ -1,0 +1,325 @@
+"""The dist/ initialization layer, pod identity, and pod-aware keys.
+
+Host-side contracts of the multi-controller path, all testable on one
+process: coordinator resolution (the run_pod rules, now in-package),
+``pod_info`` precedence (live runtime > launcher env > single), the
+``dN.pK`` ProgramStore key segment (byte-identical single-process
+grammar; disjoint per-slot keys multi-process), the pod-canonical mesh
+construction, addressable-shard placement equivalence, and the
+runstore's num_processes config axis.
+"""
+
+import json
+import pathlib
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import jax
+
+from distributed_sddmm_tpu.dist.init import (
+    PodContext, cross_process_probe, pod_info, resolve_init_kwargs,
+)
+from distributed_sddmm_tpu.programs import keys as keys_mod
+
+REPO = pathlib.Path(__file__).resolve().parents[1]
+
+
+class TestResolveInitKwargs:
+    def test_auto_discovery_is_empty(self, monkeypatch):
+        for k in ("DSDDMM_DIST_COORDINATOR", "DSDDMM_DIST_NPROCS",
+                  "DSDDMM_DIST_PROC_ID"):
+            monkeypatch.delenv(k, raising=False)
+        assert resolve_init_kwargs() == {}
+
+    def test_explicit_coordinator(self):
+        kw = resolve_init_kwargs("10.0.0.1:1234", 4, 2,
+                                 initialization_timeout=30)
+        assert kw == {
+            "coordinator_address": "10.0.0.1:1234", "num_processes": 4,
+            "process_id": 2, "initialization_timeout": 30,
+        }
+
+    def test_nprocs_without_coordinator_rejected(self, monkeypatch):
+        monkeypatch.delenv("DSDDMM_DIST_COORDINATOR", raising=False)
+        with pytest.raises(ValueError, match="coordinator"):
+            resolve_init_kwargs(num_processes=2)
+        with pytest.raises(ValueError, match="coordinator"):
+            resolve_init_kwargs(process_id=1)
+
+    def test_env_fallbacks(self, monkeypatch):
+        monkeypatch.setenv("DSDDMM_DIST_COORDINATOR", "h:9")
+        monkeypatch.setenv("DSDDMM_DIST_NPROCS", "3")
+        monkeypatch.setenv("DSDDMM_DIST_PROC_ID", "1")
+        kw = resolve_init_kwargs()
+        assert kw["coordinator_address"] == "h:9"
+        assert kw["num_processes"] == 3 and kw["process_id"] == 1
+        # Explicit arguments beat the env.
+        kw = resolve_init_kwargs("x:1", 2, 0)
+        assert kw["coordinator_address"] == "x:1"
+        assert kw["num_processes"] == 2 and kw["process_id"] == 0
+
+
+class TestPodInfo:
+    def test_single_process_default(self, monkeypatch):
+        for k in ("DSDDMM_DIST_COORDINATOR", "DSDDMM_DIST_NPROCS",
+                  "DSDDMM_DIST_PROC_ID"):
+            monkeypatch.delenv(k, raising=False)
+        ctx = pod_info()
+        assert ctx == PodContext(1, 0, None)
+        assert not ctx.is_multi_host
+
+    def test_env_labels_apply_on_single_process_backend(self, monkeypatch):
+        # The test process HAS a live (single-process) backend; the
+        # launcher labels must still win so off-pod tooling can produce
+        # pod-keyed artifacts.
+        monkeypatch.setenv("DSDDMM_DIST_NPROCS", "2")
+        monkeypatch.setenv("DSDDMM_DIST_PROC_ID", "1")
+        monkeypatch.setenv("DSDDMM_DIST_COORDINATOR", "c:1")
+        ctx = pod_info()
+        assert (ctx.num_processes, ctx.process_index) == (2, 1)
+        assert ctx.coordinator == "c:1" and ctx.is_multi_host
+        assert ctx.as_dict() == {
+            "num_processes": 2, "process_index": 1, "coordinator": "c:1",
+        }
+
+    def test_nprocs_label_without_slot_fails_loudly(self, monkeypatch):
+        # Every worker silently claiming p0 would alias per-slot store
+        # entries — a launcher that forgets the slot must hear about it.
+        monkeypatch.setenv("DSDDMM_DIST_NPROCS", "4")
+        monkeypatch.delenv("DSDDMM_DIST_PROC_ID", raising=False)
+        with pytest.raises(ValueError, match="DSDDMM_DIST_PROC_ID"):
+            pod_info()
+
+    def test_probe_trivially_true_single_process(self):
+        ok, err = cross_process_probe()
+        assert ok is True and err is None
+
+
+class TestDistKeySegment:
+    def test_single_process_empty(self, monkeypatch):
+        monkeypatch.delenv("DSDDMM_DIST_NPROCS", raising=False)
+        assert keys_mod.dist_segment() == ""
+        assert keys_mod.dist_segment(1, 0) == ""
+        assert keys_mod.dist_segment(None, None) == ""
+
+    def test_segment_grammar_round_trip(self):
+        seg = keys_mod.dist_segment(4, 3)
+        assert seg == "d4.p3"
+        assert keys_mod.parse_dist_segment(seg) == {
+            "num_processes": 4, "process_index": 3,
+        }
+        assert keys_mod.parse_dist_segment("b4") is None
+        assert keys_mod.parse_dist_segment("d4") is None
+
+    def test_plan_key_byte_identical_without_dist(self):
+        old = keys_mod.plan_program_key("fp", "op", "sig", "cpu", code="c0")
+        new = keys_mod.plan_program_key("fp", "op", "sig", "cpu", code="c0",
+                                        dist="")
+        assert old == new
+        assert old.count(":") == 5
+
+    def test_plan_key_with_dist_round_trips(self):
+        key = keys_mod.plan_program_key(
+            "fp", "op", "sig", "tpu", code="c0",
+            dist=keys_mod.dist_segment(2, 1),
+        )
+        assert key.endswith(":d2.p1")
+        parsed = keys_mod.parse_plan_key(key)
+        assert parsed["num_processes"] == 2
+        assert parsed["process_index"] == 1
+        assert parsed["dist"] == "d2.p1"
+        assert parsed["fingerprint_key"] == "fp"
+        # A 7th segment that is not dist-shaped is not a plan key.
+        assert keys_mod.parse_plan_key(key + "x") is None
+        assert keys_mod.parse_key(key)["family"] == "plan"
+
+    def test_serve_key_dist_segment_round_trips(self):
+        key = keys_mod.serve_program_key(
+            "alsFoldIn", 4, 8, 16, "cpu", code="c0", params="k3",
+            sig="s1", variant="v1.rb8.rm", dist=keys_mod.dist_segment(2, 1),
+        )
+        assert key.endswith(":d2.p1")
+        parsed = keys_mod.parse_serve_key(key)
+        assert parsed["num_processes"] == 2 and parsed["process_index"] == 1
+        assert parsed["variant"] == "v1.rb8.rm"
+        # No dist: byte-identical to the PR 5-13 grammar.
+        base = keys_mod.serve_program_key(
+            "alsFoldIn", 4, 8, 16, "cpu", code="c0", dist="",
+        )
+        assert base == keys_mod.serve_program_key(
+            "alsFoldIn", 4, 8, 16, "cpu", code="c0",
+        )
+
+    def test_bound_strategy_keys_carry_pod_slot(self, monkeypatch, tmp_path):
+        """A worker labeled as slot 0 of a 2-pod writes store entries
+        under ``:d2.p0`` keys; an unlabeled (single-process) bind of
+        the SAME problem writes the classic 6-segment keys — the two
+        generations can never alias."""
+        from distributed_sddmm_tpu import programs
+        from distributed_sddmm_tpu.common import MatMode
+        from distributed_sddmm_tpu.parallel.dense_shift_15d import DenseShift15D
+        from distributed_sddmm_tpu.utils.coo import HostCOO
+
+        S = HostCOO.erdos_renyi(48, 40, 4, seed=2, values="normal")
+
+        def run_bound(store_root):
+            store = programs.ProgramStore(store_root)
+            alg = DenseShift15D(S, R=8, c=2, fusion_approach=2)
+            assert programs.bind_strategy(alg, "fpkey", store=store)
+            A = alg.dummy_initialize(MatMode.A)
+            B = alg.dummy_initialize(MatMode.B)
+            alg.fused_spmm(A, B, alg.like_s_values(1.0))
+            return [r["key"] for r in store.index()]
+
+        monkeypatch.setenv("DSDDMM_DIST_NPROCS", "2")
+        monkeypatch.setenv("DSDDMM_DIST_PROC_ID", "0")
+        pod_keys = run_bound(tmp_path / "pod")
+        assert pod_keys and all(k.endswith(":d2.p0") for k in pod_keys)
+
+        monkeypatch.delenv("DSDDMM_DIST_NPROCS")
+        monkeypatch.delenv("DSDDMM_DIST_PROC_ID")
+        solo_keys = run_bound(tmp_path / "solo")
+        assert solo_keys and all(
+            keys_mod.parse_plan_key(k) is not None
+            and "num_processes" not in keys_mod.parse_plan_key(k)
+            for k in solo_keys
+        )
+        assert not set(pod_keys) & set(solo_keys)
+
+
+class TestPodGrid:
+    def test_pod_grid_matches_grid_on_one_host(self):
+        from distributed_sddmm_tpu.parallel.mesh import (
+            make_grid, make_pod_grid, pod_device_order, process_spans,
+        )
+
+        g = make_pod_grid(4, 2, 1, adjacency=1)
+        ref = make_grid(4, 2, 1, adjacency=1,
+                        devices=pod_device_order())
+        assert [d.id for d in g.mesh.devices.flat] == [
+            d.id for d in ref.mesh.devices.flat
+        ]
+        # One host: no axis crosses a process boundary.
+        assert process_spans(g) == {
+            "rows": False, "cols": False, "layers": False,
+        }
+
+    def test_pod_device_order_is_host_major(self):
+        from distributed_sddmm_tpu.parallel.mesh import pod_device_order
+
+        devs = pod_device_order()
+        keys = [(d.process_index, d.id) for d in devs]
+        assert keys == sorted(keys)
+
+
+class TestPutSharded:
+    def test_single_process_bit_identical_to_device_put(self):
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+        from distributed_sddmm_tpu.parallel.sharding import put_sharded
+
+        mesh = Mesh(np.asarray(jax.devices()), ("x",))
+        sharding = NamedSharding(mesh, P("x", None))
+        host = np.arange(8 * 5, dtype=np.float32).reshape(8, 5)
+        a = put_sharded(host, sharding)
+        b = jax.device_put(host, sharding)
+        assert a.sharding == b.sharding
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+class TestRecordAndRunstoreAxis:
+    def test_bench_record_carries_pod_identity(self):
+        from distributed_sddmm_tpu.bench.harness import benchmark_algorithm
+        from distributed_sddmm_tpu.utils.coo import HostCOO
+
+        S = HostCOO.erdos_renyi(32, 32, 2, seed=0)
+        rec = benchmark_algorithm(
+            S, "15d_fusion2", None, fused=True, R=8, c=1, trials=1,
+            warmup=0,
+        )
+        assert rec["num_processes"] == 1
+        assert rec["process_index"] == 0
+        assert "coordinator" not in rec
+
+    def test_multi_host_records_never_pool_into_single(self, tmp_path):
+        from distributed_sddmm_tpu.obs.store import RunStore, build_run_doc
+
+        store = RunStore(tmp_path)
+
+        def doc(run_id, num_processes):
+            rec = {
+                "run_id": run_id, "algorithm": "15d_fusion2",
+                "app": "vanilla", "R": 8, "c": 1, "fused": True,
+                "kernel": "xla", "elapsed": 1.0,
+                "alg_info": {"m": 32, "n": 32, "nnz": 64, "p": 8},
+            }
+            if num_processes is not None:
+                rec["num_processes"] = num_processes
+                rec["process_index"] = 0
+            return build_run_doc(rec)
+
+        for i in range(3):
+            store.put(doc(f"solo-{i}", 1))
+        store.put(doc("legacy", None))   # pre-PR-14 record: no field
+        store.put(doc("pod", 2))
+
+        pod_doc = store.get("pod")
+        matches = {d["run_id"] for d in store.matching(pod_doc)}
+        assert matches == set()  # a pod run has no single-process peers
+
+        solo_doc = store.get("solo-2")
+        matches = {d["run_id"] for d in store.matching(solo_doc)}
+        # None normalizes to 1: legacy docs stay comparable to
+        # single-process runs, and the pod run stays out.
+        assert matches == {"solo-0", "solo-1", "legacy"}
+        row = next(r for r in store.index() if r["run_id"] == "pod")
+        assert row["num_processes"] == 2 and row["process_index"] == 0
+
+
+class TestManifestPodFields:
+    def test_manifest_records_pod_identity(self, monkeypatch):
+        from distributed_sddmm_tpu.obs import manifest
+
+        monkeypatch.setenv("DSDDMM_DIST_NPROCS", "2")
+        monkeypatch.setenv("DSDDMM_DIST_PROC_ID", "1")
+        monkeypatch.setenv("DSDDMM_DIST_COORDINATOR", "coord:77")
+        m = manifest.build("run-x")
+        assert m["num_processes"] == 2
+        assert m["process_index"] == 1
+        assert m["coordinator"] == "coord:77"
+        assert m["env"]["DSDDMM_DIST_COORDINATOR"] == "coord:77"
+
+
+class TestRunPodDelegation:
+    def test_dry_run_through_package_main(self, capsys):
+        from distributed_sddmm_tpu.dist.run import main
+
+        assert main(["--dry-run", "er", "12", "4", "15d_fusion2",
+                     "8", "1"]) == 0
+        out = capsys.readouterr().out
+        assert "dry-run ok" in out
+
+    def test_bad_combo_errors(self, capsys):
+        from distributed_sddmm_tpu.dist.run import main
+
+        with pytest.raises(SystemExit):
+            main(["--dry-run", "--num-processes", "2", "er", "12", "4",
+                  "15d_fusion2", "8", "1"])
+
+    def test_admin_port_injection(self, monkeypatch):
+        from distributed_sddmm_tpu.dist.run import _inject_admin_port
+
+        monkeypatch.setenv("DSDDMM_POD_ADMIN_BASE", "9100")
+        assert _inject_admin_port(["serve", "--app", "als"], 2) == [
+            "serve", "--app", "als", "--admin-port", "9102",
+        ]
+        # Explicit flag wins; non-serve commands untouched.
+        assert _inject_admin_port(
+            ["serve", "--admin-port", "7"], 2
+        ) == ["serve", "--admin-port", "7"]
+        assert _inject_admin_port(["er", "12"], 2) == ["er", "12"]
+        monkeypatch.delenv("DSDDMM_POD_ADMIN_BASE")
+        assert _inject_admin_port(["serve"], 1) == ["serve"]

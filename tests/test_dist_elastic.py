@@ -1,0 +1,204 @@
+"""Elastic membership drill: kill a worker, recover at reduced p.
+
+The acceptance-criteria drill: two workers run a partitioned,
+per-step-checkpointed computation (``tests/_mp_worker.py --elastic``
+over the ``dist/ingest`` partitioned generator); a ``kill`` fault fells
+worker 1 at the ``mp_worker:post_compute`` site mid-run (after a step's
+compute, before its checkpoint — the worst-ordered loss); the
+:class:`~distributed_sddmm_tpu.dist.elastic.ElasticSupervisor` detects
+the death and relaunches at reduced p=1, where the surviving generation
+resumes BOTH data shards from the checkpoint store's scan-back ladder
+and completes. Asserts: the final state is bit-identical to an
+uninterrupted run, the recovery demonstrably rode the scan-back branch
+(the pointer is corrupted between generations via the supervisor's
+``on_loss`` hook), and the merged trace shows both workers' spans.
+"""
+
+import json
+import pathlib
+import sys
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from distributed_sddmm_tpu.dist import ingest
+from distributed_sddmm_tpu.dist.elastic import ElasticSupervisor
+from distributed_sddmm_tpu.obs import tracemerge
+from distributed_sddmm_tpu.resilience.faults import KILL_EXIT_CODE
+
+ROOT = pathlib.Path(__file__).resolve().parents[1]
+WORKER = ROOT / "tests" / "_mp_worker.py"
+
+NSHARDS, STEPS = 2, 6
+KILL_STEP = 3
+
+
+def _expected_fingerprints() -> dict:
+    """The uninterrupted result, computed in-process with the worker's
+    exact step math (same jit, same partitioned ingest) — bit identity
+    is the claim, so the reference must share every float op."""
+    step_fn = jax.jit(lambda x, r: 0.5 * x + r)
+    out = {}
+    for s in range(NSHARDS):
+        shard = ingest.erdos_renyi_partitioned(
+            96, 80, 4, NSHARDS, s, seed=5, values="normal", chunk_edges=64,
+        )
+        drive = np.zeros(max(shard.row1 - shard.row0, 1))
+        if shard.nnz:
+            np.add.at(drive, shard.coo.rows - shard.row0, shard.coo.vals)
+        x = jnp.zeros_like(jnp.asarray(drive))
+        r = jnp.asarray(drive)
+        for _ in range(STEPS):
+            x = step_fn(x, r)
+        out[str(s)] = float(np.sum(np.asarray(x, np.float64) ** 2))
+    return out
+
+
+def test_two_worker_kill_and_recover_drill(tmp_path):
+    ckpt = tmp_path / "ckpt"
+    traces = tmp_path / "traces"
+    traces.mkdir()
+
+    def worker_argv(generation, live_p, worker, port):
+        return [
+            str(WORKER), str(worker), str(port), "--elastic",
+            "--nprocs", str(live_p), "--nshards", str(NSHARDS),
+            "--steps", str(STEPS), "--checkpoint-dir", str(ckpt),
+            "--generation", str(generation),
+        ]
+
+    def worker_env(generation, live_p, worker):
+        env = {"DSDDMM_TRACE": str(traces)}
+        if generation == 0 and worker == 1:
+            # Deterministic kill: after step KILL_STEP's compute,
+            # before its checkpoint lands (the post_compute site fires
+            # once per step).
+            env["DSDDMM_FAULTS"] = json.dumps([{
+                "site": "mp_worker:post_compute", "kind": "kill",
+                "at": [KILL_STEP],
+            }])
+        return env
+
+    def corrupt_pointer(result):
+        # Force the recovery through the scan-back branch, not just the
+        # latest.json pointer: the dead worker's shard store loses its
+        # pointer integrity (a torn write at death is exactly this).
+        latest = ckpt / "shard1" / "latest.json"
+        assert latest.exists()
+        latest.write_text("{torn")
+
+    sup = ElasticSupervisor(
+        worker_argv, NSHARDS, worker_env=worker_env,
+        max_recoveries=1, generation_timeout_s=240, grace_s=90,
+        on_loss=corrupt_pointer, cwd=str(ROOT),
+    )
+    result = sup.run()
+
+    # Generation 0 lost exactly worker 1, to the injected kill.
+    gen0 = result.generations[0]
+    assert gen0.lost == [1]
+    assert gen0.returncodes[1] == KILL_EXIT_CODE
+    # Worker 0 finished its own shard clean.
+    assert gen0.returncodes[0] == 0 and gen0.records[0]["shards"]
+
+    # Recovery generation ran at reduced p and completed.
+    assert result.recovered and result.ok
+    gen1 = result.generations[1]
+    assert gen1.live_p == 1 and gen1.ok
+
+    # The p=1 survivor owns BOTH shards; its result is bit-identical to
+    # an uninterrupted run (checkpoint floats round-trip exactly and the
+    # step programs are deterministic).
+    final = gen1.records[0]["shards"]
+    assert set(final) == {"0", "1"}
+    expected = _expected_fingerprints()
+    assert final == expected  # bit-exact, not allclose
+
+    # The drill's recovery demonstrably rode the scan-back ladder: the
+    # shard-1 pointer was corrupted, so its checkpoint_load event must
+    # carry source="scan_back" (shard 0's intact pointer loads direct).
+    shard_files = sorted(traces.glob("*.jsonl"))
+    assert len(shard_files) == 3  # gen0 x2 workers + gen1 x1
+    merged = tracemerge.merge(shard_files)
+    events = merged["events"]
+    loads = [e for e in events if e["name"] == "checkpoint_load"]
+    assert any(e["attrs"]["source"] == "scan_back" for e in loads), loads
+    # Scan-back landed on the last checkpoint the dead worker wrote.
+    assert any(
+        e["attrs"]["step"] == KILL_STEP - 1
+        and e["attrs"]["source"] == "scan_back"
+        for e in loads
+    ), loads
+
+    # Merged pod timeline shows BOTH workers' spans (generation 0) and
+    # the recovery generation's.
+    spans = [s for s in merged["spans"] if s["name"] == "elastic:step"]
+    by_gen_proc = {
+        (s["attrs"]["generation"], s["attrs"]["process"]) for s in spans
+    }
+    assert (0, 0) in by_gen_proc and (0, 1) in by_gen_proc
+    assert (1, 0) in by_gen_proc
+    # Worker 1's generation-0 spans stop at the kill step.
+    g0w1_steps = {
+        s["attrs"]["step"] for s in spans
+        if s["attrs"]["generation"] == 0 and s["attrs"]["process"] == 1
+    }
+    assert max(g0w1_steps) == KILL_STEP
+    # The recovery recomputed the lost step (and only from there) for
+    # shard 1, and nothing for the completed shard 0.
+    g1_steps = {
+        (s["attrs"]["shard"], s["attrs"]["step"]) for s in spans
+        if s["attrs"]["generation"] == 1
+    }
+    assert g1_steps == {(1, t) for t in range(KILL_STEP, STEPS)}
+
+
+def test_supervisor_clean_run_single_generation(tmp_path):
+    """No faults: one generation, no recovery, records parse."""
+    ckpt = tmp_path / "ckpt"
+
+    def worker_argv(generation, live_p, worker, port):
+        return [
+            str(WORKER), str(worker), str(port), "--elastic",
+            "--nprocs", str(live_p), "--nshards", "2", "--steps", "2",
+            "--checkpoint-dir", str(ckpt),
+            "--generation", str(generation),
+        ]
+
+    sup = ElasticSupervisor(
+        worker_argv, 2, max_recoveries=1, generation_timeout_s=180,
+        grace_s=60, cwd=str(ROOT),
+    )
+    result = sup.run()
+    assert result.ok and not result.recovered
+    assert len(result.generations) == 1
+    assert [r["pid"] for r in result.records] == [0, 1]
+
+
+def test_watch_reaps_a_hung_survivor():
+    """A worker blocked past the grace window after a peer's death is
+    killed and counted lost — recovery must not wait out the full
+    generation timeout."""
+    sup = ElasticSupervisor(
+        lambda g, p, w, port: [
+            "-c",
+            "import sys, time; "
+            "sys.exit(7) if int(sys.argv[1]) == 1 else time.sleep(60)",
+            str(w),
+        ],
+        2, max_recoveries=0, generation_timeout_s=120, grace_s=2,
+    )
+    import time
+
+    t0 = time.monotonic()
+    result = sup.run()
+    assert time.monotonic() - t0 < 60
+    gen0 = result.generations[0]
+    # The self-dead worker is LOST; the blocked survivor is REAPED —
+    # only the former shrinks the next generation's p (its host died;
+    # the reaped one's host is healthy).
+    assert gen0.lost == [1]
+    assert gen0.reaped == [0]
+    assert not result.ok

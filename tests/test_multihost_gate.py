@@ -1,0 +1,224 @@
+"""Structural HLO gate for the multi-host program path (tier-1
+acceptance, ``test_codegen_gate.py`` style) + the pod warm-start
+contract.
+
+**The gate**: the fused SDDMM→SpMM pair, AOT-compiled for a REAL 2-host
+v5e topology (``jax.experimental.topologies``, no chips needed), must
+contain collectives whose replica groups SPAN THE HOST BOUNDARY — the
+structural proof the compiled program is one global multi-host program,
+not p copies of a local one. With ``c=2`` the layout math says the
+replication axis (all-gather + reduce-scatter) crosses hosts while the
+rows ring stays intra-host; the gate asserts the boundary landed
+exactly there. The committed ``MULTIHOST_HLO.json`` is this probe's
+banked record.
+
+**The warm start**: a pod worker's programs key through the ProgramStore
+under the ``dN.pK`` dist segment, and a worker process restarting on
+the same slot must warm from the shared disk store with ZERO live
+compiles — while an unlabeled (single-controller) process of the same
+problem must MISS those entries (per-slot executables must never
+alias). Exercised with two real OS processes against one store.
+
+Subprocess + ``TPU_SKIP_MDS_QUERY=1`` for the same libtpu metadata
+reason as the other gates.
+"""
+
+import json
+import os
+import pathlib
+import subprocess
+import sys
+
+from distributed_sddmm_tpu.dist.hlo import scan_cross_host
+
+REPO = pathlib.Path(__file__).resolve().parents[1]
+
+_PROBE = """
+import json, sys
+sys.path.insert(0, {repo!r})
+from distributed_sddmm_tpu.utils.platform import force_cpu_platform
+force_cpu_platform(n_devices=8, replace=True)
+from distributed_sddmm_tpu.dist.hlo import multihost_hlo_report
+print("RESULT " + json.dumps(multihost_hlo_report()))
+"""
+
+
+def test_multihost_fused_pair_v5e_hlo_gate():
+    env = dict(os.environ)
+    env.update({
+        "TPU_SKIP_MDS_QUERY": "1",
+        "DSDDMM_PROGRAMS": "0",
+        "DSDDMM_RUNSTORE": "0",
+        "PYTHONPATH": str(REPO),
+    })
+    proc = subprocess.run(
+        [sys.executable, "-c", _PROBE.format(repo=str(REPO))],
+        capture_output=True, text=True, timeout=540, env=env,
+    )
+    assert proc.returncode == 0, proc.stdout[-2000:] + proc.stderr[-2000:]
+    line = [l for l in proc.stdout.splitlines() if l.startswith("RESULT ")]
+    assert line, proc.stdout[-2000:]
+    rec = json.loads(line[0][len("RESULT "):])
+    assert rec["topology"] == "v5e:2x4" and rec["n_hosts"] == 2
+    assert rec["is_scheduled"] is True
+    # The acceptance bar: >= 1 collective whose replica groups span
+    # both hosts, with no collective the scanner could not read.
+    assert rec["cross_host_collectives"] >= 1, rec
+    assert rec["unparsed_group_lines"] == 0, rec
+    # The boundary landed where the 1.5D layout math puts it at c=2:
+    # replication (all-gather + reduce-scatter) crosses hosts, the
+    # rows ring stays on intra-host ICI.
+    assert rec["axis_spans_hosts"] == {
+        "rows": False, "cols": True, "layers": False,
+    }
+    assert rec["collectives"]["all-gather"]["cross_host"] >= 1
+    assert rec["collectives"]["reduce-scatter"]["cross_host"] >= 1
+    assert rec["collectives"]["collective-permute"]["cross_host"] == 0
+    # Matches the committed banked record on every structural field.
+    committed = json.loads((REPO / "MULTIHOST_HLO.json").read_text())
+    for field in ("topology", "p", "c", "n_hosts", "device_processes",
+                  "axis_spans_hosts", "cross_host_collectives",
+                  "collectives"):
+        assert rec[field] == committed[field], (field, rec, committed)
+
+
+# --------------------------------------------------------------------- #
+# The scanner's own contract on synthetic HLO
+# --------------------------------------------------------------------- #
+
+_HLO_CROSS = """\
+HloModule jit_prog, is_scheduled=true
+
+%body (arg: f32[8]) -> f32[8] {
+  %ag = f32[8] all-gather(f32[4] %x), replica_groups={{0,1},{2,3}}, channel_id=1
+  %cp = f32[8] collective-permute(f32[8] %y), source_target_pairs={{0,2},{2,0},{1,3},{3,1}}
+  ROOT %r = f32[8] add(%ag, %cp)
+}
+"""
+
+_HLO_IOTA = """\
+HloModule jit_prog, is_scheduled=true
+
+%body (arg: f32[8]) -> f32[8] {
+  ROOT %ag = f32[8] all-gather(f32[4] %x), replica_groups=[2,2]<=[4], channel_id=1
+}
+"""
+
+
+def test_scanner_classifies_cross_host_groups():
+    # Hosts: partitions 0,1 on host 0; partitions 2,3 on host 1.
+    procs = [0, 0, 1, 1]
+    scan = scan_cross_host(_HLO_CROSS, procs)
+    assert scan["per_op"]["all-gather"] == {
+        "count": 1, "cross_host": 0, "groups": [[0, 1], [2, 3]],
+    }
+    # Every permute pair hops between hosts.
+    assert scan["per_op"]["collective-permute"]["cross_host"] == 1
+    assert scan["cross_host_collectives"] == 1
+    # Flip the host map: the all-gather pairs now straddle.
+    scan = scan_cross_host(_HLO_CROSS, [0, 1, 0, 1])
+    assert scan["per_op"]["all-gather"]["cross_host"] == 1
+    assert scan["per_op"]["collective-permute"]["cross_host"] == 0
+    assert scan["cross_host_collectives"] == 1
+
+
+def test_scanner_treats_empty_groups_as_all_participants():
+    # replica_groups={} is HLO's implicit one-group-of-ALL form (a
+    # global all-reduce): on a 2-host map it spans hosts.
+    hlo = (
+        "HloModule jit_prog, is_scheduled=true\n"
+        "  %ar = f32[8] all-reduce(f32[8] %x), replica_groups={}, "
+        "channel_id=1\n"
+    )
+    scan = scan_cross_host(hlo, [0, 0, 1, 1])
+    assert scan["per_op"]["all-reduce"]["cross_host"] == 1
+    assert scan["cross_host_collectives"] == 1
+    # Single-host map: same form, no boundary to cross.
+    assert scan_cross_host(hlo, [0, 0])["cross_host_collectives"] == 0
+
+
+def test_scanner_reports_unparsed_iota_groups():
+    scan = scan_cross_host(_HLO_IOTA, [0, 0, 1, 1])
+    assert scan["unparsed_group_lines"] == 1
+    assert scan["cross_host_collectives"] == 0
+
+
+def test_scanner_empty_hlo():
+    scan = scan_cross_host("", [0, 1])
+    assert scan["cross_host_collectives"] == 0
+    assert scan["per_op"] == {}
+
+
+# --------------------------------------------------------------------- #
+# Pod warm start: dist-keyed ProgramStore round trip, two OS processes
+# --------------------------------------------------------------------- #
+
+_WARM_WORKER = """
+import json, sys
+sys.path.insert(0, {repo!r})
+from distributed_sddmm_tpu.utils.platform import force_cpu_platform
+force_cpu_platform(n_devices=8, replace=True)
+import numpy as np
+from distributed_sddmm_tpu import programs
+from distributed_sddmm_tpu.common import MatMode
+from distributed_sddmm_tpu.parallel.dense_shift_15d import DenseShift15D
+from distributed_sddmm_tpu.utils.coo import HostCOO
+
+store = programs.ProgramStore({store!r})
+S = HostCOO.erdos_renyi(48, 40, 4, seed=2, values="normal")
+alg = DenseShift15D(S, R=8, c=2, fusion_approach=2)
+assert programs.bind_strategy(alg, "podfp", store=store)
+A = alg.dummy_initialize(MatMode.A)
+B = alg.dummy_initialize(MatMode.B)
+out, _mid = alg.fused_spmm(A, B, alg.like_s_values(1.0))
+fp = float(np.sum(np.asarray(out, np.float64) ** 2))
+print("RESULT " + json.dumps(
+    dict(stats=store.stats(), fp=fp,
+         keys=[r["key"] for r in store.index()])))
+"""
+
+
+def _run_warm_worker(store, nprocs=None, proc_id=None):
+    env = dict(os.environ)
+    env.update({"DSDDMM_PROGRAMS": "0", "DSDDMM_RUNSTORE": "0",
+                "PYTHONPATH": str(REPO)})
+    for k in ("DSDDMM_DIST_NPROCS", "DSDDMM_DIST_PROC_ID"):
+        env.pop(k, None)
+    if nprocs is not None:
+        env["DSDDMM_DIST_NPROCS"] = str(nprocs)
+        env["DSDDMM_DIST_PROC_ID"] = str(proc_id)
+    proc = subprocess.run(
+        [sys.executable, "-c",
+         _WARM_WORKER.format(repo=str(REPO), store=str(store))],
+        capture_output=True, text=True, timeout=300, env=env,
+    )
+    assert proc.returncode == 0, proc.stdout[-1500:] + proc.stderr[-1500:]
+    line = [l for l in proc.stdout.splitlines() if l.startswith("RESULT ")]
+    return json.loads(line[0][len("RESULT "):])
+
+
+def test_pod_worker_warm_start_zero_live_compiles(tmp_path):
+    store = tmp_path / "programs"
+    cold = _run_warm_worker(store, nprocs=2, proc_id=0)
+    assert cold["stats"]["live_compiles"] > 0
+    assert cold["stats"]["hits"] == 0
+    assert cold["keys"] and all(k.endswith(":d2.p0") for k in cold["keys"])
+
+    # The same pod slot restarting: warm start, ZERO live compiles,
+    # bit-identical output.
+    warm = _run_warm_worker(store, nprocs=2, proc_id=0)
+    assert warm["stats"]["live_compiles"] == 0, warm
+    assert warm["stats"]["hits"] >= 1
+    assert warm["fp"] == cold["fp"]
+
+    # An unlabeled single-controller process must MISS the pod-keyed
+    # entries (compiles live under its own 6-segment keys) — per-slot
+    # executables never alias across pod shapes.
+    solo = _run_warm_worker(store)
+    assert solo["stats"]["live_compiles"] > 0
+    assert set(solo["keys"]) > set(cold["keys"])  # both generations present
+    assert all(
+        not k.endswith(":d2.p0")
+        for k in set(solo["keys"]) - set(cold["keys"])
+    )
+    assert solo["fp"] == cold["fp"]

@@ -16,7 +16,6 @@ zero for "fires at call 0" to mean the first dispatch.
 import os
 import pathlib
 import signal
-import socket
 import subprocess
 import sys
 import time
@@ -63,8 +62,24 @@ def _fused_fingerprint(alg):
     return alg.fingerprint(out), alg.fingerprint(mid)
 
 
-@pytest.mark.parametrize("fname,spec", TRANSIENT_FAULTS, ids=[f[0] for f in TRANSIENT_FAULTS])
-@pytest.mark.parametrize("sname,mk", STRATEGIES, ids=[s[0] for s in STRATEGIES])
+# The retry/guard ladder lives ONCE at parallel/base._resilient_call;
+# the full 5x4 (strategy x kind) product is defensive overlap. Kept
+# strict: every kind on 15d_fusion2 (the headline strategy), plus one
+# execute-site kind (timeout) and one output-site kind (nan) on every
+# other strategy — the two hook families each strategy's output pytree
+# actually shapes. The remaining cells are slow-marked (PR 14 budget
+# satellite), not deleted.
+_HEAL_MATRIX = [
+    pytest.param(
+        sname, mk, fname, spec, id=f"{sname}-{fname}",
+        marks=() if (sname == "15d_fusion2" or fname in ("nan", "timeout"))
+        else (pytest.mark.slow,),
+    )
+    for sname, mk in STRATEGIES for fname, spec in TRANSIENT_FAULTS
+]
+
+
+@pytest.mark.parametrize("sname,mk,fname,spec", _HEAL_MATRIX)
 def test_transient_fault_heals_to_identical_result(sname, mk, fname, spec):
     """One injected fault on the first dispatch; the retry path must
     produce a result identical to a clean run — healed, not approximated."""
@@ -154,10 +169,8 @@ def test_env_activation_reaches_hooks(monkeypatch):
         faults.install(None)
 
 
-def _free_port() -> int:
-    with socket.socket() as s:
-        s.bind(("127.0.0.1", 0))
-        return s.getsockname()[1]
+# One shared bind-port-0 helper for the whole pod surface (PR 14).
+from distributed_sddmm_tpu.dist.elastic import free_port as _free_port
 
 
 def test_worker_kill_detected_without_hang():
