@@ -1,6 +1,6 @@
 """The discipline checkers.
 
-Six disciplines, eight checker ids (the three lints migrated from
+Seven disciplines, nine checker ids (the three lints migrated from
 ``tests/test_obs_lint.py`` count as one group there):
 
 ====================  ================================================
@@ -27,6 +27,10 @@ id                    invariant
                       built ONLY by ``programs/keys.py`` builders
 ``trace-purity``      no wall-clock / ``random`` / GLOBAL-counter
                       mutation inside jit- or Pallas-traced bodies
+``raw-collective``    ``lax.all_gather`` / ``lax.ppermute`` /
+                      ``lax.psum_scatter`` only through the
+                      policy-aware ``parallel/loops.py`` wrappers
+                      (or tagged ``# raw-collective-ok``)
 ====================  ================================================
 
 Every checker is a pure AST pass (regex only inside comments); the
@@ -633,7 +637,50 @@ class KeyGrammarChecker(Checker):
 
 
 # --------------------------------------------------------------------- #
-# 8. trace-purity
+# 8. raw-collective
+# --------------------------------------------------------------------- #
+
+
+@register
+class RawCollectiveChecker(Checker):
+    id = "raw-collective"
+    description = ("raw lax collective outside the parallel/loops.py "
+                   "policy-aware wrappers (abl_all_gather / abl_ppermute "
+                   "/ abl_psum_scatter)")
+    suppress_tags = ("raw-collective-ok",)
+
+    #: The wrappers themselves — the ONE place the raw collectives (and
+    #: the wire-precision boundary casts around them) may live.
+    ALLOWLIST = {"parallel/loops.py"}
+    #: The three collectives the wrappers own. ``pmax``/``psum`` stay
+    #: out: they carry scalar/row-stat payloads the wire policy keeps
+    #: exact by contract, so raw use is not a policy bypass.
+    COLLECTIVES = {
+        "lax.all_gather", "lax.ppermute", "lax.psum_scatter",
+        "jax.lax.all_gather", "jax.lax.ppermute", "jax.lax.psum_scatter",
+    }
+
+    def select(self, src):
+        return in_pkg(src) and pkg_rel(src) not in self.ALLOWLIST
+
+    def check(self, src, ctx):
+        for node in ast.walk(src.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = call_name(node)
+            if name not in self.COLLECTIVES:
+                continue
+            yield self.finding(
+                src, node,
+                f"raw {name}( outside parallel/loops.py — route through "
+                "the abl_* wrappers so the collective honors the "
+                "ablation mode AND the wire-precision policy (or tag a "
+                "deliberate off-policy collective '# raw-collective-ok')",
+            )
+
+
+# --------------------------------------------------------------------- #
+# 9. trace-purity
 # --------------------------------------------------------------------- #
 
 

@@ -54,6 +54,9 @@ TAG_VOCABULARY = {
                       "programs/keys.py (key-grammar)",
     "trace-impure-ok": "deliberate impurity in a traced body "
                        "(trace-purity)",
+    "raw-collective-ok": "deliberate raw lax collective outside the "
+                         "parallel/loops.py policy-aware wrappers "
+                         "(raw-collective)",
 }
 
 _TAG_RES = {

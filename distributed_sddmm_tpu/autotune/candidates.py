@@ -69,6 +69,12 @@ class Candidate:
     block: tuple | None = None       # Pallas (block_rows, block_cols)
     gather_budget: int | None = None  # set => chunked XLA kernel forced
     variant: str | None = None       # codegen kernel-variant id (pallas)
+    #: Wire-precision comm dtype (``parallel/wire.py``): None/f32 = the
+    #: identity wire, "bf16" = bf16 gather/ring payloads with f32
+    #: accumulation. A plan axis exactly like ``variant``: it changes
+    #: the traced program (and its store key) without changing any
+    #: argument shape.
+    wire: str | None = None
 
     @property
     def chunked(self) -> bool:
@@ -223,6 +229,16 @@ def enumerate_candidates(
                         cand = hbm_guard(problem, cand, p, budget_bytes)
                         if cand is not None:
                             out.append(cand)
+    # Wire-precision axis: every survivor also enumerates as a
+    # bf16-wire twin — but only for float32 problems (the boundary
+    # casts only touch f32 payloads; on a reduced-precision model the
+    # wire is already narrow, so a bf16-wire candidate would claim a
+    # discount it cannot realize). The twin's modeled cost earns
+    # exactly the per-algorithm byte discount ``costmodel.pair_bytes``
+    # can realize (sparse-shift's int32 index traffic and the
+    # accumulator legs stay full-width).
+    if problem.dtype == "float32":
+        out.extend(dataclasses.replace(cand, wire="bf16") for cand in list(out))
     return out
 
 
@@ -251,6 +267,7 @@ def model_cost(
     t = costmodel.pair_time(
         ALGORITHM_MODELS[cand.algorithm],
         problem.M, problem.N, problem.R, problem.nnz, p, cand.c, m,
+        wire=cand.wire,
     )
     if cand.chunked:
         t *= 1.1

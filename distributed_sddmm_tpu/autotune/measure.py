@@ -108,6 +108,7 @@ def default_trial(
             trials=trials,
             warmup=warmup,
             kernel=_build_kernel(cand),
+            wire=cand.wire,
         )
 
 

@@ -52,6 +52,10 @@ class Plan:
     #: None, and a plan carrying an unknown variant generation falls
     #: back to the generic kernel at build time.
     variant: str | None = None
+    #: Wire-precision comm dtype (``parallel/wire.py``); None = the f32
+    #: identity wire. Optional field — pre-PR-15 cached plans load with
+    #: None and build byte-identical strategies.
+    wire: str | None = None
     source: str = "model"            # model | measured | seed
     predicted_ms: float | None = None
     measured_gflops: float | None = None
@@ -72,6 +76,7 @@ class Plan:
             block=tuple(block) if block else None,
             gather_budget=d.get("gather_budget"),
             variant=d.get("variant"),
+            wire=d.get("wire"),
             source=d.get("source", "model"),
             predicted_ms=d.get("predicted_ms"),
             measured_gflops=d.get("measured_gflops"),
@@ -82,7 +87,7 @@ class Plan:
         return Candidate(
             algorithm=self.algorithm, c=self.c, kernel=self.kernel,
             block=self.block, gather_budget=self.gather_budget,
-            variant=self.variant,
+            variant=self.variant, wire=self.wire,
         )
 
     def make_kernel(self):
@@ -106,7 +111,8 @@ class Plan:
         with measure_mod.block_knobs(self.candidate()):
             alg = make_algorithm(
                 self.algorithm, S, R=R, c=self.c,
-                kernel=self.make_kernel(), devices=devices, **kw
+                kernel=self.make_kernel(), devices=devices,
+                wire=self.wire, **kw
             )
         if self.fingerprint_key:
             from distributed_sddmm_tpu import programs
@@ -236,6 +242,7 @@ def get_plan(
             kernel=best_cand.kernel, block=best_cand.block,
             gather_budget=best_cand.gather_budget,
             variant=best_cand.variant,
+            wire=best_cand.wire,
             source="measured",
             predicted_ms=_predicted_ms(problem, best_cand, p, machine),
             measured_gflops=rec.get("overall_throughput"),
@@ -248,6 +255,7 @@ def get_plan(
             kernel=best_cand.kernel, block=best_cand.block,
             gather_budget=best_cand.gather_budget,
             variant=best_cand.variant,
+            wire=best_cand.wire,
             source="seed" if seed is not None and best_cand == seed else "model",
             predicted_ms=cost * 1e3,
             fingerprint_key=fp.key,

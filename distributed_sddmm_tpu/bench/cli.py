@@ -139,6 +139,7 @@ def _run_configs(S, alg_names, args, r_values=None):
                     f"plan[{plan.source}] {run_alg} c={run_c} "
                     f"kernel={plan.kernel}"
                     + (f" variant={plan.variant}" if plan.variant else "")
+                    + (f" wire={plan.wire}" if plan.wire else "")
                     + (" (chunked)" if plan.gather_budget else ""),
                     file=sys.stderr,
                 )
@@ -182,6 +183,11 @@ def _run_configs(S, alg_names, args, r_values=None):
                                 getattr(args, "mask", None)
                                 if args.app == "attention" else None
                             ),
+                            # Plan-routed runs realize the plan's own
+                            # comm_dtype axis; explicit algorithms take
+                            # the CLI policy.
+                            wire=(plan.wire if plan is not None
+                                  else getattr(args, "wire", None)),
                         )
                 except ValueError as e:
                     # Divisibility constraints differ per algorithm
@@ -231,6 +237,15 @@ def _add_common(p: argparse.ArgumentParser) -> None:
         help="with an 'auto' algorithm: 'model' selects by cost model / "
         "cache only (fast, no trial runs); 'measure' times the top "
         "candidates first; 'auto' measures when possible",
+    )
+    p.add_argument(
+        "--wire", default=None, choices=["f32", "bf16"],
+        help="wire-precision policy for the distributed collectives "
+        "(parallel/wire.py): 'bf16' halves gather/ring payload bytes "
+        "with f32 accumulation everywhere; 'f32' (and the default, "
+        "absent DSDDMM_WIRE) is the bit-identical identity wire. With "
+        "--algorithm auto the plan's comm_dtype axis supersedes this; "
+        "gated structurally by WIRE_HLO.json",
     )
     p.add_argument("--fused", default="yes", choices=["yes", "no", "both"])
     p.add_argument(

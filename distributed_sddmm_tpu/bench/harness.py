@@ -82,12 +82,17 @@ def make_algorithm(
     devices=None,
     overlap: bool = False,
     attention: bool = False,
+    wire=None,
     **kw,
 ) -> DistributedSparse:
     """Instantiate one of the five named algorithm configurations.
     ``overlap=True`` selects the double-buffered local-kernel-overlap
     ring programs (shift strategies only); ``attention=True`` asserts
-    the strategy can run the fused block-sparse attention pair."""
+    the strategy can run the fused block-sparse attention pair;
+    ``wire`` selects the wire-precision policy (``parallel/wire.py``;
+    None = env default, i.e. the f32 identity wire)."""
+    if wire is not None:
+        kw["wire"] = wire
     if name not in ALGORITHM_FACTORIES:
         raise ValueError(
             f"unknown algorithm {name!r}; available: {sorted(ALGORITHM_FACTORIES)}"
@@ -293,6 +298,7 @@ def benchmark_algorithm(
     resume: bool = False,
     overlap: bool = False,
     mask: Optional[str] = None,
+    wire=None,
 ) -> dict:
     """Run one benchmark configuration; append a JSON record to
     ``output_file`` (if given) and return it.
@@ -348,7 +354,7 @@ def benchmark_algorithm(
 
     alg = make_algorithm(algorithm_name, S, R, c, kernel=kernel,
                          devices=devices, overlap=overlap,
-                         attention=app == "attention")
+                         attention=app == "attention", wire=wire)
     # Bind the strategy (and the app chains built on it) to the active
     # persistent program store under the problem fingerprint — the
     # strategy-config tag in the key keeps sweep cells apart. No active
@@ -425,6 +431,13 @@ def benchmark_algorithm(
         "overall_throughput": throughput,
         "kernel": getattr(alg.kernel, "name", type(alg.kernel).__name__),
         "kernel_variant": realized_kernel_variant(alg),
+        # The REALIZED wire policy (a runstore config axis like
+        # kernel_variant: a bf16-wire run must never pool into an f32
+        # baseline). The label keeps role overrides distinguishable —
+        # bf16 and bf16.reduce=bf16 are different numerics and must
+        # not share a baseline. "f32" for default runs; pre-PR-15 docs
+        # carry None, which the store's axis matcher normalizes to f32.
+        "wire": alg.wire.label,
         # Pod identity: the runstore indexes these and gates on
         # num_processes, so a future multi-host record can never pool
         # into a single-process baseline.

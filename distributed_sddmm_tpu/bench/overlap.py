@@ -57,6 +57,8 @@ def _program(p: int, steps_work: int, serialize: bool):
                 # Data dependency: the permute input depends on the compute
                 # result, so the collective cannot start early.
                 X = X + acc[:1, :1] * 0
+            # raw-collective-ok: standalone overlap microbenchmark ring
+            # (not a strategy payload — wire policy does not apply).
             nxt = lax.ppermute(X, "ring", perm)
             return nxt, acc
 

@@ -206,6 +206,8 @@ _OP_FIELDS = (
     ("retries", f"{PREFIX}_op_retries_total", "retries per op"),
     ("comm_words", f"{PREFIX}_op_comm_words_total",
      "counted per-device communication words per op"),
+    ("comm_bytes", f"{PREFIX}_op_comm_bytes_total",
+     "counted per-device communication bytes per op (wire-dtype aware)"),
     ("flops", f"{PREFIX}_op_flops_total", "analytic useful FLOPs per op"),
 )
 

@@ -31,7 +31,12 @@ analytic predictions):
   Only collectives the model counts contribute (``in_model`` entries of
   the strategy's ``comm_profile``); the SpMM reduce-scatter the notebook
   folds out of its comparison is tracked separately as
-  ``comm_words_extra``.
+  ``comm_words_extra``. Words count ELEMENTS and are wire-dtype
+  independent — ``comm_bytes`` (PR 15) is the dtype-aware volume under
+  the strategy's wire policy (``costmodel.pair_bytes``); at the f32
+  identity wire ``comm_bytes == 4 * comm_words`` exactly, so
+  ``comm_words`` is simply the byte count re-expressed at 4 B/element
+  and pre-PR-15 gate history keeps comparing.
 * ``flops`` are **global useful FLOPs**: ``4 * nnz * R`` per fused
   SDDMM+SpMM pair, ``2 * nnz * R`` per single op — the bench harness's
   throughput convention (`benchmark_dist.cpp:147-149`).
@@ -73,7 +78,7 @@ GLOBAL = Counters()
 
 _FIELDS = (
     "calls", "kernel_s", "overhead_s", "retries",
-    "comm_words", "comm_words_extra", "flops",
+    "comm_words", "comm_bytes", "comm_words_extra", "flops",
 )
 
 
@@ -114,6 +119,7 @@ class OpMetrics:
         overhead_s: float = 0.0,
         retries: int = 0,
         comm_words: float = 0.0,
+        comm_bytes: float = 0.0,
         comm_words_extra: float = 0.0,
         flops: float = 0.0,
         calls: int = 1,
@@ -127,6 +133,7 @@ class OpMetrics:
             rec["overhead_s"] += overhead_s
             rec["retries"] += retries
             rec["comm_words"] += comm_words
+            rec["comm_bytes"] += comm_bytes
             rec["comm_words_extra"] += comm_words_extra
             rec["flops"] += flops
 
@@ -173,6 +180,7 @@ class OpMetrics:
                     "overhead_s": round(rec["overhead_s"], 9),
                     "retries": int(rec["retries"]),
                     "comm_words": rec["comm_words"],
+                    "comm_bytes": rec["comm_bytes"],
                     "comm_words_extra": rec["comm_words_extra"],
                     "flops": rec["flops"],
                     **self._gauges.get(op, {}),

@@ -54,6 +54,7 @@ def _optional_axis(name: str) -> bool:
     return (
         name.startswith("xla:")
         or name.startswith("tuner:")
+        or name.startswith("comm:")
         or name == "serve:burn_rate"
     )
 
@@ -86,6 +87,7 @@ def phase_stats(doc: dict) -> dict[str, dict]:
                 "overhead_s": m.get("overhead_s", 0.0),
                 "retries": m.get("retries", 0),
                 "comm_words": m.get("comm_words", 0.0),
+                "comm_bytes": m.get("comm_bytes"),
                 "flops": m.get("flops", 0.0),
             }
             tp = trace_phases.get(op)
@@ -109,6 +111,7 @@ def phase_stats(doc: dict) -> dict[str, dict]:
             "overhead_s": ph.get("overhead_s", 0.0),
             "retries": int(ph.get("retries", 0)),
             "comm_words": ph.get("comm_words", 0.0),
+            "comm_bytes": ph.get("comm_bytes"),
             "flops": ph.get("flops", 0.0),
         }
         row["t_call"] = row["total_s"] / calls
@@ -127,6 +130,7 @@ def phase_stats(doc: dict) -> dict[str, dict]:
     out.update(_serving_rows(doc))
     out.update(_xla_rows(doc))
     out.update(_tuner_rows(doc))
+    out.update(_comm_bytes_rows(doc))
     return out
 
 
@@ -137,7 +141,8 @@ def _pseudo_row(calls: int, value: float) -> dict:
     return {
         "calls": int(calls), "total_s": value * calls,
         "kernel_s": value * calls, "overhead_s": 0.0, "retries": 0,
-        "comm_words": 0.0, "flops": 0.0, "t_call": value, "gflops": None,
+        "comm_words": 0.0, "comm_bytes": None, "flops": 0.0,
+        "t_call": value, "gflops": None,
     }
 
 
@@ -194,6 +199,23 @@ def _xla_rows(doc: dict) -> dict[str, dict]:
             rows[f"xla:{op}_flops"] = _pseudo_row(
                 calls, (flops / calls) / xla
             )
+    return rows
+
+
+def _comm_bytes_rows(doc: dict) -> dict[str, dict]:
+    """Wire-volume axes (PR 15): one pseudo-phase per op that counted
+    ``comm_bytes``, ``t_call`` = bytes per call. The gate judges the
+    realized wire volume's stability — a bf16-wire run's ~2x drop
+    reads as an improvement, a policy that silently stopped realizing
+    its discount as a regression. OPTIONAL in compare(): pre-PR-15
+    docs lack the field entirely and read as "not-measured", never a
+    failure."""
+    metrics = (doc.get("record") or {}).get("metrics") or {}
+    rows = {}
+    for op, m in metrics.items():
+        calls, nbytes = m.get("calls") or 0, m.get("comm_bytes")
+        if calls and nbytes:
+            rows[f"comm:{op}_bytes"] = _pseudo_row(calls, nbytes / calls)
     return rows
 
 

@@ -171,6 +171,7 @@ def _compare_table(report: dict) -> str:
         "<table><tr><th class=l>phase</th><th>calls</th>"
         "<th>t/call base</th><th>t/call new</th><th>Δ%</th>"
         "<th>GF/s base</th><th>GF/s new</th><th>Mwords/call</th>"
+        "<th>MB/call</th>"
         "<th>words/model</th><th>verdict</th><th>blame</th></tr>"
     ]
     for name, row in report["phases"].items():
@@ -179,11 +180,17 @@ def _compare_table(report: dict) -> str:
         if v in ("missing", "new"):
             cells.append(
                 f'<tr class="{v}"><td class=l>{_esc(name)}</td>'
-                + "<td>-</td>" * 8
+                + "<td>-</td>" * 9
                 + f"<td>{v}</td><td></td></tr>"
             )
             continue
         mwords = b["comm_words"] / b["calls"] / 1e6 if b["calls"] else 0.0
+        # Wire-dtype-aware volume (PR 15); None on pre-PR-15 docs —
+        # rendered as '-' (not measured), never as zero traffic.
+        mbytes = (
+            b["comm_bytes"] / b["calls"] / 1e6
+            if b["calls"] and b.get("comm_bytes") is not None else None
+        )
         cells.append(
             f'<tr class="{v if v != "ok" else ""}">'
             f"<td class=l>{_esc(name)}</td><td>{b['calls']}</td>"
@@ -192,6 +199,7 @@ def _compare_table(report: dict) -> str:
             f"<td>{_fmt(row.get('delta_pct'), 1)}</td>"
             f"<td>{_fmt(a.get('gflops'))}</td><td>{_fmt(b.get('gflops'))}</td>"
             f"<td>{_fmt(mwords)}</td>"
+            f"<td>{_fmt(mbytes)}</td>"
             f"<td>{_fmt(b.get('model_ratio'))}</td>"
             f"<td>{v}</td><td>{_esc(row.get('attribution', ''))}</td></tr>"
         )

@@ -73,6 +73,11 @@ _INDEX_FIELDS = (
     # comparable while future multi-host records never pool into
     # single-process baselines.
     "num_processes", "process_index",
+    # Wire precision (PR 15): the realized collective payload policy
+    # ("f32"/"bf16") and the run's total counted comm bytes (summed
+    # over per-op metrics; None on pre-PR-15 docs and metric-less
+    # records — "not measured", never a verdict).
+    "wire", "comm_bytes",
 )
 
 #: Configuration axes (beyond the fingerprint key) two runs must share
@@ -94,18 +99,25 @@ _INDEX_FIELDS = (
 # would poison the noise bands. Pre-pod docs carry None, which the
 # matcher normalizes to 1 (single-process) so existing history keeps
 # comparing.
+# ``wire`` joined in PR 15: a bf16-wire run moves half the collective
+# bytes of an f32 run of the same problem — pooling either way would
+# poison the bands. Pre-PR-15 docs carry None, which the matcher
+# normalizes to "f32" (the identity wire every old run realized).
 _CONFIG_AXES = (
     "algorithm", "app", "c", "fused", "kernel", "kernel_variant", "mask",
-    "num_processes",
+    "num_processes", "wire",
 )
 
 
 def _axis_value(row: dict, axis: str):
     """Config-axis value with absence normalization: ``num_processes``
-    None (every pre-PR-14 row) means single-process."""
+    None (every pre-PR-14 row) means single-process; ``wire`` None
+    (every pre-PR-15 row) means the f32 identity wire."""
     v = row.get(axis)
     if axis == "num_processes" and v is None:
         return 1
+    if axis == "wire" and v is None:
+        return "f32"
     return v
 
 
@@ -330,6 +342,18 @@ def _safe_id(run_id: str) -> str:
     return safe.lstrip(".") or "run"
 
 
+def _total_comm_bytes(rec: dict):
+    """Total counted comm bytes across the record's per-op metrics —
+    None (not 0) when no op reported the field, so pre-PR-15 docs read
+    as "not measured" rather than "moved nothing"."""
+    vals = [
+        m.get("comm_bytes")
+        for m in (rec.get("metrics") or {}).values()
+        if isinstance(m, dict) and m.get("comm_bytes") is not None
+    ]
+    return sum(vals) if vals else None
+
+
 def _index_row(doc: dict) -> dict:
     rec = doc.get("record") or {}
     anomalies = (doc.get("anomalies") or {}).get("anomalies", [])
@@ -359,6 +383,8 @@ def _index_row(doc: dict) -> dict:
         "burn_rate": rec.get("burn_rate"),
         "num_processes": rec.get("num_processes"),
         "process_index": rec.get("process_index"),
+        "wire": rec.get("wire"),
+        "comm_bytes": _total_comm_bytes(rec),
         # Offline records carry the GLOBAL counter delta; serving
         # records the engine's own ladder attribution.
         "live_compiles": (

@@ -96,12 +96,20 @@ class DistributedSparse(abc.ABC):
         c: int,
         kernel: Optional[LocalKernel] = None,
         dtype=jnp.float32,
+        wire=None,
     ):
+        from distributed_sddmm_tpu.parallel.wire import wire_policy
+
         self.grid = grid
         self.p = grid.p
         self.M, self.N, self.R, self.c = M, N, R, c
         self.kernel = kernel if kernel is not None else XlaKernel()
         self.dtype = dtype
+        #: Realized wire-precision policy (``parallel/wire.py``): which
+        #: dtype each collective payload role crosses the ICI in. The
+        #: default (None, no env knobs) is the f32 identity wire —
+        #: bit-identical programs, unchanged cache keys.
+        self.wire = wire_policy(wire)
         self.r_split = False  # overridden by R-splitting strategies
         #: Per-op attribution registry (kernel vs retry/fault overhead,
         #: comm words, FLOPs). Replaces the unsynchronized total_time /
@@ -361,6 +369,13 @@ class DistributedSparse(abc.ABC):
             vid = getattr(tiles, "blk_variant", None)
             if vid:
                 key += (f"variant={vid}{_band_sig(tiles)}",)
+        # Wire-precision segment (``w<dtype>``): a bf16-wire program
+        # traces different casts and must never answer for (or alias)
+        # the f32 one. The identity policy appends NOTHING, so default
+        # keys — and every pre-PR-15 store entry — stay byte-identical.
+        wseg = self.wire.key_segment()
+        if wseg:
+            key += (wseg,)
         return key
 
     def inject_program(self, op: str, use_st: bool, loaded) -> None:
@@ -597,16 +612,24 @@ class DistributedSparse(abc.ABC):
         return self.metrics.calls_view()
 
     def _op_cost(self, op: str, pairs: float) -> tuple:
-        """(model comm words, folded-out comm words, global FLOPs) for one
-        call of ``op`` at the current R — cached, so the per-dispatch cost
-        on the fast path is one dict hit."""
+        """(model comm words, comm bytes, folded-out comm words, global
+        FLOPs) for one call of ``op`` at the current R — cached, so the
+        per-dispatch cost on the fast path is one dict hit.
+
+        ``comm_words`` keeps its pre-PR-15 meaning (per-device float
+        ELEMENTS moved — derived as bytes / element width, so gate
+        history keeps comparing across the wire-precision change);
+        ``comm_bytes`` is the dtype-aware volume the wire policy
+        actually moves."""
         key = (op, self.R, pairs)
         hit = self._op_cost_cache.get(key)
         if hit is None:
             from distributed_sddmm_tpu.resilience import faults
 
             profile = self.comm_profile(op, pairs)
-            words = sum(e["words"] for e in profile if e.get("in_model"))
+            in_model = [e for e in profile if e.get("in_model")]
+            words = sum(e["words"] for e in in_model)
+            nbytes = sum(e.get("bytes", e["words"] * 4) for e in in_model)
             extra = sum(e["words"] for e in profile if not e.get("in_model"))
             # Fault hook for comm-accounting drift: a `skew` spec at
             # comm:<op> scales the counted words. Applied on the cache
@@ -614,16 +637,23 @@ class DistributedSparse(abc.ABC):
             # cleared (reset_performance_timers) — the shape of a real
             # layout-math regression (the watchdog's comm-vs-costmodel
             # check is what must notice). The site counter advances once
-            # per cache computation, not per dispatch.
-            words = faults.scale_value(f"comm:{op}", words)
+            # per cache computation, not per dispatch. Bytes scale with
+            # words: a layout-math drift moves both together.
+            scaled = faults.scale_value(f"comm:{op}", words)
+            if words and scaled != words:
+                nbytes *= scaled / words
+            words = scaled
             nnz = self.S_tiles.nnz if self.S_tiles is not None else 0
             flops = obs_metrics.op_flops(op, nnz, self.R, pairs)
-            hit = self._op_cost_cache[key] = (words, extra, flops)
+            hit = self._op_cost_cache[key] = (words, nbytes, extra, flops)
         return hit
 
     def comm_profile(self, op: str, pairs: float = 1.0) -> list[dict]:
         """Per-call collective profile: ``[{"collective", "axis", "count",
-        "words", "in_model"}, ...]`` with per-device word volumes.
+        "words", "bytes", "in_model"}, ...]`` with per-device volumes —
+        ``words`` in float elements (the pre-PR-15 unit, wire-dtype
+        independent), ``bytes`` dtype-aware under the strategy's
+        :attr:`wire` policy (entries omitting it are priced at 4 B/elem).
 
         The base implementation charges the strategy's analytic model
         volume (``tools/costmodel.pair_words`` scaled by the op's pair
@@ -645,11 +675,16 @@ class DistributedSparse(abc.ABC):
                 model, self.M_pad, self.N_pad, self.R,
                 self.S_tiles.nnz, self.p, self.c,
             )
+            b = costmodel.pair_bytes(
+                model, self.M_pad, self.N_pad, self.R,
+                self.S_tiles.nnz, self.p, self.c, wire=self.wire,
+            )
         except ValueError:
             return []
         return [{
             "collective": "modeled", "axis": None, "count": 0,
-            "words": w * frac * pairs, "in_model": True,
+            "words": w * frac * pairs, "bytes": b * frac * pairs,
+            "in_model": True,
         }]
 
     def _emit_strategy_meta(self) -> None:
@@ -666,6 +701,7 @@ class DistributedSparse(abc.ABC):
             R=self.R, nnz=self.S_tiles.nnz if self.S_tiles else 0,
             p=self.p, c=self.c,
             kernel=getattr(self.kernel, "name", type(self.kernel).__name__),
+            wire=self.wire.label,
         )
 
     def _timed(
@@ -696,10 +732,10 @@ class DistributedSparse(abc.ABC):
             # scalar per output leaf is negligible next to any timed op.
             force_fetch(out)
             kernel_s = time.perf_counter() - t0
-            words, extra, flops = self._op_cost(cost_op, _pairs)
+            words, nbytes, extra, flops = self._op_cost(cost_op, _pairs)
             self.metrics.record(
-                name, kernel_s, comm_words=words, comm_words_extra=extra,
-                flops=flops,
+                name, kernel_s, comm_words=words, comm_bytes=nbytes,
+                comm_words_extra=extra, flops=flops,
             )
             if wd is not None:
                 # After metrics.record: a strict-mode alarm must not lose
@@ -711,7 +747,7 @@ class DistributedSparse(abc.ABC):
             return out
 
         self._emit_strategy_meta()
-        words, extra, flops = self._op_cost(cost_op, _pairs)
+        words, nbytes, extra, flops = self._op_cost(cost_op, _pairs)
         with obs_trace.span(name, R=self.R, pairs=_pairs) as sp:
             t0 = time.perf_counter()
             if resilient:
@@ -725,11 +761,13 @@ class DistributedSparse(abc.ABC):
             overhead_s = max(time.perf_counter() - t0 - kernel_s, 0.0)
             self.metrics.record(
                 name, kernel_s, overhead_s=overhead_s, retries=attempts - 1,
-                comm_words=words, comm_words_extra=extra, flops=flops,
+                comm_words=words, comm_bytes=nbytes, comm_words_extra=extra,
+                flops=flops,
             )
             sp.set(
                 kernel_s=round(kernel_s, 9), overhead_s=round(overhead_s, 9),
-                retries=attempts - 1, comm_words=words, flops=flops,
+                retries=attempts - 1, comm_words=words, comm_bytes=nbytes,
+                flops=flops,
             )
         if wd is not None:
             # Outside the span so a strict-mode WatchdogAlarm cannot leave

@@ -75,6 +75,7 @@ class CannonDense25D(DistributedSparse):
         devices=None,
         dtype=jnp.float32,
         unroll: bool = True,
+        wire=None,
     ):
         if devices is None:
             devices = jax.devices()
@@ -91,7 +92,8 @@ class CannonDense25D(DistributedSparse):
                 f"(R={R}, sqrt(p/c)={sqrtpc})"
             )
         grid = make_grid(sqrtpc, sqrtpc, c, adjacency=adjacency, devices=devices)
-        super().__init__(grid, S.M, S.N, R, c, kernel=kernel, dtype=dtype)
+        super().__init__(grid, S.M, S.N, R, c, kernel=kernel, dtype=dtype,
+                         wire=wire)
         self.sqrtpc = sqrtpc
         self.r_split = True
         self.r_split_axis = "cols"  # reference A_R_split_world = row_world
@@ -172,6 +174,10 @@ class CannonDense25D(DistributedSparse):
         def prog(x):
             if n == 1:
                 return x
+            # raw-collective-ok: one-time layout skew outside the ring
+            # loops — a multi-axis permute the wire policy does not
+            # price (it moves the operand once at op entry, not per
+            # pair), so it stays on the raw f32 path deliberately.
             return lax.ppermute(x, ("rows", "cols"), perm)
 
         fn = jax.jit(
@@ -217,19 +223,30 @@ class CannonDense25D(DistributedSparse):
         bm, bn, grb, gcb, grp = tiles.blk_geom
         mov_pad, stat_pad = grb * bm, gcb * bn
         C = max_nnz // CHUNK
+        # Wire roles: read-only ring payloads (the SDDMM moving input,
+        # tile mask/values; int chunk indices never cast) vs the two
+        # in-flight accumulators — the traveling SDDMM dots and SpMM's
+        # rotating OUTPUT — which hop at ring_accum (f32 under the
+        # default bf16 policy).
+        w_ring = self.wire.dtype_for("ring")
+        w_ring_accum = self.wire.dtype_for("ring_accum")
+        w_gather = self.wire.dtype_for("gather")
 
-        def shift_dense(x):
-            return x if n == 1 else abl_ppermute(x, "rows", perm)
+        def shift_dense(x, wire=w_ring):
+            return x if n == 1 else abl_ppermute(x, "rows", perm, wire=wire)
 
-        def shift_sparse(tree):
+        def shift_sparse(tree, wire=w_ring):
             if n == 1:
                 return tree
-            return jax.tree.map(lambda t: abl_ppermute(t, "cols", perm), tree)
+            return jax.tree.map(
+                lambda t: abl_ppermute(t, "cols", perm, wire=wire), tree
+            )
 
         def replicate(stat):
             if c == 1:
                 return stat
-            return abl_all_gather(stat, "layers", axis=0, tiled=True, size=c)
+            return abl_all_gather(stat, "layers", axis=0, tiled=True, size=c,
+                                  wire=w_gather)
 
         def dvary(x):
             return vary(x, ("rows", "cols", "layers"))
@@ -271,12 +288,14 @@ class CannonDense25D(DistributedSparse):
 
                 def shift_all(state):
                     fields, mask, acc, mov = state
-                    fields, mask, acc = shift_sparse((fields, mask, acc))
+                    fields, mask = shift_sparse((fields, mask))
+                    acc = shift_sparse(acc, wire=w_ring_accum)
                     return (fields, mask, acc, shift_dense(mov))
 
                 def shift_acc_home(state):
                     fields, mask, acc, mov = state
-                    return fields, mask, shift_sparse(acc), mov
+                    return (fields, mask,
+                            shift_sparse(acc, wire=w_ring_accum), mov)
 
                 state = ring_loop(
                     n, body, init, shift_all, shift_final=shift_acc_home,
@@ -310,11 +329,14 @@ class CannonDense25D(DistributedSparse):
                 def shift_all(state):
                     fields, vals, mov = state
                     fields, vals = shift_sparse((fields, vals))
-                    return (fields, vals, shift_dense(mov))
+                    # mov IS the accumulating output here (rotating
+                    # bBuf): ring_accum, not ring.
+                    return (fields, vals,
+                            shift_dense(mov, wire=w_ring_accum))
 
                 def shift_out_home(state):
                     fields, vals, mov = state
-                    return fields, vals, shift_dense(mov)
+                    return fields, vals, shift_dense(mov, wire=w_ring_accum)
 
                 state = ring_loop(
                     n, body, init, shift_all, shift_final=shift_out_home,
@@ -354,16 +376,24 @@ class CannonDense25D(DistributedSparse):
         kern = self.kernel
         unroll = self.unroll
         perm = ring_perm(n)
+        # Same wire-role split as the blocked builder: read-only ring
+        # payloads vs the two in-flight accumulators (traveling SDDMM
+        # dots, SpMM's rotating output) at ring_accum.
+        w_ring = self.wire.dtype_for("ring")
+        w_ring_accum = self.wire.dtype_for("ring_accum")
+        w_gather = self.wire.dtype_for("gather")
 
-        def shift_dense(x):
+        def shift_dense(x, wire=w_ring):
             if n == 1:
                 return x
-            return abl_ppermute(x, "rows", perm)
+            return abl_ppermute(x, "rows", perm, wire=wire)
 
-        def shift_sparse(tree):
+        def shift_sparse(tree, wire=w_ring):
             if n == 1:
                 return tree
-            return jax.tree.map(lambda t: abl_ppermute(t, "cols", perm), tree)
+            return jax.tree.map(
+                lambda t: abl_ppermute(t, "cols", perm, wire=wire), tree
+            )
 
         def replicate(stat):
             # (localXrows, r_loc) -> (localXrows * c, r_loc), k-major order
@@ -371,7 +401,8 @@ class CannonDense25D(DistributedSparse):
             # 25D_cannon_dense.hpp:261-269).
             if c == 1:
                 return stat
-            return abl_all_gather(stat, "layers", axis=0, tiled=True, size=c)
+            return abl_all_gather(stat, "layers", axis=0, tiled=True, size=c,
+                                  wire=w_gather)
 
         def dvary(x):
             return vary(x, ("rows", "cols", "layers"))
@@ -401,12 +432,14 @@ class CannonDense25D(DistributedSparse):
 
                 def shift_all(state):
                     rows, cols, mask, acc, mov = state
-                    rows, cols, mask, acc = shift_sparse((rows, cols, mask, acc))
+                    rows, cols, mask = shift_sparse((rows, cols, mask))
+                    acc = shift_sparse(acc, wire=w_ring_accum)
                     return (rows, cols, mask, acc, shift_dense(mov))
 
                 def shift_acc_home(state):
                     rows, cols, mask, acc, mov = state
-                    return rows, cols, mask, shift_sparse(acc), mov
+                    return (rows, cols, mask,
+                            shift_sparse(acc, wire=w_ring_accum), mov)
 
                 state = ring_loop(
                     n, body, init, shift_all, shift_final=shift_acc_home,
@@ -435,11 +468,14 @@ class CannonDense25D(DistributedSparse):
                 def shift_all(state):
                     rows, cols, vals, mov = state
                     rows, cols, vals = shift_sparse((rows, cols, vals))
-                    return (rows, cols, vals, shift_dense(mov))
+                    # mov IS the accumulating output (rotating bBuf):
+                    # ring_accum, not ring.
+                    return (rows, cols, vals,
+                            shift_dense(mov, wire=w_ring_accum))
 
                 def shift_out_home(state):
                     rows, cols, vals, mov = state
-                    return rows, cols, vals, shift_dense(mov)
+                    return rows, cols, vals, shift_dense(mov, wire=w_ring_accum)
 
                 # The rotating OUTPUT must complete the ring back to its
                 # skewed home; the spent tile needn't.

@@ -77,6 +77,7 @@ class CannonSparse25D(DistributedSparse):
         devices=None,
         dtype=jnp.float32,
         unroll: bool = True,
+        wire=None,
     ):
         if devices is None:
             devices = jax.devices()
@@ -93,7 +94,8 @@ class CannonSparse25D(DistributedSparse):
                 "25D_cannon_sparse.hpp:142-145)"
             )
         grid = make_grid(sqrtpc, sqrtpc, c, adjacency=adjacency, devices=devices)
-        super().__init__(grid, S.M, S.N, R, c, kernel=kernel, dtype=dtype)
+        super().__init__(grid, S.M, S.N, R, c, kernel=kernel, dtype=dtype,
+                         wire=wire)
         self.sqrtpc = sqrtpc
         self.r_split = True
         self.r_split_axis = ("cols", "layers")
@@ -252,6 +254,9 @@ class CannonSparse25D(DistributedSparse):
             if n == 1:
                 return x
             perm = [(i * n + j, j * n + i) for i in range(n) for j in range(n)]
+            # raw-collective-ok: one-time transpose skew outside the
+            # ring loops (multi-axis permute, not a per-pair payload
+            # the wire policy prices) — deliberately on the raw path.
             return lax.ppermute(x, ("rows", "cols"), perm)
 
         fn = jax.jit(
@@ -296,12 +301,21 @@ class CannonSparse25D(DistributedSparse):
         bm, bn, grb, gcb, grp = tiles.blk_geom
         rows_pad, cols_pad = grb * bm, gcb * bn
         C = max_nnz // CHUNK
+        # Wire roles: both rotating dense operands are read-only in
+        # SDDMM (ring); in SpMM the A-role is the accumulating OUTPUT
+        # (ring_accum). The fiber value gather is input data (gather);
+        # the fiber psum_scatter of SDDMM dots is a reduction (reduce —
+        # f32 under the default bf16 policy).
+        w_ring = self.wire.dtype_for("ring")
+        w_ring_accum = self.wire.dtype_for("ring_accum")
+        w_gather = self.wire.dtype_for("gather")
+        w_reduce = self.wire.dtype_for("reduce")
 
-        def shift_a(x):
-            return x if n == 1 else abl_ppermute(x, "cols", perm)
+        def shift_a(x, wire=w_ring):
+            return x if n == 1 else abl_ppermute(x, "cols", perm, wire=wire)
 
         def shift_b(x):
-            return x if n == 1 else abl_ppermute(x, "rows", perm)
+            return x if n == 1 else abl_ppermute(x, "rows", perm, wire=w_ring)
 
         def dvary(x):
             return vary(x, ("rows", "cols", "layers"))
@@ -341,7 +355,8 @@ class CannonSparse25D(DistributedSparse):
                 acc = state[0]
                 if c > 1:
                     owned = abl_psum_scatter(
-                        acc, "layers", scatter_dimension=0, tiled=True, size=c
+                        acc, "layers", scatter_dimension=0, tiled=True,
+                        size=c, wire=w_reduce,
                     )
                 else:
                     owned = acc
@@ -361,7 +376,8 @@ class CannonSparse25D(DistributedSparse):
                 blk = blk_of(blr, blc, bmeta)
                 v = vals_owned.reshape(owned_len)
                 if c > 1:
-                    vals = abl_all_gather(v, "layers", axis=0, tiled=True, size=c)
+                    vals = abl_all_gather(v, "layers", axis=0, tiled=True,
+                                          size=c, wire=w_gather)
                 else:
                     vals = v
                 init = (a_role, b_role)
@@ -372,12 +388,13 @@ class CannonSparse25D(DistributedSparse):
                     return (a + partial.T[:out_rows].astype(a.dtype), b)
 
                 def shift_ab(state):
+                    # The A-role is the accumulating output: ring_accum.
                     a, b = state
-                    return (shift_a(a), shift_b(b))
+                    return (shift_a(a, wire=w_ring_accum), shift_b(b))
 
                 def shift_out_home(state):
                     a, b = state
-                    return (shift_a(a), b)
+                    return (shift_a(a, wire=w_ring_accum), b)
 
                 state = ring_loop(
                     n, body, init, shift_ab, shift_final=shift_out_home,
@@ -419,12 +436,17 @@ class CannonSparse25D(DistributedSparse):
         kern = self.kernel
         unroll = self.unroll
         perm = ring_perm(n)
+        # Same wire-role split as the blocked builder (see there).
+        w_ring = self.wire.dtype_for("ring")
+        w_ring_accum = self.wire.dtype_for("ring_accum")
+        w_gather = self.wire.dtype_for("gather")
+        w_reduce = self.wire.dtype_for("reduce")
 
-        def shift_a(x):  # A-role rotates along the cols axis (row_world)
-            return x if n == 1 else abl_ppermute(x, "cols", perm)
+        def shift_a(x, wire=w_ring):  # A-role rotates along cols (row_world)
+            return x if n == 1 else abl_ppermute(x, "cols", perm, wire=wire)
 
         def shift_b(x):  # B-role rotates along the rows axis (col_world)
-            return x if n == 1 else abl_ppermute(x, "rows", perm)
+            return x if n == 1 else abl_ppermute(x, "rows", perm, wire=w_ring)
 
         def dvary(x):
             return vary(x, ("rows", "cols", "layers"))
@@ -456,7 +478,8 @@ class CannonSparse25D(DistributedSparse):
                 acc = state[0]
                 if c > 1:
                     owned = abl_psum_scatter(
-                        acc, "layers", scatter_dimension=0, tiled=True, size=c
+                        acc, "layers", scatter_dimension=0, tiled=True,
+                        size=c, wire=w_reduce,
                     )
                 else:
                     owned = acc
@@ -479,7 +502,8 @@ class CannonSparse25D(DistributedSparse):
                 cols = t_cols.reshape(max_nnz)
                 v = vals_owned.reshape(owned_len)
                 if c > 1:
-                    vals = abl_all_gather(v, "layers", axis=0, tiled=True, size=c)
+                    vals = abl_all_gather(v, "layers", axis=0, tiled=True,
+                                          size=c, wire=w_gather)
                 else:
                     vals = v
                 init = (a_role, b_role)
@@ -489,12 +513,13 @@ class CannonSparse25D(DistributedSparse):
                     return (a + kern.spmm(rows, cols, vals, b, out_rows), b)
 
                 def shift_ab(state):
+                    # The A-role is the accumulating output: ring_accum.
                     a, b = state
-                    return (shift_a(a), shift_b(b))
+                    return (shift_a(a, wire=w_ring_accum), shift_b(b))
 
                 def shift_out_home(state):
                     a, b = state
-                    return (shift_a(a), b)
+                    return (shift_a(a, wire=w_ring_accum), b)
 
                 # The rotating A-role OUTPUT completes its ring trip home;
                 # the spent B-role needn't.

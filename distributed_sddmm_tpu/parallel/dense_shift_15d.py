@@ -70,6 +70,7 @@ class DenseShift15D(DistributedSparse):
         dtype=jnp.float32,
         unroll: bool = True,
         overlap: bool = False,
+        wire=None,
     ):
         if devices is None:
             devices = jax.devices()
@@ -79,7 +80,8 @@ class DenseShift15D(DistributedSparse):
         if fusion_approach not in (1, 2):
             raise ValueError("fusion_approach must be 1 or 2")
         grid = make_grid(p // c, c, 1, adjacency=adjacency, devices=devices)
-        super().__init__(grid, S.M, S.N, R, c, kernel=kernel, dtype=dtype)
+        super().__init__(grid, S.M, S.N, R, c, kernel=kernel, dtype=dtype,
+                         wire=wire)
         self.fusion_approach = fusion_approach
         #: ``overlap=True`` builds every ring program double-buffered
         #: (``ring_loop_overlap``): the next tile's ``ppermute`` is issued
@@ -139,28 +141,36 @@ class DenseShift15D(DistributedSparse):
         R, c, nr = self.R, self.c, self.nr
         n_pass = 1 if self.fusion_approach == 2 else 2
         # B-output ops run on the transposed tiles: stationary/output rows
-        # come from the N side, the A blocks ride the ring.
+        # come from the N side, the A blocks ride the ring (the swap
+        # carries into the byte column unchanged — bytes = words x the
+        # role's wire width).
         stat_rows, mov_rows = self.localArows, self.localBrows
         if op.endswith("B"):
             stat_rows, mov_rows = mov_rows, stat_rows
+        wire = self.wire
+        repl_words = (c - 1) * stat_rows * R * pairs
         repl = {
             "collective": "all_gather", "axis": "cols",
             "count": (1 if c > 1 else 0) * pairs,
-            "words": (c - 1) * stat_rows * R * pairs,
+            "words": repl_words,
+            "bytes": repl_words * wire.bytes_for("gather"),
             "in_model": True,
         }
         reduce_ = {
             "collective": "psum_scatter", "axis": "cols",
             "count": (1 if c > 1 else 0) * pairs,
-            "words": (c - 1) * stat_rows * R * pairs,
+            "words": repl_words,
+            "bytes": repl_words * wire.bytes_for("reduce"),
             "in_model": False,
         }
 
         def ring(passes):
+            words = (nr - 1) * mov_rows * R * passes * pairs
             return {
                 "collective": "ppermute", "axis": "rows",
                 "count": (nr - 1) * passes * pairs,
-                "words": (nr - 1) * mov_rows * R * passes * pairs,
+                "words": words,
+                "bytes": words * wire.bytes_for("ring"),
                 "in_model": True,
             }
 
@@ -171,11 +181,15 @@ class DenseShift15D(DistributedSparse):
             # needs the complete SDDMM rotation) plus one [rows]-vector
             # max/denominator merge over the replication axis — tiny
             # next to the dense traffic, counted but out of model like
-            # the reduce-scatter.
+            # the reduce-scatter. The merge is ALWAYS f32 (4 B): exact
+            # softmax row stats are what keep fused and unfused
+            # attention bitwise-aligned, under every wire policy.
+            merge_words = 2 * (c - 1) * stat_rows * pairs
             merge = {
                 "collective": "pmax+psum", "axis": "cols",
                 "count": (2 if c > 1 else 0) * pairs,
-                "words": 2 * (c - 1) * stat_rows * pairs,
+                "words": merge_words,
+                "bytes": merge_words * 4,
                 "in_model": False,
             }
             return [repl, ring(2), merge, reduce_]
@@ -228,9 +242,16 @@ class DenseShift15D(DistributedSparse):
         perm = ring_perm(nr)
         unroll = self.unroll
         overlap = self.overlap
+        # Wire-precision dtypes per collective role: the moving operand
+        # is read-only on every dense-shift ring (ring role), the
+        # stationary gather is input data, and the SpMM partial reduce
+        # is an accumulation (f32 under the default bf16 policy).
+        w_ring = self.wire.dtype_for("ring")
+        w_gather = self.wire.dtype_for("gather")
+        w_reduce = self.wire.dtype_for("reduce")
 
         def shift_one(mov):
-            return abl_ppermute(mov, "rows", perm)
+            return abl_ppermute(mov, "rows", perm, wire=w_ring)
 
         def shift_mov(state):
             carry, mov = state
@@ -245,13 +266,15 @@ class DenseShift15D(DistributedSparse):
         def replicate(stat_blk):
             if c == 1:
                 return stat_blk
-            return abl_all_gather(stat_blk, "cols", axis=0, tiled=True, size=c)
+            return abl_all_gather(stat_blk, "cols", axis=0, tiled=True,
+                                  size=c, wire=w_gather)
 
         def reduce_out(acc):
             if c == 1:
                 return acc
             return abl_psum_scatter(
-                acc, "cols", scatter_dimension=0, tiled=True, size=c
+                acc, "cols", scatter_dimension=0, tiled=True, size=c,
+                wire=w_reduce,
             )
 
         def squeeze(t):
@@ -531,9 +554,15 @@ class DenseShift15D(DistributedSparse):
         bm, bn, grb, gcb, grp = tiles.blk_geom
         rows_pad, cols_pad = grb * bm, gcb * bn
         chunk_len = CHUNK
+        # Same per-role wire dtypes as the flat programs (the blocked
+        # ring/collective skeleton is identical — only local compute
+        # changes).
+        w_ring = self.wire.dtype_for("ring")
+        w_gather = self.wire.dtype_for("gather")
+        w_reduce = self.wire.dtype_for("reduce")
 
         def shift_one(mov):
-            return abl_ppermute(mov, "rows", perm)
+            return abl_ppermute(mov, "rows", perm, wire=w_ring)
 
         def shift_mov(state):
             carry, mov = state
@@ -547,13 +576,15 @@ class DenseShift15D(DistributedSparse):
         def replicate(stat_blk):
             if c == 1:
                 return stat_blk
-            return abl_all_gather(stat_blk, "cols", axis=0, tiled=True, size=c)
+            return abl_all_gather(stat_blk, "cols", axis=0, tiled=True,
+                                  size=c, wire=w_gather)
 
         def reduce_out(acc):
             if c == 1:
                 return acc
             return abl_psum_scatter(
-                acc, "cols", scatter_dimension=0, tiled=True, size=c
+                acc, "cols", scatter_dimension=0, tiled=True, size=c,
+                wire=w_reduce,
             )
 
         def dvary(x):

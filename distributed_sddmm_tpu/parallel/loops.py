@@ -76,28 +76,67 @@ def ablation_mode(mode: str):
         _ABLATION = prev
 
 
-def abl_ppermute(x, axis_name, perm):
-    """Ring hop; identity under "no_ring"/"local" (Propagation)."""
+# --------------------------------------------------------------------- #
+# Wire-precision boundary casts (parallel/wire.py): payloads downcast
+# JUST before the collective and upcast right after, so compute and
+# every accumulation stay in the resident dtype. Only float32 payloads
+# cast — integer tile indices (and already-reduced-precision data) pass
+# through untouched. ``wire="f32"`` is the identity: the traced program
+# is byte-for-byte the pre-wire one.
+# --------------------------------------------------------------------- #
+
+
+def _wire_down(x, wire: str):
+    if wire == "bf16" and x.dtype == jnp.float32:
+        return x.astype(jnp.bfloat16)
+    return x
+
+
+def _wire_up(y, orig_dtype):
+    if y.dtype != orig_dtype:
+        return y.astype(orig_dtype)
+    return y
+
+
+def abl_ppermute(x, axis_name, perm, *, wire: str = "f32"):
+    """Ring hop; identity under "no_ring"/"local" (Propagation).
+
+    ``wire="bf16"`` halves the hop's bytes for f32 payloads (downcast
+    before, upcast after). Rounding is idempotent, so a READ-ONLY
+    payload riding k hops is rounded once total; accumulators that
+    travel (sparse-shift dots, Cannon's rotating output) must be
+    shifted with the policy's ``ring_accum`` dtype instead — a per-hop
+    downcast of a changing partial sum compounds with ring length."""
     if _ABLATION != "full":
         return x
-    return lax.ppermute(x, axis_name, perm)
+    y = lax.ppermute(_wire_down(x, wire), axis_name, perm)
+    return _wire_up(y, x.dtype)
 
 
-def abl_all_gather(x, axis_name, *, axis, tiled=True, size):
+def abl_all_gather(x, axis_name, *, axis, tiled=True, size, wire: str = "f32"):
     """Replication gather; local concat of ``size`` copies under "local"."""
     if _ABLATION == "local":
         return jnp.concatenate([x] * size, axis=axis)
-    return lax.all_gather(x, axis_name, axis=axis, tiled=tiled)
+    y = lax.all_gather(_wire_down(x, wire), axis_name, axis=axis, tiled=tiled)
+    return _wire_up(y, x.dtype)
 
 
-def abl_psum_scatter(x, axis_name, *, scatter_dimension, tiled=True, size):
-    """Replication reduce-scatter; local 1/``size`` slice under "local"."""
+def abl_psum_scatter(x, axis_name, *, scatter_dimension, tiled=True, size,
+                     wire: str = "f32"):
+    """Replication reduce-scatter; local 1/``size`` slice under "local".
+
+    ``wire="bf16"`` here accumulates ON THE WIRE in bf16 — the default
+    bf16 :class:`~distributed_sddmm_tpu.parallel.wire.WirePolicy` keeps
+    this role f32 for exactly that reason (always-f32 accumulation),
+    and only an explicit ``reduce=bf16`` override reaches this cast."""
     if _ABLATION == "local":
         n = x.shape[scatter_dimension] // size
         return lax.slice_in_dim(x, 0, n, axis=scatter_dimension)
-    return lax.psum_scatter(
-        x, axis_name, scatter_dimension=scatter_dimension, tiled=tiled
+    y = lax.psum_scatter(
+        _wire_down(x, wire), axis_name,
+        scatter_dimension=scatter_dimension, tiled=tiled,
     )
+    return _wire_up(y, x.dtype)
 
 
 def vary(x, axes):

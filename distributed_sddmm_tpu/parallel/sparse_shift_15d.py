@@ -67,6 +67,7 @@ class SparseShift15D(DistributedSparse):
         dtype=jnp.float32,
         unroll: bool = True,
         overlap: bool = False,
+        wire=None,
     ):
         if devices is None:
             devices = jax.devices()
@@ -81,7 +82,8 @@ class SparseShift15D(DistributedSparse):
                 "(reference check at 15D_sparse_shift.hpp:145-147)"
             )
         grid = make_grid(nr, c, 1, adjacency=adjacency, devices=devices)
-        super().__init__(grid, S.M, S.N, R, c, kernel=kernel, dtype=dtype)
+        super().__init__(grid, S.M, S.N, R, c, kernel=kernel, dtype=dtype,
+                         wire=wire)
         #: Double-buffered ring programs (``--fusion overlap``): the
         #: traveling tile's body-independent arrays (indices, mask/vals)
         #: hop BEFORE the local kernel consumes the resident copy; the
@@ -153,15 +155,28 @@ class SparseShift15D(DistributedSparse):
         bm, bn, grb, gcb, grp = tiles.blk_geom
         rows_pad, cols_pad = grb * bm, gcb * bn
         C = max_nnz // CHUNK
+        # Wire roles: the tile's index/mask/value arrays are read-only
+        # ring payloads (indices are int — the boundary cast skips
+        # them); the SDDMM dots accumulate IN FLIGHT, so they hop at
+        # the ring_accum dtype (f32 under the default bf16 policy).
+        w_ring = self.wire.dtype_for("ring")
+        w_ring_accum = self.wire.dtype_for("ring_accum")
+        w_gather = self.wire.dtype_for("gather")
 
-        def shift(tree):
+        def shift(tree, wire=w_ring):
             if nr == 1:
                 return tree
-            return jax.tree.map(lambda x: abl_ppermute(x, "rows", perm), tree)
+            return jax.tree.map(
+                lambda x: abl_ppermute(x, "rows", perm, wire=wire), tree
+            )
+
+        def shift_accum(tree):
+            return shift(tree, wire=w_ring_accum)
 
         def replicate_stationary(blk):
             if c > 1:
-                blk = abl_all_gather(blk, "cols", axis=1, tiled=True, size=c)
+                blk = abl_all_gather(blk, "cols", axis=1, tiled=True, size=c,
+                                     wire=w_gather)
             return blk.reshape(blk.shape[0] * blk.shape[1] * blk.shape[2], blk.shape[3])
 
         def dvary(x):
@@ -209,7 +224,8 @@ class SparseShift15D(DistributedSparse):
                         return local(s, fields, mask, acc)
 
                     acc, _ = ring_loop_overlap(
-                        nr, body, acc0, mov0, shift, shift_carry=shift,
+                        nr, body, acc0, mov0, shift,
+                        shift_carry=shift_accum,
                         final_shift=True, unroll=unroll,
                     )
                 else:
@@ -217,9 +233,13 @@ class SparseShift15D(DistributedSparse):
                         (fields, mask), acc = state
                         return ((fields, mask), local(s, fields, mask, acc))
 
+                    def shift_state(state):
+                        mov, acc = state
+                        return (shift(mov), shift_accum(acc))
+
                     state = ring_loop(
-                        nr, body, (mov0, acc0), shift,
-                        shift_final=shift, unroll=unroll,
+                        nr, body, (mov0, acc0), shift_state,
+                        shift_final=shift_state, unroll=unroll,
                     )
                     acc = state[1]
                 return (t_vals.reshape(max_nnz) * acc).reshape(1, 1, 1, 1, max_nnz)
@@ -308,16 +328,28 @@ class SparseShift15D(DistributedSparse):
         perm = ring_perm(nr)
         unroll = self.unroll
         overlap = self.overlap
+        # Wire roles (see the blocked builder): read-only tile arrays
+        # ride at the ring dtype, the in-flight SDDMM dot accumulator
+        # at ring_accum (f32 under the default bf16 policy).
+        w_ring = self.wire.dtype_for("ring")
+        w_ring_accum = self.wire.dtype_for("ring_accum")
+        w_gather = self.wire.dtype_for("gather")
 
-        def shift(tree):
+        def shift(tree, wire=w_ring):
             if nr == 1:
                 return tree
-            return jax.tree.map(lambda x: abl_ppermute(x, "rows", perm), tree)
+            return jax.tree.map(
+                lambda x: abl_ppermute(x, "rows", perm, wire=wire), tree
+            )
+
+        def shift_accum(tree):
+            return shift(tree, wire=w_ring_accum)
 
         def replicate_stationary(blk):
             # blk: (nr, 1, bw, r_loc) -> all-gather layers -> (N_pad, r_loc)
             if c > 1:
-                blk = abl_all_gather(blk, "cols", axis=1, tiled=True, size=c)
+                blk = abl_all_gather(blk, "cols", axis=1, tiled=True, size=c,
+                                     wire=w_gather)
             return blk.reshape(blk.shape[0] * blk.shape[1] * blk.shape[2], blk.shape[3])
 
         def dvary(x):
@@ -357,7 +389,9 @@ class SparseShift15D(DistributedSparse):
                     # Index/mask arrays are body-independent: they
                     # double-buffer. The accumulating dots depend on the
                     # body, so they hop after it (shift_carry) — the one
-                    # leg of this traveling tile that cannot overlap.
+                    # leg of this traveling tile that cannot overlap —
+                    # and at the ring_accum wire dtype (a changing
+                    # partial sum must not be re-rounded per hop).
                     def body(s, acc, fields):
                         rows, cols, mask = fields
                         return acc + kern.sddmm(
@@ -365,7 +399,8 @@ class SparseShift15D(DistributedSparse):
                         )
 
                     acc, _ = ring_loop_overlap(
-                        nr, body, acc0, fields, shift, shift_carry=shift,
+                        nr, body, acc0, fields, shift,
+                        shift_carry=shift_accum,
                         final_shift=True, unroll=unroll,
                     )
                 else:
@@ -376,11 +411,16 @@ class SparseShift15D(DistributedSparse):
                         )
                         return (rows, cols, mask, acc)
 
+                    def shift_state(state):
+                        rows, cols, mask, acc = state
+                        rows, cols, mask = shift((rows, cols, mask))
+                        return (rows, cols, mask, shift_accum(acc))
+
                     # The accumulating dots travel WITH the tile; the
                     # final shift completes their round trip home.
                     state = ring_loop(
-                        nr, body, (*fields, acc0), shift,
-                        shift_final=shift, unroll=unroll,
+                        nr, body, (*fields, acc0), shift_state,
+                        shift_final=shift_state, unroll=unroll,
                     )
                     acc = state[3]
                 return (squeeze_tile(t_vals) * acc).reshape(1, 1, 1, 1, max_nnz)

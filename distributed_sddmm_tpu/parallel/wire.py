@@ -1,0 +1,217 @@
+"""Wire precision: reduced-precision payload dtypes for the collectives.
+
+The 1.5D/2.5D algorithms are bandwidth-bound by design — the whole
+``c`` tradeoff in ``tools/costmodel.py`` is a words-moved argument —
+yet every distributed payload historically crossed the ICI in float32
+even after PR 9 moved the MXU compute to bf16. A :class:`WirePolicy`
+names the dtype each collective ROLE uses **on the wire only**:
+payloads are downcast at the collective boundary and upcast right
+after, and every accumulation stays float32 (the mixed-precision/
+f32-accumulation discipline of "Sparse GPU Kernels for Deep Learning",
+PAPERS.md).
+
+Roles — the policy's unit is what a payload IS, not which collective
+carries it:
+
+``gather``
+    Stationary-operand replication (``all_gather``). Input data; one
+    rounding total, exact at c == 1.
+``ring``
+    Ring-shifted payloads that the body only READS (the dense-shift
+    moving operand, sparse-shift index/mask/value arrays, Cannon's
+    rotating inputs). bf16 rounding is idempotent, so a payload that
+    rides k hops is rounded ONCE, not k times — the error does not
+    compound with ring length.
+``ring_accum``
+    Traveling accumulators (sparse-shift's in-flight SDDMM dots,
+    Cannon's rotating output). These are reductions in flight: a
+    downcast per hop would re-round a *changing* partial sum and
+    compound with ring length, so the default bf16 policy keeps them
+    f32 (override explicitly to trade exactness for bytes).
+``reduce``
+    ``psum_scatter`` partial sums. On-wire reduction accumulates in
+    the wire dtype, so the default bf16 policy keeps it f32 (the
+    gather-then-local-f32-reduce alternative moves MORE bytes than an
+    f32 reduce-scatter for c > 2 — not a win; an explicit override
+    buys the bf16 bytes at bf16 accumulation precision).
+
+Always exact regardless of policy: integer tile indices (the cast
+helpers only touch float32 arrays) and the attention softmax row-stat
+``pmax``/``psum`` merge (exactness of the denominators is what makes
+fused and unfused attention agree bitwise).
+
+The f32 default is the identity: no casts are traced, program
+cache/store keys gain no segment (``key_segment() == ""``), so every
+pre-PR-15 store entry keeps hitting and numerics are bit-identical to
+the pre-wire code by construction. bf16 runs are deterministic (pure
+rounding, no stochastic path) — replay-stable, so the tuner's
+shadow-compare still works bit-for-bit.
+
+Import discipline: stdlib only (keys and offline tooling resolve
+policies in jax-free subprocesses).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+
+#: Collective payload roles (module doc): replication gather, read-only
+#: ring payloads, traveling accumulators, reduce-scatter partials.
+ROLES = ("gather", "ring", "ring_accum", "reduce")
+
+#: Wire dtypes the policy understands, with their byte widths. f32 is
+#: the identity wire; bf16 halves every payload it is applied to.
+WIRE_DTYPES = {"f32": 4, "bf16": 2}
+
+#: Roles the ``bf16`` comm_dtype applies to by default. Accumulating
+#: payloads (``ring_accum``, ``reduce``) stay f32 unless explicitly
+#: overridden — always-f32 accumulation is the policy's contract.
+_BF16_DEFAULT_ROLES = ("gather", "ring")
+
+
+def wire_bytes(dtype: str) -> int:
+    """Bytes per element of one wire dtype name."""
+    return WIRE_DTYPES[dtype]
+
+
+@dataclasses.dataclass(frozen=True)
+class WirePolicy:
+    """Per-role wire dtypes for one strategy's collectives.
+
+    ``comm_dtype`` is the headline request (``f32`` | ``bf16``);
+    ``overrides`` pins individual roles, e.g. ``(("reduce", "bf16"),)``
+    to push the reduce-scatter down too, or ``(("ring", "f32"),)`` to
+    keep the ring exact under an otherwise-bf16 policy.
+    """
+
+    comm_dtype: str = "f32"
+    overrides: tuple = ()
+
+    def __post_init__(self):
+        if self.comm_dtype not in WIRE_DTYPES:
+            raise ValueError(
+                f"unknown comm_dtype {self.comm_dtype!r}; "
+                f"expected one of {sorted(WIRE_DTYPES)}"
+            )
+        for role, dt in self.overrides:
+            if role not in ROLES:
+                raise ValueError(
+                    f"unknown wire role {role!r}; expected one of {ROLES}"
+                )
+            if dt not in WIRE_DTYPES:
+                raise ValueError(
+                    f"unknown wire dtype {dt!r} for role {role!r}"
+                )
+
+    # ------------------------------------------------------------------ #
+    # Resolution
+    # ------------------------------------------------------------------ #
+
+    def dtype_for(self, role: str) -> str:
+        """The wire dtype one role realizes under this policy."""
+        if role not in ROLES:
+            raise ValueError(
+                f"unknown wire role {role!r}; expected one of {ROLES}"
+            )
+        for r, dt in self.overrides:
+            if r == role:
+                return dt
+        if self.comm_dtype == "bf16" and role in _BF16_DEFAULT_ROLES:
+            return "bf16"
+        return "f32"
+
+    def bytes_for(self, role: str) -> int:
+        """Bytes per float element one role pays on the wire."""
+        return wire_bytes(self.dtype_for(role))
+
+    def realized(self) -> dict:
+        """``{role: dtype}`` — the full resolved map (records carry it)."""
+        return {role: self.dtype_for(role) for role in ROLES}
+
+    @property
+    def name(self) -> str:
+        """Coarse human label: ``f32`` when every role resolves f32
+        (identity wire), else the requested comm_dtype (``mixed`` for
+        the odd f32-base-with-bf16-override policy). Display only —
+        records, serve keys and gate axes use :attr:`label`, which
+        keeps overrides distinguishable."""
+        if all(self.dtype_for(r) == "f32" for r in ROLES):
+            return "f32"
+        return self.comm_dtype if self.comm_dtype != "f32" else "mixed"
+
+    @property
+    def label(self) -> str:
+        """Canonical policy identity for records, serve keys and the
+        runstore ``wire`` config axis: ``f32`` for the identity wire,
+        else the :meth:`key_segment` minus its ``w`` prefix — role
+        overrides INCLUDED, so two numerically different policies
+        (``bf16`` vs ``bf16.reduce=bf16``) can never alias a serve-key
+        segment or pool into one gate baseline."""
+        seg = self.key_segment()
+        return seg[1:] if seg else "f32"
+
+    # ------------------------------------------------------------------ #
+    # Keys
+    # ------------------------------------------------------------------ #
+
+    def key_segment(self) -> str:
+        """Program-cache / store-key segment: ``""`` for the identity
+        (f32-everywhere) policy — pre-PR-15 keys stay byte-identical and
+        old store entries keep hitting — else ``w<dtype>`` plus any
+        role overrides that differ from the comm_dtype's default map,
+        dot-joined (printable, colon-free: safe as one key segment)."""
+        realized = self.realized()
+        if all(dt == "f32" for dt in realized.values()):
+            return ""
+        base = WirePolicy(self.comm_dtype)
+        diff = [
+            f"{role}={dt}" for role, dt in realized.items()
+            if dt != base.dtype_for(role)
+        ]
+        seg = f"w{self.comm_dtype}"
+        if diff:
+            seg += "." + ".".join(sorted(diff))
+        return seg
+
+
+#: The identity policy (every payload f32 — today's wire format).
+F32 = WirePolicy("f32")
+#: The standard reduced-precision policy: bf16 gather/ring payloads,
+#: f32 accumulation everywhere.
+BF16 = WirePolicy("bf16")
+
+
+def _env_default() -> WirePolicy:
+    """The process-default policy: ``DSDDMM_WIRE`` names the comm
+    dtype, ``DSDDMM_WIRE_OVERRIDES`` pins roles (``role=dtype`` comma
+    list). Unset -> the f32 identity wire."""
+    dt = os.environ.get("DSDDMM_WIRE", "f32").strip() or "f32"
+    spec = os.environ.get("DSDDMM_WIRE_OVERRIDES", "").strip()
+    overrides = []
+    if spec:
+        for part in spec.split(","):
+            part = part.strip()
+            if not part:
+                continue
+            role, _, val = part.partition("=")
+            overrides.append((role.strip(), val.strip()))
+    return WirePolicy(dt, tuple(overrides))
+
+
+def wire_policy(spec=None) -> WirePolicy:
+    """Normalize anything callers hand a ``wire=`` parameter into a
+    :class:`WirePolicy`: an existing policy passes through, a dtype
+    name (``"f32"``/``"bf16"``) builds the standard policy, and None
+    resolves the ``DSDDMM_WIRE*`` env defaults (identity wire when
+    unset — strategies built without ``wire=`` behave exactly as
+    before this layer existed)."""
+    if spec is None:
+        return _env_default()
+    if isinstance(spec, WirePolicy):
+        return spec
+    if isinstance(spec, str):
+        return WirePolicy(spec)
+    raise TypeError(
+        f"wire= expects a WirePolicy, a dtype name or None; got {spec!r}"
+    )

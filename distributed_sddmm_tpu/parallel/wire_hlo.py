@@ -1,0 +1,227 @@
+"""Offline structural gate for the wire-precision layer (PR 15).
+
+``codegen/hlo.py`` retarget pattern: the headline dense-shift fused
+pair is AOT-compiled for a REAL v5e topology
+(``jax.experimental.topologies``, no chips needed) under BOTH wire
+policies, and the compiled HLO is scanned for the element dtype each
+collective actually carries — the property that makes "bf16
+collectives" a compile artifact instead of a tracing claim. Under the
+default bf16 policy the ``all-gather`` and ``collective-permute``
+payloads must be bf16 while the ``reduce-scatter`` stays f32 (the
+always-f32-accumulation contract), and the f32 module must carry no
+bf16 collective at all (the identity-wire bit-identity claim, seen
+from the compiler's side).
+
+Alongside the structure, the report banks the measurable halves of the
+acceptance bar on the live (CPU test) mesh: the counted in-model
+``comm_bytes`` ratio bf16/f32 for the fused op (~0.5x on dense-shift —
+every in-model payload is gather/ring), the normalized float64-oracle
+error of the bf16 run, and bf16 replay determinism (two fresh builds,
+bitwise-equal outputs — what keeps the tuner's shadow-compare working
+under a bf16 wire). The committed ``WIRE_HLO.json`` is this probe's
+banked record (``tests/test_wire_gate.py``).
+
+Environment note (same as every other gate): on machines without TPU
+instance metadata export ``TPU_SKIP_MDS_QUERY=1`` before first
+jax/libtpu init or the topology lookup stalls in metadata retries.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+
+#: Collective ops whose result element type the scanner reads. -start
+#: forms subsume their -done halves (counted once, like dist/hlo.py).
+_COLLECTIVE_OPS = (
+    "collective-permute-start", "collective-permute",
+    "all-gather-start", "all-gather",
+    "all-reduce-start", "all-reduce",
+    "reduce-scatter", "all-to-all",
+)
+
+#: Result element type of an HLO instruction line: ``%x = bf16[...]``
+#: (tuple results — the -start forms — name the payload dtype first:
+#: ``(bf16[..], bf16[..])``).
+_RESULT_DTYPE_RE = re.compile(r"=\s*\(?([a-z][a-z0-9]*)\[")
+
+
+def scan_collective_dtypes(hlo: str) -> dict:
+    """Per-collective element-dtype census of one compiled-HLO text:
+    ``{op: {"count": n, "dtypes": {dtype: count}}}``. Lines whose
+    result type the scanner cannot read land in ``unparsed_lines`` —
+    nonzero means the gate's evidence is incomplete and the committed
+    record must say so."""
+    per_op: dict[str, dict] = {}
+    unparsed = 0
+    for line in hlo.splitlines():
+        op = next((o for o in _COLLECTIVE_OPS if f" {o}(" in line
+                   or line.lstrip().startswith(f"%{o}")
+                   or f"= {o}" in line or f"{o}(" in line), None)
+        if op is None:
+            continue
+        base = op.replace("-start", "")
+        if "-done(" in line:
+            continue
+        m = _RESULT_DTYPE_RE.search(line)
+        entry = per_op.setdefault(base, {"count": 0, "dtypes": {}})
+        entry["count"] += 1
+        if m is None:
+            unparsed += 1
+            continue
+        dt = m.group(1)
+        entry["dtypes"][dt] = entry["dtypes"].get(dt, 0) + 1
+    return {
+        "per_op": per_op,
+        "unparsed_lines": unparsed,
+    }
+
+
+def _fused_run(alg, A, B, vals):
+    """One fused dispatch -> host (M, R) float64 result."""
+    import numpy as np
+
+    out, _mid = alg.fused_spmm(A, B, vals)
+    return np.asarray(alg.host_a(out), dtype=np.float64)
+
+
+def _in_model_bytes(alg, op: str = "fusedSpMM") -> float:
+    return sum(
+        e.get("bytes", e["words"] * 4)
+        for e in alg.comm_profile(op)
+        if e.get("in_model")
+    )
+
+
+def wire_hlo_report(
+    topology_name: str = "v5e:2x4",
+    log_m: int = 11,
+    edge_factor: int = 4,
+    R: int = 128,
+    c: int = 2,
+    output_file: str | None = None,
+) -> dict:
+    """Compile the fused dense-shift pair for a v5e topology under the
+    f32 and bf16 wire policies, scan the collective element dtypes, and
+    bank counted bytes + oracle error + determinism alongside.
+
+    ``c=2`` puts the replication axis (all-gather + reduce-scatter) on
+    the grid so BOTH bf16-able and must-stay-f32 collectives exist in
+    one module; the rows ring supplies the collective-permute.
+    """
+    import numpy as np
+
+    from distributed_sddmm_tpu.codegen.hlo import _aot_compile_ops, _topology
+    from distributed_sddmm_tpu.common import MatMode
+    from distributed_sddmm_tpu.parallel.dense_shift_15d import DenseShift15D
+    from distributed_sddmm_tpu.utils import oracle
+    from distributed_sddmm_tpu.utils.coo import HostCOO
+
+    import jax
+
+    topo = _topology(topology_name, len(jax.devices()))
+
+    S = HostCOO.rmat(log_m=log_m, edge_factor=edge_factor, seed=0)
+
+    def build(wire):
+        return DenseShift15D(S, R=R, c=c, fusion_approach=2, wire=wire)
+
+    # ---- live-mesh numerics first (the AOT retarget mutates grids) --- #
+    algs = {"f32": build("f32"), "bf16": build("bf16")}
+    results, bytes_counted = {}, {}
+    for name, alg in algs.items():
+        A = alg.dummy_initialize(MatMode.A)
+        B = alg.dummy_initialize(MatMode.B)
+        vals = alg.like_s_values(1.0)
+        results[name] = _fused_run(alg, A, B, vals)
+        bytes_counted[name] = _in_model_bytes(alg)
+    # Replay determinism: a FRESH bf16 build must reproduce bitwise
+    # (pure rounding, no stochastic path) — the tuner shadow-compare
+    # contract under a bf16 wire.
+    alg2 = build("bf16")
+    replay = _fused_run(
+        alg2, alg2.dummy_initialize(MatMode.A),
+        alg2.dummy_initialize(MatMode.B), alg2.like_s_values(1.0),
+    )
+    deterministic = bool(np.array_equal(results["bf16"], replay))
+
+    # Normalized L2 error vs the float64 oracle (pointwise relative
+    # error is dominated by near-zero outputs; the norm ratio is the
+    # standard mixed-precision accuracy statement).
+    Ah = algs["f32"].host_a(algs["f32"].dummy_initialize(MatMode.A))
+    Bh = algs["f32"].host_b(algs["f32"].dummy_initialize(MatMode.B))
+    ref = oracle.fused_spmm_a(
+        S, Ah.astype(np.float64), Bh.astype(np.float64)
+    )
+    denom = float(np.linalg.norm(ref)) or 1.0
+    rel = {
+        name: float(np.linalg.norm(out[: S.M] - ref) / denom)
+        for name, out in results.items()
+    }
+
+    # ---- structural halves: AOT retarget + dtype census -------------- #
+    scans = {}
+    for name, alg in algs.items():
+        vals = alg.like_s_values(1.0)
+        args = (
+            alg.dummy_initialize(MatMode.A),
+            alg.dummy_initialize(MatMode.B),
+            *alg._tile_args(alg.S_tiles, vals),
+        )
+        hlo = _aot_compile_ops(alg, args, topo, ("fused",))["fused"]
+        scans[name] = scan_collective_dtypes(hlo)
+        scans[name]["is_scheduled"] = "is_scheduled=true" in hlo
+
+    record = {
+        "experiment": "wire-hlo",
+        "topology": topology_name,
+        "p": algs["f32"].p,
+        "c": c,
+        "M": S.M,
+        "nnz": S.nnz,
+        "R": R,
+        "collectives_f32": scans["f32"]["per_op"],
+        "collectives_bf16": scans["bf16"]["per_op"],
+        "unparsed_lines": (scans["f32"]["unparsed_lines"]
+                           + scans["bf16"]["unparsed_lines"]),
+        "is_scheduled": bool(scans["f32"]["is_scheduled"]
+                             and scans["bf16"]["is_scheduled"]),
+        "comm_bytes_f32": bytes_counted["f32"],
+        "comm_bytes_bf16": bytes_counted["bf16"],
+        "bytes_ratio": bytes_counted["bf16"] / bytes_counted["f32"],
+        "oracle_rel_err_f32": rel["f32"],
+        "oracle_rel_err_bf16": rel["bf16"],
+        "bf16_deterministic": deterministic,
+    }
+    if output_file:
+        # non-atomic-ok: append-only record stream (the -o contract).
+        with open(output_file, "a") as f:
+            f.write(json.dumps(record) + "\n")
+    return record
+
+
+def main(argv=None) -> int:
+    """CLI: print (and optionally append) the wire-HLO record."""
+    import argparse
+
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--topology", default="v5e:2x4")
+    ap.add_argument("--log-m", type=int, default=11)
+    ap.add_argument("--edge-factor", type=int, default=4)
+    ap.add_argument("--R", type=int, default=128)
+    ap.add_argument("--c", type=int, default=2)
+    ap.add_argument("-o", "--output-file", default=None)
+    args = ap.parse_args(argv)
+    rec = wire_hlo_report(
+        topology_name=args.topology, log_m=args.log_m,
+        edge_factor=args.edge_factor, R=args.R, c=args.c,
+        output_file=args.output_file,
+    )
+    print(json.dumps(rec, indent=2))  # cli-output
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

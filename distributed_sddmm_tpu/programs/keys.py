@@ -172,6 +172,7 @@ def serve_program_key(
     params: str | None = None,
     sig: str | None = None,
     variant: str | None = None,
+    wire: str | None = None,
     dist: str | None = None,
 ) -> str:
     """Cache key for one serving bucket cell — the grammar the engine
@@ -185,7 +186,11 @@ def serve_program_key(
     ``v<variant>`` (the warm model's codegen kernel-variant id, PR 9 —
     a ladder warmed under one kernel specialization never answers for
     another; variant-less keys are byte-identical to the PR 5-8
-    grammar, so existing stores keep hitting). ``dist`` is the
+    grammar, so existing stores keep hitting) and ``w<wire>`` (PR 15 —
+    the warm model's realized wire-precision policy: a ladder compiled
+    with bf16 collectives must never answer for the f32 wire or vice
+    versa; None and "f32" append nothing, so default keys — and every
+    pre-PR-15 store — stay byte-identical). ``dist`` is the
     :func:`dist_segment` of the compiling worker (PR 14) — serving
     executables are per-process exactly like plan programs, so a pod
     worker's ladder entries must never answer for another slot's;
@@ -204,6 +209,8 @@ def serve_program_key(
         key += f":s{_seg(sig)}"
     if variant:
         key += f":v{_seg(variant)}"
+    if wire and wire != "f32":
+        key += f":w{_seg(wire)}"
     if dist:
         key += f":{_seg(dist)}"
     return key
@@ -211,7 +218,7 @@ def serve_program_key(
 
 def parse_serve_key(key: str) -> dict | None:
     parts = key.split(":")
-    if not (7 <= len(parts) <= 11) or parts[0] != "serve":
+    if not (7 <= len(parts) <= 12) or parts[0] != "serve":
         return None
     if not (parts[2].startswith("b") and parts[3].startswith("i")
             and parts[4].startswith("r")):
@@ -236,6 +243,8 @@ def parse_serve_key(key: str) -> dict | None:
             out["sig"] = extra[1:]
         elif extra.startswith("v"):
             out["variant"] = extra[1:]
+        elif extra.startswith("w"):
+            out["wire"] = extra[1:]
         else:
             return None
     return out

@@ -176,6 +176,10 @@ class ServingEngine:
             self.workload.name, batch_bucket, inner_bucket, r, backend,
             params=self.workload.program_params(), sig=sig,
             variant=variant,
+            # Realized wire policy of the warm model's strategy (PR
+            # 15): bf16-wire ladder entries never alias f32's; None/
+            # f32 appends nothing, keeping default keys byte-identical.
+            wire=getattr(self.workload, "wire", None),
             # Serving executables are per-process like plan programs:
             # on a pod each worker's ladder keys carry its dN.pK slot
             # (empty single-process — keys byte-identical to PR 5-13).

@@ -70,6 +70,25 @@ def _model_kernel_variant(model) -> Optional[str]:
     return realized_kernel_variant(getattr(model, "d_ops", None))
 
 
+def _model_wire(model_or_ops) -> Optional[str]:
+    """The warm model's realized wire-precision policy LABEL (``bf16``,
+    ``bf16.reduce=bf16``, ...), or None for the f32 identity wire —
+    same by-construction key-isolation role as
+    :func:`_model_kernel_variant`: a ladder warmed over bf16-wire
+    strategy programs stamps ``w<label>`` into its keys
+    (``programs/keys.serve_program_key``) so it can never answer for an
+    f32-wire engine — and the label carries role overrides, so two
+    numerically different bf16 policies never alias either. Accepts
+    the model or the strategy itself (the attention workload holds
+    ``d_ops`` directly)."""
+    ops = getattr(model_or_ops, "d_ops", model_or_ops)
+    policy = getattr(ops, "wire", None)
+    if policy is None:
+        return None
+    label = policy.label
+    return None if label == "f32" else label
+
+
 def _chol_solve(gram, rhs):
     """Batched SPD solve via a hand-unrolled Cholesky (``gram`` is
     ``(b, R, R)``, ``rhs`` ``(b, R)``).
@@ -129,6 +148,11 @@ class ServingWorkload(abc.ABC):
     #: (``programs/keys.serve_program_key``) so a cache warmed under one
     #: specialization can never answer for another.
     kernel_variant: Optional[str] = None
+    #: Realized wire-precision policy name of the warm model's strategy
+    #: (None = the f32 identity wire). Baked into the ladder's program
+    #: keys as ``w<dtype>`` for the same isolation reason — and None
+    #: appends nothing, so f32 keys stay byte-identical to PR 5-14.
+    wire: Optional[str] = None
 
     @abc.abstractmethod
     def inner_size(self, payload: dict) -> int:
@@ -223,6 +247,7 @@ class ALSFoldInTopK(ServingWorkload):
             kernel_variant if kernel_variant is not None
             else _model_kernel_variant(model)
         )
+        self.wire = _model_wire(model)
 
         if model.B is None:
             raise ValueError(
@@ -447,6 +472,7 @@ class AttentionTokenScore(ServingWorkload):
 
             kernel_variant = realized_kernel_variant(d_ops)
         self.kernel_variant = kernel_variant
+        self.wire = _model_wire(d_ops) if d_ops is not None else None
         self.d_ops = d_ops
         if window is None:
             window = int(os.environ.get("DSDDMM_ATTN_SERVE_WINDOW", "16"))
@@ -628,6 +654,7 @@ class GATNodeScore(ServingWorkload):
             kernel_variant if kernel_variant is not None
             else _model_kernel_variant(model)
         )
+        self.wire = _model_wire(model)
         self.inner_buckets = tuple(sorted(int(b) for b in node_buckets))
         self.M = model.d_ops.M
         self._F = model.layers[-1].output_features

@@ -111,15 +111,36 @@ class Machine:
     flops_rate: float = DEFAULT_FLOPS_RATE
 
 
-def _dense_shift_words(M, N, R, p, c, n_pass, n_repl):
+# Per-model volume components: the ONE place each replicate/ring/
+# reduce formula lives. ``pair_words`` (dtype-independent element
+# counts) and ``_discountable_terms`` (the wire-pricing role split of
+# the SAME quantities) both assemble from these, so the two views
+# cannot drift apart when a formula changes.
+
+
+def _dense_shift_components(M, N, R, p, c, n_pass, n_repl):
+    """(replicated words incl. n_repl, ring words)."""
     replicate = (c - 1) / c * (M * R * c / p)
     ring = (p / c - 1) * (N * R / p) * n_pass
-    return n_repl * replicate + ring
+    return n_repl * replicate, ring
+
+
+def _dense_shift_words(M, N, R, p, c, n_pass, n_repl):
+    replicate, ring = _dense_shift_components(M, N, R, p, c, n_pass, n_repl)
+    return replicate + ring
+
+
+def _sparse_shift_components(M, N, R, nnz, p, c, n_pass):
+    """(replicate words, full ring words, the ring's float-value third
+    — rows/cols travel as int32 and never take a wire discount)."""
+    replicate = (c - 1) / c * (N * R * c / p)
+    ring = (p / c - 1) * (3 * nnz / p) * n_pass
+    ring_vals = (p / c - 1) * (nnz / p) * n_pass
+    return replicate, ring, ring_vals
 
 
 def _sparse_shift_words(M, N, R, nnz, p, c, n_pass):
-    replicate = (c - 1) / c * (N * R * c / p)
-    ring = (p / c - 1) * (3 * nnz / p) * n_pass
+    replicate, ring, _ = _sparse_shift_components(M, N, R, nnz, p, c, n_pass)
     return replicate + ring
 
 
@@ -134,6 +155,19 @@ def _sqrtpc(p: int, c: int) -> int:
     return s
 
 
+def _cannon_dense_components(M, N, R, p, c):
+    """(block_a, block_b, steps, layer-broadcast words, fiber
+    reduce-scatter words). block_a's ring share is the rotating OUTPUT
+    (an accumulator for wire pricing); block_b's rides read-only."""
+    s = _sqrtpc(p, c)
+    block_a = (M / (s * c)) * (R / s)
+    block_b = (N / (s * c)) * (R / s)
+    steps = max(s // c, 1)
+    replicate = (c - 1) / c * c * (block_a + block_b)  # layer broadcast
+    reduce_out = (c - 1) / c * c * block_a             # fiber reduce-scatter
+    return block_a, block_b, steps, replicate, reduce_out
+
+
 def _cannon_dense_words(M, N, R, p, c):
     """2.5D Cannon, dense replicated: first-order per-device words.
 
@@ -144,26 +178,31 @@ def _cannon_dense_words(M, N, R, p, c):
     models — the 2.5D strategies are not in the notebook, so these extend
     it following Koanantakool et al.'s 2.5D volume accounting.
     """
-    s = _sqrtpc(p, c)
-    block_a = (M / (s * c)) * (R / s)
-    block_b = (N / (s * c)) * (R / s)
-    steps = max(s // c, 1)
-    replicate = (c - 1) / c * c * (block_a + block_b)  # layer broadcast
+    block_a, block_b, steps, replicate, reduce_out = \
+        _cannon_dense_components(M, N, R, p, c)
     ring = steps * (block_a + block_b)
-    reduce_out = (c - 1) / c * c * block_a             # fiber reduce-scatter
     return replicate + ring + reduce_out
+
+
+def _cannon_sparse_components(M, N, R, nnz, p, c):
+    """(block_a, block_b, steps, fiber reduce-scatter words) — same
+    role split as the dense variant, minus the ingest-time sparse
+    replication the model does not charge per pair."""
+    s = _sqrtpc(p, c)
+    block_a = (M / s) * (R / (s * c))
+    block_b = (N / s) * (R / (s * c))
+    steps = max(s // c, 1)
+    reduce_out = (c - 1) / c * c * block_a
+    return block_a, block_b, steps, reduce_out
 
 
 def _cannon_sparse_words(M, N, R, nnz, p, c):
     """2.5D Cannon, sparse replicated: the sparse tiles are resident
     (replication paid once at ingest, not per pair); the dense blocks ride
     and the R-split (cols x layers) fiber carries the output reduction."""
-    s = _sqrtpc(p, c)
-    block_a = (M / s) * (R / (s * c))
-    block_b = (N / s) * (R / (s * c))
-    steps = max(s // c, 1)
+    block_a, block_b, steps, reduce_out = \
+        _cannon_sparse_components(M, N, R, nnz, p, c)
     ring = steps * (block_a + block_b)
-    reduce_out = (c - 1) / c * c * block_a
     return ring + reduce_out
 
 
@@ -175,8 +214,82 @@ def pair_words(
     the observability layer's counted comm volume (strategy layout math,
     ``obs/metrics.py``) can be checked against the analytic prediction.
     Same conventions as the notebook models: the SpMM reduce-scatter is
-    folded out. Raises ValueError exactly as :func:`pair_time` does."""
+    folded out. Raises ValueError exactly as :func:`pair_time` does.
+
+    ``words`` count ELEMENTS, wire-dtype independent (the pre-PR-15
+    unit, kept so counted/modeled history stays comparable);
+    :func:`pair_bytes` is the dtype-aware volume."""
     return _pair_words_hops(alg, M, N, R, nnz, p, c)[0]
+
+
+def _discountable_terms(
+    alg: str, M: int, N: int, R: int, nnz: int, p: int, c: int,
+) -> list[tuple[str, float]]:
+    """``(wire role, words)`` for every FLOAT-element term of one model
+    — the payloads a reduced-precision wire policy could shrink, tagged
+    with the role that decides whether it does. Assembled from the SAME
+    ``_*_components`` helpers the words models use, so the two views
+    cannot drift apart. Integer index traffic (sparse-shift's traveling
+    rows/cols, 2/3 of its ring term) is deliberately absent: indices
+    never cast, so no policy discounts them. Cannon's rotating-OUTPUT
+    share of the ring and every model's reduce term carry accumulator
+    roles (``ring_accum``/``reduce``) that the default bf16 policy
+    keeps at f32 — the discount only applies where the policy can
+    realize it."""
+    if alg in ("15d_fusion2", "15d_fusion1", "15d_unfused"):
+        n_pass = 1 if alg == "15d_fusion2" else 2
+        n_repl = 2 if alg == "15d_unfused" else 1
+        replicate, ring = _dense_shift_components(
+            M, N, R, p, c, n_pass, n_repl)
+        return [("gather", replicate), ("ring", ring)]
+    if alg == "15d_sparse":
+        replicate, _ring, ring_vals = _sparse_shift_components(
+            M, N, R, nnz, p, c, n_pass=1)
+        return [("gather", replicate), ("ring", ring_vals)]
+    if alg == "25d_dense":
+        block_a, block_b, steps, replicate, reduce_out = \
+            _cannon_dense_components(M, N, R, p, c)
+        return [
+            # The rotating OUTPUT (block_a side) is a reduction in
+            # flight; only the read-only input blocks ride at the ring
+            # role's dtype.
+            ("ring", steps * block_b),
+            ("ring_accum", steps * block_a),
+            ("reduce", reduce_out),
+            ("gather", replicate),
+        ]
+    if alg == "25d_sparse":
+        block_a, block_b, steps, reduce_out = \
+            _cannon_sparse_components(M, N, R, nnz, p, c)
+        return [
+            ("ring", steps * block_b),
+            ("ring_accum", steps * block_a),
+            ("reduce", reduce_out),
+        ]
+    raise ValueError(f"unknown model {alg!r}")
+
+
+def pair_bytes(
+    alg: str, M: int, N: int, R: int, nnz: int, p: int, c: int,
+    wire=None,
+) -> float:
+    """Modeled per-device communication BYTES for one fused pair under
+    a wire-precision policy (``parallel/wire.py``; None / ``"f32"`` =
+    the identity wire).
+
+    Computed as ``4 * pair_words`` minus each float term's realized
+    discount, so the f32 policy is EXACTLY four bytes per word (no
+    re-summation drift) and a policy only earns the discount on
+    payloads it can actually shrink — sparse-shift's integer index
+    traffic and (under the default bf16 policy) the traveling
+    accumulators and reduce-scatter stay at 4 B/element."""
+    from distributed_sddmm_tpu.parallel.wire import wire_policy
+
+    policy = wire_policy(wire if wire is not None else "f32")
+    total = 4.0 * _pair_words_hops(alg, M, N, R, nnz, p, c)[0]
+    for role, words in _discountable_terms(alg, M, N, R, nnz, p, c):
+        total -= words * (4 - policy.bytes_for(role))
+    return total
 
 
 def _pair_words_hops(alg, M, N, R, nnz, p, c) -> tuple[float, float]:
@@ -200,14 +313,25 @@ def _pair_words_hops(alg, M, N, R, nnz, p, c) -> tuple[float, float]:
 def pair_time(
     alg: str, M: int, N: int, R: int, nnz: int, p: int, c: int,
     machine: Machine = Machine(),
+    wire=None,
 ) -> float:
     """Modeled seconds for one fused SDDMM+SpMM pair on p chips at
     replication c. ``alg`` in {15d_fusion1, 15d_fusion2, 15d_unfused,
     15d_sparse, 25d_dense, 25d_sparse}. Raises ValueError for (p, c)
     combinations the named algorithm cannot run (non-divisor c, non-square
     p/c) — callers enumerating c filter on that, exactly as the strategy
-    constructors do."""
+    constructors do.
+
+    ``wire`` (a policy or dtype name, ``parallel/wire.py``) prices the
+    volume term in realized bytes: the bf16 discount shifts the
+    1.5D↔2.5D crossover and the optimal c, which is exactly what the
+    autotune ``comm_dtype`` axis ranks on. None keeps the historical
+    f32-words pricing bit-for-bit."""
     words, hops = _pair_words_hops(alg, M, N, R, nnz, p, c)
+    if wire is not None:
+        # ici_words_per_s is calibrated in f32 words (4 B); bytes/4
+        # re-expresses the dtype-aware volume in that unit exactly.
+        words = pair_bytes(alg, M, N, R, nnz, p, c, wire=wire) / 4.0
     compute = 4.0 * nnz * R / p / machine.flops_rate
     return words / machine.ici_words_per_s + hops * machine.alpha_s + compute
 

@@ -341,7 +341,8 @@ def aggregate(trace: dict) -> dict:
         a = sp["attrs"]
         ph = phases.setdefault(sp["name"], {
             "calls": 0, "total_s": 0.0, "kernel_s": 0.0, "overhead_s": 0.0,
-            "retries": 0, "comm_words": 0.0, "flops": 0.0, "pairs": 0.0,
+            "retries": 0, "comm_words": 0.0, "comm_bytes": 0.0,
+            "flops": 0.0, "pairs": 0.0,
         })
         ph["calls"] += 1
         ph["total_s"] += sp["dur_s"]
@@ -349,6 +350,10 @@ def aggregate(trace: dict) -> dict:
         ph["overhead_s"] += a.get("overhead_s", 0.0)
         ph["retries"] += a.get("retries", 0)
         ph["comm_words"] += a.get("comm_words", 0.0)
+        # Wire-dtype-aware volume (PR 15); pre-PR-15 traces lack the
+        # attr and aggregate to 0 (the dispatch spans that carry words
+        # always carry bytes from PR 15 on).
+        ph["comm_bytes"] += a.get("comm_bytes", 0.0)
         ph["flops"] += a.get("flops", 0.0)
         ph["pairs"] += a.get("pairs", 0.0) * (
             obs_metrics.OP_PAIRS.get(sp["name"], 0.0)

@@ -203,11 +203,18 @@ def retune(
 
     cands = cand_mod.enumerate_candidates(problem, p, kernels)
     if hot_swappable and incumbent is not None:
+        # Same wire policy too: a wire change alters numerics (bf16
+        # rounding), so a wire-changed challenger can never clear the
+        # bit-identical shadow compare — measuring it here is budget
+        # burned on an unpromotable candidate. Like an algorithm/c
+        # change, a wire change belongs to the next replica via the
+        # plan cache.
         cands = [
             cand for cand in cands
             if cand.algorithm == incumbent.algorithm
             and cand.c == incumbent.c
             and cand.kernel == incumbent.kernel
+            and cand.wire == incumbent.wire
         ]
     if not cands:
         return None
@@ -255,6 +262,7 @@ def retune(
         algorithm=best_cand.algorithm, c=best_cand.c,
         kernel=best_cand.kernel, block=best_cand.block,
         gather_budget=best_cand.gather_budget, variant=best_cand.variant,
+        wire=best_cand.wire,
         source="tuned",
         predicted_ms=cand_mod.model_cost(problem, best_cand, p) * 1e3,
         measured_gflops=best_g,

@@ -155,6 +155,12 @@ KNOBS: dict[str, Knob] = {k.name: k for k in [
        "padded-lane trials), auto (wall on TPU else counted)"),
     _K("DSDDMM_WATCHDOG", "str", "off",
        "in-run anomaly monitor: warn or strict"),
+    _K("DSDDMM_WIRE", "str", "f32",
+       "default wire-precision comm dtype (f32|bf16) for strategies "
+       "built without an explicit wire= (parallel/wire.py)"),
+    _K("DSDDMM_WIRE_OVERRIDES", "spec", "unset",
+       "per-role wire-dtype overrides, e.g. reduce=bf16,ring=f32 "
+       "(roles: gather|ring|ring_accum|reduce)"),
     _K("DSDDMM_XLA_GATHER_BUDGET", "int", "536870912",
        "HBM gather budget that routes oversize problems onto the "
        "chunked XLA kernel"),

@@ -132,6 +132,20 @@ CASES = {
                    "def f(x):\n"
                    "    return x + time.time()  # trace-impure-ok\n"),
     },
+    "raw-collective": {
+        "path": f"{PKG}/parallel/x.py",
+        "clean": ("from distributed_sddmm_tpu.parallel.loops import "
+                  "abl_ppermute\n"
+                  "def f(x, perm):\n"
+                  "    return abl_ppermute(x, 'rows', perm, wire='bf16')\n"),
+        "bad": ("from jax import lax\n"
+                "def f(x, perm):\n"
+                "    return lax.ppermute(x, 'rows', perm)\n"),
+        "tagged": ("from jax import lax\n"
+                   "def f(x, perm):\n"
+                   "    return lax.ppermute(x, 'rows', perm)"
+                   "  # raw-collective-ok\n"),
+    },
 }
 
 
@@ -413,7 +427,7 @@ def test_registry_covers_the_six_disciplines():
     assert set(analysis.CHECKERS) == {
         "bare-print", "monotonic-clock", "export-completeness",
         "atomic-write", "env-knob", "lock-discipline", "key-grammar",
-        "trace-purity",
+        "trace-purity", "raw-collective",
     }
 
 

@@ -130,3 +130,97 @@ def test_machine_scaling_sanity():
     laggy = Machine(alpha_s=1e-3)
     c_laggy = optimal_c("15d_fusion2", M, N, 128, NNZ, P, laggy)
     assert c_laggy >= c_slow
+
+
+# --------------------------------------------------------------------- #
+# Wire-precision byte pricing (PR 15)
+# --------------------------------------------------------------------- #
+
+ALL_MODELS = ("15d_fusion2", "15d_fusion1", "15d_unfused", "15d_sparse",
+              "25d_dense", "25d_sparse")
+
+
+def _legal_c(alg, p):
+    import math
+
+    out = []
+    for c in range(1, p + 1):
+        if p % c:
+            continue
+        if alg.startswith("25d"):
+            s = math.isqrt(p // c)
+            if s * s * c != p:
+                continue
+        out.append(c)
+    return out
+
+
+def test_pair_bytes_f32_is_exactly_four_bytes_per_word():
+    from distributed_sddmm_tpu.tools.costmodel import pair_bytes, pair_words
+
+    for alg in ALL_MODELS:
+        for c in _legal_c(alg, P):
+            w = pair_words(alg, M, N, 128, NNZ, P, c)
+            for wire in (None, "f32"):
+                assert pair_bytes(alg, M, N, 128, NNZ, P, c, wire=wire) \
+                    == 4.0 * w, (alg, c, wire)
+
+
+def test_pair_bytes_bf16_discounts_only_realizable_payloads():
+    from distributed_sddmm_tpu.tools.costmodel import pair_bytes, pair_words
+
+    for c in (2, 4):
+        w = pair_words("15d_fusion2", M, N, 128, NNZ, P, c)
+        # Dense-shift in-model terms are all gather/ring: full halving.
+        assert pair_bytes("15d_fusion2", M, N, 128, NNZ, P, c,
+                          wire="bf16") == pytest.approx(2.0 * w)
+        # Sparse-shift: 2/3 of the ring term is int32 indices — the
+        # discount applies to the replicate and the value third only.
+        ws = pair_words("15d_sparse", M, N, 128, NNZ, P, c)
+        b = pair_bytes("15d_sparse", M, N, 128, NNZ, P, c, wire="bf16")
+        assert 2.0 * ws < b < 4.0 * ws
+        repl = (c - 1) / c * (N * 128 * c / P)
+        ring_vals = (P / c - 1) * (NNZ / P)
+        assert b == pytest.approx(4.0 * ws - 2 * repl - 2 * ring_vals)
+    # The 2.5D models keep their accumulator legs (rotating output,
+    # fiber reduce) at 4 B: strictly between half and full price.
+    for alg in ("25d_dense", "25d_sparse"):
+        for c in _legal_c(alg, P):
+            if c == P:
+                continue
+            w = pair_words(alg, M, N, 128, NNZ, P, c)
+            b = pair_bytes(alg, M, N, 128, NNZ, P, c, wire="bf16")
+            assert 2.0 * w < b < 4.0 * w, (alg, c)
+
+
+def test_pair_bytes_override_reaches_the_reduce_leg():
+    from distributed_sddmm_tpu.parallel.wire import WirePolicy
+    from distributed_sddmm_tpu.tools.costmodel import pair_bytes
+
+    default = pair_bytes("25d_dense", M, N, 128, NNZ, P, 4, wire="bf16")
+    pushed = pair_bytes(
+        "25d_dense", M, N, 128, NNZ, P, 4,
+        wire=WirePolicy("bf16", (("reduce", "bf16"),
+                                 ("ring_accum", "bf16"))),
+    )
+    assert pushed < default
+
+
+def test_pair_time_wire_none_matches_historical_and_bf16_shifts_c():
+    from distributed_sddmm_tpu.tools.costmodel import pair_time
+
+    for alg in ("15d_fusion2", "15d_sparse"):
+        for c in (1, 2, 8):
+            base = pair_time(alg, M, N, 128, NNZ, P, c)
+            assert pair_time(alg, M, N, 128, NNZ, P, c, wire="f32") == base
+            assert pair_time(alg, M, N, 128, NNZ, P, c, wire="bf16") < base
+    # Halving collective bytes changes where the replication tradeoff
+    # lands: the modeled volume term shrinks relative to alpha/compute,
+    # so the bf16 optimum never wants MORE replication than f32 (fewer
+    # bytes to avoid), and on the headline shape it genuinely moves.
+    times_f32 = {c: pair_time("15d_fusion2", M, N, 512, NNZ, P, c)
+                 for c in _legal_c("15d_fusion2", P)}
+    times_b16 = {c: pair_time("15d_fusion2", M, N, 512, NNZ, P, c,
+                              wire="bf16")
+                 for c in _legal_c("15d_fusion2", P)}
+    assert min(times_b16.values()) < min(times_f32.values())

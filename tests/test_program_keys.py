@@ -66,6 +66,36 @@ def test_serve_key_variant_segment():
     assert "variant" not in keys.parse_serve_key(base)
 
 
+def test_serve_key_wire_segment():
+    """The PR 15 ``w<dtype>`` segment: a ladder compiled over bf16-wire
+    strategy programs must never answer for the f32 wire — and the f32/
+    None wire appends NOTHING, so default keys (and every pre-PR-15
+    store entry) stay byte-identical."""
+    base = keys.serve_program_key("als", 4, 8, 16, "cpu", code="c",
+                                  params="k10-l0.1", sig="s",
+                                  variant="v1.rb32.rm")
+    for identity in (None, "f32"):
+        assert keys.serve_program_key(
+            "als", 4, 8, 16, "cpu", code="c", params="k10-l0.1", sig="s",
+            variant="v1.rb32.rm", wire=identity,
+        ) == base
+    wired = keys.serve_program_key("als", 4, 8, 16, "cpu", code="c",
+                                   params="k10-l0.1", sig="s",
+                                   variant="v1.rb32.rm", wire="bf16")
+    assert wired == base + ":wbf16"
+    parsed = keys.parse_serve_key(wired)
+    assert parsed["wire"] == "bf16"
+    assert parsed["variant"] == "v1.rb32.rm"
+    assert keys.parse_key(wired) == parsed
+    assert "wire" not in keys.parse_serve_key(base)
+    # Full grammar (params + sig + variant + wire + dist) still parses.
+    full = keys.serve_program_key("als", 4, 8, 16, "cpu", code="c",
+                                  params="p", sig="s", variant="v",
+                                  wire="bf16", dist="d2.p1")
+    parsed = keys.parse_serve_key(full)
+    assert parsed["wire"] == "bf16" and parsed["num_processes"] == 2
+
+
 def test_serve_key_separates_baked_workload_constants():
     """Two fold-in configurations differing only in trace-time constants
     (top-k size, ridge) must produce distinct keys — the constants are

@@ -1,0 +1,449 @@
+"""Wire-precision layer (PR 15): policy semantics, the f32 bit-identity
+contract, bf16 determinism + oracle accuracy, byte accounting (incl.
+the rectangular B-mode swap and zero-nnz shards), key isolation, and
+the autotune comm_dtype axis.
+
+The two contracts everything hangs on:
+
+* the f32 default is BIT-IDENTICAL to pre-wire behavior — no casts
+  traced, program cache keys unchanged (old store entries keep
+  hitting), outputs byte-equal;
+* bf16 wire is deterministic (replay-stable — the tuner's bitwise
+  shadow-compare survives) with always-f32 accumulation, pinned
+  against the float64 oracle under a normalized-error bound.
+"""
+
+import numpy as np
+import pytest
+
+from distributed_sddmm_tpu.common import KernelMode, MatMode
+from distributed_sddmm_tpu.parallel import wire as wire_mod
+from distributed_sddmm_tpu.parallel.cannon_dense_25d import CannonDense25D
+from distributed_sddmm_tpu.parallel.cannon_sparse_25d import CannonSparse25D
+from distributed_sddmm_tpu.parallel.dense_shift_15d import DenseShift15D
+from distributed_sddmm_tpu.parallel.sparse_shift_15d import SparseShift15D
+from distributed_sddmm_tpu.parallel.wire import BF16, F32, WirePolicy, wire_policy
+from distributed_sddmm_tpu.utils import oracle
+from distributed_sddmm_tpu.utils.coo import HostCOO
+
+#: Documented accuracy bound for the default bf16 policy: normalized
+#: L2 error vs the float64 oracle of a fused pair (one rounding per
+#: read-only payload; all accumulation f32). WIRE_HLO.json banks
+#: ~2e-3 on the headline shape.
+BF16_REL_ERR_BOUND = 2e-2
+
+STRATEGIES = (DenseShift15D, SparseShift15D, CannonDense25D, CannonSparse25D)
+
+
+def _small_S(M=48, N=40):
+    return HostCOO.erdos_renyi(M, N, 4, seed=2, values="normal")
+
+
+def _fused_host(cls, S, wire, R=16, c=2, **kw):
+    alg = cls(S, R=R, c=c, wire=wire, **kw)
+    rng = np.random.default_rng(0)
+    Ah = rng.normal(size=(S.M, R)).astype(np.float32)
+    Bh = rng.normal(size=(S.N, R)).astype(np.float32)
+    A, B = alg.put_a(Ah), alg.put_b(Bh)
+    vals = alg.scatter_s_values(S.vals.astype(np.float32))
+    A, B = alg.initial_shift(A, B, KernelMode.SDDMM_A)
+    out, mid = alg.fused_spmm(A, B, vals)
+    out, _ = alg.de_shift(out, B, KernelMode.SPMM_A)
+    return alg.host_a(out), alg.gather_s_values(mid), alg, (Ah, Bh)
+
+
+# --------------------------------------------------------------------- #
+# WirePolicy semantics (no mesh needed)
+# --------------------------------------------------------------------- #
+
+
+def test_policy_role_resolution_and_f32_accumulation_default():
+    assert F32.realized() == {r: "f32" for r in wire_mod.ROLES}
+    assert BF16.realized() == {
+        "gather": "bf16", "ring": "bf16",
+        "ring_accum": "f32", "reduce": "f32",
+    }
+    pushed = WirePolicy("bf16", (("reduce", "bf16"),))
+    assert pushed.dtype_for("reduce") == "bf16"
+    assert pushed.dtype_for("ring_accum") == "f32"
+    assert BF16.bytes_for("gather") == 2 and BF16.bytes_for("reduce") == 4
+
+
+def test_policy_key_segments():
+    # Identity policy: EMPTY segment — pre-PR-15 keys byte-identical.
+    assert F32.key_segment() == ""
+    assert WirePolicy("f32").key_segment() == ""
+    assert BF16.key_segment() == "wbf16"
+    # Overrides that differ from the comm_dtype's default map show up;
+    # redundant overrides do not fork the key.
+    assert WirePolicy("bf16", (("ring_accum", "f32"),)).key_segment() \
+        == "wbf16"
+    seg = WirePolicy("bf16", (("reduce", "bf16"),)).key_segment()
+    assert seg == "wbf16.reduce=bf16"
+
+
+def test_policy_normalization_and_errors(monkeypatch):
+    assert wire_policy(BF16) is BF16
+    assert wire_policy("bf16") == BF16
+    monkeypatch.delenv("DSDDMM_WIRE", raising=False)
+    monkeypatch.delenv("DSDDMM_WIRE_OVERRIDES", raising=False)
+    assert wire_policy(None) == F32
+    monkeypatch.setenv("DSDDMM_WIRE", "bf16")
+    monkeypatch.setenv("DSDDMM_WIRE_OVERRIDES", "reduce=bf16")
+    env = wire_policy(None)
+    assert env.comm_dtype == "bf16" and env.dtype_for("reduce") == "bf16"
+    with pytest.raises(ValueError):
+        WirePolicy("fp8")
+    with pytest.raises(ValueError):
+        WirePolicy("bf16", (("warp", "bf16"),))
+    with pytest.raises(TypeError):
+        wire_policy(16)
+
+
+def test_policy_names():
+    assert F32.name == "f32" and BF16.name == "bf16"
+    assert WirePolicy("bf16", (("gather", "f32"), ("ring", "f32"))).name \
+        == "f32"  # fully overridden back to identity
+
+
+def test_policy_label_distinguishes_overrides():
+    # The LABEL (records, serve keys, gate axes) must keep numerically
+    # different policies apart — .name collapses overrides by design
+    # (display only) and must not reach any key or baseline axis.
+    assert F32.label == "f32" and BF16.label == "bf16"
+    pushed = WirePolicy("bf16", (("reduce", "bf16"),))
+    assert pushed.name == BF16.name  # coarse display collapses...
+    assert pushed.label != BF16.label  # ...the identity does not
+    assert pushed.label == "bf16.reduce=bf16"
+    # And it flows into serve keys: two different bf16 policies give
+    # two different w-segments.
+    from distributed_sddmm_tpu.programs import keys
+
+    k_a = keys.serve_program_key("als", 4, 8, 16, "cpu", code="c",
+                                 wire=BF16.label)
+    k_b = keys.serve_program_key("als", 4, 8, 16, "cpu", code="c",
+                                 wire=pushed.label)
+    assert k_a != k_b
+
+
+# --------------------------------------------------------------------- #
+# f32 default: bit-identical, key-stable, cast-free
+# --------------------------------------------------------------------- #
+
+
+def test_f32_default_bit_identical_all_kernel_modes_and_attention():
+    S = _small_S()
+    rng = np.random.default_rng(1)
+    Ah = rng.normal(size=(S.M, 16)).astype(np.float32)
+    Bh = rng.normal(size=(S.N, 16)).astype(np.float32)
+
+    def all_ops(alg):
+        A, B = alg.put_a(Ah), alg.put_b(Bh)
+        vals = alg.like_s_values(1.0)
+        st_vals = alg.like_st_values(1.0)
+        out = [
+            np.asarray(alg.sddmm_a(A, B, vals)),
+            np.asarray(alg.sddmm_b(A, B, st_vals)),
+            np.asarray(alg.spmm_a(A, B, vals)),
+            np.asarray(alg.spmm_b(A, B, st_vals)),
+            np.asarray(alg.fused_spmm(A, B, vals)[0]),
+        ]
+        out.append(np.asarray(alg.fused_attention(A, B, vals)[0]))
+        return out
+
+    default = all_ops(DenseShift15D(S, R=16, c=2))
+    explicit = all_ops(DenseShift15D(S, R=16, c=2, wire="f32"))
+    for d, e in zip(default, explicit):
+        assert np.array_equal(d, e)
+
+
+def test_f32_default_keys_unchanged_and_no_bf16_traced():
+    S = _small_S()
+    alg = DenseShift15D(S, R=16, c=2)
+    # The pre-PR-15 key shape, byte for byte: no wire segment at all —
+    # every existing ProgramStore entry keeps resolving.
+    assert alg._program_cache_key("fused", False) == \
+        ("fused", False, "full", "seq")
+    b16 = DenseShift15D(S, R=16, c=2, wire="bf16")
+    assert b16._program_cache_key("fused", False) == \
+        ("fused", False, "full", "wbf16", "seq")
+    # Structural half of bit-identity: the default trace contains no
+    # bfloat16 anywhere (no boundary casts were emitted).
+    import jax
+
+    vals = alg.like_s_values(1.0)
+    A = alg.dummy_initialize(MatMode.A)
+    B = alg.dummy_initialize(MatMode.B)
+    jaxpr = jax.make_jaxpr(
+        lambda a, b, v: alg._program("fused", False)(
+            a, b, *alg._tile_args(alg.S_tiles, v))
+    )(A, B, vals)
+    assert "bf16" not in str(jaxpr)
+    jaxpr_b = jax.make_jaxpr(
+        lambda a, b, v: b16._program("fused", False)(
+            a, b, *b16._tile_args(b16.S_tiles, v))
+    )(A, B, vals)
+    assert "bf16" in str(jaxpr_b)
+
+
+def test_f32_default_bit_identical_als():
+    from distributed_sddmm_tpu.models.als import DistributedALS
+
+    S = _small_S()
+
+    def run(wire):
+        alg = SparseShift15D(S, R=16, c=2, wire=wire)
+        als = DistributedALS(alg, S_host=S)
+        als.initialize_embeddings()
+        als.run_cg(1, cg_iters=2)
+        return np.asarray(als.A), np.asarray(als.B)
+
+    A0, B0 = run(None)
+    A1, B1 = run("f32")
+    assert np.array_equal(A0, A1) and np.array_equal(B0, B1)
+
+
+# --------------------------------------------------------------------- #
+# bf16 wire: determinism + oracle accuracy, all four strategies
+# --------------------------------------------------------------------- #
+
+
+@pytest.mark.parametrize("cls", STRATEGIES)
+def test_bf16_deterministic_and_oracle_pinned(cls):
+    S = _small_S()
+    out1, mid1, _, (Ah, Bh) = _fused_host(cls, S, "bf16")
+    out2, mid2, _, _ = _fused_host(cls, S, "bf16")
+    # Replay-stable: two FRESH builds agree bitwise (the tuner's
+    # shadow-compare contract under a bf16 wire).
+    assert np.array_equal(out1, out2) and np.array_equal(mid1, mid2)
+    ref = oracle.fused_spmm_a(S, Ah.astype(np.float64),
+                              Bh.astype(np.float64))
+    err = np.linalg.norm(out1[: S.M] - ref) / np.linalg.norm(ref)
+    assert err < BF16_REL_ERR_BOUND, (cls.__name__, err)
+    # And the f32 wire of the same strategy is much tighter — the bf16
+    # error is the wire's, not the strategy's.
+    out_f, _, _, _ = _fused_host(cls, S, "f32")
+    err_f = np.linalg.norm(out_f[: S.M] - ref) / np.linalg.norm(ref)
+    assert err_f < 1e-5, (cls.__name__, err_f)
+
+
+def test_bf16_attention_stays_close_and_fully_masked_rows_zero():
+    S = _small_S()
+    alg_f = DenseShift15D(S, R=16, c=2, wire="f32")
+    alg_b = DenseShift15D(S, R=16, c=2, wire="bf16")
+    outs = {}
+    for name, alg in (("f32", alg_f), ("bf16", alg_b)):
+        A = alg.dummy_initialize(MatMode.A)
+        B = alg.dummy_initialize(MatMode.B)
+        out, probs = alg.fused_attention(A, B, alg.like_s_values(1.0))
+        outs[name] = np.asarray(out, dtype=np.float64)
+        assert np.all(np.isfinite(np.asarray(probs)))
+    err = (np.linalg.norm(outs["bf16"] - outs["f32"])
+           / np.linalg.norm(outs["f32"]))
+    assert err < BF16_REL_ERR_BOUND
+
+
+def test_bf16_overlap_and_rolled_builds_bit_identical():
+    # The overlap fusion's contract — every build consumes exactly the
+    # buffers the sequential loop would — must survive the boundary
+    # casts: same hop, same cast chain, only the issue position moves.
+    S = _small_S()
+    outs = []
+    for overlap in (False, True):
+        for unroll in (True, False):
+            alg = DenseShift15D(S, R=16, c=2, wire="bf16",
+                                overlap=overlap, unroll=unroll)
+            A = alg.dummy_initialize(MatMode.A)
+            B = alg.dummy_initialize(MatMode.B)
+            out, mid = alg.fused_spmm(A, B, alg.like_s_values(1.0))
+            outs.append((np.asarray(out), np.asarray(mid)))
+    for out, mid in outs[1:]:
+        assert np.array_equal(out, outs[0][0])
+        assert np.array_equal(mid, outs[0][1])
+
+
+def test_bf16_zero_nnz_shards():
+    # Every nonzero in the first two rows: most block-row tiles hold 0
+    # nnz — the casts must not manufacture NaNs on all-padding shards.
+    rows = np.array([0, 0, 1, 1], dtype=np.int64)
+    cols = np.array([0, 3, 1, 5], dtype=np.int64)
+    vals = np.array([1.0, 2.0, 3.0, 4.0])
+    S = HostCOO(rows, cols, vals, M=48, N=40)
+    for cls in (DenseShift15D, SparseShift15D):
+        out, _, _, (Ah, Bh) = _fused_host(cls, S, "bf16")
+        assert np.all(np.isfinite(out))
+        ref = oracle.fused_spmm_a(S, Ah.astype(np.float64),
+                                  Bh.astype(np.float64))
+        err = np.linalg.norm(out[: S.M] - ref) / np.linalg.norm(ref)
+        assert err < BF16_REL_ERR_BOUND, (cls.__name__, err)
+
+
+# --------------------------------------------------------------------- #
+# Byte accounting: counted metrics, B-mode swap, Prometheus
+# --------------------------------------------------------------------- #
+
+
+def test_counted_bytes_f32_is_4x_words_and_bf16_halves_dense_shift():
+    S = _small_S()
+    for wire, width in (("f32", 4.0), ("bf16", 2.0)):
+        out, _, alg, _ = _fused_host(DenseShift15D, S, wire)
+        m = alg.metrics.to_dict()["fusedSpMM"]
+        assert m["comm_bytes"] == pytest.approx(width * m["comm_words"])
+        assert m["comm_words"] > 0
+
+
+def test_counted_words_are_wire_independent():
+    # comm_words keeps its pre-PR-15 element-count meaning, so gate
+    # history compares across the wire change; only bytes move.
+    S = _small_S()
+    per_wire = {}
+    for wire in ("f32", "bf16"):
+        _, _, alg, _ = _fused_host(SparseShift15D, S, wire)
+        m = {}
+        for op in ("sddmmA", "spmmA"):
+            m[op] = alg.metrics.to_dict()[op]
+        per_wire[wire] = m
+    for op in ("sddmmA", "spmmA"):
+        f, b = per_wire["f32"][op], per_wire["bf16"][op]
+        assert f["comm_words"] == b["comm_words"]
+        assert b["comm_bytes"] < f["comm_bytes"]
+
+
+def test_b_mode_rectangular_byte_accounting():
+    # Rectangular matrix: the B-mode profile swaps stationary/moving
+    # row counts (the transposed-layout _comm_op aliases from PR 3) and
+    # the swap must carry into the byte column at each role's width.
+    S = _small_S(M=48, N=24)
+    for wire, gather_w, ring_w in (("f32", 4, 4), ("bf16", 2, 2)):
+        alg = DenseShift15D(S, R=16, c=2, wire=wire)
+        prof = {op: alg.comm_profile(op)
+                for op in ("fusedSpMM", "fusedSpMMB")}
+        for op, entries in prof.items():
+            by = {e["collective"]: e for e in entries}
+            assert by["all_gather"]["bytes"] == \
+                by["all_gather"]["words"] * gather_w
+            assert by["ppermute"]["bytes"] == \
+                by["ppermute"]["words"] * ring_w
+            # The reduce-scatter stays f32 under the default policies.
+            assert by["psum_scatter"]["bytes"] == \
+                by["psum_scatter"]["words"] * 4
+        a_prof = dict((e["collective"], e) for e in prof["fusedSpMM"])
+        b_prof = dict((e["collective"], e) for e in prof["fusedSpMMB"])
+        # M != N: A-mode gathers the A-side frame (localArows=6) while
+        # B rides the ring (localBrows=3); B-mode swaps them exactly.
+        nr, R, c = 4, 16, 2
+        la, lb = 6, 3  # ceil(48/8), ceil(24/8)
+        assert a_prof["all_gather"]["words"] == (c - 1) * la * R
+        assert a_prof["ppermute"]["words"] == (nr - 1) * lb * R
+        assert b_prof["all_gather"]["words"] == (c - 1) * lb * R
+        assert b_prof["ppermute"]["words"] == (nr - 1) * la * R
+
+
+def test_comm_bytes_on_metrics_surface():
+    from distributed_sddmm_tpu.obs.httpexp import AdminServer
+    from distributed_sddmm_tpu.obs.metrics import OpMetrics
+
+    om = OpMetrics()
+    om.record("fusedSpMM", 0.1, comm_words=100.0, comm_bytes=200.0)
+    text = AdminServer(op_metrics=om).metrics_text()
+    assert 'dsddmm_op_comm_bytes_total{op="fusedSpMM"} 200' in text
+
+
+def test_runstore_index_and_wire_axis():
+    from distributed_sddmm_tpu.obs.store import _axis_value, _index_row
+
+    doc = {"run_id": "r1", "record": {
+        "wire": "bf16",
+        "metrics": {"fusedSpMM": {"comm_bytes": 128.0, "calls": 2},
+                    "sddmmA": {"comm_bytes": 64.0, "calls": 1}},
+    }}
+    row = _index_row(doc)
+    assert row["wire"] == "bf16" and row["comm_bytes"] == 192.0
+    # Pre-PR-15 docs: no field anywhere -> None (not zero traffic).
+    old = _index_row({"run_id": "r0", "record": {
+        "metrics": {"fusedSpMM": {"comm_words": 9.0}}}})
+    assert old["wire"] is None and old["comm_bytes"] is None
+    # Axis normalization: absence == the f32 identity wire, so history
+    # keeps comparing; bf16 records never pool into it.
+    assert _axis_value(old, "wire") == "f32"
+    assert _axis_value(row, "wire") == "bf16"
+
+
+def test_gate_comm_bytes_axes_are_optional():
+    from distributed_sddmm_tpu.obs import regress
+
+    new = {"run_id": "b", "record": {"metrics": {
+        "fusedSpMM": {"calls": 4, "kernel_s": 0.4, "comm_words": 40.0,
+                      "comm_bytes": 80.0, "flops": 100.0},
+    }}}
+    old = {"run_id": "a", "record": {"metrics": {
+        "fusedSpMM": {"calls": 4, "kernel_s": 0.4, "comm_words": 40.0,
+                      "flops": 100.0},
+    }}}
+    # New-vs-old: the comm axis is new — informational, not a failure.
+    rep = regress.compare(new, old)
+    assert rep["phases"]["comm:fusedSpMM_bytes"]["verdict"] == "new"
+    assert rep["verdict"] != "regression"
+    # Old-vs-new baseline: absent comm axis reads "not-measured", and
+    # the overall verdict cannot regress on it.
+    rep = regress.compare(old, new)
+    assert rep["phases"]["comm:fusedSpMM_bytes"]["verdict"] == \
+        "not-measured"
+    assert rep["verdict"] != "regression"
+
+
+# --------------------------------------------------------------------- #
+# Autotune comm_dtype axis + plan/serve key isolation
+# --------------------------------------------------------------------- #
+
+
+def test_candidates_enumerate_wire_axis_for_f32_problems_only():
+    from distributed_sddmm_tpu.autotune import candidates as cand_mod
+    from distributed_sddmm_tpu.autotune.fingerprint import Problem
+
+    prob = Problem(M=1 << 12, N=1 << 12, nnz=1 << 16, R=128)
+    cands = cand_mod.enumerate_candidates(prob, 8)
+    wires = {c.wire for c in cands}
+    assert wires == {None, "bf16"}
+    base = [c for c in cands if c.wire is None]
+    twins = [c for c in cands if c.wire == "bf16"]
+    assert len(base) == len(twins)
+    # The bf16 twin is modeled strictly cheaper whenever communication
+    # exists (c > 1 or a ring), never more expensive.
+    for b, t in zip(base, twins):
+        assert cand_mod.model_cost(prob, t, 8) <= \
+            cand_mod.model_cost(prob, b, 8)
+    # Non-f32 problems cannot realize the cast: no bf16 twins at all.
+    prob16 = Problem(M=1 << 12, N=1 << 12, nnz=1 << 16, R=128,
+                     dtype="bfloat16")
+    assert {c.wire for c in cand_mod.enumerate_candidates(prob16, 8)} \
+        == {None}
+
+
+def test_plan_wire_roundtrip_and_instantiate():
+    from distributed_sddmm_tpu.autotune.plan import Plan
+
+    plan = Plan(algorithm="15d_fusion2", c=2, wire="bf16")
+    assert Plan.from_dict(plan.to_dict()).wire == "bf16"
+    assert plan.candidate().wire == "bf16"
+    # Pre-PR-15 cached dicts (no field) load as the identity wire.
+    d = plan.to_dict()
+    del d["wire"]
+    assert Plan.from_dict(d).wire is None
+    S = _small_S()
+    alg = plan.instantiate(S, R=16)
+    assert alg.wire.name == "bf16"
+    assert Plan.from_dict(d).instantiate(S, R=16).wire.name == "f32"
+
+
+def test_workload_wire_rides_into_serve_keys():
+    from distributed_sddmm_tpu.serve.workloads import _model_wire
+
+    S = _small_S()
+    assert _model_wire(DenseShift15D(S, R=16, c=2)) is None
+    assert _model_wire(DenseShift15D(S, R=16, c=2, wire="bf16")) == "bf16"
+
+    class FakeModel:
+        d_ops = DenseShift15D(S, R=16, c=2, wire="bf16")
+
+    assert _model_wire(FakeModel()) == "bf16"

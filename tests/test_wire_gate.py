@@ -1,0 +1,121 @@
+"""Structural HLO gate for the wire-precision layer (tier-1 acceptance,
+``test_multihost_gate.py`` style).
+
+The fused dense-shift pair, AOT-compiled for a REAL v5e topology under
+the bf16 wire policy, must carry bf16 element types on its
+``all-gather`` and ``collective-permute`` collectives while the
+``reduce-scatter`` stays f32 (always-f32 accumulation), and the f32
+module must carry no bf16 collective at all. Counted in-model
+``comm_bytes`` must drop to <= 0.55x under bf16 on the headline
+config, the bf16 run must match the float64 oracle within the
+documented bound, and must replay bitwise (tuner shadow-compare
+contract). The committed ``WIRE_HLO.json`` is the banked record.
+
+Subprocess + ``TPU_SKIP_MDS_QUERY=1`` for the same libtpu metadata
+reason as the other gates.
+"""
+
+import json
+import os
+import pathlib
+import subprocess
+import sys
+
+from distributed_sddmm_tpu.parallel.wire_hlo import scan_collective_dtypes
+
+REPO = pathlib.Path(__file__).resolve().parents[1]
+
+_PROBE = """
+import json, sys
+sys.path.insert(0, {repo!r})
+from distributed_sddmm_tpu.utils.platform import force_cpu_platform
+force_cpu_platform(n_devices=8, replace=True)
+from distributed_sddmm_tpu.parallel.wire_hlo import wire_hlo_report
+print("RESULT " + json.dumps(wire_hlo_report()))
+"""
+
+
+def test_wire_fused_pair_v5e_hlo_gate():
+    env = dict(os.environ)
+    env.update({
+        "TPU_SKIP_MDS_QUERY": "1",
+        "DSDDMM_PROGRAMS": "0",
+        "DSDDMM_RUNSTORE": "0",
+        "PYTHONPATH": str(REPO),
+    })
+    proc = subprocess.run(
+        [sys.executable, "-c", _PROBE.format(repo=str(REPO))],
+        capture_output=True, text=True, timeout=540, env=env,
+    )
+    assert proc.returncode == 0, proc.stdout[-2000:] + proc.stderr[-2000:]
+    line = [l for l in proc.stdout.splitlines() if l.startswith("RESULT ")]
+    assert line, proc.stdout[-2000:]
+    rec = json.loads(line[0][len("RESULT "):])
+    assert rec["topology"] == "v5e:2x4" and rec["is_scheduled"] is True
+    assert rec["unparsed_lines"] == 0, rec
+
+    # The acceptance bar: bf16 element types on the gather + ring
+    # collectives, f32 on the reduce-scatter, and a module-wide clean
+    # f32 story for the identity wire.
+    b16 = rec["collectives_bf16"]
+    assert b16["all-gather"]["dtypes"].get("bf16", 0) >= 1, b16
+    assert b16["collective-permute"]["dtypes"].get("bf16", 0) >= 1, b16
+    assert b16["reduce-scatter"]["dtypes"] == \
+        {"f32": b16["reduce-scatter"]["count"]}, b16
+    for op, entry in rec["collectives_f32"].items():
+        assert entry["dtypes"] == {"f32": entry["count"]}, (op, entry)
+
+    # Counted bytes: <= 0.55x on the headline dense-shift fused config
+    # (the in-model payloads are all gather/ring, so the realized ratio
+    # is exactly 0.5).
+    assert rec["bytes_ratio"] <= 0.55, rec["bytes_ratio"]
+    # Oracle + determinism: the documented bf16 accuracy bound and the
+    # replay-stability the tuner's bitwise shadow-compare relies on.
+    assert rec["oracle_rel_err_bf16"] <= 1e-2, rec
+    assert rec["oracle_rel_err_f32"] <= 1e-6, rec
+    assert rec["bf16_deterministic"] is True
+
+    # Matches the committed banked record on every structural field.
+    committed = json.loads((REPO / "WIRE_HLO.json").read_text())
+    for field in ("topology", "p", "c", "M", "nnz", "R",
+                  "collectives_f32", "collectives_bf16",
+                  "unparsed_lines", "bytes_ratio", "bf16_deterministic"):
+        assert rec[field] == committed[field], (field, rec, committed)
+
+
+# --------------------------------------------------------------------- #
+# The dtype scanner's own contract on synthetic HLO
+# --------------------------------------------------------------------- #
+
+_HLO = """\
+HloModule jit_prog, is_scheduled=true
+
+%body (arg: f32[8]) -> f32[8] {
+  %ag = bf16[8] all-gather(bf16[4] %x), replica_groups={{0,1}}, channel_id=1
+  %cps = (bf16[8], bf16[8]) collective-permute-start(bf16[8] %y), source_target_pairs={{0,1},{1,0}}
+  %cpd = bf16[8] collective-permute-done((bf16[8], bf16[8]) %cps)
+  %rs = f32[4] reduce-scatter(f32[8] %z), replica_groups={{0,1}}, dimensions={0}
+  ROOT %r = f32[8] add(%a, %b)
+}
+"""
+
+
+def test_scanner_reads_element_dtypes_and_counts_starts_once():
+    scan = scan_collective_dtypes(_HLO)
+    assert scan["per_op"]["all-gather"] == {
+        "count": 1, "dtypes": {"bf16": 1},
+    }
+    # -start counted once (the -done names no fresh collective); the
+    # tuple result's payload dtype is read.
+    assert scan["per_op"]["collective-permute"] == {
+        "count": 1, "dtypes": {"bf16": 1},
+    }
+    assert scan["per_op"]["reduce-scatter"] == {
+        "count": 1, "dtypes": {"f32": 1},
+    }
+    assert scan["unparsed_lines"] == 0
+
+
+def test_scanner_empty_hlo():
+    scan = scan_collective_dtypes("")
+    assert scan["per_op"] == {} and scan["unparsed_lines"] == 0
