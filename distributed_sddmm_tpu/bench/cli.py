@@ -490,6 +490,70 @@ def build_parser() -> argparse.ArgumentParser:
         "DSDDMM_TUNER_* knobs pace it)",
     )
     sv.add_argument("--no-runstore", action="store_true")
+    sv.add_argument(
+        "--serve-http", action="store_true",
+        help="replica mode: instead of generating load, accept requests "
+        "over the admin server's POST /submit until SIGTERM, then drain "
+        "and print the serving record as the last stdout line (the "
+        "fleet manager's replica contract; implies --admin-port 0 when "
+        "unset)",
+    )
+    sv.add_argument(
+        "--tenants", default=None, metavar="SPEC",
+        help="multi-tenant QoS classes 'name[:weight[:slo]];...' (e.g. "
+        "'premium:3:p99_ms=250;batch:1'): weighted-fair dequeue across "
+        "classes, per-tenant shed counters and burn-rate gate axes "
+        "(default DSDDMM_TENANTS)",
+    )
+
+    fl = sub.add_parser(
+        "fleet",
+        help="serving-fleet harness: spawn N `bench serve --serve-http` "
+        "replicas behind the front router (fleet/), drive an open-loop "
+        "HTTP load with a multi-tenant mix, optionally kill a replica "
+        "mid-load (--chaos kill-replica), and pin that replies stay "
+        "bit-identical to a single-engine oracle while availability "
+        "holds above --availability-floor; the record lands in the run "
+        "store with fleet:availability / per-tenant serve:burn_rate "
+        "gate axes",
+    )
+    fl.add_argument("--replicas", type=int, default=None, metavar="N",
+                    help="serve-role replica count (default "
+                    "DSDDMM_FLEET_REPLICAS or 2)")
+    fl.add_argument("--chaos", default="none",
+                    choices=["none", "kill-replica"],
+                    help="kill-replica: SIGKILL one replica at the load "
+                    "midpoint; the manager must respawn it warm (0 "
+                    "request-path compiles) and no reply may be lost or "
+                    "wrong")
+    fl.add_argument("--app", default="als", choices=["als", "gat"])
+    fl.add_argument("--log-m", type=int, default=6)
+    fl.add_argument("--edge-factor", type=int, default=4)
+    fl.add_argument("--R", type=int, default=8)
+    fl.add_argument("--k", type=int, default=5)
+    fl.add_argument("--train-steps", type=int, default=1)
+    fl.add_argument("--duration", type=float, default=6.0,
+                    metavar="SECONDS")
+    fl.add_argument("--rate", type=float, default=20.0, metavar="HZ")
+    fl.add_argument("--max-batch", type=int, default=4)
+    fl.add_argument("--max-depth", type=int, default=32)
+    fl.add_argument("--max-wait-ms", type=float, default=5.0)
+    fl.add_argument(
+        "--tenants", default="premium:3:p99_ms=2000;batch:1",
+        metavar="SPEC",
+        help="tenant mix for the generated load (same grammar as serve "
+        "--tenants)",
+    )
+    fl.add_argument("--slo", default=None, metavar="SPEC")
+    fl.add_argument("--availability-floor", type=float, default=0.95,
+                    help="minimum (answered + shed-with-retry)/offered "
+                    "fraction; below it the harness exits 3")
+    fl.add_argument("--seed", type=int, default=0)
+    fl.add_argument("--ready-timeout", type=float, default=300.0,
+                    metavar="SECONDS",
+                    help="warmup budget for the replica pool")
+    fl.add_argument("-o", "--output-file", default=None)
+    fl.add_argument("--no-runstore", action="store_true")
 
     tn = sub.add_parser(
         "tune",
@@ -782,7 +846,7 @@ def _dispatch_store(args) -> int:
 
 
 #: Subcommands that execute benchmarks and therefore feed the run store.
-_BENCH_CMDS = ("er", "file", "heatmap", "serve")
+_BENCH_CMDS = ("er", "file", "heatmap", "serve", "fleet")
 
 
 def main(argv=None) -> int:
@@ -1148,16 +1212,21 @@ def _dispatch_serve(args) -> int:
     from distributed_sddmm_tpu.resilience import faults
     from distributed_sddmm_tpu.serve import (
         SLOSpec, build_als_engine, build_attention_engine,
-        build_gat_engine, run_load,
+        build_gat_engine, parse_tenants, run_load, tenants_from_env,
     )
 
     S = HostCOO.rmat(log_m=args.log_m, edge_factor=args.edge_factor, seed=0)
     if args.app == "attention":
         S = _maybe_mask(S, args)
     slo = SLOSpec.parse(args.slo) if args.slo else SLOSpec.from_env()
+    tenants = (parse_tenants(args.tenants) if args.tenants
+               else tenants_from_env())
+    serve_http = bool(getattr(args, "serve_http", False))
+    if serve_http and args.admin_port is None:
+        args.admin_port = 0  # replica mode NEEDS the ingestion surface
     engine_kw = dict(
         max_batch=args.max_batch, max_depth=args.max_depth,
-        max_wait_ms=args.max_wait_ms,
+        max_wait_ms=args.max_wait_ms, tenants=tenants,
     )
     # XLA-cost cursor: warmup + serving programs resolved from here on
     # feed the record's analytic-vs-XLA cross-check.
@@ -1212,13 +1281,37 @@ def _dispatch_serve(args) -> int:
     if args.admin_port is not None:
         from distributed_sddmm_tpu.obs import httpexp
 
+        submit_fn = None
+        if serve_http:
+            def submit_fn(payload, tenant="default", serial=False,
+                          timeout_s=30.0):
+                # Wire decode is the workload's own clamp (np.asarray
+                # normalizes the JSON lists back to the exact dtypes),
+                # so an HTTP-submitted payload takes the IDENTICAL
+                # path an in-process one does — bit-identical replies.
+                if serial:
+                    return eng.workload.serial(eng.workload.clamp(payload))
+                req = eng.submit(payload, tenant=tenant)
+                # Reply accounting is the CLIENT's job (run_load does it
+                # in-process); over HTTP that client is this boundary —
+                # without it a replica's drained record reads 0 completed
+                # and the fleet's per-tenant burn axes go dark.
+                try:
+                    reply = req.result(timeout_s=timeout_s)
+                except Exception:
+                    eng.recorder.record_error(tenant)
+                    raise
+                eng.recorder.record_reply(req)
+                return reply
+
         admin = httpexp.AdminServer(
             engine=eng, op_metrics=d_ops.metrics, slo=slo,
-            port=args.admin_port,
+            port=args.admin_port, submit_fn=submit_fn,
         )
         admin.start()
         print(f"[admin] serving http://127.0.0.1:{admin.port} "
-              "(/metrics /healthz /readyz /debug/requests /snapshot)",
+              "(/metrics /healthz /readyz /debug/requests /snapshot"
+              + (" POST:/submit" if submit_fn else "") + ")",
               file=sys.stderr)
 
     # An armed flight recorder gets the engine's telemetry snapshot as
@@ -1250,10 +1343,14 @@ def _dispatch_serve(args) -> int:
             sampler.start()
             print(f"[telemetry] sampling to {sampler.path}",
                   file=sys.stderr)
-        summary = run_load(
-            eng, duration_s=args.duration, rate_hz=args.rate,
-            seed=args.seed, oracle_every=args.oracle_every, slo=slo,
-        )
+        if serve_http:
+            summary = _serve_until_signal(eng, slo, tenants)
+        else:
+            summary = run_load(
+                eng, duration_s=args.duration, rate_hz=args.rate,
+                seed=args.seed, oracle_every=args.oracle_every, slo=slo,
+                tenants=tenants,
+            )
     finally:
         if tuner is not None:
             tuner.stop()
@@ -1287,6 +1384,8 @@ def _dispatch_serve(args) -> int:
             "max_wait_ms": args.max_wait_ms,
             "batch_buckets": list(eng.batch_buckets),
             "inner_buckets": list(eng.workload.inner_buckets),
+            "tenants": args.tenants or os.environ.get("DSDDMM_TENANTS"),
+            "serve_http": serve_http,
         },
         **summary,
     }
@@ -1329,20 +1428,27 @@ def _dispatch_serve(args) -> int:
     if _watchdog is not None:
         record["anomalies"] = _watchdog.summary(since=_anomalies_before)
 
-    print(json.dumps({
-        "app": record["app"], "algorithm": record["algorithm"],
-        "requests": summary["requests"], "completed": summary["completed"],
-        "throughput_rps": summary["throughput_rps"],
-        "latency_ms": summary["latency_ms"],
-        "batch_occupancy": summary.get("batch_occupancy"),
-        "shed_count": summary["shed_count"],
-        "degraded_count": summary["degraded_count"],
-        "oracle_checked": summary["oracle_checked"],
-        "oracle_failures": summary["oracle_failures"],
-        "slo_violations": summary["slo_violations"],
-        "burn_rate": summary.get("burn_rate"),
-        "latency_hist_ms": summary.get("latency_hist_ms"),
-    }))
+    if serve_http:
+        # Replica contract (fleet/manager.py): the FULL record is the
+        # last stdout JSON line — the manager collects it at reap time.
+        print(json.dumps(record))
+    else:
+        print(json.dumps({
+            "app": record["app"], "algorithm": record["algorithm"],
+            "requests": summary["requests"],
+            "completed": summary["completed"],
+            "throughput_rps": summary["throughput_rps"],
+            "latency_ms": summary["latency_ms"],
+            "batch_occupancy": summary.get("batch_occupancy"),
+            "shed_count": summary["shed_count"],
+            "degraded_count": summary["degraded_count"],
+            "oracle_checked": summary["oracle_checked"],
+            "oracle_failures": summary["oracle_failures"],
+            "slo_violations": summary["slo_violations"],
+            "burn_rate": summary.get("burn_rate"),
+            "latency_hist_ms": summary.get("latency_hist_ms"),
+            "tenant": summary.get("tenant"),
+        }))
     if args.output_file:
         # non-atomic-ok: append-only record stream (the -o contract).
         with open(args.output_file, "a") as f:
@@ -1358,10 +1464,488 @@ def _dispatch_serve(args) -> int:
         except Exception as e:  # noqa: BLE001 — never fail the run
             print(f"[serve] runstore ingest failed: {e}", file=sys.stderr)
 
+    if serve_http:
+        # Replica mode: the record carries any violations; the fleet
+        # harness (not this process's exit code) judges them — a
+        # drained replica must read as a clean exit to its manager.
+        return 0
     if summary["oracle_failures"]:
         return 1
     if summary["slo_violations"]:
         return 2
+    return 0
+
+
+def _serve_until_signal(eng, slo, tenants) -> dict:
+    """Replica mode: park until SIGTERM/SIGINT, then drain the queue and
+    summarize — the serving half of the record comes entirely from the
+    recorder (there is no local load generator to measure throughput
+    against; requests arrived over POST /submit)."""
+    import signal
+    import threading
+
+    from distributed_sddmm_tpu.obs import clock
+    from distributed_sddmm_tpu.serve.slo import attach_tenant_slo
+
+    done = threading.Event()
+
+    def _handler(signum, frame):  # noqa: ARG001 — signal API
+        done.set()
+
+    old_term = signal.signal(signal.SIGTERM, _handler)
+    old_int = signal.signal(signal.SIGINT, _handler)
+    print("[serve] replica mode: accepting POST /submit until SIGTERM",
+          file=sys.stderr)
+    t0 = clock.now()
+    try:
+        done.wait()
+    finally:
+        signal.signal(signal.SIGTERM, old_term)
+        signal.signal(signal.SIGINT, old_int)
+    # Drain before summarizing: admission closes, queued requests
+    # finish and reach the recorder (the caller's eng.stop() is then a
+    # no-op on the already-joined runner).
+    eng.stop(drain=True)
+    elapsed = clock.now() - t0
+    summary = eng.recorder.summary()
+    completed = summary["completed"]
+    summary.update({
+        "duration_s": round(elapsed, 3),
+        "offered": eng.queue.submitted_count + summary["shed_count"],
+        "submitted": eng.queue.submitted_count,
+        "throughput_rps": round(completed / elapsed, 3)
+        if elapsed > 0 else 0.0,
+        "oracle_checked": 0,
+        "oracle_failures": 0,
+    })
+    summary["slo"] = slo.to_dict()
+    summary["slo_violations"] = slo.check(summary)
+    summary["burn_rate"] = slo.burn_rate(summary)
+    attach_tenant_slo(summary, tenants)
+    return summary
+
+
+def _dispatch_fleet(args) -> int:
+    """``bench fleet``: spawn N ``bench serve --serve-http`` replicas
+    behind the front router, drive an open-loop multi-tenant HTTP load,
+    optionally SIGKILL a replica at the load midpoint, and judge the
+    fleet the way the single-engine harness judges one engine:
+
+    * every 200 reply must be bit-identical (post-JSON) to the
+      single-engine oracle's ``execute_now`` answer for that payload;
+    * a killed replica's in-flight work must be re-admitted (router
+      failover) or shed WITH a Retry-After hint — never silently lost;
+    * the respawned replacement must warm-start from the shared
+      ProgramStore: 0 request-path live compiles;
+    * availability = (answered + shed-with-retry + client-deferred) /
+      offered must hold above ``--availability-floor``.
+
+    Exit 0 clean; 1 on a wrong/lost reply or a cold respawn; 3 on an
+    availability-floor breach. Sheds and failovers are expected
+    operating conditions, not failures.
+    """
+    import dataclasses
+    import threading
+    import time as _time
+
+    import numpy as np
+
+    from distributed_sddmm_tpu import programs as programs_mod
+    from distributed_sddmm_tpu.fleet import (
+        FleetManager, FleetRouter, ScalerConfig,
+    )
+    from distributed_sddmm_tpu.obs.httpexp import _json_default, post_json
+    from distributed_sddmm_tpu.obs.telemetry import LatencyHistogram
+    from distributed_sddmm_tpu.serve import (
+        SLOSpec, build_als_engine, build_gat_engine, parse_tenants,
+    )
+    from distributed_sddmm_tpu.serve.slo import attach_tenant_slo
+
+    n_replicas = (
+        args.replicas if args.replicas is not None
+        else int(os.environ.get("DSDDMM_FLEET_REPLICAS") or "2")
+    )
+    tenants = parse_tenants(args.tenants)
+    slo = SLOSpec.parse(args.slo) if args.slo else SLOSpec.from_env()
+
+    # The warm-start substrate: replicas inherit DSDDMM_PROGRAMS through
+    # their environment, so the oracle's warmup below populates the SAME
+    # store every replica (and every respawn) resolves its ladder from.
+    if programs_mod.active() is None:
+        import tempfile
+
+        store_root = tempfile.mkdtemp(prefix="dsddmm-fleet-programs-")
+        programs_mod.enable(store_root)
+        os.environ["DSDDMM_PROGRAMS"] = store_root
+        print(f"[fleet] shared program store at {store_root}",
+              file=sys.stderr)
+
+    # -- single-engine oracle (and store pre-warmer) -------------------- #
+    S = HostCOO.rmat(log_m=args.log_m, edge_factor=args.edge_factor, seed=0)
+    engine_kw = dict(
+        max_batch=args.max_batch, max_depth=args.max_depth,
+        max_wait_ms=args.max_wait_ms,
+    )
+    print(f"[fleet] building oracle {args.app} engine "
+          f"(2^{args.log_m} matrix, R={args.R})", file=sys.stderr)
+    if args.app == "als":
+        oracle = build_als_engine(
+            S, R=args.R, train_steps=args.train_steps, k=args.k,
+            plan_mode="model", **engine_kw,
+        )
+    else:
+        oracle = build_gat_engine(S, R=args.R, plan_mode="model",
+                                  **engine_kw)
+    oracle.warmup()
+
+    # -- precomputed load plan ------------------------------------------ #
+    rng_arr = np.random.default_rng(args.seed)
+    gaps = rng_arr.exponential(
+        1.0 / max(args.rate, 1e-9),
+        size=max(1, int(args.duration * args.rate * 3)),
+    )
+    t_arrivals = np.cumsum(gaps)
+    t_arrivals = [float(t) for t in t_arrivals[t_arrivals < args.duration]]
+    rng_pay = np.random.default_rng(args.seed + 1)
+    payloads = [oracle.workload.sample_payload(rng_pay) for _ in t_arrivals]
+    tenant_names = sorted(tenants) if tenants else ["default"]
+    if tenants:
+        w = np.array([tenants[t].weight for t in tenant_names], float)
+        probs = w / w.sum()
+    else:
+        probs = np.ones(1)
+    rng_t = np.random.default_rng(args.seed + 2)
+    assigned = [
+        tenant_names[int(rng_t.choice(len(tenant_names), p=probs))]
+        for _ in t_arrivals
+    ]
+    # Oracle answers, JSON-round-tripped the same way an HTTP reply is —
+    # the comparison must see both sides through the identical wire
+    # encoding. One payload per call: batching-determinism makes the
+    # grouping irrelevant, and it sidesteps any batch-bucket clamp.
+    oracle_replies = [
+        json.loads(json.dumps(oracle.execute_now([p])[0],
+                              default=_json_default))
+        for p in payloads
+    ]
+    print(f"[fleet] oracle precomputed {len(oracle_replies)} replies",
+          file=sys.stderr)
+
+    # -- the fleet ------------------------------------------------------ #
+    def replica_argv(name, port, role):  # noqa: ARG001 — manager contract
+        argv = [
+            sys.executable, "-m", "distributed_sddmm_tpu.bench", "serve",
+            "--serve-http", "--admin-port", str(port), "--no-runstore",
+            "--app", args.app, "--log-m", str(args.log_m),
+            "--edge-factor", str(args.edge_factor), "--R", str(args.R),
+            "--k", str(args.k), "--train-steps", str(args.train_steps),
+            "--max-batch", str(args.max_batch),
+            "--max-depth", str(args.max_depth),
+            "--max-wait-ms", str(args.max_wait_ms),
+            "--seed", str(args.seed), "--oracle-every", "0",
+        ]
+        if args.tenants:
+            argv += ["--tenants", args.tenants]
+        if args.slo:
+            argv += ["--slo", args.slo]
+        return argv
+
+    # No live canary here: the chaos harness owns the replica count, and
+    # a background tuner's CPU burn would only add latency noise to the
+    # availability measurement. fleet/manager tests cover the canary.
+    manager = FleetManager(replica_argv, tuner_canary=False)
+    for _ in range(n_replicas):
+        manager.spawn(role="serve")
+    print(f"[fleet] warming {n_replicas} replicas "
+          f"(budget {args.ready_timeout:.0f}s)...", file=sys.stderr)
+
+    router = None
+    killed_name = None
+    results: list = [None] * len(t_arrivals)
+    router_stats: dict = {}
+    topology: dict = {}
+    elapsed = 0.0
+    try:
+        if not manager.wait_ready(args.ready_timeout):
+            print("[fleet] replica pool failed to become ready",
+                  file=sys.stderr)
+            return 1
+        router = FleetRouter(manager, poll_interval_s=0.2).start()
+        print(f"[fleet] router at http://127.0.0.1:{router.port}",
+              file=sys.stderr)
+
+        lock = threading.Lock()
+        backoff_until = [0.0]
+
+        def _fire(i):
+            body = {"payload": payloads[i], "tenant": assigned[i],
+                    "timeout_s": 30.0}
+            try:
+                code, decoded, headers = post_json(
+                    "127.0.0.1", router.port, "/submit", body,
+                    timeout_s=60.0,
+                )
+            except OSError as e:
+                results[i] = ("error", f"{type(e).__name__}: {e}")
+                return
+            if code == 200:
+                results[i] = ("ok", decoded.get("reply"))
+            elif code == 429:
+                hint = headers.get("Retry-After")
+                if hint is None:
+                    hint = decoded.get("retry_after_s")
+                try:
+                    hint_f = float(hint)
+                except (TypeError, ValueError):
+                    hint_f = None
+                if hint_f:
+                    # Honor the hint (satellite of run_load's
+                    # honor_retry_after): later arrivals inside the
+                    # window defer instead of piling on.
+                    with lock:
+                        backoff_until[0] = max(
+                            backoff_until[0], _time.monotonic() + hint_f,
+                        )
+                results[i] = ("shed", hint_f)
+            else:
+                results[i] = (
+                    "error", f"HTTP {code}: {decoded.get('error', decoded)}"
+                )
+
+        chaos_at = (len(t_arrivals) // 2
+                    if args.chaos == "kill-replica" and t_arrivals else None)
+        healer = None
+        threads = []
+        t0 = _time.monotonic()
+        for i, t_arr in enumerate(t_arrivals):
+            delay = t0 + t_arr - _time.monotonic()
+            if delay > 0:
+                _time.sleep(delay)
+            if chaos_at is not None and i == chaos_at:
+                victims = manager.replicas(role="serve")
+                if victims:
+                    killed_name = victims[-1].name
+                    print(f"[fleet] chaos: SIGKILL {killed_name} at "
+                          f"request {i}/{len(t_arrivals)}", file=sys.stderr)
+                    manager.kill(killed_name)
+
+                    def _heal():
+                        # SIGKILL delivery is asynchronous: wait for the
+                        # corpse before reaping, or respawn_dead() finds
+                        # nothing dead and the slot never heals.
+                        rep = manager.get(killed_name)
+                        deadline = _time.monotonic() + 30.0
+                        while rep.alive and _time.monotonic() < deadline:
+                            _time.sleep(0.05)
+                        manager.respawn_dead()
+                        manager.wait_ready(args.ready_timeout,
+                                           names=[killed_name])
+
+                    healer = threading.Thread(target=_heal, daemon=True)
+                    healer.start()
+            with lock:
+                wait = backoff_until[0] - _time.monotonic()
+            if wait > 0:
+                results[i] = ("deferred", round(wait, 3))
+                continue
+            th = threading.Thread(target=_fire, args=(i,), daemon=True)
+            th.start()
+            threads.append(th)
+        for th in threads:
+            th.join(90.0)
+        elapsed = _time.monotonic() - t0
+        if healer is not None:
+            healer.join(args.ready_timeout)
+        router_stats = dict(router.stats)
+        topology = router.topology()
+    finally:
+        if router is not None:
+            router.stop()
+        manager.stop_all()
+
+    # -- judgment ------------------------------------------------------- #
+    counts = {"ok": 0, "shed": 0, "deferred": 0, "error": 0, "lost": 0}
+    shed_with_retry = 0
+    n_mismatch = 0
+    mismatch_examples = []
+    client_tenant: dict[str, dict] = {}
+    for i, res in enumerate(results):
+        cell = client_tenant.setdefault(
+            assigned[i],
+            {"ok": 0, "shed": 0, "deferred": 0, "error": 0, "lost": 0},
+        )
+        kind = res[0] if res is not None else "lost"
+        counts[kind] += 1
+        cell[kind] += 1
+        if kind == "shed" and res[1]:
+            shed_with_retry += 1
+        if kind == "ok" and res[1] != oracle_replies[i]:
+            n_mismatch += 1
+            if len(mismatch_examples) < 5:
+                mismatch_examples.append(
+                    {"request": i, "tenant": assigned[i]}
+                )
+    offered = len(t_arrivals)
+    availability = (
+        (counts["ok"] + shed_with_retry + counts["deferred"]) / offered
+        if offered else 1.0
+    )
+
+    # Replacement warm-start: the replica living under the killed name
+    # at stop time IS the respawn (generation >= 1); its drained record
+    # carries the compile attribution.
+    replacement = (manager.get(killed_name)
+                   if killed_name is not None else None)
+    repl_engine = ((replacement.record or {}).get("engine") or {}
+                   if replacement is not None and replacement.generation >= 1
+                   else {})
+    repl_live_compiles = repl_engine.get("live_compiles")
+
+    # -- fleet-wide + per-tenant rollups from the drained records ------- #
+    fleet_hist = None
+    tot = {"completed": 0, "errors": 0, "shed_count": 0}
+    tenant_agg: dict[str, dict] = {}
+    for rec in manager.records:
+        h = LatencyHistogram.from_dict(rec.get("request_hist"))
+        if h is not None:
+            fleet_hist = h if fleet_hist is None else fleet_hist.merge(h)
+        for k in tot:
+            tot[k] += int(rec.get(k) or 0)
+        for name, cell in (rec.get("tenant") or {}).items():
+            a = tenant_agg.setdefault(name, {
+                "requests": 0, "completed": 0, "errors": 0,
+                "shed_count": 0, "_hist": None,
+            })
+            for k in ("requests", "completed", "errors", "shed_count"):
+                a[k] += int(cell.get(k) or 0)
+            th = LatencyHistogram.from_dict(cell.get("request_hist"))
+            if th is not None:
+                a["_hist"] = (th if a["_hist"] is None
+                              else a["_hist"].merge(th))
+    t_req = sum(tot.values())
+    fleet_summary = {
+        **tot,
+        "err_rate": tot["errors"] / t_req if t_req else 0.0,
+        "shed_rate": tot["shed_count"] / t_req if t_req else 0.0,
+    }
+    if fleet_hist is not None and fleet_hist.total:
+        fleet_summary["request_hist"] = fleet_hist.to_dict()
+        fleet_summary["latency_hist_ms"] = fleet_hist.percentiles_ms()
+    tenant_table = {}
+    for name, a in sorted(tenant_agg.items()):
+        n_req = a["requests"]
+        entry = {k: a[k] for k in
+                 ("requests", "completed", "errors", "shed_count")}
+        entry["err_rate"] = a["errors"] / n_req if n_req else 0.0
+        entry["shed_rate"] = a["shed_count"] / n_req if n_req else 0.0
+        if a["_hist"] is not None and a["_hist"].total:
+            entry["request_hist"] = a["_hist"].to_dict()
+            entry["latency_hist_ms"] = a["_hist"].percentiles_ms()
+        tenant_table[name] = entry
+    tenant_wrap = {"tenant": tenant_table}
+    attach_tenant_slo(tenant_wrap, tenants)
+
+    model = oracle.workload.model
+    d_ops = model.d_ops
+    plan = getattr(model, "plan", None)
+    record = {
+        "app": f"fleet-{args.app}",
+        "algorithm": plan.algorithm if plan else d_ops.algorithm_name,
+        "R": args.R,
+        "c": plan.c if plan else d_ops.c,
+        "fused": True,
+        "kernel": getattr(d_ops.kernel, "name",
+                          type(d_ops.kernel).__name__),
+        "kernel_variant": oracle.workload.kernel_variant,
+        **harness.pod_record_fields(),
+        "num_trials": counts["ok"],
+        "elapsed": round(elapsed, 3),
+        "overall_throughput": None,
+        "requests": offered,
+        "throughput_rps": (round(counts["ok"] / elapsed, 3)
+                           if elapsed > 0 else 0.0),
+        **fleet_summary,
+        "slo": slo.to_dict(),
+        "slo_violations": slo.check(fleet_summary),
+        "burn_rate": slo.burn_rate(fleet_summary),
+        "tenant": tenant_wrap.get("tenant"),
+        "fleet": {
+            "replicas": n_replicas,
+            "chaos": args.chaos,
+            "availability": round(availability, 4),
+            "availability_floor": args.availability_floor,
+            "offered": offered,
+            "ok": counts["ok"],
+            "shed_with_retry": shed_with_retry,
+            "shed_no_hint": counts["shed"] - shed_with_retry,
+            "deferred": counts["deferred"],
+            "errors": counts["error"],
+            "lost": counts["lost"],
+            "oracle_checked": counts["ok"],
+            "mismatches": n_mismatch,
+            "mismatch_examples": mismatch_examples,
+            "killed": killed_name,
+            "spawns": manager.spawns,
+            "losses": manager.losses,
+            "records_collected": len(manager.records),
+            "replacement_live_compiles": repl_live_compiles,
+            "replacement_disk_hits": repl_engine.get("disk_hits"),
+            "router": router_stats,
+            "topology": topology,
+            "scaler_config": dataclasses.asdict(ScalerConfig.from_env()),
+            "tenant_client": client_tenant,
+        },
+        "serve_config": {
+            "rate_hz": args.rate, "duration_s": args.duration,
+            "max_batch": args.max_batch, "max_depth": args.max_depth,
+            "max_wait_ms": args.max_wait_ms,
+            "tenants": args.tenants,
+        },
+    }
+    if plan is not None:
+        record["plan"] = plan.to_dict()
+
+    print(json.dumps({
+        "app": record["app"],
+        "replicas": n_replicas,
+        "chaos": args.chaos,
+        "offered": offered,
+        "ok": counts["ok"],
+        "shed_with_retry": shed_with_retry,
+        "deferred": counts["deferred"],
+        "errors": counts["error"],
+        "lost": counts["lost"],
+        "mismatches": n_mismatch,
+        "availability": record["fleet"]["availability"],
+        "replacement_live_compiles": repl_live_compiles,
+        "burn_rate": record["burn_rate"],
+        "router": router_stats,
+    }))
+    if args.output_file:
+        # non-atomic-ok: append-only record stream (the -o contract).
+        with open(args.output_file, "a") as f:
+            f.write(json.dumps(record) + "\n")
+
+    from distributed_sddmm_tpu.obs import store as obs_store
+
+    run_store = obs_store.active()
+    if run_store is not None:
+        try:
+            doc = run_store.ingest_record(record, source="fleet")
+            print(f"[fleet] runstore doc {doc['run_id']}", file=sys.stderr)
+        except Exception as e:  # noqa: BLE001 — never fail the run
+            print(f"[fleet] runstore ingest failed: {e}", file=sys.stderr)
+
+    if n_mismatch or counts["lost"]:
+        return 1
+    if killed_name is not None and (repl_live_compiles is None
+                                    or repl_live_compiles > 0):
+        # The respawn either never came back with a record or it
+        # compiled on the request path — both break the warm-start
+        # contract the fleet's capacity math depends on.
+        return 1
+    if availability < args.availability_floor:
+        return 3
     return 0
 
 
@@ -1380,6 +1964,9 @@ def _maybe_mask(S, args):
 def _dispatch(args) -> int:
     if args.cmd == "serve":
         return _dispatch_serve(args)
+
+    if args.cmd == "fleet":
+        return _dispatch_fleet(args)
 
     if args.cmd == "er":
         S = HostCOO.rmat(log_m=args.log_m, edge_factor=args.edge_factor, seed=0)

@@ -51,6 +51,60 @@ def free_port() -> int:
         return s.getsockname()[1]
 
 
+def spawn_process(argv: list, env: Optional[dict] = None,
+                  cwd: Optional[str] = None) -> subprocess.Popen:
+    """Spawn one supervised child with temp-file stdout/stderr.
+
+    Temp files, not PIPEs: supervisors here do not drain output until
+    exit, and a chatty worker (``DSDDMM_LOG=debug`` writes structured
+    logs to stderr) would fill a ~64KB pipe buffer, block in write(),
+    and read as hung/lost. Pair with :func:`collect_output`. Shared by
+    :class:`ElasticSupervisor` and the fleet manager
+    (``fleet/manager.py``) so both spawn paths have the same hang-proof
+    discipline.
+    """
+    import tempfile
+
+    out_f = tempfile.TemporaryFile(mode="w+")
+    err_f = tempfile.TemporaryFile(mode="w+")
+    proc = subprocess.Popen(
+        argv, stdout=out_f, stderr=err_f, text=True, env=env, cwd=cwd,
+    )
+    proc._elastic_out, proc._elastic_err = out_f, err_f
+    return proc
+
+
+def collect_output(proc: subprocess.Popen) -> tuple[str, str]:
+    """Read back (and close) a :func:`spawn_process` child's captured
+    stdout/stderr. Call once, after exit."""
+    out = err = ""
+    for fh, slot in ((proc._elastic_out, "out"), (proc._elastic_err, "err")):
+        try:
+            fh.seek(0)
+            text = fh.read()
+        finally:
+            fh.close()
+        if slot == "out":
+            out = text
+        else:
+            err = text
+    return out, err
+
+
+def last_json_line(text: str) -> Optional[dict]:
+    """The worker-record convention: a child's result is the LAST line
+    of stdout that parses as JSON (banners/log noise above it are
+    ignored). None when no line parses."""
+    for line in reversed((text or "").strip().splitlines()):
+        try:
+            doc = json.loads(line)
+        except ValueError:
+            continue
+        if isinstance(doc, dict):
+            return doc
+    return None
+
+
 @dataclasses.dataclass
 class GenerationResult:
     """One generation's outcome.
@@ -136,8 +190,6 @@ class ElasticSupervisor:
     # ------------------------------------------------------------------ #
 
     def _spawn(self, generation: int, live_p: int) -> list:
-        import tempfile
-
         port = free_port()
         procs = []
         for w in range(live_p):
@@ -146,21 +198,12 @@ class ElasticSupervisor:
                 env.pop("DSDDMM_FAULTS", None)
             if self.worker_env is not None:
                 env.update(self.worker_env(generation, live_p, w))
-            # Temp files, not PIPEs: the watch loop does not drain
-            # output until exit, and a chatty worker (DSDDMM_LOG=debug
-            # writes structured logs to stderr) would fill a ~64KB pipe
-            # buffer, block in write(), and read as hung/lost.
-            out_f = tempfile.TemporaryFile(mode="w+")
-            err_f = tempfile.TemporaryFile(mode="w+")
-            proc = subprocess.Popen(
+            procs.append(spawn_process(
                 [sys.executable, *self.worker_argv(
                     generation, live_p, w, port
                 )],
-                stdout=out_f, stderr=err_f, text=True,
                 env=env, cwd=self.cwd,
-            )
-            proc._elastic_out, proc._elastic_err = out_f, err_f
-            procs.append(proc)
+            ))
         return procs
 
     def _watch(self, procs: list, generation: int, live_p: int
@@ -194,28 +237,10 @@ class ElasticSupervisor:
         lost = []
         for w, p in enumerate(procs):
             p.wait()
-            out, err = "", ""
-            for fh, slot in ((p._elastic_out, "out"),
-                             (p._elastic_err, "err")):
-                try:
-                    fh.seek(0)
-                    text = fh.read()
-                finally:
-                    fh.close()
-                if slot == "out":
-                    out = text
-                else:
-                    err = text
+            out, err = collect_output(p)
             rc = p.returncode
             rcs.append(rc)
-            rec = None
-            for line in reversed(out.strip().splitlines() or []):
-                try:
-                    rec = json.loads(line)
-                    break
-                except ValueError:
-                    continue
-            records.append(rec)
+            records.append(last_json_line(out))
             if rc != 0 and w not in reaped:
                 lost.append(w)
                 obs_log.warn(

@@ -1,0 +1,47 @@
+"""Serving fleet: replica manager, front router, autoscaler.
+
+One :class:`~distributed_sddmm_tpu.serve.engine.ServingEngine` is a
+single queue and a single point of failure. This package turns the
+serving layer into a *fleet*:
+
+* :mod:`~distributed_sddmm_tpu.fleet.manager` — process-per-replica
+  lifecycle: each replica is one ``bench serve --serve-http`` OS
+  process with an injected ephemeral ``--admin-port``, spawned/reaped
+  with the same hang-proof discipline as the elastic pod supervisor
+  (``dist/elastic.py``) and warm-started from the shared ProgramStore
+  so a replacement replica compiles nothing on the request path.
+* :mod:`~distributed_sddmm_tpu.fleet.router` — a zero-dependency front
+  router balancing on the signals the replicas already export
+  (``/readyz`` readiness, SLO burn rate, queue depth), shedding at the
+  edge with the ``Retry-After`` hint propagated from ``ShedError``,
+  draining burning replicas instead of killing them, and routing by
+  request *structure* (size buckets — the NeutronSparse admission idea
+  at request granularity; pathological outliers go to the host-serial
+  tier).
+* :mod:`~distributed_sddmm_tpu.fleet.scaler` — telemetry-driven
+  autoscaling over the same ``/snapshot`` stream: spawn on sustained
+  depth/burn pressure, drain-then-reap on sustained idle, min/max
+  bounds and a cooldown.
+
+Fleet-wide tuner discipline: exactly ONE replica runs the background
+tuner (the canary); its promotion lands the winning plan in the shared
+plan cache, and :meth:`FleetManager.rollout` rolls the rest of the
+fleet onto it replica-by-replica (drain → respawn → warm-start onto
+the cached winner) — the PR-12 closed loop with a blast-radius story.
+
+``bench fleet`` (bench/cli.py) is the harness: an open-loop HTTP load
+against the router with a kill-a-replica chaos mode, pinning replies
+bit-identical to a single-engine oracle and availability above a floor
+through the kill.
+"""
+
+from __future__ import annotations
+
+from distributed_sddmm_tpu.fleet.manager import FleetManager, Replica
+from distributed_sddmm_tpu.fleet.router import FleetRouter, ReplicaState
+from distributed_sddmm_tpu.fleet.scaler import AutoScaler, ScalerConfig
+
+__all__ = [
+    "AutoScaler", "FleetManager", "FleetRouter", "Replica",
+    "ReplicaState", "ScalerConfig",
+]

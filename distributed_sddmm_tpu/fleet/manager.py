@@ -1,0 +1,309 @@
+"""Process-per-replica fleet lifecycle.
+
+A replica is ONE OS process serving one warm engine behind an admin
+server (``bench serve --serve-http --admin-port <ephemeral>``). The
+manager owns spawn/reap/replace:
+
+* **Spawning is cheap by design**: every replica shares the process-
+  wide ProgramStore directory, so a replacement's compile-ahead warmup
+  resolves the whole bucket ladder from disk (``disk_hits``) instead of
+  compiling — the acceptance bar is 0 request-path live compiles on a
+  respawn.
+* **Reaping reuses the elastic discipline** (``dist/elastic.py``):
+  temp-file stdout/stderr (a chatty child must never block on a full
+  pipe) and the last-JSON-line record convention — a drained replica's
+  final stdout line is its serving record, collected into
+  :attr:`FleetManager.records`.
+* **Generations**: a replaced replica keeps its name and bumps its
+  generation, mirroring the elastic supervisor's recovery-generation
+  bookkeeping — fleet telemetry can tell "r1 gen 2" (respawned twice)
+  from a fresh slot.
+* **Tuner discipline**: exactly one replica (the first ``serve``-role
+  spawn, by default) gets ``DSDDMM_TUNER=1`` overlaid — the canary that
+  shadow-tests challengers. :meth:`rollout` then replaces the other
+  replicas one at a time so their warmups pick the promoted plan out of
+  the shared plan cache: canary → all, never the whole fleet at once.
+
+The manager is deliberately transport-agnostic: it talks to replicas
+only through their admin HTTP surface (``/healthz``, ``/readyz``,
+``/snapshot``) and POSIX signals (SIGTERM = drain-and-exit-with-record,
+SIGKILL = chaos).
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import subprocess
+import time
+from typing import Callable, Optional
+
+from distributed_sddmm_tpu.dist.elastic import (
+    collect_output, free_port, last_json_line, spawn_process,
+)
+from distributed_sddmm_tpu.obs import log as obs_log
+
+
+class Replica:
+    """One managed replica process (live or just-reaped)."""
+
+    def __init__(self, name: str, port: int, proc: subprocess.Popen,
+                 role: str = "serve", generation: int = 0,
+                 tuner: bool = False):
+        self.name = name
+        self.port = port
+        self.proc = proc
+        self.role = role
+        self.generation = generation
+        self.tuner = tuner
+        self.t_spawn = time.monotonic()
+        #: Filled at reap time: exit code and last-JSON-line record.
+        self.rc: Optional[int] = None
+        self.record: Optional[dict] = None
+
+    @property
+    def alive(self) -> bool:
+        return self.proc.poll() is None
+
+    def describe(self) -> dict:
+        return {
+            "name": self.name, "port": self.port, "role": self.role,
+            "generation": self.generation, "tuner": self.tuner,
+            "alive": self.alive, "rc": self.rc,
+        }
+
+
+class FleetManager:
+    """Spawn, watch, replace, and drain a pool of serving replicas.
+
+    ``replica_argv(name, port, role)`` builds one replica's full command
+    line (``bench fleet`` points it at ``bench serve --serve-http``;
+    tests point it at a cheap stub worker). ``env_overlay(name, port,
+    role, tuner)`` returns extra environment for one replica — the
+    manager itself only adds the tuner arming.
+    """
+
+    def __init__(
+        self,
+        replica_argv: Callable[[str, int, str], list],
+        *,
+        env_overlay: Optional[Callable] = None,
+        cwd: Optional[str] = None,
+        tuner_canary: bool = True,
+    ):
+        self.replica_argv = replica_argv
+        self.env_overlay = env_overlay
+        self.cwd = cwd
+        #: Arm the background tuner on exactly one serve-role replica.
+        self.tuner_canary = tuner_canary
+        self._replicas: dict[str, Replica] = {}
+        self._next_id = 0
+        self._generation: dict[str, int] = {}
+        #: Records collected from exited replicas (last JSON stdout
+        #: line — the ``bench serve`` record), in reap order.
+        self.records: list[dict] = []
+        self.spawns = 0
+        #: Replicas that died WITHOUT being asked (chaos kills, crashes).
+        self.losses = 0
+
+    # -- introspection -------------------------------------------------- #
+
+    def replicas(self, role: Optional[str] = None) -> list[Replica]:
+        """Live replicas (optionally one role), spawn order."""
+        return [r for r in self._replicas.values()
+                if r.alive and (role is None or r.role == role)]
+
+    def get(self, name: str) -> Optional[Replica]:
+        return self._replicas.get(name)
+
+    def describe(self) -> dict:
+        return {
+            "replicas": [r.describe() for r in self._replicas.values()],
+            "spawns": self.spawns,
+            "losses": self.losses,
+            "records_collected": len(self.records),
+        }
+
+    def _tuner_armed(self) -> bool:
+        return any(r.tuner for r in self._replicas.values() if r.alive)
+
+    # -- lifecycle ------------------------------------------------------ #
+
+    def spawn(self, role: str = "serve", name: Optional[str] = None
+              ) -> Replica:
+        """Launch one replica on a fresh ephemeral admin port. A reused
+        ``name`` (respawn) bumps that slot's generation; the tuner
+        arms on the first serve-role replica only — one canary,
+        never a fleet of independently-tuning engines."""
+        if name is None:
+            name = f"r{self._next_id}"
+            self._next_id += 1
+        generation = self._generation.get(name, -1) + 1
+        self._generation[name] = generation
+        port = free_port()
+        tuner = (self.tuner_canary and role == "serve"
+                 and not self._tuner_armed())
+        env = dict(os.environ)
+        if tuner:
+            env["DSDDMM_TUNER"] = "1"
+        else:
+            env.pop("DSDDMM_TUNER", None)
+        if self.env_overlay is not None:
+            env.update(self.env_overlay(name, port, role, tuner) or {})
+        proc = spawn_process(
+            list(self.replica_argv(name, port, role)), env=env, cwd=self.cwd,
+        )
+        rep = Replica(name, port, proc, role=role, generation=generation,
+                      tuner=tuner)
+        self._replicas[name] = rep
+        self.spawns += 1
+        obs_log.info("fleet", "replica spawned", name=name, port=port,
+                     role=role, generation=generation, tuner=tuner)
+        return rep
+
+    def wait_ready(self, timeout_s: float = 120.0,
+                   names: Optional[list] = None) -> bool:
+        """Poll each replica's ``/readyz`` until all are ready (True) or
+        the deadline passes (False). A replica that *dies* while we
+        wait fails fast — waiting out the full timeout on a corpse
+        would hide a crash-on-boot as a timeout."""
+        from distributed_sddmm_tpu.obs.httpexp import fetch_json
+
+        want = names if names is not None else [
+            r.name for r in self.replicas()
+        ]
+        deadline = time.monotonic() + timeout_s
+        pending = set(want)
+        while pending and time.monotonic() < deadline:
+            for name in sorted(pending):
+                rep = self._replicas.get(name)
+                if rep is None or not rep.alive:
+                    obs_log.warn("fleet", "replica died before ready",
+                                 name=name)
+                    return False
+                try:
+                    body = fetch_json("127.0.0.1", rep.port, "/readyz",
+                                      timeout_s=1.0)
+                except OSError:
+                    continue  # not listening yet
+                if body.get("ready"):
+                    pending.discard(name)
+            if pending:
+                time.sleep(0.1)
+        return not pending
+
+    def _reap(self, rep: Replica, expected: bool) -> None:
+        rep.proc.wait()
+        out, err = collect_output(rep.proc)
+        rep.rc = rep.proc.returncode
+        rep.record = last_json_line(out)
+        if rep.record is not None:
+            self.records.append(rep.record)
+        if not expected:
+            self.losses += 1
+            obs_log.warn(
+                "fleet", "replica lost", name=rep.name, rc=rep.rc,
+                generation=rep.generation, stderr_tail=(err or "")[-300:],
+            )
+        else:
+            obs_log.info("fleet", "replica reaped", name=rep.name,
+                         rc=rep.rc, generation=rep.generation)
+
+    def poll(self) -> list[Replica]:
+        """Reap replicas that died on their own since the last poll;
+        returns them (records collected, ``losses`` bumped)."""
+        dead = [r for r in self._replicas.values()
+                if r.rc is None and not r.alive]
+        for rep in dead:
+            self._reap(rep, expected=False)
+        return dead
+
+    def respawn_dead(self) -> list[Replica]:
+        """The self-healing move: reap losses, then relaunch each under
+        its old name (generation+1). The replacement's warmup resolves
+        its ladder from the shared ProgramStore — disk hits, not
+        request-path compiles."""
+        replaced = []
+        for rep in self.poll():
+            replaced.append(self.spawn(role=rep.role, name=rep.name))
+        return replaced
+
+    def kill(self, name: str) -> None:
+        """Chaos move: SIGKILL — no drain, no record, in-flight work
+        dies with the process (the router's retry path owns it)."""
+        rep = self._replicas.get(name)
+        if rep is None or not rep.alive:
+            raise ValueError(f"no live replica {name!r}")
+        obs_log.warn("fleet", "replica killed (chaos)", name=name)
+        rep.proc.kill()
+
+    def drain(self, name: str, timeout_s: float = 60.0) -> Optional[dict]:
+        """Graceful exit: SIGTERM → the replica closes admission, drains
+        its queue, prints its record, exits 0. Returns the record."""
+        rep = self._replicas.get(name)
+        if rep is None or not rep.alive:
+            raise ValueError(f"no live replica {name!r}")
+        rep.proc.send_signal(signal.SIGTERM)
+        try:
+            rep.proc.wait(timeout_s)
+        except subprocess.TimeoutExpired:
+            obs_log.warn("fleet", "drain timed out; killing", name=name)
+            rep.proc.kill()
+        self._reap(rep, expected=True)
+        return rep.record
+
+    def stop_all(self, timeout_s: float = 60.0) -> list[dict]:
+        """Drain every live replica; returns all collected records."""
+        live = [r for r in self._replicas.values() if r.alive]
+        for rep in live:
+            rep.proc.send_signal(signal.SIGTERM)
+        deadline = time.monotonic() + timeout_s
+        for rep in live:
+            remain = max(0.1, deadline - time.monotonic())
+            try:
+                rep.proc.wait(remain)
+            except subprocess.TimeoutExpired:
+                rep.proc.kill()
+            self._reap(rep, expected=True)
+        return list(self.records)
+
+    # -- fleet-wide tuner rollout --------------------------------------- #
+
+    def rollout(self, ready_timeout_s: float = 120.0) -> list[str]:
+        """Canary → all: after the tuner replica promotes a challenger
+        (its promotion stores the winning plan in the shared plan
+        cache), replace every OTHER serve replica one at a time — drain,
+        respawn under the same name, wait ready — so each replacement
+        warms straight onto the winner. One replica's worth of capacity
+        is out at any instant; a bad challenger is caught by the
+        canary's shadow validation before this ever runs."""
+        rolled = []
+        targets = [r.name for r in self.replicas(role="serve")
+                   if not r.tuner]
+        for name in targets:
+            role = self._replicas[name].role
+            self.drain(name)
+            self.spawn(role=role, name=name)
+            if not self.wait_ready(ready_timeout_s, names=[name]):
+                obs_log.warn("fleet", "rollout replacement not ready",
+                             name=name)
+                break
+            rolled.append(name)
+        obs_log.info("fleet", "rollout complete", replaced=rolled)
+        return rolled
+
+    # -- telemetry ------------------------------------------------------ #
+
+    def snapshots(self) -> dict:
+        """Live ``/snapshot`` per replica (None where unreachable) —
+        the autoscaler's input stream."""
+        from distributed_sddmm_tpu.obs.httpexp import fetch_json
+
+        out = {}
+        for rep in self.replicas():
+            try:
+                out[rep.name] = fetch_json("127.0.0.1", rep.port,
+                                           "/snapshot", timeout_s=1.0)
+            except (OSError, ValueError):
+                out[rep.name] = None
+        return out

@@ -1,0 +1,355 @@
+"""The front router: one door, many replicas.
+
+Zero-dependency, same stdlib-HTTP stance as ``obs/httpexp.py`` — in
+fact the router's HTTP surface IS an :class:`~distributed_sddmm_tpu.
+obs.httpexp.AdminServer` whose ``submit_fn`` is the routing decision:
+``POST /submit`` routes, ``/snapshot`` serves the fleet topology,
+``/healthz``/``/readyz`` make the router itself probeable. A shed
+raised here (:class:`~distributed_sddmm_tpu.serve.queue.ShedError`)
+leaves the building as the same 429 + ``Retry-After`` a replica's own
+admission control produces — backpressure composes through the tiers.
+
+Routing policy, in order:
+
+1. **Structure-aware admission** (NeutronSparse, at request
+   granularity): the request's inner size is bucketed against each
+   replica's exported warm ladder (``/snapshot``'s ``buckets``); a
+   request larger than every ready replica's largest warm rung is
+   *pathological* — padding it into a batch would poison the batch, so
+   it routes to the host-serial tier (``serial=true``, preferring a
+   ``fallback``-role replica) instead.
+2. **Health**: only replicas that are ready (``/readyz``), not
+   draining, and recently polled are candidates.
+3. **Drain, don't kill, burning replicas**: a replica whose SLO burn
+   rate exceeds ``drain_burn`` stops receiving admissions but finishes
+   its in-flight queue; it resumes when burn recovers below
+   ``resume_burn`` (hysteresis — no flapping at the threshold).
+4. **Least pressure**: among candidates, lowest (queue depth fraction,
+   burn) wins.
+5. **Failover**: a connection-level failure (killed replica) marks the
+   replica not-ready and retries the SAME request on the next
+   candidate — a chaos kill turns into a re-admission, never a
+   silently dropped reply. A 429 from one replica tries the next; only
+   when every candidate sheds does the router shed at the edge, with
+   the largest ``Retry-After`` hint it saw.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Callable, Optional
+
+from distributed_sddmm_tpu.obs import log as obs_log
+from distributed_sddmm_tpu.serve.queue import DEFAULT_TENANT, ShedError
+from distributed_sddmm_tpu.utils.buckets import bucket_for
+
+
+def _drain_burn_default() -> float:
+    v = os.environ.get("DSDDMM_FLEET_DRAIN_BURN")
+    return float(v) if v not in (None, "") else 1.0
+
+
+class ReplicaState:
+    """The router's cached view of one replica's exported signals."""
+
+    def __init__(self, name: str, port: int, role: str = "serve"):
+        self.name = name
+        self.port = port
+        self.role = role
+        self.ready = False
+        self.draining = False
+        self.burn: Optional[float] = None
+        self.depth_frac = 0.0
+        self.inner_buckets: tuple = ()
+        self.t_poll = 0.0
+        self.errors = 0
+
+    @property
+    def inner_max(self) -> int:
+        return max(self.inner_buckets) if self.inner_buckets else 0
+
+    def describe(self) -> dict:
+        return {
+            "name": self.name, "port": self.port, "role": self.role,
+            "ready": self.ready, "draining": self.draining,
+            "burn": self.burn, "depth_frac": self.depth_frac,
+            "inner_buckets": list(self.inner_buckets),
+            "errors": self.errors,
+        }
+
+
+def _default_inner_size(payload: dict) -> int:
+    """Workload-agnostic inner-size probe: the longest list-valued
+    field. Matches ``inner_size`` for the shipped workloads (ALS items,
+    GAT neighbor lists, attention windows) without importing them."""
+    n = 1
+    for v in payload.values():
+        if isinstance(v, (list, tuple)):
+            n = max(n, len(v))
+        else:
+            size = getattr(v, "shape", None)
+            if size:
+                n = max(n, int(size[0]))
+    return n
+
+
+class FleetRouter:
+    """Balance, shed, drain, and structure-route over a replica pool.
+
+    ``manager`` (a :class:`~distributed_sddmm_tpu.fleet.manager.
+    FleetManager`) is the live endpoint source — respawns are picked up
+    on the next poll tick. Tests can instead pass static ``endpoints``
+    ``[(name, port, role), ...]``.
+    """
+
+    def __init__(
+        self,
+        manager=None,
+        endpoints: Optional[list] = None,
+        *,
+        poll_interval_s: float = 0.25,
+        drain_burn: Optional[float] = None,
+        resume_frac: float = 0.8,
+        request_timeout_s: float = 30.0,
+        shed_retry_after_s: float = 1.0,
+        inner_size_fn: Optional[Callable[[dict], int]] = None,
+        port: int = 0,
+    ):
+        if manager is None and endpoints is None:
+            raise ValueError("need a manager or static endpoints")
+        self.manager = manager
+        self.static_endpoints = endpoints
+        self.poll_interval_s = float(poll_interval_s)
+        self.drain_burn = (
+            _drain_burn_default() if drain_burn is None
+            else float(drain_burn)
+        )
+        self.resume_burn = self.drain_burn * float(resume_frac)
+        self.request_timeout_s = float(request_timeout_s)
+        self.shed_retry_after_s = float(shed_retry_after_s)
+        self.inner_size_fn = inner_size_fn or _default_inner_size
+        self._states: dict[str, ReplicaState] = {}
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._server = None
+        self._port = int(port)
+        self.stats = {
+            "routed": 0, "failovers": 0, "serial_routed": 0,
+            "edge_sheds": 0, "replica_sheds_seen": 0, "drains": 0,
+        }
+
+    # -- polling -------------------------------------------------------- #
+
+    def _endpoints(self) -> list:
+        if self.manager is not None:
+            return [(r.name, r.port, r.role) for r in self.manager.replicas()]
+        return list(self.static_endpoints)
+
+    def poll_once(self) -> None:
+        """One health sweep: refresh every replica's readiness, burn,
+        depth, and ladder; apply the drain/resume hysteresis."""
+        from distributed_sddmm_tpu.obs.httpexp import fetch_json
+
+        seen = set()
+        for name, port, role in self._endpoints():
+            seen.add(name)
+            with self._lock:
+                st = self._states.get(name)
+                if st is None or st.port != port:
+                    # New replica, or a respawn on a fresh port — reset
+                    # the cached view; it must re-prove readiness.
+                    st = self._states[name] = ReplicaState(name, port, role)
+            try:
+                ready_body = fetch_json("127.0.0.1", port, "/readyz",
+                                        timeout_s=1.0)
+                snap = fetch_json("127.0.0.1", port, "/snapshot",
+                                  timeout_s=1.0)
+            except (OSError, ValueError):
+                with self._lock:
+                    st.ready = False
+                    st.errors += 1
+                continue
+            with self._lock:
+                st.ready = bool(ready_body.get("ready"))
+                st.depth_frac = float(snap.get("depth_frac") or 0.0)
+                st.burn = snap.get("burn_rate")
+                buckets = snap.get("buckets") or {}
+                st.inner_buckets = tuple(buckets.get("inner") or ())
+                st.t_poll = time.monotonic()
+                if st.burn is not None:
+                    if not st.draining and st.burn > self.drain_burn:
+                        st.draining = True
+                        self.stats["drains"] += 1
+                        obs_log.warn("fleet", "draining burning replica",
+                                     name=name, burn=st.burn)
+                    elif st.draining and st.burn <= self.resume_burn:
+                        st.draining = False
+                        obs_log.info("fleet", "replica resumed admissions",
+                                     name=name, burn=st.burn)
+        with self._lock:
+            for gone in set(self._states) - seen:
+                del self._states[gone]
+
+    def _poll_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self.poll_once()
+            except Exception as e:  # noqa: BLE001 — the loop must survive
+                obs_log.warn("fleet", "router poll failed",
+                             error=f"{type(e).__name__}: {e}")
+            self._stop.wait(self.poll_interval_s)
+
+    # -- routing -------------------------------------------------------- #
+
+    def states(self) -> list[ReplicaState]:
+        with self._lock:
+            return list(self._states.values())
+
+    def _candidates(self, serial: bool) -> list[ReplicaState]:
+        with self._lock:
+            states = list(self._states.values())
+        pool = [s for s in states if s.ready and not s.draining]
+        if serial:
+            # Host-serial tier: prefer dedicated fallback replicas, but
+            # any ready replica can run the serial rung.
+            fallback = [s for s in pool if s.role == "fallback"]
+            pool = fallback or pool
+        else:
+            pool = [s for s in pool if s.role == "serve"]
+        return sorted(pool, key=lambda s: (s.depth_frac, s.burn or 0.0,
+                                           s.name))
+
+    def route(self, payload: dict, tenant: str = DEFAULT_TENANT,
+              serial: bool = False, timeout_s: Optional[float] = None
+              ) -> dict:
+        """The ``submit_fn`` contract: returns the reply dict, raises
+        :class:`ShedError` (→ 429 + Retry-After at the edge) when no
+        replica admits the request."""
+        from distributed_sddmm_tpu.obs.httpexp import post_json
+
+        timeout_s = self.request_timeout_s if timeout_s is None else timeout_s
+        inner = self.inner_size_fn(payload)
+        candidates = self._candidates(serial)
+        if not serial and candidates:
+            # Pathological outlier: larger than every candidate's
+            # largest warm rung → host-serial tier, not a poisoned batch.
+            fleet_max = max(s.inner_max for s in candidates)
+            if fleet_max and inner > fleet_max:
+                serial = True
+                candidates = self._candidates(serial=True)
+        if not candidates:
+            self.stats["edge_sheds"] += 1
+            raise ShedError("no ready replica",
+                            retry_after_s=self.shed_retry_after_s)
+        if not serial and len(candidates) > 1:
+            # Bucket fit: among healthy candidates prefer those whose
+            # warm ladder covers this inner size without clamping to
+            # the top rung (bucket_for maps oversize onto the last
+            # rung — correct, but it pads maximally).
+            fitting = [s for s in candidates if s.inner_buckets
+                       and bucket_for(inner, s.inner_buckets) >= inner]
+            candidates = fitting or candidates
+
+        shed_hint = 0.0
+        saw_shed = False
+        for st in candidates:
+            body = {"payload": payload, "tenant": tenant,
+                    "serial": serial, "timeout_s": timeout_s}
+            try:
+                code, decoded, headers = post_json(
+                    "127.0.0.1", st.port, "/submit", body,
+                    timeout_s=timeout_s,
+                )
+            except OSError as e:
+                # Connection-level failure: the replica is gone (chaos
+                # kill) or wedged. Mark it and FAIL OVER — the request
+                # is re-admitted on the next candidate, not dropped.
+                with self._lock:
+                    st.ready = False
+                    st.errors += 1
+                self.stats["failovers"] += 1
+                obs_log.warn("fleet", "replica unreachable; failing over",
+                             name=st.name, error=f"{type(e).__name__}: {e}")
+                continue
+            if code == 200:
+                with self._lock:
+                    self.stats["routed"] += 1
+                    if serial:
+                        self.stats["serial_routed"] += 1
+                return decoded.get("reply")
+            if code == 429:
+                saw_shed = True
+                self.stats["replica_sheds_seen"] += 1
+                hint = headers.get("Retry-After") or decoded.get(
+                    "retry_after_s", 0.0
+                )
+                try:
+                    shed_hint = max(shed_hint, float(hint))
+                except (TypeError, ValueError):
+                    pass
+                continue  # another replica may have headroom
+            raise RuntimeError(
+                f"replica {st.name} answered {code}: "
+                f"{decoded.get('error', decoded)}"
+            )
+        self.stats["edge_sheds"] += 1
+        raise ShedError(
+            "all replicas shed" if saw_shed else "no replica reachable",
+            retry_after_s=shed_hint or self.shed_retry_after_s,
+        )
+
+    # -- the router's own HTTP surface ---------------------------------- #
+
+    def topology(self) -> dict:
+        """The ``/snapshot`` body: per-replica state + router counters
+        (and the manager's spawn/loss ledger when attached)."""
+        out = {
+            "router": True,
+            "replicas": [s.describe() for s in self.states()],
+            "stats": dict(self.stats),
+            "drain_burn": self.drain_burn,
+        }
+        if self.manager is not None:
+            out["manager"] = self.manager.describe()
+        return out
+
+    @property
+    def port(self) -> int:
+        return self._server.port if self._server is not None else self._port
+
+    def start(self) -> "FleetRouter":
+        from distributed_sddmm_tpu.obs.httpexp import AdminServer
+
+        if self._thread is not None:
+            raise RuntimeError("router already started")
+        self.poll_once()  # candidates exist before the first request
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._poll_loop, daemon=True, name="fleet-router-poll",
+        )
+        self._thread.start()
+        self._server = AdminServer(
+            snapshot_fn=self.topology, submit_fn=self.route,
+            port=self._port,
+        ).start()
+        obs_log.info("fleet", "router serving",
+                     url=f"http://127.0.0.1:{self._server.port}")
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(5.0)
+            self._thread = None
+        if self._server is not None:
+            self._server.stop()
+            self._server = None
+
+    def __enter__(self) -> "FleetRouter":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()

@@ -1,0 +1,188 @@
+"""Telemetry-driven autoscaling over the replica pool.
+
+The scaler reads the SAME snapshot stream everything else does
+(:func:`~distributed_sddmm_tpu.obs.telemetry.engine_snapshot` — live
+``/snapshot`` endpoints via :meth:`FleetManager.snapshots`, or sampler
+JSONL lines replayed in tests) and makes exactly two moves:
+
+* **Scale up** when pressure (queue depth fraction ≥ ``high_depth_frac``
+  or SLO burn ≥ ``high_burn``) is *sustained* for ``sustain_ticks``
+  consecutive observations — a single Poisson burst must not spawn a
+  replica whose warmup outlives the burst.
+* **Scale down** by drain-then-reap (never a kill: queued work finishes
+  and the record is collected) after ``idle_ticks`` consecutive idle
+  observations.
+
+Both moves respect ``min_replicas``/``max_replicas`` bounds and a
+``cooldown_s`` between actions, so decisions cannot oscillate faster
+than replicas warm. The decision core (:meth:`AutoScaler.step`) is a
+pure-ish synchronous function of the snapshot dict — tests drive it
+with fabricated snapshots and a fake manager; :meth:`AutoScaler.start`
+wraps it in the usual daemon-thread loop for live fleets.
+
+Knobs (all ``DSDDMM_FLEET_*``, registered in ``utils/envreg.py``):
+MIN/MAX bounds, HIGH_DEPTH/HIGH_BURN thresholds, IDLE_S idle window,
+COOLDOWN seconds between actions.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import threading
+import time
+from typing import Optional
+
+from distributed_sddmm_tpu.obs import log as obs_log
+
+
+def _cast(v, default, cast):
+    return cast(v) if v not in (None, "") else default
+
+
+@dataclasses.dataclass
+class ScalerConfig:
+    min_replicas: int = 1
+    max_replicas: int = 4
+    #: Pressure thresholds: either sustained past ``sustain_ticks``
+    #: observations triggers a spawn.
+    high_depth_frac: float = 0.7
+    high_burn: float = 1.0
+    #: Idle: every replica's depth fraction at or under this.
+    idle_depth_frac: float = 0.05
+    sustain_ticks: int = 3
+    #: Idle observations before a drain (the interval_s multiplier —
+    #: from_env derives it from DSDDMM_FLEET_IDLE_S).
+    idle_ticks: int = 20
+    cooldown_s: float = 5.0
+    interval_s: float = 0.5
+
+    @classmethod
+    def from_env(cls) -> "ScalerConfig":
+        interval_s = 0.5
+        idle_s = _cast(os.environ.get("DSDDMM_FLEET_IDLE_S"), 10.0, float)
+        return cls(
+            min_replicas=_cast(os.environ.get("DSDDMM_FLEET_MIN"), 1, int),
+            max_replicas=_cast(os.environ.get("DSDDMM_FLEET_MAX"), 4, int),
+            high_depth_frac=_cast(
+                os.environ.get("DSDDMM_FLEET_HIGH_DEPTH"), 0.7, float),
+            high_burn=_cast(
+                os.environ.get("DSDDMM_FLEET_HIGH_BURN"), 1.0, float),
+            cooldown_s=_cast(
+                os.environ.get("DSDDMM_FLEET_COOLDOWN"), 5.0, float),
+            interval_s=interval_s,
+            idle_ticks=max(1, int(idle_s / interval_s)),
+        )
+
+
+class AutoScaler:
+    """Sustained-pressure spawn / sustained-idle drain over a
+    :class:`~distributed_sddmm_tpu.fleet.manager.FleetManager`."""
+
+    def __init__(self, manager, config: Optional[ScalerConfig] = None):
+        self.manager = manager
+        self.config = config or ScalerConfig.from_env()
+        self._high_streak = 0
+        self._idle_streak = 0
+        self._last_action_t = float("-inf")
+        #: Decision log for the fleet record: (t_monotonic, action, why).
+        self.actions: list[dict] = []
+
+    # -- the decision core ---------------------------------------------- #
+
+    @staticmethod
+    def _pressure(snap: dict) -> tuple[float, float]:
+        depth = float(snap.get("depth_frac") or 0.0)
+        burn = snap.get("burn_rate")
+        return depth, float(burn) if burn is not None else 0.0
+
+    def step(self, snapshots: dict, now: Optional[float] = None
+             ) -> Optional[str]:
+        """One observation → at most one action. ``snapshots`` is
+        ``{replica_name: snapshot_dict_or_None}``; an unreachable
+        replica (None) is treated as pressure — it is not absorbing
+        load, whatever its queue claims. Returns ``"scale_up"``,
+        ``"scale_down"``, or None."""
+        cfg = self.config
+        now = time.monotonic() if now is None else now
+        live = self.manager.replicas(role="serve")
+        n = len(live)
+        snaps = [snapshots.get(r.name) for r in live]
+        if not snaps:
+            return None
+        high = any(
+            s is None
+            or self._pressure(s)[0] >= cfg.high_depth_frac
+            or self._pressure(s)[1] >= cfg.high_burn
+            for s in snaps
+        )
+        idle = all(
+            s is not None and self._pressure(s)[0] <= cfg.idle_depth_frac
+            and self._pressure(s)[1] < cfg.high_burn
+            for s in snaps
+        )
+        if high:
+            self._high_streak += 1
+            self._idle_streak = 0
+        elif idle:
+            self._idle_streak += 1
+            self._high_streak = 0
+        else:
+            self._high_streak = 0
+            self._idle_streak = 0
+
+        if now - self._last_action_t < cfg.cooldown_s:
+            return None
+        if self._high_streak >= cfg.sustain_ticks and n < cfg.max_replicas:
+            rep = self.manager.spawn(role="serve")
+            self._note(now, "scale_up", replicas=n + 1, spawned=rep.name,
+                       streak=self._high_streak)
+            self._high_streak = 0
+            self._last_action_t = now
+            return "scale_up"
+        if self._idle_streak >= cfg.idle_ticks and n > cfg.min_replicas:
+            # Drain the newest non-tuner replica: the canary's shadow
+            # state is the most expensive thing in the fleet to lose.
+            victims = sorted(
+                (r for r in live if not r.tuner),
+                key=lambda r: r.t_spawn, reverse=True,
+            )
+            if not victims:
+                return None
+            self.manager.drain(victims[0].name)
+            self._note(now, "scale_down", replicas=n - 1,
+                       drained=victims[0].name, streak=self._idle_streak)
+            self._idle_streak = 0
+            self._last_action_t = now
+            return "scale_down"
+        return None
+
+    def _note(self, now: float, action: str, **why) -> None:
+        self.actions.append({"t": round(now, 3), "action": action, **why})
+        obs_log.info("fleet", f"autoscaler {action}", **why)
+
+    # -- live loop ------------------------------------------------------ #
+
+    def start(self) -> "AutoScaler":
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True, name="fleet-scaler",
+        )
+        self._thread.start()
+        return self
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self.step(self.manager.snapshots())
+            except Exception as e:  # noqa: BLE001 — the loop must survive
+                obs_log.warn("fleet", "scaler step failed",
+                             error=f"{type(e).__name__}: {e}")
+            self._stop.wait(self.config.interval_s)
+
+    def stop(self) -> None:
+        stop = getattr(self, "_stop", None)
+        if stop is None:
+            return
+        stop.set()
+        self._thread.join(5.0)

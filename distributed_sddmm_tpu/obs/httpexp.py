@@ -30,6 +30,12 @@ Endpoints (:class:`AdminServer`):
   the last N enqueue→batch→reply chains with their segment splits.
 * ``/snapshot`` — the :func:`~distributed_sddmm_tpu.obs.telemetry.
   engine_snapshot` JSON (``bench top --admin-port`` reads this).
+* ``POST /submit`` — request ingestion (only when a ``submit_fn`` is
+  injected — ``bench serve --serve-http`` replica mode): JSON
+  ``{"payload": {...}, "tenant": "...", "serial": false}`` → the reply
+  JSON, or 429 + ``Retry-After`` when admission control sheds (the
+  ``ShedError.retry_after_s`` hint, end to end). The fleet router
+  (``fleet/router.py``) fronts a pool of these.
 
 Two sources, one exposition: a **live engine** (``bench serve
 --admin-port``) scrapes the engine/recorder/queue directly; a
@@ -93,6 +99,21 @@ KNOWN_GLOBAL_COUNTERS: dict = {
 
 #: Exposition metric-name prefix.
 PREFIX = "dsddmm"
+
+
+def _json_default(o):
+    """JSON fallback for numpy payloads/replies crossing the wire: array
+    ``tolist()`` / scalar ``item()`` keep int64 and float values exact
+    (JSON numbers round-trip Python ints losslessly and floats via
+    shortest-repr), so a decoded payload re-normalized by the workload's
+    ``clamp`` is bit-identical to the original."""
+    tolist = getattr(o, "tolist", None)
+    if callable(tolist):
+        return tolist()
+    item = getattr(o, "item", None)
+    if callable(item):
+        return item()
+    return str(o)
 
 
 def _fmt_value(v) -> str:
@@ -313,11 +334,15 @@ class AdminServer:
         burn_threshold: float = 1.0,
         ring_capacity: int = 512,
         debug_requests_limit: int = 64,
+        submit_fn: Optional[Callable] = None,
     ):
         self.engine = engine
         self.op_metrics = op_metrics
         self.slo = slo
         self.snapshot_fn = snapshot_fn
+        #: ``submit_fn(payload, tenant=..., serial=..., timeout_s=...)``
+        #: → reply dict. None keeps the server read-only (no /submit).
+        self.submit_fn = submit_fn
         self.host = host
         self.port = int(port)
         self.burn_threshold = float(burn_threshold)
@@ -442,9 +467,9 @@ class AdminServer:
             server_version = "dsddmm-admin/1"
             protocol_version = "HTTP/1.1"
 
-            def do_GET(self):  # noqa: N802 — http.server API
+            def _guarded(self, route):
                 try:
-                    admin._route(self)
+                    route(self)
                 except BrokenPipeError:
                     pass
                 except Exception as e:  # noqa: BLE001 — 500, never die
@@ -458,6 +483,12 @@ class AdminServer:
                         self.wfile.write(payload)
                     except Exception:  # noqa: BLE001
                         pass
+
+            def do_GET(self):  # noqa: N802 — http.server API
+                self._guarded(admin._route)
+
+            def do_POST(self):  # noqa: N802 — http.server API
+                self._guarded(admin._route_post)
 
             def log_message(self, fmt, *args):  # silence stderr chatter
                 obs_log.debug("admin", fmt % args)
@@ -524,29 +555,81 @@ class AdminServer:
             else:
                 self._send_json(handler, 200, snap)
         elif path == "/":
+            endpoints = ["/metrics", "/healthz", "/readyz",
+                         "/debug/requests", "/snapshot"]
+            if self.submit_fn is not None:
+                endpoints.append("POST /submit")
             self._send_json(handler, 200, {
-                "endpoints": ["/metrics", "/healthz", "/readyz",
-                              "/debug/requests", "/snapshot"],
+                "endpoints": endpoints,
                 "t_epoch": clock.epoch(),
             })
         else:
             self._send(handler, 404, f"no such endpoint: {path}\n",
                        "text/plain")
 
+    def _route_post(self, handler: BaseHTTPRequestHandler) -> None:
+        from distributed_sddmm_tpu.serve.queue import ShedError
+
+        path = urlsplit(handler.path).path.rstrip("/") or "/"
+        if path != "/submit" or self.submit_fn is None:
+            self._send(handler, 404, f"no such POST endpoint: {path}\n",
+                       "text/plain")
+            return
+        length = int(handler.headers.get("Content-Length") or 0)
+        raw = handler.rfile.read(length) if length else b""
+        try:
+            body = json.loads(raw.decode() or "{}")
+        except (UnicodeDecodeError, json.JSONDecodeError) as e:
+            self._send_json(handler, 400, {"error": f"bad JSON: {e}"})
+            return
+        payload = body.get("payload")
+        if not isinstance(payload, dict):
+            self._send_json(handler, 400,
+                            {"error": "body.payload must be an object"})
+            return
+        tenant = str(body.get("tenant") or "default")
+        serial = bool(body.get("serial"))
+        timeout_s = float(body.get("timeout_s") or 30.0)
+        try:
+            reply = self.submit_fn(payload, tenant=tenant, serial=serial,
+                                   timeout_s=timeout_s)
+        except ShedError as e:
+            # The backpressure hint crosses the process boundary as the
+            # standard header; the fleet router forwards it verbatim.
+            retry_s = float(getattr(e, "retry_after_s", 0.0) or 0.0)
+            self._send_json(
+                handler, 429,
+                {"error": str(e), "shed": True, "retry_after_s": retry_s},
+                extra_headers={"Retry-After": f"{retry_s:.3f}"},
+            )
+        except ValueError as e:
+            self._send_json(handler, 400, {"error": str(e)})
+        except Exception as e:  # noqa: BLE001 — typed 500, never die
+            self._send_json(
+                handler, 500,
+                {"error": f"{type(e).__name__}: {e}"},
+            )
+        else:
+            self._send_json(handler, 200, {"reply": reply, "tenant": tenant})
+
     @staticmethod
-    def _send(handler, code: int, body: str, content_type: str) -> None:
+    def _send(handler, code: int, body: str, content_type: str,
+              extra_headers: Optional[dict] = None) -> None:
         payload = body.encode()
         handler.send_response(code)
         handler.send_header("Content-Type", content_type)
         handler.send_header("Content-Length", str(len(payload)))
+        for k, v in (extra_headers or {}).items():
+            handler.send_header(k, v)
         handler.end_headers()
         handler.wfile.write(payload)
 
     @staticmethod
-    def _send_json(handler, code: int, body: dict) -> None:
+    def _send_json(handler, code: int, body: dict,
+                   extra_headers: Optional[dict] = None) -> None:
         AdminServer._send(
-            handler, code, json.dumps(body, default=str) + "\n",
-            "application/json",
+            handler, code, json.dumps(body, default=_json_default) + "\n",
+            "application/json", extra_headers=extra_headers,
         )
 
 
@@ -560,3 +643,34 @@ def fetch_json(host: str, port: int, path: str = "/snapshot",
     url = f"http://{host}:{port}{path}"
     with urllib.request.urlopen(url, timeout=timeout_s) as resp:
         return json.loads(resp.read().decode())
+
+
+def post_json(
+    host: str, port: int, path: str, body: dict, timeout_s: float = 30.0,
+) -> tuple[int, dict, dict]:
+    """POST JSON to a local admin/router server; returns ``(status,
+    decoded_body, headers)``. HTTP error statuses (429/4xx/5xx) are
+    returned, not raised — a shed IS a reply and its ``Retry-After``
+    header is in the caller's contract. Connection-level failures
+    (refused, reset, timeout) still raise the ``OSError`` family —
+    that is how a router tells a dead replica from a shedding one."""
+    import urllib.error
+    import urllib.request
+
+    url = f"http://{host}:{port}{path}"
+    data = json.dumps(body, default=_json_default).encode()
+    req = urllib.request.Request(
+        url, data=data, method="POST",
+        headers={"Content-Type": "application/json"},
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout_s) as resp:
+            return (resp.status, json.loads(resp.read().decode()),
+                    dict(resp.headers))
+    except urllib.error.HTTPError as e:
+        raw = e.read()
+        try:
+            decoded = json.loads(raw.decode())
+        except Exception:  # noqa: BLE001 — non-JSON error body
+            decoded = {"error": raw.decode(errors="replace")}
+        return e.code, decoded, dict(e.headers)

@@ -42,7 +42,12 @@ def log(level: str, component: str, msg: str, **fields) -> None:
     with _write_lock:
         sys.stderr.write(line + "\n")
     if trace.enabled():
-        trace.event("log", level=level, component=component, msg=msg, **fields)
+        attrs = {"level": level, "component": component, "msg": msg}
+        for k, v in fields.items():
+            # "name" is trace.event's own positional (the event name);
+            # a log field by that name must not shadow it.
+            attrs[k if k != "name" else "name_"] = v
+        trace.event("log", **attrs)
 
 
 def debug(component: str, msg: str, **fields) -> None:

@@ -50,12 +50,17 @@ def _optional_axis(name: str) -> bool:
     """Axes that only exist when optional telemetry ran (SLO burn rate
     needs an SLO spec; XLA cost needs the program store; time-to-adapt
     needs the background tuner to have promoted). Their absence in the
-    judged run is "not measured", never a gate failure."""
+    judged run is "not measured", never a gate failure. The
+    ``serve:burn_rate`` prefix covers the per-tenant sub-axes
+    (``serve:burn_rate:<tenant>``) — a run without that tenant declared
+    simply did not measure it; ``fleet:`` axes exist only for ``bench
+    fleet`` records."""
     return (
         name.startswith("xla:")
         or name.startswith("tuner:")
         or name.startswith("comm:")
-        or name == "serve:burn_rate"
+        or name.startswith("fleet:")
+        or name.startswith("serve:burn_rate")
     )
 
 
@@ -131,6 +136,7 @@ def phase_stats(doc: dict) -> dict[str, dict]:
     out.update(_xla_rows(doc))
     out.update(_tuner_rows(doc))
     out.update(_comm_bytes_rows(doc))
+    out.update(_fleet_rows(doc))
     return out
 
 
@@ -176,7 +182,36 @@ def _serving_rows(doc: dict) -> dict[str, dict]:
         rows["serve:burn_rate"] = _pseudo_row(
             requests, float(rec["burn_rate"])
         )
+    for tname, cell in sorted((rec.get("tenant") or {}).items()):
+        # Multi-tenant QoS (PR 16): each tenant with its own SLO gets
+        # its own burn-rate axis, so one tenant's budget burning inside
+        # a healthy aggregate still regresses. OPTIONAL like the
+        # fleet-wide axis (startswith in _optional_axis).
+        if cell.get("burn_rate") is not None:
+            rows[f"serve:burn_rate:{tname}"] = _pseudo_row(
+                max(int(cell.get("requests") or 0), 1),
+                float(cell["burn_rate"]),
+            )
     return rows
+
+
+def _fleet_rows(doc: dict) -> dict[str, dict]:
+    """The fleet verdict axis (``bench fleet`` records):
+    ``fleet:availability`` as a pseudo-phase whose ``t_call`` is the
+    UNAVAILABLE fraction ``max(1 - availability, 0.01)`` — the gate's
+    higher-is-worse convention, floored so a perfect baseline does not
+    make every subsequent run read as an infinite regression. OPTIONAL
+    in compare(): only fleet records carry the field."""
+    fleet = (doc.get("record") or {}).get("fleet") or {}
+    avail = fleet.get("availability")
+    if avail is None:
+        return {}
+    offered = max(int(fleet.get("offered") or 0), 1)
+    return {
+        "fleet:availability": _pseudo_row(
+            offered, max(1.0 - float(avail), 0.01)
+        ),
+    }
 
 
 def _xla_rows(doc: dict) -> dict[str, dict]:
@@ -343,6 +378,10 @@ def compare(
                 # Serving axes carry no comm/overhead split to blame;
                 # the axis itself names what went bad.
                 row["attribution"] = "serving"
+            elif name.startswith("fleet:"):
+                # Availability moved: a replica-lifecycle or routing
+                # problem, not a kernel one.
+                row["attribution"] = "fleet"
             elif name.startswith("xla:"):
                 # Agreement drifted: either the analytic count or the
                 # compiled program changed — the axis IS the blame.

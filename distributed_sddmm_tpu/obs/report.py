@@ -277,6 +277,55 @@ def _attention_table(rows: list[tuple]) -> str:
     return f"<table>{head}{''.join(body)}</table>"
 
 
+def _fleet_rows(store, rows: list[dict]) -> list[tuple]:
+    """(run_id, replicas, chaos, availability, ok, shed+deferred,
+    losses, mismatches, replacement live compiles, worst tenant burn)
+    for every ``bench fleet`` run — the serving-fleet ops panel."""
+    out = []
+    for r in rows:
+        doc = store.get(r["run_id"]) or {}
+        rec = doc.get("record") or {}
+        fleet = rec.get("fleet") or {}
+        if fleet.get("availability") is None:
+            continue
+        worst = None
+        for name, cell in (rec.get("tenant") or {}).items():
+            b = cell.get("burn_rate")
+            if b is not None and (worst is None or b > worst[1]):
+                worst = (name, b)
+        out.append((
+            r.get("run_id"), fleet.get("replicas"), fleet.get("chaos"),
+            fleet.get("availability"), fleet.get("ok"),
+            (fleet.get("shed_with_retry") or 0)
+            + (fleet.get("deferred") or 0),
+            fleet.get("losses"), fleet.get("mismatches"),
+            fleet.get("replacement_live_compiles"), worst,
+        ))
+    return out
+
+
+def _fleet_table(rows: list[tuple]) -> str:
+    head = (
+        "<tr><th class=l>run</th><th>replicas</th><th class=l>chaos</th>"
+        "<th>availability</th><th>ok</th><th>shed/deferred</th>"
+        "<th>losses</th><th>mismatches</th><th>respawn compiles</th>"
+        "<th class=l>worst tenant burn</th></tr>"
+    )
+    body = []
+    for run, n, chaos, avail, ok, shed, losses, mism, rlc, worst in rows:
+        body.append(
+            f"<tr><td class=l>{_esc((run or '')[:24])}</td>"
+            f"<td>{_fmt(n, 0)}</td><td class=l>{_esc(chaos or '-')}</td>"
+            f"<td>{_fmt(avail * 100, 2) + '%' if avail is not None else '-'}"
+            f"</td><td>{_fmt(ok, 0)}</td><td>{_fmt(shed, 0)}</td>"
+            f"<td>{_fmt(losses, 0)}</td><td>{_fmt(mism, 0)}</td>"
+            f"<td>{_fmt(rlc, 0)}</td>"
+            f"<td class=l>{_esc(f'{worst[0]} ({worst[1]:.2f}x)') if worst else '-'}"
+            f"</td></tr>"
+        )
+    return f"<table>{head}{''.join(body)}</table>"
+
+
 def _trend_series(store, rows: list[dict]) -> tuple[dict, dict]:
     """(per-phase t/call series, headline series) across ``rows``."""
     per_phase: dict[str, list] = {}
@@ -376,6 +425,19 @@ def build_html(
             "<p class=meta>1.0 = burning exactly at budget; above the "
             "line the SLO will be violated if the window holds.</p>",
             f'<img src="{png}" alt="burn rate trend">',
+        ]
+
+    fleet = _fleet_rows(store, all_rows)
+    if fleet:
+        sections += [
+            "<h2>Serving fleet (all fleet runs)</h2>",
+            "<p class=meta>Replica pool behind the front router: "
+            "availability = (answered + shed-with-retry + deferred) / "
+            "offered through the chaos window; mismatches compare every "
+            "reply bit-for-bit against the single-engine oracle; "
+            "respawn compiles must be 0 (warm-start from the shared "
+            "program store).</p>",
+            _fleet_table(fleet),
         ]
 
     if len(focus_rows) >= 2:

@@ -249,6 +249,38 @@ def engine_snapshot(engine, slo=None, run_id: str | None = None) -> dict:
     }
     if slo is not None:
         snap["burn_rate"] = slo.burn_rate(summary)
+    # Bucket ladders — the router's structure-aware admission signal: a
+    # request routes to a replica whose warm ladder fits its inner size.
+    try:
+        snap["buckets"] = {
+            "batch": list(engine.batch_buckets),
+            "inner": list(engine.workload.inner_buckets),
+        }
+    except Exception:  # noqa: BLE001 — telemetry never fails serving
+        pass
+    # Per-tenant QoS view: live queue depths from the weighted-fair
+    # scheduler plus the recorder's per-tenant breakdown (when any
+    # named tenant has shown up).
+    q_tenants = getattr(q, "tenants", None) or {}
+    if set(q_tenants) - {"default"} and hasattr(q, "tenant_depths"):
+        tenant_view: dict[str, dict] = {}
+        depths = q.tenant_depths()
+        shed = dict(getattr(q, "tenant_shed", {}))
+        sub = dict(getattr(q, "tenant_submitted", {}))
+        rec_tenants = summary.get("tenant") or {}
+        for name, spec in q_tenants.items():
+            cell = {
+                "depth": depths.get(name, 0),
+                "submitted": sub.get(name, 0),
+                "queue_shed": shed.get(name, 0),
+                "weight": spec.weight,
+            }
+            cell.update(rec_tenants.get(name, {}))
+            t_slo = getattr(spec, "slo", None)
+            if t_slo is not None and name in rec_tenants:
+                cell["burn_rate"] = t_slo.burn_rate(rec_tenants[name])
+            tenant_view[name] = cell
+        snap["tenant"] = tenant_view
     tuner = getattr(engine, "tuner", None)
     if tuner is not None:
         # The closed-loop tuner's live state (state machine phase,

@@ -24,10 +24,12 @@ from typing import Optional
 
 from distributed_sddmm_tpu.serve.engine import ServingEngine
 from distributed_sddmm_tpu.serve.queue import (
-    Request, RequestError, RequestQueue, ShedError,
+    DEFAULT_TENANT, Request, RequestError, RequestQueue, ShedError,
+    TenantSpec,
 )
 from distributed_sddmm_tpu.serve.slo import (
-    LatencyRecorder, SLOSpec, percentile, run_load,
+    LatencyRecorder, SLOSpec, parse_tenants, percentile, run_load,
+    tenants_from_env,
 )
 from distributed_sddmm_tpu.serve.workloads import (
     ALSFoldInTopK, AttentionTokenScore, GATNodeScore, ServingWorkload,
@@ -35,11 +37,12 @@ from distributed_sddmm_tpu.serve.workloads import (
 )
 
 __all__ = [
-    "ALSFoldInTopK", "AttentionTokenScore", "GATNodeScore",
+    "ALSFoldInTopK", "AttentionTokenScore", "DEFAULT_TENANT", "GATNodeScore",
     "LatencyRecorder", "Request", "RequestError", "RequestQueue",
-    "ServingEngine", "ServingWorkload", "ShedError", "SLOSpec",
+    "ServingEngine", "ServingWorkload", "ShedError", "SLOSpec", "TenantSpec",
     "bucket_for", "build_als_engine", "build_attention_engine",
-    "build_gat_engine", "percentile", "run_load",
+    "build_gat_engine", "parse_tenants", "percentile", "run_load",
+    "tenants_from_env",
 ]
 
 

@@ -42,7 +42,9 @@ from distributed_sddmm_tpu.obs import trace as obs_trace
 from distributed_sddmm_tpu.obs import watchdog as obs_watchdog
 from distributed_sddmm_tpu.resilience import faults
 from distributed_sddmm_tpu.resilience.guards import NumericalFault
-from distributed_sddmm_tpu.serve.queue import Request, RequestError, RequestQueue
+from distributed_sddmm_tpu.serve.queue import (
+    DEFAULT_TENANT, Request, RequestError, RequestQueue,
+)
 from distributed_sddmm_tpu.serve.slo import LatencyRecorder
 from distributed_sddmm_tpu.serve.workloads import ServingWorkload, bucket_for
 from distributed_sddmm_tpu.utils.buckets import pow2_ladder
@@ -78,10 +80,12 @@ class ServingEngine:
         exec_retries: Optional[int] = None,
         recorder: Optional[LatencyRecorder] = None,
         program_store=None,
+        tenants=None,
     ):
         self.workload = workload
         self.queue = RequestQueue(
-            max_depth=max_depth, max_batch=max_batch, max_wait_ms=max_wait_ms
+            max_depth=max_depth, max_batch=max_batch, max_wait_ms=max_wait_ms,
+            tenants=tenants,
         )
         self.batch_buckets = tuple(
             sorted(batch_buckets or _default_batch_buckets(max_batch))
@@ -302,10 +306,12 @@ class ServingEngine:
     # Client surface
     # ------------------------------------------------------------------ #
 
-    def submit(self, payload: dict) -> Request:
+    def submit(self, payload: dict, tenant: str = DEFAULT_TENANT) -> Request:
         """Admit one request (sheds with
         :class:`~distributed_sddmm_tpu.serve.queue.ShedError` when the
-        queue is at depth)."""
+        queue is at depth). ``tenant`` must be a class declared at
+        construction; the queue's weighted-fair scheduler isolates the
+        classes from each other."""
         from distributed_sddmm_tpu.serve.queue import ShedError
 
         wd = obs_watchdog.active()
@@ -317,16 +323,17 @@ class ServingEngine:
             try:
                 wd.observe_queue(self.queue.depth(), self.queue.max_depth)
             except NumericalFault:
-                self.recorder.record_shed()
+                self.recorder.record_shed(tenant)
                 obs_metrics.GLOBAL.add("serve_shed")
                 raise ShedError(
                     "queue runaway (watchdog strict)",
                     retry_after_s=self.queue.max_wait_ms / 1e3,
                 ) from None
         try:
-            return self.queue.submit(self.workload.clamp(payload))
+            return self.queue.submit(self.workload.clamp(payload),
+                                     tenant=tenant)
         except ShedError:
-            self.recorder.record_shed()
+            self.recorder.record_shed(tenant)
             obs_metrics.GLOBAL.add("serve_shed")
             raise
 

@@ -21,6 +21,20 @@ Every request carries its timeline (enqueue → admit → execute → reply
 monotonic stamps, all read through ``obs.clock``); ``serve/slo.py``
 turns those into the percentile histograms the SLO gate judges.
 
+Multi-tenant QoS (PR 16): the queue holds one FIFO **per tenant class**
+(:class:`TenantSpec` — a name, a scheduling weight, and an optional
+per-tenant SLO spec the recorder judges burn against) and extracts
+micro-batches by **stride scheduling**: each tenant carries a virtual
+``pass`` advanced by ``1/weight`` per dequeued request, and the batcher
+always drains the non-empty tenant with the smallest pass — over any
+busy window tenants receive service in weight proportion, while a lone
+tenant degenerates to the exact FIFO the single-tenant engine always
+had. Admission stays one shared ``max_depth`` bound (a fleet router
+does cross-replica isolation; inside one replica the bound is the
+latency protection), but sheds and submissions are **counted per
+tenant** so the serve record, telemetry snapshot and gate axes can
+judge each class separately.
+
 Trace context: the request id minted at :meth:`~RequestQueue.submit` is
 the correlation key the whole serving path carries — the queue emits a
 ``serve:enqueue`` event per admission (and ``serve:shed`` per
@@ -33,12 +47,53 @@ any request's enqueue→reply timeline from the trace alone.
 from __future__ import annotations
 
 import collections
+import dataclasses
 import itertools
 import threading
 from typing import Any, Optional
 
 from distributed_sddmm_tpu.obs import clock
 from distributed_sddmm_tpu.obs import trace as obs_trace
+
+#: The implicit tenant every un-labeled request belongs to.
+DEFAULT_TENANT = "default"
+
+
+@dataclasses.dataclass(frozen=True)
+class TenantSpec:
+    """One tenant class: a scheduling weight and (optionally) its own
+    SLO. ``slo`` is opaque to the queue (an
+    :class:`~distributed_sddmm_tpu.serve.slo.SLOSpec`; ``serve/slo.py``
+    parses specs and computes per-tenant burn) — the queue only
+    schedules and counts."""
+
+    name: str
+    weight: float = 1.0
+    slo: Optional[object] = None
+
+    def __post_init__(self):
+        if not self.name or any(c in self.name for c in ":;,= \t"):
+            raise ValueError(f"bad tenant name {self.name!r}")
+        if not self.weight > 0:
+            raise ValueError(
+                f"tenant {self.name!r} weight must be > 0, "
+                f"got {self.weight}"
+            )
+
+
+def _normalize_tenants(tenants) -> dict[str, TenantSpec]:
+    if not tenants:
+        return {DEFAULT_TENANT: TenantSpec(DEFAULT_TENANT)}
+    if isinstance(tenants, dict):
+        specs = list(tenants.values())
+    else:
+        specs = list(tenants)
+    out = {}
+    for spec in specs:
+        if spec.name in out:
+            raise ValueError(f"duplicate tenant {spec.name!r}")
+        out[spec.name] = spec
+    return out
 
 
 class ShedError(RuntimeError):
@@ -72,13 +127,15 @@ class Request:
     """
 
     __slots__ = (
-        "req_id", "payload", "t_enqueue", "t_admit", "t_execute", "t_reply",
-        "degraded", "_done", "_value", "_error",
+        "req_id", "payload", "tenant", "t_enqueue", "t_admit", "t_execute",
+        "t_reply", "degraded", "_done", "_value", "_error",
     )
 
-    def __init__(self, req_id: int, payload: Any):
+    def __init__(self, req_id: int, payload: Any,
+                 tenant: str = DEFAULT_TENANT):
         self.req_id = req_id
         self.payload = payload
+        self.tenant = tenant
         self.t_enqueue: float = 0.0
         self.t_admit: Optional[float] = None
         self.t_execute: Optional[float] = None
@@ -146,7 +203,10 @@ class RequestQueue:
     ``max_batch``/``max_wait_ms`` shape the micro-batches
     :meth:`next_batch` hands the engine. ``drain_rate_hint`` (requests/s,
     updated by the engine from observed throughput) feeds the
-    ``retry_after_s`` hint on shed.
+    ``retry_after_s`` hint on shed. ``tenants`` (a list/dict of
+    :class:`TenantSpec`) enables weighted-fair scheduling across tenant
+    classes; omitted, the queue is the single implicit
+    :data:`DEFAULT_TENANT` and behaves exactly as it always has.
     """
 
     def __init__(
@@ -154,19 +214,35 @@ class RequestQueue:
         max_depth: int = 256,
         max_batch: int = 16,
         max_wait_ms: float = 5.0,
+        tenants=None,
     ):
         if max_depth < 1 or max_batch < 1:
             raise ValueError("max_depth and max_batch must be >= 1")
         self.max_depth = int(max_depth)
         self.max_batch = int(max_batch)
         self.max_wait_ms = float(max_wait_ms)
-        self._q: collections.deque[Request] = collections.deque()
+        self.tenants: dict[str, TenantSpec] = _normalize_tenants(tenants)
+        #: One FIFO per tenant class; total depth is what admission
+        #: bounds.
+        self._queues: dict[str, collections.deque[Request]] = {
+            name: collections.deque() for name in self.tenants
+        }
+        #: Stride scheduling state: each dequeue advances the tenant's
+        #: virtual pass by 1/weight; the batcher drains the non-empty
+        #: tenant with the smallest pass.
+        self._stride = {
+            name: 1.0 / spec.weight for name, spec in self.tenants.items()
+        }
+        self._pass = {name: 0.0 for name in self.tenants}
+        self._depth = 0
         self._lock = threading.Lock()
         self._not_empty = threading.Condition(self._lock)
         self._ids = itertools.count()
         self._closed = False
         self.shed_count = 0
         self.submitted_count = 0
+        self.tenant_shed = {name: 0 for name in self.tenants}
+        self.tenant_submitted = {name: 0 for name in self.tenants}
         #: Engine-maintained throughput estimate for retry_after hints.
         self.drain_rate_hint: float = 0.0
 
@@ -174,17 +250,25 @@ class RequestQueue:
     # Client side
     # ------------------------------------------------------------------ #
 
-    def submit(self, payload: Any) -> Request:
+    def submit(self, payload: Any, tenant: str = DEFAULT_TENANT) -> Request:
         """Admit one request (raises :class:`ShedError` when full, or
         ``RuntimeError`` after :meth:`close`). Admissions and sheds emit
         ``serve:enqueue`` / ``serve:shed`` trace events carrying the
-        request id — the head of the request's trace chain."""
+        request id — the head of the request's trace chain. An unknown
+        ``tenant`` raises ``ValueError`` — a typo'd class silently
+        scheduled at default weight would defeat the QoS contract."""
+        if tenant not in self.tenants:
+            raise ValueError(
+                f"unknown tenant {tenant!r}; declared: "
+                f"{sorted(self.tenants)}"
+            )
         with self._lock:
             if self._closed:
                 raise RuntimeError("queue is closed")
-            if len(self._q) >= self.max_depth:
+            if self._depth >= self.max_depth:
                 self.shed_count += 1
-                depth = len(self._q)
+                self.tenant_shed[tenant] += 1
+                depth = self._depth
                 rate = self.drain_rate_hint
                 retry_after = (
                     depth / rate if rate > 0
@@ -192,15 +276,29 @@ class RequestQueue:
                 )
                 shed_id = next(self._ids)
             else:
-                req = Request(next(self._ids), payload)
+                req = Request(next(self._ids), payload, tenant=tenant)
                 req.t_enqueue = clock.now()
-                self._q.append(req)
+                q = self._queues[tenant]
+                if not q:
+                    # A tenant waking from idle must not replay the
+                    # service it did not ask for: its pass catches up to
+                    # the busiest tenants' floor instead of draining a
+                    # backlog of virtual credit.
+                    floor = min(
+                        (self._pass[t] for t, d in self._queues.items()
+                         if d), default=self._pass[tenant],
+                    )
+                    self._pass[tenant] = max(self._pass[tenant], floor)
+                q.append(req)
+                self._depth += 1
                 self.submitted_count += 1
-                depth = len(self._q)
+                self.tenant_submitted[tenant] += 1
+                depth = self._depth
                 self._not_empty.notify()
                 shed_id = None
         if shed_id is not None:
             obs_trace.event("serve:shed", req=shed_id, depth=depth,
+                            tenant=tenant,
                             retry_after_s=round(retry_after, 6))
             raise ShedError(
                 f"queue full ({depth}/{self.max_depth}); "
@@ -208,12 +306,18 @@ class RequestQueue:
                 retry_after_s=retry_after,
             )
         if obs_trace.enabled():
-            obs_trace.event("serve:enqueue", req=req.req_id, depth=depth)
+            obs_trace.event("serve:enqueue", req=req.req_id, depth=depth,
+                            tenant=tenant)
         return req
 
     def depth(self) -> int:
         with self._lock:
-            return len(self._q)
+            return self._depth
+
+    def tenant_depths(self) -> dict[str, int]:
+        """Live per-tenant backlog (telemetry snapshot field)."""
+        with self._lock:
+            return {name: len(q) for name, q in self._queues.items()}
 
     # ------------------------------------------------------------------ #
     # Engine side
@@ -227,12 +331,18 @@ class RequestQueue:
         the arrival of request #1 starts the clock, so a lone request
         pays at most ``max_wait_ms`` of batching latency. Returns ``[]``
         on ``timeout_s`` with nothing queued, or when closed and empty.
+
+        Batch membership is stride-scheduled across tenant classes:
+        each slot goes to the non-empty tenant with the smallest
+        virtual pass (advanced by ``1/weight`` per dequeue), FIFO
+        within a tenant — weighted-fair service over any busy window,
+        exact FIFO with a single tenant.
         """
         deadline = (
             clock.now() + timeout_s if timeout_s is not None else None
         )
         with self._not_empty:
-            while not self._q:
+            while not self._depth:
                 if self._closed:
                     return []
                 remaining = None
@@ -243,18 +353,26 @@ class RequestQueue:
                 self._not_empty.wait(remaining)
             # First arrival in hand: linger up to max_wait_ms for peers.
             batch_deadline = (
-                self._q[0].t_enqueue + self.max_wait_ms / 1e3
+                min(q[0].t_enqueue for q in self._queues.values() if q)
+                + self.max_wait_ms / 1e3
             )
             while (
-                len(self._q) < self.max_batch
+                self._depth < self.max_batch
                 and not self._closed
             ):
                 linger = batch_deadline - clock.now()
                 if linger <= 0:
                     break
                 self._not_empty.wait(linger)
-            n = min(len(self._q), self.max_batch)
-            batch = [self._q.popleft() for _ in range(n)]
+            batch = []
+            while self._depth and len(batch) < self.max_batch:
+                tenant = min(
+                    (t for t, q in self._queues.items() if q),
+                    key=lambda t: (self._pass[t], t),
+                )
+                batch.append(self._queues[tenant].popleft())
+                self._pass[tenant] += self._stride[tenant]
+                self._depth -= 1
         t_admit = clock.now()
         for req in batch:
             req.t_admit = t_admit

@@ -39,7 +39,9 @@ import numpy as np
 from distributed_sddmm_tpu.obs import clock
 from distributed_sddmm_tpu.obs import log as obs_log
 from distributed_sddmm_tpu.obs.telemetry import LatencyHistogram
-from distributed_sddmm_tpu.serve.queue import ShedError
+from distributed_sddmm_tpu.serve.queue import (
+    DEFAULT_TENANT, ShedError, TenantSpec,
+)
 
 _PCTS = (50, 95, 99)
 
@@ -144,6 +146,39 @@ class SLOSpec:
         return round(max(rates), 4) if rates else None
 
 
+def parse_tenants(spec: str | None) -> Optional[dict[str, TenantSpec]]:
+    """``"premium:3:p99_ms=250,err_rate=0.01;batch:1"`` → tenant table.
+
+    Grammar: ``;``-separated tenant clauses, each ``name[:weight[:slo]]``.
+    The SLO sub-spec is the :meth:`SLOSpec.parse` grammar (commas inside
+    it are why clauses join on ``;``). Weight defaults to 1.0. Returns
+    None on an empty spec so callers fall back to single-tenant mode.
+    """
+    if not spec:
+        return None
+    out: dict[str, TenantSpec] = {}
+    for part in spec.split(";"):
+        part = part.strip()
+        if not part:
+            continue
+        fields = part.split(":", 2)
+        name = fields[0].strip()
+        weight = 1.0
+        if len(fields) > 1 and fields[1].strip():
+            weight = float(fields[1])
+        slo = None
+        if len(fields) > 2 and fields[2].strip():
+            slo = SLOSpec.parse(fields[2].strip())
+        if name in out:
+            raise ValueError(f"duplicate tenant {name!r} in spec")
+        out[name] = TenantSpec(name=name, weight=weight, slo=slo)
+    return out or None
+
+
+def tenants_from_env() -> Optional[dict[str, TenantSpec]]:
+    return parse_tenants(os.environ.get("DSDDMM_TENANTS"))
+
+
 class LatencyRecorder:
     """Thread-safe accumulator for one serving session's observations."""
 
@@ -162,18 +197,34 @@ class LatencyRecorder:
         self.errors = 0
         self.degraded = 0
         self.shed = 0
+        #: Per-tenant breakdown (QoS axes). Cells appear lazily so the
+        #: single-tenant path pays nothing and old summaries are stable.
+        self._tenant_stats: dict[str, dict] = {}
 
     # -- feeding ------------------------------------------------------- #
 
+    def _tenant_cell(self, tenant: str) -> dict:
+        """Caller holds the lock."""
+        cell = self._tenant_stats.get(tenant)
+        if cell is None:
+            cell = {"completed": 0, "errors": 0, "shed": 0,
+                    "hist": LatencyHistogram()}
+            self._tenant_stats[tenant] = cell
+        return cell
+
     def record_reply(self, req) -> None:
         stages = req.stage_latencies_s()
+        tenant = getattr(req, "tenant", DEFAULT_TENANT)
         with self._lock:
             self.completed += 1
+            cell = self._tenant_cell(tenant)
+            cell["completed"] += 1
             if req.degraded:
                 self.degraded += 1
             if "total_s" in stages:
                 self._total_s.append(stages["total_s"])
                 self.hist.add(stages["total_s"] * 1e3)
+                cell["hist"].add(stages["total_s"] * 1e3)
             if "queue_s" in stages:
                 self._queue_s.append(stages["queue_s"])
             if "batch_wait_s" in stages:
@@ -181,13 +232,15 @@ class LatencyRecorder:
             if "execute_s" in stages:
                 self._execute_s.append(stages["execute_s"])
 
-    def record_error(self) -> None:
+    def record_error(self, tenant: str = DEFAULT_TENANT) -> None:
         with self._lock:
             self.errors += 1
+            self._tenant_cell(tenant)["errors"] += 1
 
-    def record_shed(self) -> None:
+    def record_shed(self, tenant: str = DEFAULT_TENANT) -> None:
         with self._lock:
             self.shed += 1
+            self._tenant_cell(tenant)["shed"] += 1
 
     def record_batch(self, batch_size: int, bucket: int, depth: int) -> None:
         with self._lock:
@@ -220,6 +273,13 @@ class LatencyRecorder:
             shed, degraded = self.shed, self.degraded
             hist = LatencyHistogram(self.hist.bounds_ms,
                                     list(self.hist.counts))
+            tstats = {
+                name: {"completed": c["completed"], "errors": c["errors"],
+                       "shed": c["shed"],
+                       "hist": LatencyHistogram(c["hist"].bounds_ms,
+                                                list(c["hist"].counts))}
+                for name, c in self._tenant_stats.items()
+            }
         requests = completed + errors + shed
         out = {
             "requests": requests,
@@ -251,7 +311,31 @@ class LatencyRecorder:
                 "p95": percentile(depth, 95),
                 "max": max(depth),
             }
+        if set(tstats) - {DEFAULT_TENANT}:
+            # Per-tenant QoS breakdown — only emitted once a named
+            # tenant shows up, so single-tenant records keep their
+            # pre-fleet shape byte for byte.
+            out["tenant"] = {
+                name: self._tenant_summary(cell)
+                for name, cell in sorted(tstats.items())
+            }
         return out
+
+    @staticmethod
+    def _tenant_summary(cell: dict) -> dict:
+        t_req = cell["completed"] + cell["errors"] + cell["shed"]
+        entry = {
+            "requests": t_req,
+            "completed": cell["completed"],
+            "errors": cell["errors"],
+            "shed_count": cell["shed"],
+            "err_rate": cell["errors"] / t_req if t_req else 0.0,
+            "shed_rate": cell["shed"] / t_req if t_req else 0.0,
+        }
+        if cell["hist"].total:
+            entry["request_hist"] = cell["hist"].to_dict()
+            entry["latency_hist_ms"] = cell["hist"].percentiles_ms()
+        return entry
 
 
 # --------------------------------------------------------------------- #
@@ -267,6 +351,8 @@ def run_load(
     oracle_every: int = 8,
     reply_timeout_s: float = 30.0,
     slo: Optional[SLOSpec] = None,
+    tenants: Optional[dict[str, TenantSpec]] = None,
+    honor_retry_after: bool = False,
 ) -> dict:
     """Drive ``engine`` with Poisson arrivals for ``duration_s`` seconds.
 
@@ -283,11 +369,25 @@ def run_load(
 
     Returns the recorder summary extended with throughput, oracle-check
     results, and SLO violations (``slo`` defaults to the env spec).
+
+    ``tenants`` (a :func:`parse_tenants` table) makes each arrival pick a
+    tenant weighted by the spec weights; per-tenant burn rates land in
+    ``summary["tenant"]``. ``honor_retry_after=True`` makes the client a
+    good citizen: a shed whose :class:`ShedError` carries a positive
+    ``retry_after_s`` opens a backoff window, and arrivals inside it are
+    *deferred* (counted, never submitted) — the admission-control
+    contract a fleet router relies on to actually relieve pressure.
     """
     slo = slo if slo is not None else SLOSpec.from_env()
     rec = engine.recorder
     rng = np.random.default_rng(seed)
     workload = engine.workload
+
+    tenant_names: list[str] = list(tenants) if tenants else []
+    tenant_probs = None
+    if tenant_names:
+        w = np.array([tenants[t].weight for t in tenant_names], dtype=float)
+        tenant_probs = w / w.sum()
 
     n_expect = max(1, int(duration_s * rate_hz * 2))
     gaps = rng.exponential(1.0 / max(rate_hz, 1e-9), size=n_expect)
@@ -316,16 +416,32 @@ def run_load(
                 oracle_failures[0] += 1
                 obs_log.error("serve", "oracle mismatch", req=req.req_id)
 
+    deferred = 0
+    backoff_until = 0.0
+
     t0 = clock.now()
     for i, t_arr in enumerate(arrivals):
         delay = t0 + float(t_arr) - clock.now()
         if delay > 0:
             time.sleep(delay)
+        if honor_retry_after and clock.now() < backoff_until:
+            deferred += 1  # honoring the server's Retry-After hint
+            continue
         payload = workload.sample_payload(rng)
         try:
-            req = engine.submit(payload)
-        except ShedError:
-            continue  # the engine's submit path recorded the shed
+            if tenant_names:
+                tenant = tenant_names[
+                    int(rng.choice(len(tenant_names), p=tenant_probs))
+                ]
+                req = engine.submit(payload, tenant=tenant)
+            else:
+                req = engine.submit(payload)
+        except ShedError as e:
+            # The engine's submit path recorded the shed.
+            hint = float(getattr(e, "retry_after_s", 0.0) or 0.0)
+            if honor_retry_after and hint > 0:
+                backoff_until = clock.now() + hint
+            continue
         submitted += 1
         w = threading.Thread(
             target=wait_reply,
@@ -352,9 +468,36 @@ def run_load(
         "oracle_checked": oracle_checked[0],
         "oracle_failures": oracle_failures[0],
     })
+    if honor_retry_after:
+        summary["retry_after_deferred"] = deferred
     summary["slo"] = slo.to_dict()
     summary["slo_violations"] = slo.check(summary)
     # Error-budget burn rate (None when the spec constrains nothing):
     # the live-telemetry axis `bench gate` regresses run over run.
     summary["burn_rate"] = slo.burn_rate(summary)
+    attach_tenant_slo(summary, tenants)
+    return summary
+
+
+def attach_tenant_slo(
+    summary: dict, tenants: Optional[dict[str, TenantSpec]],
+) -> dict:
+    """Judge each declared tenant's sub-summary against its own SLO:
+    ``summary["tenant"][name]`` gains ``slo``/``slo_violations``/
+    ``burn_rate`` (the per-tenant ``serve:burn_rate:<name>`` gate axes)
+    plus the scheduler weight. Declared-but-idle tenants get a zeroed
+    cell so the record's tenant table always matches the declaration."""
+    if not tenants:
+        return summary
+    tstats = summary.setdefault("tenant", {})
+    for name, tspec in tenants.items():
+        entry = tstats.setdefault(name, {
+            "requests": 0, "completed": 0, "errors": 0,
+            "shed_count": 0, "err_rate": 0.0, "shed_rate": 0.0,
+        })
+        if tspec.slo is not None:
+            entry["slo"] = tspec.slo.to_dict()
+            entry["slo_violations"] = tspec.slo.check(entry)
+            entry["burn_rate"] = tspec.slo.burn_rate(entry)
+        entry["weight"] = tspec.weight
     return summary

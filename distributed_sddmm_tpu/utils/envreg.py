@@ -92,6 +92,28 @@ KNOBS: dict[str, Knob] = {k.name: k for k in [
     _K("DSDDMM_FAULTS", "spec", "off",
        "fault-injection plan: JSON spec list, @plan.json, or comma "
        "shorthand (nan,delay,...)"),
+    _K("DSDDMM_FLEET_COOLDOWN", "float", "5",
+       "fleet autoscaler: seconds between scaling actions "
+       "(fleet/scaler.py)"),
+    _K("DSDDMM_FLEET_DRAIN_BURN", "float", "1.0",
+       "front router: SLO burn rate above which a replica stops "
+       "receiving admissions until it recovers (fleet/router.py)"),
+    _K("DSDDMM_FLEET_HIGH_BURN", "float", "1.0",
+       "fleet autoscaler: replica burn rate counting as sustained "
+       "pressure (spawn trigger)"),
+    _K("DSDDMM_FLEET_HIGH_DEPTH", "float", "0.7",
+       "fleet autoscaler: queue-depth fraction counting as sustained "
+       "pressure (spawn trigger)"),
+    _K("DSDDMM_FLEET_IDLE_S", "float", "10",
+       "fleet autoscaler: seconds every replica must sit idle before a "
+       "drain-then-reap scale-down"),
+    _K("DSDDMM_FLEET_MAX", "int", "4",
+       "fleet autoscaler: replica ceiling"),
+    _K("DSDDMM_FLEET_MIN", "int", "1",
+       "fleet autoscaler: replica floor"),
+    _K("DSDDMM_FLEET_REPLICAS", "int", "2",
+       "`bench fleet` serve-role replica count when --replicas is "
+       "unset (bench/cli.py)"),
     _K("DSDDMM_FLIGHTREC", "spec", "off",
        "anomaly-triggered flight recorder: 1 or a dump directory"),
     _K("DSDDMM_GUARD_MODE", "str", "raise",
@@ -129,6 +151,10 @@ KNOBS: dict[str, Knob] = {k.name: k for k in [
        "serve/slo.py validates keys)"),
     _K("DSDDMM_TELEMETRY", "spec", "off",
        "serving telemetry sampler: 1 or the JSONL output path"),
+    _K("DSDDMM_TENANTS", "spec", "unset",
+       "multi-tenant QoS classes 'name[:weight[:slo]];...' — "
+       "weighted-fair dequeue + per-tenant burn-rate gate axes "
+       "(serve/slo.py)"),
     _K("DSDDMM_TRACE", "spec", "off",
        "span tracing: 1 (default artifacts/traces), a file, or a "
        "directory; exported as PATH.shards to children"),

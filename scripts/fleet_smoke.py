@@ -1,0 +1,126 @@
+"""Serving-fleet smoke: chaos kill mid-load, zero wrong or lost replies.
+
+The PR-16 acceptance demo on the CPU test mesh (a tier-1 test runs this
+as a subprocess): ``bench fleet`` spawns serve-replica processes behind
+the front router, drives an open-loop multi-tenant HTTP load, SIGKILLs
+one replica at the load midpoint, and the judgment must hold:
+
+* every 200 reply bit-identical (post-JSON) to the single-engine
+  oracle — replica count, routing order, and the chaos kill must be
+  invisible in the numbers;
+* no reply lost: the killed replica's in-flight work is re-admitted by
+  the router (failover) or shed WITH a Retry-After hint;
+* the replacement replica warm-starts from the shared ProgramStore —
+  0 request-path live compiles on generation ≥ 1;
+* availability (ok + shed-with-retry + client-deferred)/offered stays
+  above the floor;
+* the record carries the fleet/tenant telemetry the gate reads
+  (``fleet:availability``, per-tenant ``serve:burn_rate:*``).
+
+Usage::
+
+    python scripts/fleet_smoke.py [-o out.json]
+
+Prints one JSON report; exit 0 when every check passes, 2 otherwise
+(the 0/2 contract ``tests/test_fleet_smoke.py`` pins).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import pathlib
+import sys
+import tempfile
+import time
+
+REPO = pathlib.Path(__file__).resolve().parents[1]
+sys.path.insert(0, str(REPO))
+
+
+def exit_code(report: dict) -> int:
+    """The smoke's exit contract: 0 all checks green, 2 otherwise."""
+    return 0 if report.get("ok") else 2
+
+
+def check_chaos_fleet(tmp: pathlib.Path) -> dict:
+    """One ``bench fleet`` chaos run, then re-judge the record."""
+    from distributed_sddmm_tpu.bench.cli import main as bench_main
+    from distributed_sddmm_tpu.obs.regress import phase_stats
+
+    out = tmp / "fleet.json"
+    rc = bench_main([
+        "fleet", "--replicas", "2", "--chaos", "kill-replica",
+        "--duration", "5", "--rate", "12", "--log-m", "6", "--R", "8",
+        "--no-runstore", "-o", str(out),
+    ])
+    records = [json.loads(line) for line in out.read_text().splitlines()]
+    rec = records[-1] if records else {}
+    fleet = rec.get("fleet") or {}
+    axes = phase_stats({"record": rec})
+    tenant = rec.get("tenant") or {}
+    tenant_requests = sum(
+        int(c.get("requests") or 0) for c in tenant.values()
+    )
+    return {
+        "name": "chaos-fleet",
+        "ok": bool(
+            rc == 0
+            and fleet.get("mismatches") == 0
+            and fleet.get("lost") == 0
+            and fleet.get("killed")
+            and fleet.get("losses") == 1
+            and fleet.get("replacement_live_compiles") == 0
+            and (fleet.get("replacement_disk_hits") or 0) > 0
+            and fleet.get("availability", 0.0)
+            >= fleet.get("availability_floor", 0.95)
+            and "fleet:availability" in axes
+            # A SIGKILLed replica's recorder dies with it, so the
+            # drained-record rollup may undercount the client's ok
+            # tally by what the victim had served — never overcount,
+            # and never lose the surviving replicas' attribution.
+            and 0 < tenant_requests <= (fleet.get("ok") or 0)
+        ),
+        "exit_code": rc,
+        "offered": fleet.get("offered"),
+        "ok_replies": fleet.get("ok"),
+        "mismatches": fleet.get("mismatches"),
+        "lost": fleet.get("lost"),
+        "killed": fleet.get("killed"),
+        "availability": fleet.get("availability"),
+        "replacement_live_compiles": fleet.get("replacement_live_compiles"),
+        "replacement_disk_hits": fleet.get("replacement_disk_hits"),
+        "gate_axes": sorted(
+            k for k in axes if k.startswith(("fleet:", "serve:"))
+        ),
+        "tenant_requests": tenant_requests,
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("-o", "--output-file", default=None)
+    args = ap.parse_args(argv)
+
+    from distributed_sddmm_tpu.utils.platform import force_cpu_platform
+
+    force_cpu_platform(n_devices=8, replace=True)
+
+    t0 = time.perf_counter()
+    with tempfile.TemporaryDirectory() as tmpdir:
+        checks = [check_chaos_fleet(pathlib.Path(tmpdir))]
+
+    report = {
+        "ok": all(c["ok"] for c in checks),
+        "elapsed_s": round(time.perf_counter() - t0, 2),
+        "checks": checks,
+    }
+    text = json.dumps(report, indent=1)
+    print(text)
+    if args.output_file:
+        pathlib.Path(args.output_file).write_text(text)
+    return exit_code(report)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,198 @@
+"""Front-router contract tests against in-process stub replicas.
+
+Each stub replica is a real :class:`AdminServer` in exporter mode
+(``snapshot_fn`` + ``submit_fn``) — the router talks to it over actual
+HTTP, so readiness probing, 429 + Retry-After propagation, and
+connection-failure failover are exercised on the real wire path without
+a jax engine anywhere.
+"""
+
+import pytest
+
+from distributed_sddmm_tpu.fleet import FleetRouter
+from distributed_sddmm_tpu.obs.httpexp import AdminServer, post_json
+from distributed_sddmm_tpu.serve import ShedError
+
+
+class StubReplica:
+    """Scriptable replica: snapshot fields + submit behavior."""
+
+    def __init__(self, name, *, depth_frac=0.0, burn=0.0,
+                 inner_buckets=(4, 8), shed_after=None, reply=None):
+        self.name = name
+        self.depth_frac = depth_frac
+        self.burn = burn
+        self.inner_buckets = inner_buckets
+        #: None = always answer; a float = shed with this retry hint.
+        self.shed_retry = shed_after
+        self.reply = reply if reply is not None else {"by": name}
+        self.submits = []
+        self.server = AdminServer(
+            snapshot_fn=self._snapshot, submit_fn=self._submit,
+            burn_threshold=1e9,  # readiness stays 200; drain is the
+        ).start()                # router's own burn policy under test
+
+    @property
+    def port(self):
+        return self.server.port
+
+    def _snapshot(self):
+        return {
+            "depth_frac": self.depth_frac, "burn_rate": self.burn,
+            "buckets": {"batch": [2, 4], "inner": list(self.inner_buckets)},
+        }
+
+    def _submit(self, payload, tenant="default", serial=False,
+                timeout_s=30.0):
+        self.submits.append(
+            {"payload": payload, "tenant": tenant, "serial": serial}
+        )
+        if self.shed_retry is not None:
+            raise ShedError("stub full", retry_after_s=self.shed_retry)
+        return dict(self.reply, serial=serial)
+
+    def stop(self):
+        self.server.stop()
+
+
+@pytest.fixture
+def pool():
+    replicas = []
+
+    def make(*args, **kw):
+        rep = StubReplica(*args, **kw)
+        replicas.append(rep)
+        return rep
+
+    yield make
+    for rep in replicas:
+        rep.stop()
+
+
+def _router(*reps, **kw):
+    r = FleetRouter(
+        endpoints=[(rep.name, rep.port, "serve") for rep in reps], **kw,
+    )
+    r.poll_once()
+    return r
+
+
+class TestRouting:
+    def test_least_depth_wins(self, pool):
+        busy = pool("busy", depth_frac=0.8)
+        idle = pool("idle", depth_frac=0.1)
+        router = _router(busy, idle)
+        reply = router.route({"q": [1, 2]})
+        assert reply["by"] == "idle"
+        assert router.stats["routed"] == 1
+        assert not busy.submits
+
+    def test_replica_shed_fails_over(self, pool):
+        full = pool("full", depth_frac=0.0, shed_after=2.5)
+        ok = pool("ok", depth_frac=0.5)
+        router = _router(full, ok)
+        reply = router.route({"q": [1]})
+        assert reply["by"] == "ok"
+        assert router.stats["replica_sheds_seen"] == 1
+
+    def test_all_shed_escalates_with_largest_hint(self, pool):
+        a = pool("a", shed_after=0.5)
+        b = pool("b", shed_after=3.0)
+        router = _router(a, b)
+        with pytest.raises(ShedError) as ei:
+            router.route({"q": [1]})
+        assert ei.value.retry_after_s == pytest.approx(3.0)
+        assert router.stats["edge_sheds"] == 1
+
+    def test_dead_replica_fails_over_and_is_marked(self, pool):
+        dead = pool("dead", depth_frac=0.0)
+        live = pool("live", depth_frac=0.9)
+        router = _router(dead, live)
+        dead.stop()  # connection refused from now on
+        reply = router.route({"q": [1]})
+        assert reply["by"] == "live"
+        assert router.stats["failovers"] == 1
+        st = {s.name: s for s in router.states()}
+        assert st["dead"].ready is False
+
+    def test_no_replicas_sheds_at_edge(self):
+        router = FleetRouter(endpoints=[], shed_retry_after_s=1.5)
+        with pytest.raises(ShedError) as ei:
+            router.route({"q": [1]})
+        assert ei.value.retry_after_s == pytest.approx(1.5)
+
+
+class TestBurnDrain:
+    def test_burning_replica_drains_then_resumes(self, pool):
+        hot = pool("hot", depth_frac=0.0, burn=2.0)
+        cool = pool("cool", depth_frac=0.9, burn=0.1)
+        router = _router(hot, cool, drain_burn=1.0)
+        assert router.route({"q": [1]})["by"] == "cool"
+        assert router.stats["drains"] == 1
+        # Recovery below the hysteresis floor resumes admissions.
+        hot.burn = 0.5
+        router.poll_once()
+        assert router.route({"q": [1]})["by"] == "hot"
+
+    def test_hysteresis_holds_between_thresholds(self, pool):
+        hot = pool("hot", burn=2.0)
+        cool = pool("cool", depth_frac=0.9, burn=0.1)
+        router = _router(hot, cool, drain_burn=1.0, resume_frac=0.8)
+        hot.burn = 0.9  # below drain (1.0) but above resume (0.8)
+        router.poll_once()
+        assert router.route({"q": [1]})["by"] == "cool"
+
+
+class TestStructureRouting:
+    def test_pathological_oversize_goes_serial(self, pool):
+        rep = pool("r", inner_buckets=(4, 8))
+        router = _router(rep)
+        router.route({"q": list(range(50))})  # > every warm rung
+        assert rep.submits[-1]["serial"] is True
+        assert router.stats["serial_routed"] == 1
+
+    def test_bucket_fit_preferred_over_clamp(self, pool):
+        small = pool("small", depth_frac=0.0, inner_buckets=(4,))
+        big = pool("big", depth_frac=0.5, inner_buckets=(4, 16))
+        router = _router(small, big)
+        # Inner size 10 clamps on "small" (max rung 4) but fits "big";
+        # fit beats the lower queue depth.
+        assert router.route({"q": list(range(10))})["by"] == "big"
+        # A size-2 request fits both → depth order applies again.
+        assert router.route({"q": [1, 2]})["by"] == "small"
+
+
+class TestRouterSurface:
+    def test_http_edge_propagates_retry_after(self, pool):
+        """End to end over the router's OWN AdminServer: a fleet-wide
+        shed leaves as 429 + Retry-After at the front door."""
+        a = pool("a", shed_after=2.0)
+        router = _router(a)
+        router.start()
+        try:
+            code, body, headers = post_json(
+                "127.0.0.1", router.port, "/submit",
+                {"payload": {"q": [1]}},
+            )
+            assert code == 429
+            assert float(headers["Retry-After"]) == pytest.approx(2.0)
+            assert body["retry_after_s"] == pytest.approx(2.0)
+            a.shed_retry = None  # headroom recovered
+            code, body, _ = post_json(
+                "127.0.0.1", router.port, "/submit",
+                {"payload": {"q": [1]}, "tenant": "default"},
+            )
+            assert code == 200
+            assert body["reply"]["by"] == "a"
+        finally:
+            router.stop()
+
+    def test_topology_snapshot(self, pool):
+        a = pool("a", depth_frac=0.3)
+        router = _router(a)
+        topo = router.topology()
+        assert topo["router"] is True
+        (st,) = topo["replicas"]
+        assert st["name"] == "a" and st["ready"] is True
+        assert st["depth_frac"] == pytest.approx(0.3)
+        assert topo["stats"]["routed"] == 0

@@ -1,0 +1,155 @@
+"""Autoscaler decision-core tests: fabricated snapshots + a fake
+manager drive :meth:`AutoScaler.step` synchronously — sustained
+pressure spawns, sustained idle drains (newest non-tuner first), single
+bursts and cooldown windows do nothing, min/max bounds hold.
+"""
+
+import pytest
+
+from distributed_sddmm_tpu.fleet import AutoScaler, ScalerConfig
+
+
+class _FakeReplica:
+    def __init__(self, name, t_spawn, tuner=False, role="serve"):
+        self.name = name
+        self.t_spawn = t_spawn
+        self.tuner = tuner
+        self.role = role
+
+
+class _FakeManager:
+    def __init__(self, names):
+        self._live = [
+            _FakeReplica(n, t_spawn=i) for i, n in enumerate(names)
+        ]
+        self.spawned = []
+        self.drained = []
+
+    def replicas(self, role=None):
+        return [r for r in self._live if role is None or r.role == role]
+
+    def spawn(self, role="serve"):
+        rep = _FakeReplica(f"r{len(self._live)}",
+                           t_spawn=100 + len(self.spawned), role=role)
+        self._live.append(rep)
+        self.spawned.append(rep.name)
+        return rep
+
+    def drain(self, name):
+        self.drained.append(name)
+        self._live = [r for r in self._live if r.name != name]
+
+
+def _cfg(**kw):
+    base = dict(min_replicas=1, max_replicas=4, high_depth_frac=0.7,
+                high_burn=1.0, idle_depth_frac=0.05, sustain_ticks=3,
+                idle_ticks=4, cooldown_s=5.0, interval_s=0.5)
+    base.update(kw)
+    return ScalerConfig(**base)
+
+
+def _snaps(mgr, depth=0.0, burn=0.0):
+    return {r.name: {"depth_frac": depth, "burn_rate": burn}
+            for r in mgr.replicas()}
+
+
+class TestScaleUp:
+    def test_sustained_depth_spawns(self):
+        mgr = _FakeManager(["r0"])
+        sc = AutoScaler(mgr, _cfg())
+        for t in range(2):
+            assert sc.step(_snaps(mgr, depth=0.9), now=10.0 + t) is None
+        assert sc.step(_snaps(mgr, depth=0.9), now=12.0) == "scale_up"
+        assert mgr.spawned == ["r1"]
+        assert sc.actions[0]["action"] == "scale_up"
+
+    def test_burn_pressure_also_spawns(self):
+        mgr = _FakeManager(["r0"])
+        sc = AutoScaler(mgr, _cfg(sustain_ticks=1, cooldown_s=0.0))
+        assert sc.step(_snaps(mgr, burn=2.0), now=10.0) == "scale_up"
+
+    def test_unreachable_replica_counts_as_pressure(self):
+        mgr = _FakeManager(["r0"])
+        sc = AutoScaler(mgr, _cfg(sustain_ticks=1, cooldown_s=0.0))
+        assert sc.step({"r0": None}, now=10.0) == "scale_up"
+
+    def test_single_burst_does_not_spawn(self):
+        mgr = _FakeManager(["r0"])
+        sc = AutoScaler(mgr, _cfg())
+        sc.step(_snaps(mgr, depth=0.9), now=10.0)
+        sc.step(_snaps(mgr, depth=0.0), now=11.0)  # burst over → reset
+        sc.step(_snaps(mgr, depth=0.9), now=12.0)
+        sc.step(_snaps(mgr, depth=0.9), now=13.0)
+        assert mgr.spawned == []
+
+    def test_max_replicas_bound(self):
+        mgr = _FakeManager(["r0", "r1", "r2", "r3"])
+        sc = AutoScaler(mgr, _cfg(sustain_ticks=1, cooldown_s=0.0))
+        assert sc.step(_snaps(mgr, depth=0.9), now=10.0) is None
+        assert mgr.spawned == []
+
+
+class TestScaleDown:
+    def test_sustained_idle_drains_newest(self):
+        mgr = _FakeManager(["r0", "r1", "r2"])
+        sc = AutoScaler(mgr, _cfg(cooldown_s=0.0))
+        for t in range(3):
+            assert sc.step(_snaps(mgr), now=10.0 + t) is None
+        assert sc.step(_snaps(mgr), now=13.0) == "scale_down"
+        assert mgr.drained == ["r2"]  # newest first
+
+    def test_tuner_canary_never_drained(self):
+        mgr = _FakeManager(["r0", "r1"])
+        mgr._live[1].tuner = True  # newest is the canary
+        sc = AutoScaler(mgr, _cfg(idle_ticks=1, cooldown_s=0.0))
+        assert sc.step(_snaps(mgr), now=10.0) == "scale_down"
+        assert mgr.drained == ["r0"]
+
+    def test_min_replicas_bound(self):
+        mgr = _FakeManager(["r0"])
+        sc = AutoScaler(mgr, _cfg(idle_ticks=1, cooldown_s=0.0))
+        for t in range(5):
+            assert sc.step(_snaps(mgr), now=10.0 + t) is None
+        assert mgr.drained == []
+
+
+class TestPacing:
+    def test_cooldown_blocks_back_to_back_actions(self):
+        mgr = _FakeManager(["r0"])
+        sc = AutoScaler(mgr, _cfg(sustain_ticks=1, cooldown_s=5.0))
+        assert sc.step(_snaps(mgr, depth=0.9), now=10.0) == "scale_up"
+        # Pressure persists but the cooldown window holds.
+        for t in (11.0, 12.0, 14.9):
+            assert sc.step(_snaps(mgr, depth=0.9), now=t) is None
+        assert sc.step(_snaps(mgr, depth=0.9), now=15.1) == "scale_up"
+        assert mgr.spawned == ["r1", "r2"]
+
+    def test_empty_pool_is_a_noop(self):
+        mgr = _FakeManager([])
+        sc = AutoScaler(mgr, _cfg())
+        assert sc.step({}, now=10.0) is None
+
+
+class TestConfig:
+    def test_from_env(self, monkeypatch):
+        monkeypatch.setenv("DSDDMM_FLEET_MIN", "2")
+        monkeypatch.setenv("DSDDMM_FLEET_MAX", "7")
+        monkeypatch.setenv("DSDDMM_FLEET_HIGH_DEPTH", "0.5")
+        monkeypatch.setenv("DSDDMM_FLEET_HIGH_BURN", "1.5")
+        monkeypatch.setenv("DSDDMM_FLEET_COOLDOWN", "9")
+        monkeypatch.setenv("DSDDMM_FLEET_IDLE_S", "3")
+        cfg = ScalerConfig.from_env()
+        assert (cfg.min_replicas, cfg.max_replicas) == (2, 7)
+        assert cfg.high_depth_frac == 0.5
+        assert cfg.high_burn == 1.5
+        assert cfg.cooldown_s == 9.0
+        assert cfg.idle_ticks == int(3 / cfg.interval_s)
+
+    def test_defaults(self, monkeypatch):
+        for k in ("DSDDMM_FLEET_MIN", "DSDDMM_FLEET_MAX",
+                  "DSDDMM_FLEET_HIGH_DEPTH", "DSDDMM_FLEET_HIGH_BURN",
+                  "DSDDMM_FLEET_COOLDOWN", "DSDDMM_FLEET_IDLE_S"):
+            monkeypatch.delenv(k, raising=False)
+        cfg = ScalerConfig.from_env()
+        assert (cfg.min_replicas, cfg.max_replicas) == (1, 4)
+        assert cfg.high_depth_frac == pytest.approx(0.7)

@@ -1,0 +1,63 @@
+"""Tier-1 smoke for the serving fleet (ISSUE 16 acceptance).
+
+Runs ``scripts/fleet_smoke.py`` as a subprocess — ``bench fleet`` with
+a chaos kill at the load midpoint: replies must stay bit-identical to
+the single-engine oracle, nothing may be lost (re-admitted by router
+failover or shed with Retry-After), the replacement replica must
+warm-start from the shared ProgramStore with 0 request-path compiles,
+and the record must carry the ``fleet:availability`` gate axis. Exit
+contract 0 (all green) / 2 (any check red).
+"""
+
+import json
+import pathlib
+import subprocess
+import sys
+
+REPO = pathlib.Path(__file__).resolve().parents[1]
+SCRIPT = REPO / "scripts" / "fleet_smoke.py"
+
+
+def test_fleet_smoke_script(tmp_path):
+    out = tmp_path / "fleet_smoke.json"
+    proc = subprocess.run(
+        [sys.executable, str(SCRIPT), "-o", str(out)],
+        capture_output=True, text=True, timeout=600, cwd=REPO,
+        env={
+            "PATH": "/usr/bin:/bin",
+            "HOME": "/tmp",
+            "JAX_PLATFORMS": "cpu",
+            "DSDDMM_RUNSTORE": "0",
+            "DSDDMM_PROGRAMS": "0",
+        },
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    report = json.loads(out.read_text())
+    assert report["ok"] is True
+    (chaos,) = report["checks"]
+
+    assert chaos["exit_code"] == 0
+    assert chaos["mismatches"] == 0  # bit-identical through the kill
+    assert chaos["lost"] == 0       # re-admitted or shed-with-retry
+    assert chaos["killed"]          # the chaos actually fired
+    assert chaos["replacement_live_compiles"] == 0  # warm respawn
+    assert chaos["replacement_disk_hits"] > 0
+    assert chaos["availability"] >= 0.95
+    assert "fleet:availability" in chaos["gate_axes"]
+    # Per-tenant accounting survives the fleet rollup. The SIGKILLed
+    # replica's recorder dies with it, so attribution may undercount
+    # the client's ok tally by what the victim had served — but never
+    # overcount, and never go dark.
+    assert 0 < chaos["tenant_requests"] <= chaos["ok_replies"]
+
+
+def test_exit_code_contract():
+    """The 0/2 contract without a second subprocess run."""
+    sys.path.insert(0, str(REPO / "scripts"))
+    try:
+        import fleet_smoke
+    finally:
+        sys.path.pop(0)
+    assert fleet_smoke.exit_code({"ok": True}) == 0
+    assert fleet_smoke.exit_code({"ok": False}) == 2
+    assert fleet_smoke.exit_code({}) == 2

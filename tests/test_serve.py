@@ -116,6 +116,64 @@ class TestQueue:
 
 
 # --------------------------------------------------------------------- #
+# Retry-After contract: the shed hint is load-bearing end to end
+# --------------------------------------------------------------------- #
+
+
+class TestRetryAfterContract:
+    def test_shed_hint_uses_drain_rate(self):
+        """With a throughput estimate the hint is depth/rate — the
+        server's actual drain-time forecast, not a constant."""
+        q = RequestQueue(max_depth=4, max_batch=2, max_wait_ms=1.0)
+        for i in range(4):
+            q.submit(i)
+        q.drain_rate_hint = 8.0  # req/s
+        with pytest.raises(ShedError) as ei:
+            q.submit("x")
+        assert ei.value.retry_after_s == pytest.approx(4 / 8.0)
+
+    def test_run_load_honors_retry_after(self):
+        """A good-citizen client defers every arrival inside the backoff
+        window a shed opened — one shed, many deferrals, and the engine
+        never sees the deferred traffic."""
+        from distributed_sddmm_tpu.serve.slo import LatencyRecorder, run_load
+
+        class _ShedWorkload:
+            def sample_payload(self, rng):
+                return {"q": [1]}
+
+            def check_reply(self, payload, reply):
+                return True
+
+        class _SheddingEngine:
+            def __init__(self):
+                self.recorder = LatencyRecorder()
+                self.workload = _ShedWorkload()
+                self.submits = 0
+
+            def submit(self, payload, tenant=None):
+                self.submits += 1
+                self.recorder.record_shed()
+                raise ShedError("full", retry_after_s=30.0)
+
+        eng = _SheddingEngine()
+        summary = run_load(eng, duration_s=0.4, rate_hz=50.0, seed=3,
+                           oracle_every=0, honor_retry_after=True)
+        # First arrival sheds and opens a 30s window covering the rest
+        # of the run; everything after is deferred client-side.
+        assert eng.submits == 1
+        assert summary["shed_count"] == 1
+        assert summary["retry_after_deferred"] == summary["offered"] - 1
+
+        eng2 = _SheddingEngine()
+        summary2 = run_load(eng2, duration_s=0.4, rate_hz=50.0, seed=3,
+                            oracle_every=0, honor_retry_after=False)
+        # A hint-blind client keeps hammering: every arrival submits.
+        assert eng2.submits == summary2["offered"] > 1
+        assert "retry_after_deferred" not in summary2
+
+
+# --------------------------------------------------------------------- #
 # Batching determinism + bucket padding (the core serving contract)
 # --------------------------------------------------------------------- #
 
