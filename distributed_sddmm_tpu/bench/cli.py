@@ -510,22 +510,44 @@ def build_parser() -> argparse.ArgumentParser:
         "fleet",
         help="serving-fleet harness: spawn N `bench serve --serve-http` "
         "replicas behind the front router (fleet/), drive an open-loop "
-        "HTTP load with a multi-tenant mix, optionally kill a replica "
-        "mid-load (--chaos kill-replica), and pin that replies stay "
-        "bit-identical to a single-engine oracle while availability "
-        "holds above --availability-floor; the record lands in the run "
-        "store with fleet:availability / per-tenant serve:burn_rate "
-        "gate axes",
+        "HTTP load with a multi-tenant mix, optionally inject a seeded "
+        "chaos schedule (--chaos 'wedge:r1@0.3/1s;corrupt@0.6;kill@0.8'),"
+        " and pin that replies stay bit-identical to a single-engine "
+        "oracle while availability holds above --availability-floor and "
+        "every gray fault is detected (breaker open / quarantine) within "
+        "--detect-deadline; the record lands in the run store with "
+        "fleet:availability / fleet:audit_mismatch / per-tenant "
+        "serve:burn_rate gate axes",
     )
     fl.add_argument("--replicas", type=int, default=None, metavar="N",
                     help="serve-role replica count (default "
                     "DSDDMM_FLEET_REPLICAS or 2)")
-    fl.add_argument("--chaos", default="none",
-                    choices=["none", "kill-replica"],
-                    help="kill-replica: SIGKILL one replica at the load "
-                    "midpoint; the manager must respawn it warm (0 "
-                    "request-path compiles) and no reply may be lost or "
-                    "wrong")
+    fl.add_argument("--chaos", default=None, metavar="SCHEDULE",
+                    help="seeded deterministic chaos schedule "
+                    "(resilience/chaos.py grammar): ';'-separated "
+                    "kind[:target]@frac[/duration][:param] actions, e.g. "
+                    "'kill@0.5;wedge:r1@0.3/1s;partition:r0@0.6/0.5s;"
+                    "slow:r2@0.4:80ms;corrupt:r1@0.7'; 'kill-replica' "
+                    "stays as sugar for 'kill@0.5' (default DSDDMM_CHAOS "
+                    "or none)")
+    fl.add_argument("--audit-frac", type=float, default=None,
+                    metavar="FRAC",
+                    help="fraction of routed requests re-executed on a "
+                    "second replica and compared bit-for-bit before "
+                    "delivery (default DSDDMM_FLEET_AUDIT_FRAC or 0; "
+                    "chaos schedules with a corrupt action default to "
+                    "1.0 so the byzantine replica cannot leak bytes)")
+    fl.add_argument("--hedge", default=None, metavar="DELAY",
+                    help="hedged requests: after this many seconds "
+                    "without a primary reply ('on' = p95-derived), "
+                    "re-submit to a second replica and take the first "
+                    "answer (default DSDDMM_FLEET_HEDGE or off)")
+    fl.add_argument("--detect-deadline", type=float, default=5.0,
+                    metavar="SECONDS",
+                    help="each injected gray fault must show its "
+                    "detection signal (wedge/partition -> breaker open, "
+                    "corrupt -> quarantine) within this window or the "
+                    "drill exits 1")
     fl.add_argument("--app", default="als", choices=["als", "gat"])
     fl.add_argument("--log-m", type=int, default=6)
     fl.add_argument("--edge-factor", type=int, default=4)
@@ -1304,9 +1326,38 @@ def _dispatch_serve(args) -> int:
                 eng.recorder.record_reply(req)
                 return reply
 
+        chaos_fn = None
+        if serve_http:
+            def chaos_fn(body):
+                # Runtime chaos arming (resilience/chaos.ChaosEngine's
+                # corrupt action): install a fault plan in THIS running
+                # process — env knobs cannot change after spawn. The
+                # drill sets guard_mode=repair so a NaN-corrupted reply
+                # is repaired to finite-but-WRONG bytes that only the
+                # router's cross-replica audit can catch (raise-mode
+                # would degrade to the serial oracle and recompute the
+                # right answer, defeating the byzantine scenario).
+                from distributed_sddmm_tpu.resilience import (
+                    faults as res_faults,
+                )
+
+                spec = body.get("faults")
+                if not isinstance(spec, (dict, list, str)):
+                    raise ValueError("body.faults must be a plan spec")
+                plan = res_faults.FaultPlan.from_spec(spec)
+                res_faults.install(plan)
+                mode = body.get("guard_mode")
+                if mode is not None:
+                    if mode not in ("raise", "repair"):
+                        raise ValueError(f"bad guard_mode: {mode!r}")
+                    os.environ["DSDDMM_GUARD_MODE"] = str(mode)
+                return {"armed": True, "specs": len(plan.specs),
+                        "seed": plan.seed, "guard_mode": mode}
+
         admin = httpexp.AdminServer(
             engine=eng, op_metrics=d_ops.metrics, slo=slo,
             port=args.admin_port, submit_fn=submit_fn,
+            chaos_fn=chaos_fn,
         )
         admin.start()
         print(f"[admin] serving http://127.0.0.1:{admin.port} "
@@ -1537,12 +1588,15 @@ def _dispatch_fleet(args) -> int:
       failover) or shed WITH a Retry-After hint — never silently lost;
     * the respawned replacement must warm-start from the shared
       ProgramStore: 0 request-path live compiles;
+    * every injected GRAY fault must be *detected* within
+      ``--detect-deadline``: a wedge or partition by a breaker-open on
+      the victim, a corrupt by a quarantine verdict;
     * availability = (answered + shed-with-retry + client-deferred) /
       offered must hold above ``--availability-floor``.
 
-    Exit 0 clean; 1 on a wrong/lost reply or a cold respawn; 3 on an
-    availability-floor breach. Sheds and failovers are expected
-    operating conditions, not failures.
+    Exit 0 clean; 1 on a wrong/lost reply, a cold respawn, or a missed
+    gray-fault detection; 3 on an availability-floor breach. Sheds and
+    failovers are expected operating conditions, not failures.
     """
     import dataclasses
     import threading
@@ -1556,6 +1610,7 @@ def _dispatch_fleet(args) -> int:
     )
     from distributed_sddmm_tpu.obs.httpexp import _json_default, post_json
     from distributed_sddmm_tpu.obs.telemetry import LatencyHistogram
+    from distributed_sddmm_tpu.resilience.chaos import ChaosEngine, ChaosSchedule
     from distributed_sddmm_tpu.serve import (
         SLOSpec, build_als_engine, build_gat_engine, parse_tenants,
     )
@@ -1565,6 +1620,26 @@ def _dispatch_fleet(args) -> int:
         args.replicas if args.replicas is not None
         else int(os.environ.get("DSDDMM_FLEET_REPLICAS") or "2")
     )
+    chaos_spec = (args.chaos if args.chaos is not None
+                  else os.environ.get("DSDDMM_CHAOS") or "")
+    schedule = ChaosSchedule.parse(chaos_spec, seed=args.seed)
+    # A schedule with a corrupt action defaults the audit on full: the
+    # drill's contract is that a byzantine replica cannot leak a single
+    # wrong reply, which needs every routed request audited pre-delivery.
+    has_corrupt = any(a.kind == "corrupt" for a in schedule.actions)
+    audit_frac = (args.audit_frac if args.audit_frac is not None
+                  else (1.0 if has_corrupt else None))
+    hedge_delay = None
+    if args.hedge is not None:
+        from distributed_sddmm_tpu.fleet.router import DEFAULT_HEDGE_FLOOR_S
+
+        h = args.hedge.strip().lower()
+        if h in ("", "0", "off", "false", "no"):
+            hedge_delay = 0.0
+        elif h in ("1", "on", "true", "yes"):
+            hedge_delay = DEFAULT_HEDGE_FLOOR_S
+        else:
+            hedge_delay = float(h)
     tenants = parse_tenants(args.tenants)
     slo = SLOSpec.parse(args.slo) if args.slo else SLOSpec.from_env()
 
@@ -1660,19 +1735,35 @@ def _dispatch_fleet(args) -> int:
           f"(budget {args.ready_timeout:.0f}s)...", file=sys.stderr)
 
     router = None
-    killed_name = None
+    chaos_engine = None
     results: list = [None] * len(t_arrivals)
     router_stats: dict = {}
     topology: dict = {}
+    chaos_events: list = []
+    breaker_events: list = []
+    quarantine_log: list = []
+    chaos_t0 = 0.0
     elapsed = 0.0
     try:
         if not manager.wait_ready(args.ready_timeout):
             print("[fleet] replica pool failed to become ready",
                   file=sys.stderr)
             return 1
-        router = FleetRouter(manager, poll_interval_s=0.2).start()
+        router_kw: dict = {"poll_interval_s": 0.2}
+        if audit_frac is not None:
+            router_kw["audit_frac"] = audit_frac
+        if hedge_delay is not None:
+            router_kw["hedge_delay_s"] = hedge_delay
+        router = FleetRouter(manager, **router_kw).start()
         print(f"[fleet] router at http://127.0.0.1:{router.port}",
               file=sys.stderr)
+        if schedule:
+            chaos_engine = ChaosEngine(
+                schedule, manager, router, duration_s=args.duration,
+                ready_timeout_s=args.ready_timeout,
+            )
+            print(f"[fleet] chaos schedule: {schedule.normalized} "
+                  f"(seed {schedule.seed})", file=sys.stderr)
 
         lock = threading.Lock()
         backoff_until = [0.0]
@@ -1712,37 +1803,17 @@ def _dispatch_fleet(args) -> int:
                     "error", f"HTTP {code}: {decoded.get('error', decoded)}"
                 )
 
-        chaos_at = (len(t_arrivals) // 2
-                    if args.chaos == "kill-replica" and t_arrivals else None)
-        healer = None
         threads = []
         t0 = _time.monotonic()
+        if chaos_engine is not None:
+            # The engine's clock starts with the load clock: schedule
+            # fractions are fractions of THIS load window.
+            chaos_engine.start()
+            chaos_t0 = chaos_engine._t0
         for i, t_arr in enumerate(t_arrivals):
             delay = t0 + t_arr - _time.monotonic()
             if delay > 0:
                 _time.sleep(delay)
-            if chaos_at is not None and i == chaos_at:
-                victims = manager.replicas(role="serve")
-                if victims:
-                    killed_name = victims[-1].name
-                    print(f"[fleet] chaos: SIGKILL {killed_name} at "
-                          f"request {i}/{len(t_arrivals)}", file=sys.stderr)
-                    manager.kill(killed_name)
-
-                    def _heal():
-                        # SIGKILL delivery is asynchronous: wait for the
-                        # corpse before reaping, or respawn_dead() finds
-                        # nothing dead and the slot never heals.
-                        rep = manager.get(killed_name)
-                        deadline = _time.monotonic() + 30.0
-                        while rep.alive and _time.monotonic() < deadline:
-                            _time.sleep(0.05)
-                        manager.respawn_dead()
-                        manager.wait_ready(args.ready_timeout,
-                                           names=[killed_name])
-
-                    healer = threading.Thread(target=_heal, daemon=True)
-                    healer.start()
             with lock:
                 wait = backoff_until[0] - _time.monotonic()
             if wait > 0:
@@ -1754,11 +1825,18 @@ def _dispatch_fleet(args) -> int:
         for th in threads:
             th.join(90.0)
         elapsed = _time.monotonic() - t0
-        if healer is not None:
-            healer.join(args.ready_timeout)
+        if chaos_engine is not None:
+            # Wait out any in-flight kill heal before reading verdicts:
+            # the warm-respawn judgment needs the replacement's record.
+            chaos_engine.close(join_timeout_s=args.ready_timeout)
+            chaos_events = list(chaos_engine.events)
         router_stats = dict(router.stats)
         topology = router.topology()
+        breaker_events = list(router.breaker_events)
+        quarantine_log = list(manager.quarantine_log)
     finally:
+        if chaos_engine is not None:
+            chaos_engine.close()
         if router is not None:
             router.stop()
         manager.stop_all()
@@ -1794,12 +1872,47 @@ def _dispatch_fleet(args) -> int:
     # Replacement warm-start: the replica living under the killed name
     # at stop time IS the respawn (generation >= 1); its drained record
     # carries the compile attribution.
+    killed_names = [ev["target"] for ev in chaos_events
+                    if ev["kind"] == "kill" and not ev.get("skipped")]
+    killed_name = killed_names[0] if killed_names else None
     replacement = (manager.get(killed_name)
                    if killed_name is not None else None)
     repl_engine = ((replacement.record or {}).get("engine") or {}
                    if replacement is not None and replacement.generation >= 1
                    else {})
     repl_live_compiles = repl_engine.get("live_compiles")
+
+    # -- gray-fault detection judge ------------------------------------- #
+    # Every injected gray fault must show its detection signal within
+    # --detect-deadline of firing: wedge/partition → a breaker-open on
+    # the victim, corrupt → a quarantine verdict on the victim. Kill is
+    # a CRASH fault (detected by construction — the connection dies);
+    # slow is a latency fault the hedge absorbs rather than detects.
+    detection = []
+    for ev in chaos_events:
+        if ev.get("skipped") or ev["kind"] not in (
+                "wedge", "partition", "corrupt"):
+            continue
+        t_fire_abs = chaos_t0 + ev["t_s"]
+        t_limit = t_fire_abs + args.detect_deadline
+        if ev["kind"] in ("wedge", "partition"):
+            hits = [b for b in breaker_events
+                    if b["name"] == ev["target"] and b["state"] == "open"
+                    and t_fire_abs <= b["t"] <= t_limit]
+            signal_name = "breaker_open"
+        else:
+            hits = [q for q in quarantine_log
+                    if q["name"] == ev["target"]
+                    and t_fire_abs <= q["t"] <= t_limit]
+            signal_name = "quarantine"
+        detection.append({
+            "kind": ev["kind"], "target": ev["target"],
+            "signal": signal_name, "detected": bool(hits),
+            "t_fire_s": ev["t_s"],
+            "t_detect_s": (round(hits[0]["t"] - chaos_t0, 3)
+                           if hits else None),
+        })
+    detection_ok = all(d["detected"] for d in detection)
 
     # -- fleet-wide + per-tenant rollups from the drained records ------- #
     fleet_hist = None
@@ -1871,7 +1984,8 @@ def _dispatch_fleet(args) -> int:
         "tenant": tenant_wrap.get("tenant"),
         "fleet": {
             "replicas": n_replicas,
-            "chaos": args.chaos,
+            "chaos": schedule.normalized,
+            "chaos_seed": schedule.seed,
             "availability": round(availability, 4),
             "availability_floor": args.availability_floor,
             "offered": offered,
@@ -1885,11 +1999,30 @@ def _dispatch_fleet(args) -> int:
             "mismatches": n_mismatch,
             "mismatch_examples": mismatch_examples,
             "killed": killed_name,
+            "killed_names": killed_names,
             "spawns": manager.spawns,
             "losses": manager.losses,
+            "quarantines": manager.quarantines,
             "records_collected": len(manager.records),
             "replacement_live_compiles": repl_live_compiles,
             "replacement_disk_hits": repl_engine.get("disk_hits"),
+            "hedges": router_stats.get("hedges", 0),
+            "hedge_wins": router_stats.get("hedge_wins", 0),
+            "audits": router_stats.get("audits", 0),
+            "audit_mismatches": router_stats.get("audit_mismatches", 0),
+            "breaker_opens": router_stats.get("breaker_opens", 0),
+            "chaos_events": chaos_events,
+            "breaker_events": [
+                {**b, "t_s": round(b["t"] - chaos_t0, 3)}
+                for b in breaker_events
+            ] if chaos_t0 else breaker_events,
+            "quarantine_log": [
+                {**q, "t_s": round(q["t"] - chaos_t0, 3)}
+                for q in quarantine_log
+            ] if chaos_t0 else quarantine_log,
+            "detection": detection,
+            "detection_ok": detection_ok,
+            "detect_deadline_s": args.detect_deadline,
             "router": router_stats,
             "topology": topology,
             "scaler_config": dataclasses.asdict(ScalerConfig.from_env()),
@@ -1908,7 +2041,7 @@ def _dispatch_fleet(args) -> int:
     print(json.dumps({
         "app": record["app"],
         "replicas": n_replicas,
-        "chaos": args.chaos,
+        "chaos": schedule.normalized,
         "offered": offered,
         "ok": counts["ok"],
         "shed_with_retry": shed_with_retry,
@@ -1918,6 +2051,11 @@ def _dispatch_fleet(args) -> int:
         "mismatches": n_mismatch,
         "availability": record["fleet"]["availability"],
         "replacement_live_compiles": repl_live_compiles,
+        "quarantines": manager.quarantines,
+        "audit_mismatches": router_stats.get("audit_mismatches", 0),
+        "breaker_opens": router_stats.get("breaker_opens", 0),
+        "hedges": router_stats.get("hedges", 0),
+        "detection_ok": detection_ok,
         "burn_rate": record["burn_rate"],
         "router": router_stats,
     }))
@@ -1943,6 +2081,12 @@ def _dispatch_fleet(args) -> int:
         # The respawn either never came back with a record or it
         # compiled on the request path — both break the warm-start
         # contract the fleet's capacity math depends on.
+        return 1
+    if not detection_ok:
+        # An injected gray fault went undetected past its deadline: the
+        # detectors (breaker, audit) are the thing under test here.
+        print("[fleet] gray-fault detection FAILED: "
+              + json.dumps(detection), file=sys.stderr)
         return 1
     if availability < args.availability_floor:
         return 3

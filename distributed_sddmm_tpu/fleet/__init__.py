@@ -17,7 +17,11 @@ serving layer into a *fleet*:
   draining burning replicas instead of killing them, and routing by
   request *structure* (size buckets — the NeutronSparse admission idea
   at request granularity; pathological outliers go to the host-serial
-  tier).
+  tier). PR 17 adds the gray-failure detectors: per-replica circuit
+  breakers (a wedged replica stops eating the request timeout),
+  hedged requests (p95-derived delay, first bit-identical reply
+  wins), and a sampled cross-replica response audit whose mismatch
+  verdict quarantines the byzantine replica.
 * :mod:`~distributed_sddmm_tpu.fleet.scaler` — telemetry-driven
   autoscaling over the same ``/snapshot`` stream: spawn on sustained
   depth/burn pressure, drain-then-reap on sustained idle, min/max
@@ -30,9 +34,10 @@ fleet onto it replica-by-replica (drain → respawn → warm-start onto
 the cached winner) — the PR-12 closed loop with a blast-radius story.
 
 ``bench fleet`` (bench/cli.py) is the harness: an open-loop HTTP load
-against the router with a kill-a-replica chaos mode, pinning replies
-bit-identical to a single-engine oracle and availability above a floor
-through the kill.
+against the router under a seeded chaos schedule
+(``resilience/chaos.py`` — kill/wedge/partition/slow/corrupt), pinning
+replies bit-identical to a single-engine oracle, every gray fault
+detected within a deadline, and availability above a floor throughout.
 """
 
 from __future__ import annotations

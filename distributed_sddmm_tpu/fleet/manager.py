@@ -27,7 +27,24 @@ manager owns spawn/reap/replace:
 The manager is deliberately transport-agnostic: it talks to replicas
 only through their admin HTTP surface (``/healthz``, ``/readyz``,
 ``/snapshot``) and POSIX signals (SIGTERM = drain-and-exit-with-record,
-SIGKILL = chaos).
+SIGKILL = chaos, SIGSTOP/SIGCONT = gray-failure wedge).
+
+Gray-failure lifecycle (PR 17):
+
+* **Wedge** (:meth:`FleetManager.wedge`): SIGSTOP — the process is
+  alive but answers nothing, the canonical gray fault. Every teardown
+  path (``drain``/``stop_all``) SIGCONTs a wedged replica *first*: a
+  stopped process cannot handle SIGTERM, so without the continue the
+  drain would time out into a kill, lose the record, and — if the
+  harness died before its timeout — leak a stopped ``bench serve``
+  process forever.
+* **Quarantine** (:meth:`FleetManager.quarantine`): a replica the
+  router caught returning byzantine bytes (or whose breaker opened) is
+  drained out of routing — excluded from :meth:`replicas` so the
+  router drops it on the next poll — but kept ALIVE for autopsy; its
+  flight record is dumped (``obs/flightrec``) and a warm replacement
+  is spawned immediately. ``stop_all`` still drains it at teardown, so
+  its serving record is collected like any other replica's.
 """
 
 from __future__ import annotations
@@ -35,6 +52,7 @@ from __future__ import annotations
 import os
 import signal
 import subprocess
+import threading
 import time
 from typing import Callable, Optional
 
@@ -60,6 +78,11 @@ class Replica:
         #: Filled at reap time: exit code and last-JSON-line record.
         self.rc: Optional[int] = None
         self.record: Optional[dict] = None
+        #: SIGSTOPped by a chaos wedge (must be SIGCONTed on teardown).
+        self.wedged = False
+        #: Pulled from routing for autopsy (byzantine/breaker verdict).
+        self.quarantined = False
+        self.quarantine_reason: Optional[str] = None
 
     @property
     def alive(self) -> bool:
@@ -70,6 +93,8 @@ class Replica:
             "name": self.name, "port": self.port, "role": self.role,
             "generation": self.generation, "tuner": self.tuner,
             "alive": self.alive, "rc": self.rc,
+            "wedged": self.wedged, "quarantined": self.quarantined,
+            "quarantine_reason": self.quarantine_reason,
         }
 
 
@@ -105,13 +130,24 @@ class FleetManager:
         self.spawns = 0
         #: Replicas that died WITHOUT being asked (chaos kills, crashes).
         self.losses = 0
+        #: Replicas pulled from routing on a byzantine/breaker verdict.
+        self.quarantines = 0
+        #: Quarantine verdicts in arrival order, monotonic-stamped —
+        #: the chaos drill's detection-deadline judge reads this.
+        self.quarantine_log: list[dict] = []
+        self._quarantine_lock = threading.Lock()
 
     # -- introspection -------------------------------------------------- #
 
-    def replicas(self, role: Optional[str] = None) -> list[Replica]:
-        """Live replicas (optionally one role), spawn order."""
+    def replicas(self, role: Optional[str] = None,
+                 include_quarantined: bool = False) -> list[Replica]:
+        """Live routable replicas (optionally one role), spawn order.
+        Quarantined replicas are alive but NOT routable — the router
+        reads this list on every poll tick, so excluding them here IS
+        the drain-out-of-routing mechanism."""
         return [r for r in self._replicas.values()
-                if r.alive and (role is None or r.role == role)]
+                if r.alive and (role is None or r.role == role)
+                and (include_quarantined or not r.quarantined)]
 
     def get(self, name: str) -> Optional[Replica]:
         return self._replicas.get(name)
@@ -121,6 +157,7 @@ class FleetManager:
             "replicas": [r.describe() for r in self._replicas.values()],
             "spawns": self.spawns,
             "losses": self.losses,
+            "quarantines": self.quarantines,
             "records_collected": len(self.records),
         }
 
@@ -237,12 +274,87 @@ class FleetManager:
         obs_log.warn("fleet", "replica killed (chaos)", name=name)
         rep.proc.kill()
 
+    def wedge(self, name: str) -> None:
+        """Gray-failure chaos move: SIGSTOP — the process stays alive
+        (and holds its ports) but answers nothing. Reversed by
+        :meth:`unwedge`; every teardown path SIGCONTs first."""
+        rep = self._replicas.get(name)
+        if rep is None or not rep.alive:
+            raise ValueError(f"no live replica {name!r}")
+        rep.proc.send_signal(signal.SIGSTOP)
+        rep.wedged = True
+        obs_log.warn("fleet", "replica wedged (chaos)", name=name)
+
+    def unwedge(self, name: str) -> None:
+        """SIGCONT a wedged replica. Idempotent; a no-op on a corpse."""
+        rep = self._replicas.get(name)
+        if rep is None:
+            return
+        if rep.alive and rep.wedged:
+            rep.proc.send_signal(signal.SIGCONT)
+            obs_log.info("fleet", "replica unwedged", name=name)
+        rep.wedged = False
+
+    def _continue_for_teardown(self, rep: Replica) -> None:
+        """A SIGSTOPped process cannot handle SIGTERM — it would sit in
+        the stopped state until the drain timeout killed it (record
+        lost) or, if the harness died first, leak forever. SIGCONT
+        before any teardown signal so the drain contract holds."""
+        if rep.wedged and rep.alive:
+            try:
+                rep.proc.send_signal(signal.SIGCONT)
+            except (OSError, ValueError):
+                pass
+            rep.wedged = False
+
+    def quarantine(self, name: str, reason: str = "",
+                   evidence: Optional[dict] = None,
+                   respawn: bool = True) -> Optional[Replica]:
+        """Byzantine/breaker verdict: pull ``name`` out of routing but
+        keep it ALIVE for autopsy. Dumps a flight-record snapshot when
+        the recorder is armed, bumps the quarantine ledger, and spawns
+        a warm replacement (fresh name — the quarantined slot still
+        exists). Returns the replacement (None when ``respawn`` is off
+        or the replica was already quarantined/dead)."""
+        from distributed_sddmm_tpu.obs import flightrec, metrics
+        from distributed_sddmm_tpu.obs import trace as obs_trace
+
+        with self._quarantine_lock:
+            rep = self._replicas.get(name)
+            if rep is None or not rep.alive or rep.quarantined:
+                return None
+            rep.quarantined = True
+            rep.quarantine_reason = reason or "quarantined"
+            self.quarantines += 1
+            self.quarantine_log.append({
+                "t": time.monotonic(), "name": name, "reason": reason,
+                "generation": rep.generation,
+            })
+        metrics.GLOBAL.add("fleet_quarantines")
+        obs_trace.event("fleet_quarantine", replica=name, reason=reason)
+        obs_log.warn("fleet", "replica quarantined", name=name,
+                     reason=reason, generation=rep.generation)
+        fr = flightrec.active()
+        if fr is not None:
+            fr.dump("fleet_quarantine", op="fleet", attrs={
+                "name": name, "reason": reason,
+                "generation": rep.generation, "role": rep.role,
+                "evidence": evidence or {},
+            })
+        if not respawn:
+            return None
+        replacement = self.spawn(role=rep.role)
+        obs_log.info("fleet", "quarantine replacement spawned",
+                     quarantined=name, replacement=replacement.name)
+        return replacement
+
     def drain(self, name: str, timeout_s: float = 60.0) -> Optional[dict]:
         """Graceful exit: SIGTERM → the replica closes admission, drains
         its queue, prints its record, exits 0. Returns the record."""
         rep = self._replicas.get(name)
         if rep is None or not rep.alive:
             raise ValueError(f"no live replica {name!r}")
+        self._continue_for_teardown(rep)
         rep.proc.send_signal(signal.SIGTERM)
         try:
             rep.proc.wait(timeout_s)
@@ -253,9 +365,12 @@ class FleetManager:
         return rep.record
 
     def stop_all(self, timeout_s: float = 60.0) -> list[dict]:
-        """Drain every live replica; returns all collected records."""
+        """Drain every live replica (wedged ones are SIGCONTed first,
+        quarantined ones included — their records still count); returns
+        all collected records."""
         live = [r for r in self._replicas.values() if r.alive]
         for rep in live:
+            self._continue_for_teardown(rep)
             rep.proc.send_signal(signal.SIGTERM)
         deadline = time.monotonic() + timeout_s
         for rep in live:

@@ -19,36 +19,101 @@ Routing policy, in order:
    it routes to the host-serial tier (``serial=true``, preferring a
    ``fallback``-role replica) instead.
 2. **Health**: only replicas that are ready (``/readyz``), not
-   draining, and recently polled are candidates.
+   draining, recently polled, AND whose circuit breaker admits are
+   candidates.
 3. **Drain, don't kill, burning replicas**: a replica whose SLO burn
    rate exceeds ``drain_burn`` stops receiving admissions but finishes
    its in-flight queue; it resumes when burn recovers below
    ``resume_burn`` (hysteresis — no flapping at the threshold).
 4. **Least pressure**: among candidates, lowest (queue depth fraction,
-   burn) wins.
-5. **Failover**: a connection-level failure (killed replica) marks the
-   replica not-ready and retries the SAME request on the next
-   candidate — a chaos kill turns into a re-admission, never a
-   silently dropped reply. A 429 from one replica tries the next; only
-   when every candidate sheds does the router shed at the edge, with
-   the largest ``Retry-After`` hint it saw.
+   burn) wins; half-open breakers sort last (probe traffic only).
+5. **Failover**: a connection-level failure, a chaos-injected drop, or
+   a malformed/undecodable reply body marks the replica and retries
+   the SAME request on the next candidate — a fault turns into a
+   re-admission, never a silently dropped reply or a client-facing
+   500. A 429 from one replica tries the next; only when every
+   candidate sheds does the router shed at the edge, with the largest
+   ``Retry-After`` hint it saw.
+
+Gray-failure hardening (PR 17) — crash faults fail fast, *gray* faults
+need detectors:
+
+* **Circuit breakers** (per replica): ``breaker_errs`` consecutive
+  strikes (submit transport errors/timeouts, undecodable replies,
+  failed health polls) → **open** — the replica stops receiving
+  admissions, so a wedged runner no longer eats ``request_timeout_s``
+  per request. After ``breaker_cooldown_s`` of quiet it goes
+  **half-open** (probe traffic admitted, sorted last); one successful
+  submit closes it, one failure re-opens it. Health polls never close
+  a breaker — ``/readyz`` can lie (that is what makes the failure
+  gray); only the submit path proves recovery.
+* **Hedged requests**: when the primary attempt has not answered
+  within a p95-derived hedge delay, the SAME request is re-submitted
+  to the next ready replica and the first reply wins — safe because
+  the serve layer's replies are bit-identical across replicas by
+  construction. When both eventually land they are compared; a
+  mismatch is a byzantine signal (counted, arbitrated, quarantined).
+* **Sampled response audit**: a deterministic ``audit_frac`` fraction
+  of requests is re-executed on a *different* replica before the
+  reply leaves the router and compared bit-for-bit (the canary is
+  never audited against itself — the comparator is always another
+  process). On mismatch a third replica arbitrates: the odd replica
+  out is quarantined (``quarantine_fn`` → ``FleetManager.
+  quarantine``) and the majority reply is what the client receives —
+  under audit, a byzantine replica cannot leak wrong bytes.
 """
 
 from __future__ import annotations
 
+import collections
+import json
 import os
 import threading
 import time
 from typing import Callable, Optional
 
 from distributed_sddmm_tpu.obs import log as obs_log
+from distributed_sddmm_tpu.obs import metrics as obs_metrics
+from distributed_sddmm_tpu.obs import trace as obs_trace
 from distributed_sddmm_tpu.serve.queue import DEFAULT_TENANT, ShedError
 from distributed_sddmm_tpu.utils.buckets import bucket_for
+
+#: Hedge delay floor when ``DSDDMM_FLEET_HEDGE`` is a bare enable.
+DEFAULT_HEDGE_FLOOR_S = 0.25
+#: Hedge delay ceiling — a hedge that waits longer than this is not
+#: rescuing a tail, it is a second timeout.
+HEDGE_CEIL_S = 2.0
 
 
 def _drain_burn_default() -> float:
     v = os.environ.get("DSDDMM_FLEET_DRAIN_BURN")
     return float(v) if v not in (None, "") else 1.0
+
+
+def _breaker_errs_default() -> int:
+    v = os.environ.get("DSDDMM_FLEET_BREAKER_ERRS")
+    return int(v) if v not in (None, "") else 3
+
+
+def _breaker_cooldown_default() -> float:
+    v = os.environ.get("DSDDMM_FLEET_BREAKER_COOLDOWN")
+    return float(v) if v not in (None, "") else 2.0
+
+
+def _audit_frac_default() -> float:
+    v = os.environ.get("DSDDMM_FLEET_AUDIT_FRAC")
+    return min(max(float(v), 0.0), 1.0) if v not in (None, "") else 0.0
+
+
+def _hedge_default() -> float:
+    """``DSDDMM_FLEET_HEDGE``: off ('' / 0 / off), on with the default
+    floor ('1' / 'on'), or a float hedge-delay floor in seconds."""
+    v = (os.environ.get("DSDDMM_FLEET_HEDGE") or "").strip().lower()
+    if v in ("", "0", "off", "false", "no"):
+        return 0.0
+    if v in ("1", "on", "true", "yes"):
+        return DEFAULT_HEDGE_FLOOR_S
+    return max(float(v), 0.0)
 
 
 class ReplicaState:
@@ -65,6 +130,12 @@ class ReplicaState:
         self.inner_buckets: tuple = ()
         self.t_poll = 0.0
         self.errors = 0
+        #: Circuit breaker: closed → open (strike threshold) →
+        #: half_open (cooldown) → closed (submit success).
+        self.breaker = "closed"
+        self.strikes = 0
+        self.t_opened = 0.0
+        self.breaker_opens = 0
 
     @property
     def inner_max(self) -> int:
@@ -77,6 +148,8 @@ class ReplicaState:
             "burn": self.burn, "depth_frac": self.depth_frac,
             "inner_buckets": list(self.inner_buckets),
             "errors": self.errors,
+            "breaker": self.breaker, "strikes": self.strikes,
+            "breaker_opens": self.breaker_opens,
         }
 
 
@@ -100,7 +173,9 @@ class FleetRouter:
 
     ``manager`` (a :class:`~distributed_sddmm_tpu.fleet.manager.
     FleetManager`) is the live endpoint source — respawns are picked up
-    on the next poll tick. Tests can instead pass static ``endpoints``
+    on the next poll tick, and its :meth:`~distributed_sddmm_tpu.fleet.
+    manager.FleetManager.quarantine` becomes the default
+    ``quarantine_fn``. Tests can instead pass static ``endpoints``
     ``[(name, port, role), ...]``.
     """
 
@@ -116,6 +191,11 @@ class FleetRouter:
         shed_retry_after_s: float = 1.0,
         inner_size_fn: Optional[Callable[[dict], int]] = None,
         port: int = 0,
+        breaker_errs: Optional[int] = None,
+        breaker_cooldown_s: Optional[float] = None,
+        hedge_delay_s: Optional[float] = None,
+        audit_frac: Optional[float] = None,
+        quarantine_fn: Optional[Callable] = None,
     ):
         if manager is None and endpoints is None:
             raise ValueError("need a manager or static endpoints")
@@ -130,15 +210,49 @@ class FleetRouter:
         self.request_timeout_s = float(request_timeout_s)
         self.shed_retry_after_s = float(shed_retry_after_s)
         self.inner_size_fn = inner_size_fn or _default_inner_size
+        self.breaker_errs = (
+            _breaker_errs_default() if breaker_errs is None
+            else int(breaker_errs)
+        )
+        self.breaker_cooldown_s = (
+            _breaker_cooldown_default() if breaker_cooldown_s is None
+            else float(breaker_cooldown_s)
+        )
+        #: 0 disables hedging; > 0 is the hedge-delay floor (seconds).
+        self.hedge_delay_s = (
+            _hedge_default() if hedge_delay_s is None
+            else max(float(hedge_delay_s), 0.0)
+        )
+        self.audit_frac = (
+            _audit_frac_default() if audit_frac is None
+            else min(max(float(audit_frac), 0.0), 1.0)
+        )
+        #: ``quarantine_fn(name, reason=..., evidence=...)`` — the
+        #: byzantine verdict sink; defaults to the manager's.
+        if quarantine_fn is None and manager is not None:
+            quarantine_fn = manager.quarantine
+        self.quarantine_fn = quarantine_fn
+        #: Chaos wire-fault hook (``resilience/chaos.ChaosEngine``):
+        #: called with the replica name before each wire attempt;
+        #: returns None or {"drop": True} / {"delay_s": x}.
+        self.fault_hook: Optional[Callable[[str], Optional[dict]]] = None
         self._states: dict[str, ReplicaState] = {}
         self._lock = threading.Lock()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._server = None
         self._port = int(port)
+        self._lat: collections.deque = collections.deque(maxlen=256)
+        self._audit_seq = 0
+        #: Breaker transitions in arrival order (the chaos judge reads
+        #: open events against the injected-fault timeline).
+        self.breaker_events: list = []
         self.stats = {
             "routed": 0, "failovers": 0, "serial_routed": 0,
             "edge_sheds": 0, "replica_sheds_seen": 0, "drains": 0,
+            "hedges": 0, "hedge_wins": 0, "audits": 0,
+            "audit_mismatches": 0, "breaker_opens": 0,
+            "quarantines": 0, "decode_failovers": 0,
         }
 
     # -- polling -------------------------------------------------------- #
@@ -150,7 +264,12 @@ class FleetRouter:
 
     def poll_once(self) -> None:
         """One health sweep: refresh every replica's readiness, burn,
-        depth, and ladder; apply the drain/resume hysteresis."""
+        depth, and ladder; apply the drain/resume hysteresis. A failed
+        poll is a breaker strike — a wedged replica (SIGSTOP freezes
+        its admin surface too) opens its breaker within
+        ``breaker_errs`` ticks — but a SUCCESSFUL poll never closes
+        one: readiness can lie while the submit path is dead (the
+        partition case), so only a served request proves recovery."""
         from distributed_sddmm_tpu.obs.httpexp import fetch_json
 
         seen = set()
@@ -170,7 +289,7 @@ class FleetRouter:
             except (OSError, ValueError):
                 with self._lock:
                     st.ready = False
-                    st.errors += 1
+                self._strike(st, "poll")
                 continue
             with self._lock:
                 st.ready = bool(ready_body.get("ready"))
@@ -202,6 +321,68 @@ class FleetRouter:
                              error=f"{type(e).__name__}: {e}")
             self._stop.wait(self.poll_interval_s)
 
+    # -- circuit breaker ------------------------------------------------ #
+
+    def _strike(self, st: ReplicaState, where: str) -> None:
+        """One consecutive-failure strike; opens the breaker at the
+        threshold (instantly from half-open — a failed probe re-opens).
+        While open, fresh strikes push the cooldown out: half-open
+        probes wait for actual quiet."""
+        opened = False
+        now = time.monotonic()
+        with self._lock:
+            st.strikes += 1
+            st.errors += 1
+            if st.breaker == "half_open" or (
+                st.breaker == "closed"
+                and st.strikes >= self.breaker_errs
+            ):
+                st.breaker = "open"
+                st.t_opened = now
+                st.breaker_opens += 1
+                self.stats["breaker_opens"] += 1
+                self.breaker_events.append(
+                    {"t": now, "name": st.name, "state": "open",
+                     "where": where})
+                opened = True
+            elif st.breaker == "open":
+                st.t_opened = now
+        if opened:
+            obs_metrics.GLOBAL.add("fleet_breaker_opens")
+            obs_trace.event("fleet_breaker_open", replica=st.name,
+                            where=where)
+            obs_log.warn("fleet", "circuit breaker opened",
+                         name=st.name, where=where, strikes=st.strikes)
+
+    def _settle(self, st: ReplicaState) -> None:
+        """A successful submit: the only evidence that closes a
+        breaker (health polls are not proof — gray failures pass
+        them)."""
+        closed = False
+        with self._lock:
+            st.strikes = 0
+            if st.breaker != "closed":
+                st.breaker = "closed"
+                self.breaker_events.append(
+                    {"t": time.monotonic(), "name": st.name,
+                     "state": "closed", "where": "submit"})
+                closed = True
+        if closed:
+            obs_log.info("fleet", "circuit breaker closed", name=st.name)
+
+    def _admits(self, st: ReplicaState, now: float) -> bool:
+        """Breaker admission (call under ``self._lock``): closed and
+        half-open admit; open flips to half-open after the cooldown."""
+        if st.breaker == "open":
+            if now - st.t_opened >= self.breaker_cooldown_s:
+                st.breaker = "half_open"
+                self.breaker_events.append(
+                    {"t": now, "name": st.name, "state": "half_open",
+                     "where": "cooldown"})
+                return True
+            return False
+        return True
+
     # -- routing -------------------------------------------------------- #
 
     def states(self) -> list[ReplicaState]:
@@ -209,9 +390,10 @@ class FleetRouter:
             return list(self._states.values())
 
     def _candidates(self, serial: bool) -> list[ReplicaState]:
+        now = time.monotonic()
         with self._lock:
-            states = list(self._states.values())
-        pool = [s for s in states if s.ready and not s.draining]
+            pool = [s for s in self._states.values()
+                    if s.ready and not s.draining and self._admits(s, now)]
         if serial:
             # Host-serial tier: prefer dedicated fallback replicas, but
             # any ready replica can run the serial rung.
@@ -219,8 +401,295 @@ class FleetRouter:
             pool = fallback or pool
         else:
             pool = [s for s in pool if s.role == "serve"]
-        return sorted(pool, key=lambda s: (s.depth_frac, s.burn or 0.0,
+        # Half-open breakers last: probe traffic only reaches them when
+        # the healthy pool is exhausted or as failover/hedge targets.
+        return sorted(pool, key=lambda s: (s.breaker == "half_open",
+                                           s.depth_frac, s.burn or 0.0,
                                            s.name))
+
+    @staticmethod
+    def _canon(reply) -> str:
+        """Bit-for-bit comparison form: replies already crossed the
+        wire as JSON, so the canonical dump IS the byte identity."""
+        from distributed_sddmm_tpu.obs.httpexp import _json_default
+
+        return json.dumps(reply, sort_keys=True, default=_json_default)
+
+    def _submit_once(self, st: ReplicaState, body: dict,
+                     timeout_s: float):
+        """One wire attempt against one replica. Outcomes::
+
+            ("ok", reply)          200 with a well-formed body
+            ("shed", hint_s)       429 — replica admission shed
+            ("error", reason)      transport failure, undecodable or
+                                   malformed reply body, chaos drop —
+                                   all strike the breaker and fail over
+            ("http", code, detail) any other HTTP status
+
+        The chaos ``fault_hook`` is consulted first: an active
+        partition window turns the attempt into a local error (the
+        wire is down for us, whatever the replica thinks), a slow
+        window delays it.
+        """
+        from distributed_sddmm_tpu.obs.httpexp import post_json
+
+        hook = self.fault_hook
+        if hook is not None:
+            act = hook(st.name) or {}
+            if act.get("delay_s"):
+                time.sleep(float(act["delay_s"]))
+            if act.get("drop"):
+                self._strike(st, "chaos-drop")
+                return ("error", f"chaos partition: {st.name} dropped")
+        t_send = time.monotonic()
+        try:
+            code, decoded, headers = post_json(
+                "127.0.0.1", st.port, "/submit", body,
+                timeout_s=timeout_s,
+            )
+        except OSError as e:
+            # Connection-level failure: the replica is gone (chaos
+            # kill) or wedged. Mark it — the caller fails over.
+            with self._lock:
+                st.ready = False
+            self._strike(st, "submit")
+            return ("error", f"{type(e).__name__}: {e}")
+        except ValueError as e:
+            # 200 whose body does not decode as JSON: the replica is
+            # answering garbage — replica failure, not client error.
+            with self._lock:
+                self.stats["decode_failovers"] += 1
+            self._strike(st, "decode")
+            return ("error", f"undecodable reply body: {e}")
+        if code == 200:
+            try:
+                reply = decoded["reply"]
+            except (TypeError, KeyError):
+                # Well-formed JSON, wrong shape — same verdict as an
+                # undecodable body: fail over, never a client 500.
+                with self._lock:
+                    self.stats["decode_failovers"] += 1
+                self._strike(st, "decode")
+                return ("error", "malformed reply body: no 'reply' key")
+            with self._lock:
+                self._lat.append(time.monotonic() - t_send)
+            self._settle(st)
+            return ("ok", reply)
+        if code == 429:
+            hint = 0.0
+            raw = headers.get("Retry-After") or (
+                decoded.get("retry_after_s", 0.0)
+                if isinstance(decoded, dict) else 0.0
+            )
+            try:
+                hint = float(raw)
+            except (TypeError, ValueError):
+                pass
+            return ("shed", hint)
+        detail = (decoded.get("error", decoded)
+                  if isinstance(decoded, dict) else decoded)
+        return ("http", code, detail)
+
+    # -- hedging -------------------------------------------------------- #
+
+    def _hedge_delay(self) -> float:
+        """The p95-derived hedge delay: 4× the observed p95 submit
+        latency, floored at ``hedge_delay_s`` and capped — with no
+        history yet, the floor alone. 0 when hedging is disabled."""
+        if self.hedge_delay_s <= 0.0:
+            return 0.0
+        with self._lock:
+            lats = sorted(self._lat)
+        if len(lats) >= 8:
+            p95 = lats[min(int(0.95 * (len(lats) - 1)), len(lats) - 1)]
+            return max(self.hedge_delay_s, min(4.0 * p95, HEDGE_CEIL_S))
+        return self.hedge_delay_s
+
+    def _attempt(self, primary: ReplicaState, hedge_pool: list,
+                 body: dict, timeout_s: float):
+        """Primary submit with an optional hedge: if the primary has
+        not answered within the hedge delay, fire the same request at
+        the next candidate and take the first success. Returns
+        ``(outcome, server_name)``. When both land with replies they
+        are compared (possibly after this returns) — a mismatch is a
+        byzantine signal."""
+        delay = self._hedge_delay() if hedge_pool else 0.0
+        if delay <= 0.0:
+            return self._submit_once(primary, body, timeout_s), primary.name
+
+        cond = threading.Condition()
+        arrivals: list = []  # (key, outcome) in completion order
+
+        def run(key: str, st: ReplicaState) -> None:
+            out = self._submit_once(st, body, timeout_s)
+            with cond:
+                arrivals.append((key, out))
+                cond.notify_all()
+
+        threading.Thread(target=run, args=("p", primary), daemon=True,
+                         name="fleet-submit").start()
+        with cond:
+            cond.wait_for(lambda: arrivals, timeout=delay)
+            early = arrivals[0] if arrivals else None
+        if early is not None:
+            # Primary answered (or failed fast) inside the delay — a
+            # quick error is the failover loop's job, not a hedge's.
+            return early[1], primary.name
+
+        backup = hedge_pool[0]
+        with self._lock:
+            self.stats["hedges"] += 1
+        obs_metrics.GLOBAL.add("fleet_hedges")
+        obs_trace.event("fleet_hedge", primary=primary.name,
+                        backup=backup.name)
+        threading.Thread(target=run, args=("h", backup), daemon=True,
+                         name="fleet-hedge").start()
+        with cond:
+            cond.wait_for(
+                lambda: any(o[0] == "ok" for _, o in arrivals)
+                or len(arrivals) == 2,
+                timeout=timeout_s,
+            )
+            snapshot = list(arrivals)
+        first_ok = next(((k, o) for k, o in snapshot if o[0] == "ok"),
+                        None)
+        self._compare_when_both_land(cond, arrivals, primary, backup,
+                                     body, timeout_s)
+        if first_ok is None:
+            # Neither landed usable: report the primary's outcome when
+            # it exists (keeps the failover loop's accounting honest).
+            by_key = dict(snapshot)
+            out = by_key.get("p") or by_key.get("h") or \
+                ("error", "hedged attempt timed out")
+            return out, primary.name
+        key, out = first_ok
+        if key == "h":
+            with self._lock:
+                self.stats["hedge_wins"] += 1
+            obs_metrics.GLOBAL.add("fleet_hedge_wins")
+        return out, (backup.name if key == "h" else primary.name)
+
+    def _compare_when_both_land(self, cond, arrivals, primary, backup,
+                                body, timeout_s) -> None:
+        """Both-land agreement check: when the loser eventually
+        answers too, the two replies must be bit-identical. Runs on a
+        side thread so the winning reply is never delayed."""
+
+        def work() -> None:
+            with cond:
+                cond.wait_for(lambda: len(arrivals) == 2,
+                              timeout=timeout_s)
+                snapshot = dict(arrivals)
+            p, h = snapshot.get("p"), snapshot.get("h")
+            if not (p and h and p[0] == "ok" and h[0] == "ok"):
+                return
+            if self._canon(p[1]) == self._canon(h[1]):
+                return
+            self._byzantine(primary.name, p[1], backup.name, h[1],
+                            body, timeout_s, where="hedge")
+
+        threading.Thread(target=work, daemon=True,
+                         name="fleet-hedge-compare").start()
+
+    # -- audit / byzantine arbitration ---------------------------------- #
+
+    def _audit_roll(self) -> bool:
+        """Deterministic stride sampling: request ``n`` audits iff the
+        integer part of ``n * frac`` advanced — exactly ``frac`` of
+        requests, no RNG, reproducible run to run."""
+        if self.audit_frac <= 0.0:
+            return False
+        with self._lock:
+            self._audit_seq += 1
+            n = self._audit_seq
+        return int(n * self.audit_frac) > int((n - 1) * self.audit_frac)
+
+    def _audit(self, server_name: str, reply, body: dict,
+               timeout_s: float, candidates: list):
+        """Synchronous sampled audit: re-execute on a DIFFERENT
+        replica and compare bit-for-bit before the reply leaves the
+        router. On mismatch, arbitration picks the majority reply —
+        that is what the client gets — and the odd replica out is
+        quarantined. Returns the reply to deliver."""
+        pool = [s for s in candidates if s.name != server_name]
+        if not pool:
+            return reply  # nobody to compare against — audit skipped
+        auditor = pool[0]
+        with self._lock:
+            self.stats["audits"] += 1
+        out = self._submit_once(auditor, body, timeout_s)
+        if out[0] != "ok":
+            return reply  # audit inconclusive; primary reply stands
+        if self._canon(out[1]) == self._canon(reply):
+            return reply
+        return self._byzantine(server_name, reply, auditor.name, out[1],
+                               body, timeout_s, where="audit",
+                               candidates=candidates)
+
+    def _byzantine(self, name_a: str, reply_a, name_b: str, reply_b,
+                   body: dict, timeout_s: float, where: str,
+                   candidates: Optional[list] = None):
+        """Two replicas disagree bit-for-bit on the same request — one
+        of them is lying. A third replica arbitrates: whichever side
+        the tiebreak contradicts is quarantined, and the majority
+        reply is returned. Without a tiebreak (2-replica fleet) the
+        mismatch is counted and logged but nobody is quarantined — no
+        quorum, no verdict."""
+        with self._lock:
+            self.stats["audit_mismatches"] += 1
+        obs_metrics.GLOBAL.add("fleet_audit_mismatches")
+        obs_trace.event("fleet_audit_mismatch", a=name_a, b=name_b,
+                        where=where)
+        obs_log.warn("fleet", "byzantine reply mismatch",
+                     a=name_a, b=name_b, where=where)
+        if candidates is None:
+            candidates = self._candidates(serial=False)
+        canon_a, canon_b = self._canon(reply_a), self._canon(reply_b)
+        for tie in candidates:
+            if tie.name in (name_a, name_b):
+                continue
+            out = self._submit_once(tie, body, timeout_s)
+            if out[0] != "ok":
+                continue
+            canon_t = self._canon(out[1])
+            if canon_t == canon_a:
+                liar, verdict = name_b, reply_a
+            elif canon_t == canon_b:
+                liar, verdict = name_a, reply_b
+            else:
+                obs_log.warn("fleet", "three-way reply disagreement; "
+                             "no quorum", a=name_a, b=name_b,
+                             tiebreak=tie.name)
+                return reply_a
+            self._quarantine(liar, where, evidence={
+                "request_tenant": body.get("tenant"),
+                "disagreed_with": [n for n in (name_a, name_b, tie.name)
+                                   if n != liar],
+                "where": where,
+            })
+            return verdict
+        obs_log.warn("fleet", "byzantine mismatch with no tiebreak "
+                     "replica — cannot arbitrate", a=name_a, b=name_b)
+        return reply_a
+
+    def _quarantine(self, name: str, where: str,
+                    evidence: Optional[dict] = None) -> None:
+        with self._lock:
+            self.stats["quarantines"] += 1
+        if self.quarantine_fn is None:
+            obs_log.warn("fleet", "no quarantine sink; byzantine "
+                         "replica stays in rotation", name=name)
+            return
+        try:
+            self.quarantine_fn(
+                name, reason=f"byzantine reply mismatch ({where})",
+                evidence=evidence,
+            )
+        except Exception as e:  # noqa: BLE001 — verdict must not 500
+            obs_log.warn("fleet", "quarantine failed", name=name,
+                         error=f"{type(e).__name__}: {e}")
+
+    # -- the routing decision ------------------------------------------- #
 
     def route(self, payload: dict, tenant: str = DEFAULT_TENANT,
               serial: bool = False, timeout_s: Optional[float] = None
@@ -228,8 +697,6 @@ class FleetRouter:
         """The ``submit_fn`` contract: returns the reply dict, raises
         :class:`ShedError` (→ 429 + Retry-After at the edge) when no
         replica admits the request."""
-        from distributed_sddmm_tpu.obs.httpexp import post_json
-
         timeout_s = self.request_timeout_s if timeout_s is None else timeout_s
         inner = self.inner_size_fn(payload)
         candidates = self._candidates(serial)
@@ -253,48 +720,40 @@ class FleetRouter:
                        and bucket_for(inner, s.inner_buckets) >= inner]
             candidates = fitting or candidates
 
+        body = {"payload": payload, "tenant": tenant,
+                "serial": serial, "timeout_s": timeout_s}
         shed_hint = 0.0
         saw_shed = False
-        for st in candidates:
-            body = {"payload": payload, "tenant": tenant,
-                    "serial": serial, "timeout_s": timeout_s}
-            try:
-                code, decoded, headers = post_json(
-                    "127.0.0.1", st.port, "/submit", body,
-                    timeout_s=timeout_s,
-                )
-            except OSError as e:
-                # Connection-level failure: the replica is gone (chaos
-                # kill) or wedged. Mark it and FAIL OVER — the request
-                # is re-admitted on the next candidate, not dropped.
-                with self._lock:
-                    st.ready = False
-                    st.errors += 1
-                self.stats["failovers"] += 1
-                obs_log.warn("fleet", "replica unreachable; failing over",
-                             name=st.name, error=f"{type(e).__name__}: {e}")
-                continue
-            if code == 200:
+        for i, st in enumerate(candidates):
+            # The serial tier is the oracle rung — not bit-identical to
+            # the batched path by design (float64), so neither hedging
+            # nor audit applies to it.
+            hedge_pool = [] if serial else candidates[i + 1:]
+            out, server = self._attempt(st, hedge_pool, body, timeout_s)
+            if out[0] == "ok":
+                reply = out[1]
+                if not serial and self._audit_roll():
+                    reply = self._audit(server, reply, body, timeout_s,
+                                        candidates)
                 with self._lock:
                     self.stats["routed"] += 1
                     if serial:
                         self.stats["serial_routed"] += 1
-                return decoded.get("reply")
-            if code == 429:
+                return reply
+            if out[0] == "shed":
                 saw_shed = True
                 self.stats["replica_sheds_seen"] += 1
-                hint = headers.get("Retry-After") or decoded.get(
-                    "retry_after_s", 0.0
-                )
-                try:
-                    shed_hint = max(shed_hint, float(hint))
-                except (TypeError, ValueError):
-                    pass
+                shed_hint = max(shed_hint, out[1])
                 continue  # another replica may have headroom
-            raise RuntimeError(
-                f"replica {st.name} answered {code}: "
-                f"{decoded.get('error', decoded)}"
-            )
+            if out[0] == "http":
+                raise RuntimeError(
+                    f"replica {server} answered {out[1]}: {out[2]}"
+                )
+            # ("error", ...): transport, decode, or chaos drop — the
+            # request is re-admitted on the next candidate, not dropped.
+            self.stats["failovers"] += 1
+            obs_log.warn("fleet", "replica attempt failed; failing over",
+                         name=server, error=out[1])
         self.stats["edge_sheds"] += 1
         raise ShedError(
             "all replicas shed" if saw_shed else "no replica reachable",
@@ -305,12 +764,18 @@ class FleetRouter:
 
     def topology(self) -> dict:
         """The ``/snapshot`` body: per-replica state + router counters
-        (and the manager's spawn/loss ledger when attached)."""
+        (and the manager's spawn/loss/quarantine ledger when
+        attached)."""
         out = {
             "router": True,
             "replicas": [s.describe() for s in self.states()],
             "stats": dict(self.stats),
             "drain_burn": self.drain_burn,
+            "breaker": {"errs": self.breaker_errs,
+                        "cooldown_s": self.breaker_cooldown_s},
+            "hedge_delay_s": self._hedge_delay(),
+            "audit_frac": self.audit_frac,
+            "breaker_events": list(self.breaker_events[-64:]),
         }
         if self.manager is not None:
             out["manager"] = self.manager.describe()

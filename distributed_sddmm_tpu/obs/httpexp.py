@@ -95,6 +95,12 @@ KNOWN_GLOBAL_COUNTERS: dict = {
         "shadow replies that diverged from the incumbent (blocks promotion)",
     "tuner_promotions": "challenger ladders hot-swapped into serving",
     "tuner_rejects": "challengers abandoned (mismatch, stale, or no better)",
+    "fleet_hedges": "hedged (duplicate) submits fired by the fleet router",
+    "fleet_hedge_wins": "hedged submits whose backup reply won the race",
+    "fleet_audit_mismatches":
+        "cross-replica reply comparisons that disagreed bit-for-bit",
+    "fleet_breaker_opens": "per-replica circuit breakers tripped open",
+    "fleet_quarantines": "replicas quarantined for autopsy (byzantine/gray)",
 }
 
 #: Exposition metric-name prefix.
@@ -335,6 +341,7 @@ class AdminServer:
         ring_capacity: int = 512,
         debug_requests_limit: int = 64,
         submit_fn: Optional[Callable] = None,
+        chaos_fn: Optional[Callable[[dict], dict]] = None,
     ):
         self.engine = engine
         self.op_metrics = op_metrics
@@ -343,6 +350,11 @@ class AdminServer:
         #: ``submit_fn(payload, tenant=..., serial=..., timeout_s=...)``
         #: → reply dict. None keeps the server read-only (no /submit).
         self.submit_fn = submit_fn
+        #: ``chaos_fn(body)`` → ack dict, serving ``POST /chaos`` — the
+        #: runtime arming hook chaos drills use to install a fault plan
+        #: in an already-running replica (env knobs cannot change after
+        #: spawn). None (the default) keeps the endpoint 404.
+        self.chaos_fn = chaos_fn
         self.host = host
         self.port = int(port)
         self.burn_threshold = float(burn_threshold)
@@ -559,6 +571,8 @@ class AdminServer:
                          "/debug/requests", "/snapshot"]
             if self.submit_fn is not None:
                 endpoints.append("POST /submit")
+            if self.chaos_fn is not None:
+                endpoints.append("POST /chaos")
             self._send_json(handler, 200, {
                 "endpoints": endpoints,
                 "t_epoch": clock.epoch(),
@@ -571,6 +585,9 @@ class AdminServer:
         from distributed_sddmm_tpu.serve.queue import ShedError
 
         path = urlsplit(handler.path).path.rstrip("/") or "/"
+        if path == "/chaos" and self.chaos_fn is not None:
+            self._route_chaos(handler)
+            return
         if path != "/submit" or self.submit_fn is None:
             self._send(handler, 404, f"no such POST endpoint: {path}\n",
                        "text/plain")
@@ -611,6 +628,29 @@ class AdminServer:
             )
         else:
             self._send_json(handler, 200, {"reply": reply, "tenant": tenant})
+
+    def _route_chaos(self, handler: BaseHTTPRequestHandler) -> None:
+        """``POST /chaos``: arm a fault plan in the running replica.
+        Only wired up in chaos-enabled ``bench serve`` replicas; a
+        malformed body is the caller's bug (400), a handler failure a
+        typed 500 — arming never crashes the serving process."""
+        length = int(handler.headers.get("Content-Length") or 0)
+        raw = handler.rfile.read(length) if length else b""
+        try:
+            body = json.loads(raw.decode() or "{}")
+        except (UnicodeDecodeError, json.JSONDecodeError) as e:
+            self._send_json(handler, 400, {"error": f"bad JSON: {e}"})
+            return
+        try:
+            ack = self.chaos_fn(body)
+        except ValueError as e:
+            self._send_json(handler, 400, {"error": str(e)})
+        except Exception as e:  # noqa: BLE001 — typed 500, never die
+            self._send_json(
+                handler, 500, {"error": f"{type(e).__name__}: {e}"},
+            )
+        else:
+            self._send_json(handler, 200, ack or {"armed": True})
 
     @staticmethod
     def _send(handler, code: int, body: str, content_type: str,

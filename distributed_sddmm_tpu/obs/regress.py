@@ -207,11 +207,30 @@ def _fleet_rows(doc: dict) -> dict[str, dict]:
     if avail is None:
         return {}
     offered = max(int(fleet.get("offered") or 0), 1)
-    return {
+    rows = {
         "fleet:availability": _pseudo_row(
             offered, max(1.0 - float(avail), 0.01)
         ),
     }
+    if fleet.get("audit_mismatches") is not None:
+        # HARD axis (compare() special-cases it ahead of the band
+        # machinery): replies are bit-identical by construction, so a
+        # single cross-replica mismatch is a byzantine event — any
+        # nonzero count regresses, no threshold, no baseline band.
+        rows["fleet:audit_mismatch"] = _pseudo_row(
+            offered, float(fleet["audit_mismatches"])
+        )
+    hedges = int(fleet.get("hedges") or 0)
+    if hedges > 0:
+        # A RISING hedge-win rate means primaries increasingly miss the
+        # p95-derived hedge deadline — tail degradation the latency
+        # percentiles can hide when the hedge keeps rescuing it. Higher
+        # = worse matches the gate convention directly.
+        rows["fleet:hedge_win_rate"] = _pseudo_row(
+            hedges,
+            max(float(fleet.get("hedge_wins") or 0) / hedges, 0.01),
+        )
+    return rows
 
 
 def _xla_rows(doc: dict) -> dict[str, dict]:
@@ -336,6 +355,24 @@ def compare(
     regressions, improvements, missing, new_phases = [], [], [], []
     for name in sorted(set(stats_a) | set(stats_b)):
         a, b = stats_a.get(name), stats_b.get(name)
+        if name == "fleet:audit_mismatch" and b is not None:
+            # Zero-tolerance hard axis: the band machinery would let a
+            # "stable" nonzero mismatch count pass — but one byzantine
+            # reply is one too many, baseline or no baseline.
+            bad = b["t_call"] > 0
+            if bad:
+                verdict = "regression"
+                regressions.append(name)
+            elif a is None:
+                verdict = "new"
+                new_phases.append(name)
+            else:
+                verdict = "ok"
+            row = {"a": a, "b": b, "verdict": verdict, "hard_axis": True}
+            if bad:
+                row["attribution"] = "fleet"
+            phases[name] = row
+            continue
         if b is None:
             if _optional_axis(name):
                 # Optional instrumentation axes (burn rate, XLA cost)

@@ -9,6 +9,9 @@ poisoning or hanging it.
 
 * :mod:`.faults`     — seeded, deterministic fault-injection plans
   (env/CLI-activated); every hook is a no-op without an active plan
+* :mod:`.chaos`      — seeded fleet-level chaos schedules (kill /
+  wedge / partition / slow / corrupt) compiled to a reproducible
+  timeline; ``bench fleet --chaos`` drills run on this
 * :mod:`.retry`      — thread-safe call timeouts + exponential backoff
   with jitter and a max-elapsed cap (replaced the SIGALRM path)
 * :mod:`.guards`     — NaN/Inf output sentinels, CG divergence detection
@@ -22,6 +25,9 @@ autotune falls to cost-model ranking), and finally fail *loudly* — a
 clean typed exception, never a hang, never a silently wrong result.
 """
 
+from distributed_sddmm_tpu.resilience.chaos import (
+    ChaosAction, ChaosEngine, ChaosSchedule,
+)
 from distributed_sddmm_tpu.resilience.checkpoint import (
     CheckpointStore, default_checkpoint_dir,
 )
@@ -38,6 +44,9 @@ __all__ = [
     "Backoff",
     "CGGuard",
     "CallTimeout",
+    "ChaosAction",
+    "ChaosEngine",
+    "ChaosSchedule",
     "CheckpointStore",
     "FaultError",
     "FaultPlan",

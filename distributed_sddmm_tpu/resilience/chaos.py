@@ -1,0 +1,457 @@
+"""Seeded, deterministic chaos schedules for fleet gray-failure drills.
+
+PR 16's ``bench fleet`` hard-coded one SIGKILL at the load midpoint.
+This module replaces that with a declarative **chaos schedule**: a
+compact grammar compiling to a reproducible timeline of fleet-level
+fault actions, so a drill is a *spec* — re-running the same schedule
+string with the same seed reproduces the identical action sequence
+(same kinds, same fire fractions, same seeded victim picks).
+
+Grammar — ``;``-separated actions, each::
+
+    kind[:target]@frac[/duration][:param]
+
+=========  ============================================================
+``kill``   SIGKILL the victim (no drain, no record) — the crash fault.
+``wedge``  SIGSTOP for ``duration`` (default 1 s), then SIGCONT: the
+           process is alive but answers nothing — the gray stall.
+``partition``  drop the router→replica submit path for ``duration``
+           (open-ended when omitted): health probes still succeed, so
+           only the circuit breaker can see it.
+``slow``   delay every submit to the victim by ``param`` (default
+           50 ms) for ``duration`` — the straggler hedging beats.
+``corrupt``  arm the victim's in-process fault plan (``DSDDMM_FAULTS``
+           machinery) at ``output:serveBatch`` with repair-mode guards:
+           the replica keeps answering with *plausible wrong bytes* —
+           the byzantine fault only cross-replica audit can see.
+=========  ============================================================
+
+``frac`` is the fire point as a fraction of the drill duration.
+Durations/params accept ``80ms`` / ``0.2s`` / bare seconds. ``target``
+names a replica (``r1``); omitted targets are resolved at fire time by
+a seeded hash over the live serve pool — deterministic, but never the
+same hard-coded victim across schedules. ``kill-replica`` is kept as
+sugar for ``kill@0.5`` (the PR-16 drill, byte-compatible records).
+
+:class:`ChaosEngine` executes a schedule against a live fleet: manager
+signals (kill/wedge), router wire-fault windows (partition/slow, via
+the ``fault_hook`` consulted by ``FleetRouter._submit_once``), and
+replica-side fault-plan arming over the admin ``POST /chaos`` surface
+(corrupt). Every fired action lands in :attr:`ChaosEngine.events` and
+the trace stream.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import re
+import threading
+import time
+from dataclasses import dataclass
+from typing import Optional
+
+from distributed_sddmm_tpu.obs import log as obs_log
+from distributed_sddmm_tpu.obs import trace as obs_trace
+
+#: Action kinds, in severity order (documentation, not semantics).
+KINDS = ("kill", "wedge", "partition", "slow", "corrupt")
+
+#: Back-compat sugar accepted wherever a schedule string is parsed.
+SUGAR = {"kill-replica": "kill@0.5", "none": "", "off": ""}
+
+#: Wedge SIGSTOP window when the action omits ``/duration``.
+DEFAULT_WEDGE_S = 1.0
+#: Submit delay when a ``slow`` action omits ``:param``.
+DEFAULT_SLOW_S = 0.05
+#: Corrupted-element fraction when ``corrupt`` omits ``:param``.
+DEFAULT_CORRUPT_FRAC = 0.05
+
+_ACTION_RE = re.compile(
+    r"^(?P<kind>[a-z]+)"
+    r"(?::(?P<target>[A-Za-z][A-Za-z0-9_.-]*))?"
+    r"@(?P<frac>[0-9]*\.?[0-9]+)"
+    r"(?:/(?P<dur>[0-9]*\.?[0-9]+(?:ms|s)?))?"
+    r"(?::(?P<param>[0-9]*\.?[0-9]+(?:ms|s)?))?$"
+)
+
+
+def _parse_time_s(text: str, token: str) -> float:
+    """``80ms`` / ``0.2s`` / bare-number seconds → seconds."""
+    if text.endswith("ms"):
+        return float(text[:-2]) / 1e3
+    if text.endswith("s"):
+        return float(text[:-1])
+    try:
+        return float(text)
+    except ValueError:
+        raise ValueError(f"bad time {text!r} in chaos action {token!r}")
+
+
+def _fmt_num(v: float) -> str:
+    """Canonical number rendering: trim trailing zeros, keep '0.5'."""
+    s = f"{v:.6f}".rstrip("0").rstrip(".")
+    return s or "0"
+
+
+def _fmt_time(v: float) -> str:
+    """Canonical time rendering: integral sub-second values in ms."""
+    ms = v * 1e3
+    if v < 1.0 and abs(ms - round(ms)) < 1e-9:
+        return f"{int(round(ms))}ms"
+    return f"{_fmt_num(v)}s"
+
+
+def _unit(text: str) -> float:
+    """Deterministic hash → [0, 1): the seeded victim-pick primitive
+    (same construction as ``resilience/faults._unit_hash``)."""
+    h = hashlib.sha256(text.encode()).digest()
+    return int.from_bytes(h[:8], "big") / float(1 << 64)
+
+
+@dataclass(frozen=True)
+class ChaosAction:
+    """One parsed schedule entry (immutable, canonically renderable)."""
+
+    kind: str
+    frac: float
+    target: Optional[str] = None
+    duration_s: Optional[float] = None
+    param: Optional[float] = None
+
+    def render(self) -> str:
+        out = self.kind
+        if self.target:
+            out += f":{self.target}"
+        out += f"@{_fmt_num(self.frac)}"
+        if self.duration_s is not None:
+            out += f"/{_fmt_time(self.duration_s)}"
+        if self.param is not None:
+            if self.kind == "slow":
+                out += f":{_fmt_time(self.param)}"
+            else:
+                out += f":{_fmt_num(self.param)}"
+        return out
+
+
+def _parse_action(token: str) -> ChaosAction:
+    m = _ACTION_RE.match(token)
+    if m is None:
+        raise ValueError(
+            f"bad chaos action {token!r} — expected "
+            "kind[:target]@frac[/duration][:param]"
+        )
+    kind = m.group("kind")
+    if kind not in KINDS:
+        raise ValueError(
+            f"unknown chaos kind {kind!r} in {token!r} "
+            f"(known: {', '.join(KINDS)})"
+        )
+    frac = float(m.group("frac"))
+    if not 0.0 <= frac <= 1.0:
+        raise ValueError(f"chaos fire point {frac} outside [0, 1] "
+                         f"in {token!r}")
+    dur = m.group("dur")
+    duration_s = _parse_time_s(dur, token) if dur else None
+    raw_param = m.group("param")
+    param: Optional[float] = None
+    if kind in ("kill", "corrupt") and duration_s is not None:
+        raise ValueError(f"{kind} takes no /duration ({token!r})")
+    if kind in ("kill", "wedge", "partition") and raw_param is not None:
+        raise ValueError(f"{kind} takes no :param ({token!r})")
+    if kind == "wedge":
+        duration_s = DEFAULT_WEDGE_S if duration_s is None else duration_s
+    elif kind == "slow":
+        param = (_parse_time_s(raw_param, token) if raw_param
+                 else DEFAULT_SLOW_S)
+    elif kind == "corrupt":
+        param = float(raw_param) if raw_param else DEFAULT_CORRUPT_FRAC
+        if not 0.0 < param <= 1.0:
+            raise ValueError(
+                f"corrupt element fraction {param} outside (0, 1] "
+                f"in {token!r}")
+    return ChaosAction(kind=kind, frac=frac, target=m.group("target"),
+                       duration_s=duration_s, param=param)
+
+
+class ChaosSchedule:
+    """A parsed, seeded schedule: actions sorted by fire fraction.
+
+    ``normalized`` is the canonical string form — what ``bench fleet``
+    stores in the record's ``chaos`` field, and what re-parses to an
+    identical schedule (sugar expanded, times canonicalized, actions
+    fire-order sorted).
+    """
+
+    def __init__(self, actions: list, seed: int = 0):
+        self.actions = sorted(
+            actions, key=lambda a: (a.frac, a.kind, a.target or ""))
+        self.seed = int(seed)
+
+    @classmethod
+    def parse(cls, spec: Optional[str], seed: int = 0) -> "ChaosSchedule":
+        spec = (spec or "").strip()
+        spec = SUGAR.get(spec, spec)
+        tokens = [t.strip() for t in spec.split(";") if t.strip()]
+        return cls([_parse_action(t) for t in tokens], seed=seed)
+
+    @property
+    def normalized(self) -> str:
+        return ";".join(a.render() for a in self.actions)
+
+    def __bool__(self) -> bool:
+        return bool(self.actions)
+
+    def timeline(self, duration_s: float) -> list:
+        """The compiled plan: one row per action with its absolute fire
+        offset. Pure function of (schedule, duration) — the
+        reproducibility contract the chaos smoke re-derives."""
+        return [
+            {"idx": i, "t_s": round(a.frac * float(duration_s), 6),
+             "frac": a.frac, "kind": a.kind, "target": a.target,
+             "duration_s": a.duration_s, "param": a.param}
+            for i, a in enumerate(self.actions)
+        ]
+
+    def resolve(self, idx: int, action: ChaosAction,
+                names: list) -> Optional[str]:
+        """The victim for one firing: the explicit target when it is
+        live, else a seeded deterministic pick over the sorted live
+        pool. None when the pool is empty (or the named target is gone
+        and the pool is empty too)."""
+        pool = sorted(names)
+        if action.target and action.target in pool:
+            return action.target
+        if not pool:
+            return None
+        u = _unit(f"chaos:{self.seed}:{idx}:{action.kind}")
+        return pool[min(int(u * len(pool)), len(pool) - 1)]
+
+
+class ChaosEngine:
+    """Executes a :class:`ChaosSchedule` against a live fleet.
+
+    ``manager`` is a :class:`~distributed_sddmm_tpu.fleet.manager.
+    FleetManager`; ``router`` (optional) receives the wire-fault hook
+    for partition/slow windows. ``heal_kills`` keeps the PR-16 drill
+    semantics: a killed replica is respawned warm as soon as its corpse
+    is reaped.
+    """
+
+    def __init__(self, schedule: ChaosSchedule, manager, router=None, *,
+                 duration_s: float, heal_kills: bool = True,
+                 ready_timeout_s: float = 120.0):
+        self.schedule = schedule
+        self.manager = manager
+        self.router = router
+        self.duration_s = float(duration_s)
+        self.heal_kills = bool(heal_kills)
+        self.ready_timeout_s = float(ready_timeout_s)
+        #: Fired actions, in fire order: the realized timeline the
+        #: record stores and the determinism check replays against.
+        self.events: list = []
+        self._windows: list = []
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._threads: list = []
+        self._t0: Optional[float] = None
+
+    # -- the router-side wire-fault hook -------------------------------- #
+
+    def fault_hook(self, name: str) -> Optional[dict]:
+        """Consulted by ``FleetRouter._submit_once`` before each wire
+        attempt: an active partition window drops the attempt, a slow
+        window delays it. Health polls are deliberately unaffected —
+        these faults are *gray*."""
+        now = time.monotonic()
+        with self._lock:
+            for w in self._windows:
+                if w["name"] != name or now < w["t0"]:
+                    continue
+                if w["t1"] is not None and now >= w["t1"]:
+                    continue
+                if w["kind"] == "partition":
+                    return {"drop": True}
+                return {"delay_s": w["delay_s"]}
+        return None
+
+    # -- lifecycle ------------------------------------------------------ #
+
+    def start(self) -> "ChaosEngine":
+        if self._t0 is not None:
+            raise RuntimeError("chaos engine already started")
+        self._t0 = time.monotonic()
+        if self.router is not None:
+            self.router.fault_hook = self.fault_hook
+        t = threading.Thread(target=self._run, daemon=True,
+                             name="chaos-engine")
+        t.start()
+        self._threads.append(t)
+        return self
+
+    def close(self, join_timeout_s: float = 10.0) -> None:
+        """Stop firing and restore every transient fault: leftover
+        wedges get SIGCONT (a stopped replica must never outlive the
+        drill — the harness teardown contract), windows are cleared,
+        and the router hook is removed."""
+        self._stop.set()
+        for rep in list(self.manager._replicas.values()):
+            if getattr(rep, "wedged", False):
+                try:
+                    self.manager.unwedge(rep.name)
+                except Exception as e:  # noqa: BLE001 — best-effort
+                    obs_log.warn("chaos", "unwedge failed on close",
+                                 name=rep.name, error=str(e))
+        with self._lock:
+            self._windows.clear()
+        if self.router is not None and self.router.fault_hook == \
+                self.fault_hook:
+            self.router.fault_hook = None
+        for t in self._threads:
+            t.join(join_timeout_s)
+        self._threads.clear()
+
+    def __enter__(self) -> "ChaosEngine":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    def describe(self) -> dict:
+        return {
+            "schedule": self.schedule.normalized,
+            "seed": self.schedule.seed,
+            "events": list(self.events),
+        }
+
+    # -- firing --------------------------------------------------------- #
+
+    def _run(self) -> None:
+        for item in self.schedule.timeline(self.duration_s):
+            delay = self._t0 + item["t_s"] - time.monotonic()
+            if delay > 0 and self._stop.wait(delay):
+                return
+            if self._stop.is_set():
+                return
+            try:
+                self._fire(item)
+            except Exception as e:  # noqa: BLE001 — drill must survive
+                obs_log.warn("chaos", "action failed",
+                             kind=item["kind"], error=f"{type(e).__name__}: {e}")
+
+    def _fire(self, item: dict) -> None:
+        action = self.schedule.actions[item["idx"]]
+        live = [r.name for r in self.manager.replicas(role="serve")]
+        victim = self.schedule.resolve(item["idx"], action, live)
+        event = {
+            "t_s": round(time.monotonic() - self._t0, 3),
+            "planned_t_s": item["t_s"], "frac": action.frac,
+            "kind": action.kind, "target": victim,
+        }
+        if victim is None:
+            event["skipped"] = "no live serve replica"
+            obs_log.warn("chaos", "action skipped: empty pool",
+                         kind=action.kind)
+        else:
+            handler = getattr(self, f"_do_{action.kind}")
+            handler(action, victim, event)
+            obs_log.warn("chaos", "action fired", kind=action.kind,
+                         target=victim, t_s=event["t_s"])
+        obs_trace.event("chaos_action", kind=action.kind,
+                        target=victim or "", frac=action.frac)
+        with self._lock:
+            self.events.append(event)
+
+    def _do_kill(self, action: ChaosAction, victim: str,
+                 event: dict) -> None:
+        self.manager.kill(victim)
+        if self.heal_kills:
+            t = threading.Thread(target=self._heal, args=(victim,),
+                                 daemon=True, name=f"chaos-heal-{victim}")
+            t.start()
+            self._threads.append(t)
+
+    def _heal(self, victim: str) -> None:
+        # Deliberately NOT gated on self._stop: the heal is part of the
+        # drill contract (a killed replica respawns warm) and must
+        # complete even when close() lands mid-wait — close() joins
+        # this thread instead of aborting it. SIGKILL delivery is
+        # asynchronous: wait for the corpse before reaping, or
+        # respawn_dead() finds nothing dead and the slot never heals.
+        rep = self.manager.get(victim)
+        deadline = time.monotonic() + 30.0
+        while (rep is not None and rep.alive
+               and time.monotonic() < deadline):
+            time.sleep(0.02)
+        self.manager.respawn_dead()
+        self.manager.wait_ready(self.ready_timeout_s, names=[victim])
+
+    def _do_wedge(self, action: ChaosAction, victim: str,
+                  event: dict) -> None:
+        self.manager.wedge(victim)
+        event["duration_s"] = action.duration_s
+
+        def _unwedge():
+            if not self._stop.wait(action.duration_s):
+                try:
+                    self.manager.unwedge(victim)
+                except Exception as e:  # noqa: BLE001
+                    obs_log.warn("chaos", "unwedge failed",
+                                 name=victim, error=str(e))
+
+        t = threading.Thread(target=_unwedge, daemon=True,
+                             name=f"chaos-unwedge-{victim}")
+        t.start()
+        self._threads.append(t)
+
+    def _window(self, kind: str, victim: str, action: ChaosAction,
+                event: dict) -> None:
+        now = time.monotonic()
+        w = {
+            "kind": kind, "name": victim, "t0": now,
+            "t1": (now + action.duration_s
+                   if action.duration_s is not None else None),
+            "delay_s": action.param,
+        }
+        with self._lock:
+            self._windows.append(w)
+        event["duration_s"] = action.duration_s
+
+    def _do_partition(self, action: ChaosAction, victim: str,
+                      event: dict) -> None:
+        self._window("partition", victim, action, event)
+
+    def _do_slow(self, action: ChaosAction, victim: str,
+                 event: dict) -> None:
+        self._window("slow", victim, action, event)
+        event["delay_s"] = action.param
+
+    def _do_corrupt(self, action: ChaosAction, victim: str,
+                    event: dict) -> None:
+        """Arm the victim's in-process fault plan over its admin
+        surface: NaN-poison a fraction of ``output:serveBatch`` leaves
+        with guards forced to *repair* mode — the repaired output is
+        finite, plausible, and WRONG, which is exactly the byzantine
+        reply only cross-replica audit can catch (raise-mode guards
+        would degrade to the serial rung and recompute correctly,
+        hiding the fault)."""
+        from distributed_sddmm_tpu.obs.httpexp import post_json
+
+        rep = self.manager.get(victim)
+        if rep is None or not rep.alive:
+            event["skipped"] = "victim died before arming"
+            return
+        spec = {
+            "seed": self.schedule.seed,
+            "specs": [{
+                "site": "output:serveBatch", "kind": "nan",
+                "prob": 1.0, "param": action.param,
+            }],
+        }
+        code, body, _ = post_json(
+            "127.0.0.1", rep.port, "/chaos",
+            {"faults": spec, "guard_mode": "repair"}, timeout_s=5.0,
+        )
+        event["armed"] = (code == 200)
+        if code != 200:
+            obs_log.warn("chaos", "corrupt arming failed", name=victim,
+                         status=code, body=str(body)[:200])

@@ -61,6 +61,9 @@ KNOBS: dict[str, Knob] = {k.name: k for k in [
        "blocked-kernel column tile size"),
     _K("DSDDMM_BLOCK_ROWS", "int", "512",
        "blocked-kernel row tile size"),
+    _K("DSDDMM_CHAOS", "spec", "off",
+       "`bench fleet` chaos schedule when --chaos is unset: "
+       "kind[:target]@frac[/dur][:param];... (resilience/chaos.py)"),
     _K("DSDDMM_CHECKPOINT_DIR", "path", "artifacts/checkpoints",
        "checkpoint store root (resilience/checkpoint.py)"),
     _K("DSDDMM_CHUNK", "int", "128",
@@ -92,12 +95,24 @@ KNOBS: dict[str, Knob] = {k.name: k for k in [
     _K("DSDDMM_FAULTS", "spec", "off",
        "fault-injection plan: JSON spec list, @plan.json, or comma "
        "shorthand (nan,delay,...)"),
+    _K("DSDDMM_FLEET_AUDIT_FRAC", "float", "0 (off)",
+       "front router: fraction of requests re-executed on a second "
+       "replica and compared bit-for-bit before delivery"),
+    _K("DSDDMM_FLEET_BREAKER_COOLDOWN", "float", "2.0",
+       "front router: seconds an open circuit breaker waits before "
+       "admitting a half-open probe"),
+    _K("DSDDMM_FLEET_BREAKER_ERRS", "int", "3",
+       "front router: consecutive strikes (submit/poll/decode "
+       "failures) that trip a replica's circuit breaker open"),
     _K("DSDDMM_FLEET_COOLDOWN", "float", "5",
        "fleet autoscaler: seconds between scaling actions "
        "(fleet/scaler.py)"),
     _K("DSDDMM_FLEET_DRAIN_BURN", "float", "1.0",
        "front router: SLO burn rate above which a replica stops "
        "receiving admissions until it recovers (fleet/router.py)"),
+    _K("DSDDMM_FLEET_HEDGE", "spec", "off",
+       "front router hedged requests: off, on (p95-derived delay), or "
+       "a float hedge-delay floor in seconds"),
     _K("DSDDMM_FLEET_HIGH_BURN", "float", "1.0",
        "fleet autoscaler: replica burn rate counting as sustained "
        "pressure (spawn trigger)"),

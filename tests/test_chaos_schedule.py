@@ -1,0 +1,169 @@
+"""Chaos-schedule grammar, determinism, and fault-hook window tests
+(``resilience/chaos.py``) — pure parsing/timeline logic, no processes.
+"""
+
+import pytest
+
+from distributed_sddmm_tpu.resilience.chaos import (
+    ChaosAction, ChaosSchedule,
+)
+
+
+class TestGrammar:
+    def test_full_grammar_round_trip(self):
+        spec = ("kill@0.5;wedge:r1@0.3/0.2s;partition:r0@0.6;"
+                "slow:r2@0.4:80ms;corrupt:r1@0.7")
+        s = ChaosSchedule.parse(spec, seed=3)
+        kinds = [a.kind for a in s.actions]
+        # Actions sort by fire fraction.
+        assert kinds == ["wedge", "slow", "kill", "partition", "corrupt"]
+        wedge = s.actions[0]
+        assert wedge.target == "r1" and wedge.duration_s == pytest.approx(0.2)
+        slow = s.actions[1]
+        assert slow.param == pytest.approx(0.08)  # 80ms
+        corrupt = s.actions[4]
+        assert corrupt.param == pytest.approx(0.05)  # default frac
+
+    def test_normalization_idempotent(self):
+        spec = "corrupt@0.9:0.10;wedge@0.1/500ms;kill@0.50"
+        s = ChaosSchedule.parse(spec, seed=0)
+        again = ChaosSchedule.parse(s.normalized, seed=0)
+        assert again.normalized == s.normalized
+        assert again.actions == s.actions
+
+    def test_time_units(self):
+        s = ChaosSchedule.parse("wedge@0.5/80ms;slow@0.6:1.5s;slow@0.7:2")
+        assert s.actions[0].duration_s == pytest.approx(0.08)
+        assert s.actions[1].param == pytest.approx(1.5)
+        assert s.actions[2].param == pytest.approx(2.0)
+
+    def test_defaults(self):
+        s = ChaosSchedule.parse("wedge@0.5;slow@0.6;corrupt@0.7")
+        assert s.actions[0].duration_s == pytest.approx(1.0)
+        assert s.actions[1].param == pytest.approx(0.05)
+        assert s.actions[2].param == pytest.approx(0.05)
+
+    def test_sugar(self):
+        assert ChaosSchedule.parse("kill-replica").normalized == "kill@0.5"
+        assert not ChaosSchedule.parse("none")
+        assert not ChaosSchedule.parse("off")
+        assert not ChaosSchedule.parse("")
+        assert not ChaosSchedule.parse(None)
+
+    @pytest.mark.parametrize("bad", [
+        "explode@0.5",          # unknown kind
+        "kill@1.5",             # frac out of range
+        "kill@0.5/2s",          # kill takes no duration
+        "corrupt@0.5/2s",       # corrupt takes no duration
+        "kill@0.5:3",           # kill takes no param
+        "wedge@0.5:3",          # wedge takes no param
+        "partition@0.5:3",      # partition takes no param
+        "corrupt@0.5:1.5",      # element fraction outside (0, 1]
+        "corrupt@0.5:0",        # element fraction outside (0, 1]
+        "wedge@",               # no fraction
+        "@0.5",                 # no kind
+        "kill 0.5",             # not the grammar at all
+    ])
+    def test_bad_specs_raise(self, bad):
+        with pytest.raises(ValueError):
+            ChaosSchedule.parse(bad)
+
+    def test_render_canonical_times(self):
+        a = ChaosAction(kind="wedge", frac=0.25, duration_s=0.2)
+        assert a.render() == "wedge@0.25/200ms"
+        b = ChaosAction(kind="slow", frac=0.4, target="r2", param=0.08)
+        assert b.render() == "slow:r2@0.4:80ms"
+
+
+class TestDeterminism:
+    def test_timeline_is_pure(self):
+        s = ChaosSchedule.parse("wedge@0.25/1s;kill@0.75", seed=11)
+        t1 = s.timeline(8.0)
+        t2 = ChaosSchedule.parse(s.normalized, seed=11).timeline(8.0)
+        assert t1 == t2
+        assert [row["t_s"] for row in t1] == [2.0, 6.0]
+
+    def test_resolve_explicit_target_wins_when_live(self):
+        s = ChaosSchedule.parse("kill:r1@0.5", seed=0)
+        assert s.resolve(0, s.actions[0], ["r0", "r1", "r2"]) == "r1"
+
+    def test_resolve_seeded_pick_is_deterministic(self):
+        s = ChaosSchedule.parse("kill@0.5", seed=7)
+        names = ["r2", "r0", "r1"]
+        picks = {s.resolve(0, s.actions[0], list(names)) for _ in range(8)}
+        assert len(picks) == 1
+        # Pool order must not matter: the pick is over the sorted pool.
+        assert s.resolve(0, s.actions[0], sorted(names)) in picks
+
+    def test_resolve_differs_by_seed_or_index(self):
+        a = ChaosSchedule.parse("kill@0.2;kill@0.8", seed=0)
+        names = [f"r{i}" for i in range(16)]
+        picks = {
+            (seed, idx): ChaosSchedule.parse("kill@0.2;kill@0.8",
+                                             seed=seed)
+            .resolve(idx, a.actions[idx], names)
+            for seed in range(4) for idx in range(2)
+        }
+        # Not a constant function of the pool alone.
+        assert len(set(picks.values())) > 1
+
+    def test_resolve_empty_pool(self):
+        s = ChaosSchedule.parse("kill@0.5", seed=0)
+        assert s.resolve(0, s.actions[0], []) is None
+
+    def test_resolve_dead_named_target_falls_back(self):
+        s = ChaosSchedule.parse("kill:r9@0.5", seed=0)
+        assert s.resolve(0, s.actions[0], ["r0", "r1"]) in ("r0", "r1")
+
+
+class TestFaultHookWindows:
+    """The router-side wire-fault hook, driven without real processes:
+    a minimal manager stand-in is enough because the windows live
+    entirely inside the engine."""
+
+    class _StubManager:
+        _replicas: dict = {}
+
+        def replicas(self, role=None):
+            return []
+
+    def _engine(self, spec, duration=10.0):
+        from distributed_sddmm_tpu.resilience.chaos import ChaosEngine
+
+        return ChaosEngine(ChaosSchedule.parse(spec),
+                           self._StubManager(), duration_s=duration)
+
+    def test_partition_window_drops(self):
+        eng = self._engine("partition:r1@0.0/5s")
+        action = eng.schedule.actions[0]
+        event = {}
+        eng._do_partition(action, "r1", event)
+        assert eng.fault_hook("r1") == {"drop": True}
+        assert eng.fault_hook("r0") is None
+
+    def test_slow_window_delays(self):
+        eng = self._engine("slow:r1@0.0:80ms")
+        eng._do_slow(eng.schedule.actions[0], "r1", {})
+        act = eng.fault_hook("r1")
+        assert act == {"delay_s": pytest.approx(0.08)}
+
+    def test_expired_window_is_inert(self):
+        eng = self._engine("partition:r1@0.0/5s")
+        eng._do_partition(eng.schedule.actions[0], "r1", {})
+        with eng._lock:
+            eng._windows[0]["t1"] = eng._windows[0]["t0"]  # expire now
+        assert eng.fault_hook("r1") is None
+
+    def test_close_clears_windows_and_hook(self):
+        class _Router:
+            fault_hook = None
+
+        eng = self._engine("partition:r1@0.0")
+        router = _Router()
+        eng.router = router
+        eng.start()
+        eng._do_partition(eng.schedule.actions[0], "r1", {})
+        assert router.fault_hook is not None
+        eng.close()
+        assert router.fault_hook is None
+        assert eng.fault_hook("r1") is None

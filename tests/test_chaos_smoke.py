@@ -1,0 +1,73 @@
+"""Tier-1 smoke for gray-failure hardening (ISSUE 17 acceptance).
+
+Runs ``scripts/chaos_smoke.py`` as a subprocess — ``bench fleet`` under
+a seeded four-fault chaos schedule (wedge, partition, corrupt, kill):
+every gray fault must be detected within the deadline (breaker open for
+wedge/partition, byzantine quarantine for corrupt), every delivered
+reply must stay bit-identical to the single-engine oracle even while a
+replica answers plausible wrong bytes, the kill must heal warm, and the
+recorded chaos events must replay the locally re-derived seeded
+timeline. Exit contract 0 (all green) / 2 (any check red).
+"""
+
+import json
+import pathlib
+import subprocess
+import sys
+
+REPO = pathlib.Path(__file__).resolve().parents[1]
+SCRIPT = REPO / "scripts" / "chaos_smoke.py"
+
+
+def test_chaos_smoke_script(tmp_path):
+    out = tmp_path / "chaos_smoke.json"
+    proc = subprocess.run(
+        [sys.executable, str(SCRIPT), "-o", str(out)],
+        capture_output=True, text=True, timeout=600, cwd=REPO,
+        env={
+            "PATH": "/usr/bin:/bin",
+            "HOME": "/tmp",
+            "JAX_PLATFORMS": "cpu",
+            "DSDDMM_RUNSTORE": "0",
+            "DSDDMM_PROGRAMS": "0",
+        },
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    report = json.loads(out.read_text())
+    assert report["ok"] is True
+    (drill,) = report["checks"]
+
+    assert drill["exit_code"] == 0
+    # The detector fired AND the client never saw the corruption: wrong
+    # bytes were caught by the pre-delivery audit, arbitrated away, and
+    # the liar quarantined.
+    assert drill["mismatches"] == 0
+    assert drill["audit_mismatches"] > 0
+    assert drill["quarantines"] >= 1
+    assert drill["lost"] == 0
+    # Every injected gray fault detected within the deadline.
+    assert drill["detection_ok"] is True
+    assert {d["kind"] for d in drill["detection"]} == {
+        "wedge", "partition", "corrupt"}
+    assert all(d["detected"] for d in drill["detection"])
+    assert drill["breaker_opens"] >= 2  # wedge + partition victims
+    # The crash fault healed warm, availability held.
+    assert drill["killed"]
+    assert drill["replacement_live_compiles"] == 0
+    assert drill["availability"] >= 0.9
+    # Same seed, same timeline: the run replayed the local derivation.
+    assert drill["timeline_ok"] is True
+    # The zero-tolerance gate axis is derived from the record.
+    assert "fleet:audit_mismatch" in drill["gate_axes"]
+
+
+def test_exit_code_contract():
+    """The 0/2 contract without a second subprocess run."""
+    sys.path.insert(0, str(REPO / "scripts"))
+    try:
+        import chaos_smoke
+    finally:
+        sys.path.pop(0)
+    assert chaos_smoke.exit_code({"ok": True}) == 0
+    assert chaos_smoke.exit_code({"ok": False}) == 2
+    assert chaos_smoke.exit_code({}) == 2

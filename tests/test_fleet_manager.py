@@ -91,6 +91,78 @@ class TestLifecycle:
         assert manager.replicas() == []
 
 
+class TestGrayFailure:
+    def test_quarantine_lifecycle(self, manager):
+        manager.spawn()
+        manager.spawn()
+        assert manager.wait_ready(READY_S)
+        replacement = manager.quarantine(
+            "r0", reason="byzantine reply mismatch (audit)",
+            evidence={"disagreed_with": ["r1"]},
+        )
+        # Drained out of routing, alive for autopsy.
+        rep = manager.get("r0")
+        assert rep.quarantined is True and rep.alive is True
+        assert [r.name for r in manager.replicas()] == ["r1", "r2"]
+        assert "r0" in [r.name for r in
+                        manager.replicas(include_quarantined=True)]
+        # Ledger + warm replacement under a FRESH name (the
+        # quarantined slot still exists for the autopsy).
+        assert replacement is not None and replacement.name == "r2"
+        assert manager.quarantines == 1
+        (entry,) = manager.quarantine_log
+        assert entry["name"] == "r0"
+        assert "byzantine" in entry["reason"]
+        assert manager.wait_ready(READY_S, names=["r2"])
+        # Teardown still collects the quarantined replica's record.
+        records = manager.stop_all(timeout_s=10.0)
+        assert "r0" in {r["name"] for r in records}
+        assert manager.get("r0").rc == 0
+
+    def test_quarantine_is_idempotent(self, manager):
+        manager.spawn()
+        manager.spawn()
+        assert manager.wait_ready(READY_S)
+        assert manager.quarantine("r0", respawn=False) is None
+        assert manager.quarantine("r0") is None  # already quarantined
+        assert manager.quarantines == 1
+        assert manager.spawns == 2  # respawn=False spawned nothing
+
+    def test_wedged_replica_freezes_and_teardown_reaps(self, manager):
+        """Satellite 6: SIGSTOP freezes the admin surface, and
+        ``stop_all`` SIGCONTs before SIGTERM so a wedged replica still
+        drains promptly with a record instead of leaking a stopped
+        process or losing the drain to the kill timeout."""
+        from distributed_sddmm_tpu.obs.httpexp import fetch_json
+
+        manager.spawn()
+        manager.spawn()
+        assert manager.wait_ready(READY_S)
+        port = manager.get("r0").port
+        manager.wedge("r0")
+        assert manager.get("r0").wedged is True
+        with pytest.raises(OSError):
+            fetch_json("127.0.0.1", port, "/readyz", timeout_s=1.0)
+        t0 = time.monotonic()
+        records = manager.stop_all(timeout_s=10.0)
+        assert time.monotonic() - t0 < 8.0  # no drain-timeout kill
+        assert {r["name"] for r in records} == {"r0", "r1"}
+        rep = manager.get("r0")
+        assert rep.alive is False and rep.rc == 0 and not rep.wedged
+
+    def test_unwedge_restores_the_admin_surface(self, manager):
+        from distributed_sddmm_tpu.obs.httpexp import fetch_json
+
+        manager.spawn()
+        assert manager.wait_ready(READY_S)
+        port = manager.get("r0").port
+        manager.wedge("r0")
+        manager.unwedge("r0")
+        assert manager.get("r0").wedged is False
+        body = fetch_json("127.0.0.1", port, "/readyz", timeout_s=5.0)
+        assert body.get("ready") is True
+
+
 class TestTunerDiscipline:
     def test_exactly_one_canary(self):
         mgr = FleetManager(_argv, tuner_canary=True)

@@ -2,10 +2,17 @@
 
 Each stub replica is a real :class:`AdminServer` in exporter mode
 (``snapshot_fn`` + ``submit_fn``) — the router talks to it over actual
-HTTP, so readiness probing, 429 + Retry-After propagation, and
-connection-failure failover are exercised on the real wire path without
-a jax engine anywhere.
+HTTP, so readiness probing, 429 + Retry-After propagation,
+connection-failure failover, circuit breakers, hedging, and the
+cross-replica audit are exercised on the real wire path without a jax
+engine anywhere. :class:`GarbageReplica` is a raw HTTP server speaking
+deliberately broken reply bodies — the decode-failure failover path.
 """
+
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 import pytest
 
@@ -18,7 +25,8 @@ class StubReplica:
     """Scriptable replica: snapshot fields + submit behavior."""
 
     def __init__(self, name, *, depth_frac=0.0, burn=0.0,
-                 inner_buckets=(4, 8), shed_after=None, reply=None):
+                 inner_buckets=(4, 8), shed_after=None, reply=None,
+                 delay_s=0.0):
         self.name = name
         self.depth_frac = depth_frac
         self.burn = burn
@@ -26,6 +34,7 @@ class StubReplica:
         #: None = always answer; a float = shed with this retry hint.
         self.shed_retry = shed_after
         self.reply = reply if reply is not None else {"by": name}
+        self.delay_s = delay_s
         self.submits = []
         self.server = AdminServer(
             snapshot_fn=self._snapshot, submit_fn=self._submit,
@@ -47,12 +56,79 @@ class StubReplica:
         self.submits.append(
             {"payload": payload, "tenant": tenant, "serial": serial}
         )
+        if self.delay_s:
+            time.sleep(self.delay_s)
         if self.shed_retry is not None:
             raise ShedError("stub full", retry_after_s=self.shed_retry)
         return dict(self.reply, serial=serial)
 
     def stop(self):
         self.server.stop()
+
+
+class GarbageReplica:
+    """A replica whose health surface is immaculate and whose submit
+    replies are broken: 200 + non-JSON bytes (``mode="garbage"``), 200
+    + JSON with no ``reply`` key (``mode="noreply"``), or — after
+    flipping ``mode = "ok"`` — well-formed replies. The gray-failure
+    case the bare ``except OSError`` failover used to leak as a 500."""
+
+    def __init__(self, name, mode="garbage"):
+        self.name = name
+        self.mode = mode
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):  # noqa: ARG002 — quiet
+                pass
+
+            def _send(self, raw, ctype="application/json"):
+                self.send_response(200)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(raw)))
+                self.end_headers()
+                self.wfile.write(raw)
+
+            def do_GET(self):
+                if self.path.startswith("/readyz"):
+                    self._send(json.dumps({"ready": True}).encode())
+                else:
+                    self._send(json.dumps({
+                        "depth_frac": 0.0, "burn_rate": 0.0,
+                        "buckets": {"inner": [4, 8]},
+                    }).encode())
+
+            def do_POST(self):
+                n = int(self.headers.get("Content-Length") or 0)
+                self.rfile.read(n)
+                if outer.mode == "ok":
+                    self._send(json.dumps(
+                        {"reply": {"by": outer.name}}).encode())
+                elif outer.mode == "noreply":
+                    self._send(json.dumps({"status": "fine"}).encode())
+                else:
+                    self._send(b"<<< not json at all >>>")
+
+        self.httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        threading.Thread(target=self.httpd.serve_forever,
+                         daemon=True).start()
+
+    @property
+    def port(self):
+        return self.httpd.server_address[1]
+
+    def stop(self):
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+
+def _wait_for(cond, timeout_s=5.0):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(0.01)
+    return False
 
 
 @pytest.fixture
@@ -196,3 +272,249 @@ class TestRouterSurface:
         assert st["name"] == "a" and st["ready"] is True
         assert st["depth_frac"] == pytest.approx(0.3)
         assert topo["stats"]["routed"] == 0
+        # PR-17 gray-failure surface is part of the snapshot contract.
+        assert topo["breaker"]["errs"] == router.breaker_errs
+        assert "audit_frac" in topo and "breaker_events" in topo
+        assert st["breaker"] == "closed"
+
+
+class TestCircuitBreaker:
+    def test_poll_strikes_open_breaker(self, pool):
+        """A wedged/dead admin surface opens the breaker from the poll
+        path alone — no request has to eat a timeout first."""
+        a = pool("a", depth_frac=0.0)
+        b = pool("b", depth_frac=0.9)
+        router = _router(a, b, breaker_errs=3, breaker_cooldown_s=60.0)
+        a.stop()
+        for _ in range(3):
+            router.poll_once()
+        st = {s.name: s for s in router.states()}
+        assert st["a"].breaker == "open"
+        assert router.stats["breaker_opens"] == 1
+        opens = [e for e in router.breaker_events if e["state"] == "open"]
+        assert opens and opens[0]["name"] == "a"
+        assert opens[0]["where"] == "poll"
+        assert router.route({"q": [1]})["by"] == "b"
+
+    def test_submit_strike_opens_at_threshold(self, pool):
+        dead = pool("dead", depth_frac=0.0)
+        live = pool("live", depth_frac=0.9)
+        router = _router(dead, live, breaker_errs=1,
+                         breaker_cooldown_s=60.0)
+        dead.stop()
+        assert router.route({"q": [1]})["by"] == "live"
+        st = {s.name: s for s in router.states()}
+        assert st["dead"].breaker == "open"
+        assert router.stats["breaker_opens"] == 1
+
+    def test_open_breaker_excludes_replica(self, pool):
+        """Once open, the replica stops receiving admissions even
+        though its health surface still answers (the gray case)."""
+        garb = GarbageReplica("garb")
+        good = pool("good", depth_frac=0.9)
+        router = _router(garb, good, breaker_errs=1,
+                         breaker_cooldown_s=60.0)
+        try:
+            assert router.route({"q": [1]})["by"] == "good"
+            assert router.stats["decode_failovers"] == 1
+            # Second request never touches the broken replica: the
+            # breaker, not another failover, keeps it out.
+            assert router.route({"q": [1]})["by"] == "good"
+            assert router.stats["decode_failovers"] == 1
+            assert router.stats["failovers"] == 1
+        finally:
+            garb.stop()
+
+    def test_half_open_closes_only_on_submit_success(self, pool):
+        garb = GarbageReplica("garb")
+        router = _router(garb, breaker_errs=1, breaker_cooldown_s=0.05)
+        try:
+            with pytest.raises(ShedError):
+                router.route({"q": [1]})
+            (st,) = router.states()
+            assert st.breaker == "open"
+            # Health polls during the cooldown succeed (the garbage
+            # replica's /readyz is immaculate) but must NOT close it.
+            router.poll_once()
+            assert st.breaker == "open"
+            time.sleep(0.1)
+            garb.mode = "ok"
+            reply = router.route({"q": [1]})
+            assert reply["by"] == "garb"
+            assert st.breaker == "closed"
+            states = [e["state"] for e in router.breaker_events]
+            assert states == ["open", "half_open", "closed"]
+        finally:
+            garb.stop()
+
+    def test_half_open_strike_reopens_instantly(self, pool):
+        garb = GarbageReplica("garb")
+        router = _router(garb, breaker_errs=1, breaker_cooldown_s=0.05)
+        try:
+            with pytest.raises(ShedError):
+                router.route({"q": [1]})
+            time.sleep(0.1)
+            with pytest.raises(ShedError):
+                router.route({"q": [1]})  # half-open probe still broken
+            (st,) = router.states()
+            assert st.breaker == "open"
+            assert router.stats["breaker_opens"] == 2
+        finally:
+            garb.stop()
+
+
+class TestHedging:
+    def test_hedge_rescues_slow_primary(self, pool):
+        slow = pool("slow", depth_frac=0.0, delay_s=0.4,
+                    reply={"v": 1})
+        fast = pool("fast", depth_frac=0.9, reply={"v": 1})
+        router = _router(slow, fast, hedge_delay_s=0.05)
+        reply = router.route({"q": [1]})
+        assert reply["v"] == 1
+        assert router.stats["hedges"] == 1
+        assert router.stats["hedge_wins"] == 1
+        assert len(fast.submits) == 1
+        # Both eventually land bit-identical: no byzantine signal.
+        assert _wait_for(lambda: len(slow.submits) == 1)
+        time.sleep(0.5)
+        assert router.stats["audit_mismatches"] == 0
+
+    def test_fast_primary_never_hedges(self, pool):
+        a = pool("a", depth_frac=0.0)
+        b = pool("b", depth_frac=0.9)
+        router = _router(a, b, hedge_delay_s=0.2)
+        assert router.route({"q": [1]})["by"] == "a"
+        assert router.stats["hedges"] == 0
+        assert not b.submits
+
+    def test_hedge_mismatch_is_byzantine_signal(self, pool):
+        """Primary and hedge both land with different bytes: the
+        mismatch is arbitrated by a third replica and the liar is
+        quarantined — detection for free from redundant work."""
+        calls = []
+
+        def quarantine_fn(name, reason="", evidence=None):
+            calls.append((name, reason, evidence))
+
+        liar = pool("liar", depth_frac=0.0, delay_s=0.4,
+                    reply={"v": 666})
+        honest = pool("honest", depth_frac=0.5, reply={"v": 1})
+        tie = pool("tie", depth_frac=0.9, reply={"v": 1})
+        router = _router(liar, honest, tie, hedge_delay_s=0.05,
+                         quarantine_fn=quarantine_fn)
+        reply = router.route({"q": [1]})
+        assert reply["v"] == 1  # the hedge (honest) reply won
+        assert _wait_for(lambda: router.stats["quarantines"] == 1)
+        assert router.stats["audit_mismatches"] == 1
+        assert calls and calls[0][0] == "liar"
+        assert "byzantine" in calls[0][1]
+
+
+class TestAudit:
+    def test_agreeing_audit_is_quiet(self, pool):
+        a = pool("a", depth_frac=0.0, reply={"v": 1})
+        b = pool("b", depth_frac=0.9, reply={"v": 1})
+        router = _router(a, b, audit_frac=1.0)
+        reply = router.route({"q": [1]})
+        assert reply["v"] == 1
+        assert router.stats["audits"] == 1
+        assert router.stats["audit_mismatches"] == 0
+        # The comparator is always another process, never the server
+        # that produced the reply.
+        assert len(a.submits) == 1 and len(b.submits) == 1
+
+    def test_mismatch_delivers_majority_and_quarantines_liar(self, pool):
+        calls = []
+
+        def quarantine_fn(name, reason="", evidence=None):
+            calls.append((name, reason, evidence))
+
+        liar = pool("liar", depth_frac=0.0, reply={"v": 666})
+        g1 = pool("g1", depth_frac=0.5, reply={"v": 1})
+        g2 = pool("g2", depth_frac=0.9, reply={"v": 1})
+        router = _router(liar, g1, g2, audit_frac=1.0,
+                         quarantine_fn=quarantine_fn)
+        reply = router.route({"q": [1]})
+        # Under audit the byzantine replica cannot leak wrong bytes:
+        # the client receives the majority reply.
+        assert reply["v"] == 1
+        assert router.stats["audit_mismatches"] == 1
+        assert router.stats["quarantines"] == 1
+        assert calls == [("liar", calls[0][1], calls[0][2])]
+        assert calls[0][0] == "liar"
+        assert set(calls[0][2]["disagreed_with"]) == {"g1", "g2"}
+
+    def test_two_replica_mismatch_has_no_quorum(self, pool):
+        calls = []
+        liar = pool("liar", depth_frac=0.0, reply={"v": 666})
+        good = pool("good", depth_frac=0.9, reply={"v": 1})
+        router = _router(
+            liar, good, audit_frac=1.0,
+            quarantine_fn=lambda n, **kw: calls.append(n),
+        )
+        router.route({"q": [1]})
+        assert router.stats["audit_mismatches"] == 1
+        assert router.stats["quarantines"] == 0
+        assert not calls  # two replicas disagreeing is not a verdict
+
+    def test_serial_tier_is_never_audited(self, pool):
+        a = pool("a", inner_buckets=(4, 8))
+        b = pool("b", inner_buckets=(4, 8), depth_frac=0.9)
+        router = _router(a, b, audit_frac=1.0)
+        router.route({"q": list(range(50))})  # pathological → serial
+        assert router.stats["serial_routed"] == 1
+        assert router.stats["audits"] == 0
+
+    def test_stride_sampling_is_deterministic(self, pool):
+        a = pool("a", depth_frac=0.0, reply={"v": 1})
+        b = pool("b", depth_frac=0.9, reply={"v": 1})
+        router = _router(a, b, audit_frac=0.5)
+        for _ in range(4):
+            router.route({"q": [1]})
+        assert router.stats["audits"] == 2
+
+
+class TestDecodeFailover:
+    """Satellite 1: a 200 whose body is garbage (or JSON missing the
+    ``reply`` key) is a REPLICA failure — the request fails over
+    instead of surfacing a client-facing error."""
+
+    def test_undecodable_body_fails_over(self, pool):
+        garb = GarbageReplica("garb", mode="garbage")
+        good = pool("good", depth_frac=0.9)
+        router = _router(garb, good)
+        try:
+            reply = router.route({"q": [1]})
+            assert reply["by"] == "good"
+            assert router.stats["decode_failovers"] == 1
+            assert router.stats["failovers"] == 1
+        finally:
+            garb.stop()
+
+    def test_missing_reply_key_fails_over(self, pool):
+        garb = GarbageReplica("garb", mode="noreply")
+        good = pool("good", depth_frac=0.9)
+        router = _router(garb, good)
+        try:
+            reply = router.route({"q": [1]})
+            assert reply["by"] == "good"
+            assert router.stats["decode_failovers"] == 1
+        finally:
+            garb.stop()
+
+
+class TestChaosHook:
+    def test_fault_hook_drop_fails_over(self, pool):
+        """An active partition window turns the wire attempt into a
+        local failure — the request is re-admitted elsewhere."""
+        a = pool("a", depth_frac=0.0)
+        b = pool("b", depth_frac=0.9)
+        router = _router(a, b, breaker_errs=3)
+        router.fault_hook = (
+            lambda name: {"drop": True} if name == "a" else None
+        )
+        assert router.route({"q": [1]})["by"] == "b"
+        assert router.stats["failovers"] == 1
+        assert not a.submits  # dropped before the wire
+        st = {s.name: s for s in router.states()}
+        assert st["a"].strikes == 1  # chaos drops strike the breaker

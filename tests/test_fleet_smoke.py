@@ -14,10 +14,19 @@ import pathlib
 import subprocess
 import sys
 
+import pytest
+
 REPO = pathlib.Path(__file__).resolve().parents[1]
 SCRIPT = REPO / "scripts" / "fleet_smoke.py"
 
 
+# Slow-marked to fund the tier-1 budget for the chaos drill
+# (tests/test_chaos_smoke.py), which subsumes this run's contract —
+# kill + warm respawn + oracle bit-identity + availability + gate
+# axes — under a four-fault schedule. The ``kill-replica`` sugar this
+# script passes is pinned at the grammar level by
+# tests/test_chaos_schedule.py, and tenant accounting by test_serve.py.
+@pytest.mark.slow
 def test_fleet_smoke_script(tmp_path):
     out = tmp_path / "fleet_smoke.json"
     proc = subprocess.run(

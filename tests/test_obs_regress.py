@@ -142,6 +142,59 @@ class TestVerdicts:
         assert not rep["missing"] and not rep["new"]
 
 
+def make_fleet_doc(run_id, *, availability=1.0, audit_mismatches=0,
+                   hedges=0, hedge_wins=0):
+    doc = make_doc(run_id)
+    doc["record"]["fleet"] = {
+        "offered": 100, "availability": availability,
+        "audit_mismatches": audit_mismatches,
+        "hedges": hedges, "hedge_wins": hedge_wins,
+    }
+    return doc
+
+
+class TestFleetAxes:
+    """PR-17 gate axes: ``fleet:audit_mismatch`` is ZERO-tolerance
+    (replies are bit-identical by construction — one cross-replica
+    mismatch is a byzantine event, not noise), ``fleet:hedge_win_rate``
+    is a banded optional axis."""
+
+    def test_audit_mismatch_axis_is_hard(self):
+        a = make_fleet_doc("a")
+        b = make_fleet_doc("b", audit_mismatches=1)
+        rep = regress.compare(b, doc_a=a)
+        assert rep["verdict"] == "regression"
+        assert "fleet:audit_mismatch" in rep["regressions"]
+        row = rep["phases"]["fleet:audit_mismatch"]
+        assert row["hard_axis"] is True
+        assert row["attribution"] == "fleet"
+
+    def test_audit_mismatch_regresses_even_without_baseline(self):
+        """No baseline band to hide in: a brand-new axis with a nonzero
+        count still gates."""
+        rep = regress.compare(make_fleet_doc("b", audit_mismatches=2),
+                              doc_a=make_doc("a"))
+        assert "fleet:audit_mismatch" in rep["regressions"]
+
+    def test_zero_mismatches_is_clean(self):
+        rep = regress.compare(make_fleet_doc("b"),
+                              doc_a=make_fleet_doc("a"))
+        assert rep["verdict"] == "ok"
+        assert rep["phases"]["fleet:audit_mismatch"]["verdict"] == "ok"
+
+    def test_hedge_win_rate_is_banded_not_hard(self):
+        """Hedge wins are an operating condition — only a RISING win
+        rate (tail degradation the hedge keeps rescuing) regresses."""
+        a = make_fleet_doc("a", hedges=100, hedge_wins=5)
+        same = make_fleet_doc("b", hedges=100, hedge_wins=5)
+        rep = regress.compare(same, doc_a=a)
+        assert rep["verdict"] == "ok"
+        worse = make_fleet_doc("c", hedges=100, hedge_wins=50)
+        rep = regress.compare(worse, doc_a=a)
+        assert "fleet:hedge_win_rate" in rep["regressions"]
+        assert not rep["phases"]["fleet:hedge_win_rate"].get("hard_axis")
+
+
 class TestGate:
     def _store(self, tmp_path, scales):
         store = RunStore(tmp_path)
