@@ -1,6 +1,6 @@
 """The discipline checkers.
 
-Seven disciplines, nine checker ids (the three lints migrated from
+Eight disciplines, ten checker ids (the three lints migrated from
 ``tests/test_obs_lint.py`` count as one group there):
 
 ====================  ================================================
@@ -31,6 +31,10 @@ id                    invariant
                       ``lax.psum_scatter`` only through the
                       policy-aware ``parallel/loops.py`` wrappers
                       (or tagged ``# raw-collective-ok``)
+``trace-propagation`` every ``post_json`` under ``fleet/`` forwards
+                      trace headers (a ``headers=`` argument) so the
+                      fleet request tree never silently loses a hop
+                      (or tagged ``# no-trace-ctx``)
 ====================  ================================================
 
 Every checker is a pure AST pass (regex only inside comments); the
@@ -680,7 +684,48 @@ class RawCollectiveChecker(Checker):
 
 
 # --------------------------------------------------------------------- #
-# 9. trace-purity
+# 9. trace-propagation
+# --------------------------------------------------------------------- #
+
+
+@register
+class TracePropagationChecker(Checker):
+    id = "trace-propagation"
+    description = ("fleet/ post_json without a headers= argument — the "
+                   "hop drops the X-DSDDMM-Trace context (or tag "
+                   "deliberate context-free calls '# no-trace-ctx')")
+    suppress_tags = ("no-trace-ctx",)
+
+    #: Only the fleet tier routes requests on behalf of a fleet trace
+    #: context; obs/ and bench CLI probes (health polls, the load
+    #: generator's client) mint or carry their own.
+    SCOPES = ("fleet/",)
+    POSTERS = ("post_json",)
+
+    def select(self, src):
+        return in_pkg(src) and pkg_rel(src).startswith(self.SCOPES)
+
+    def check(self, src, ctx):
+        for node in ast.walk(src.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = call_name(node)
+            if name is None or name.split(".")[-1] not in self.POSTERS:
+                continue
+            if any(kw.arg == "headers" for kw in node.keywords):
+                continue
+            yield self.finding(
+                src, node,
+                "post_json under fleet/ without headers= — the request "
+                "leaves the process with no X-DSDDMM-Trace context, so "
+                "the replica's spans can never re-join the fleet "
+                "request tree; pass encode_fleet_ctx(...) headers or "
+                "tag a deliberate context-free call '# no-trace-ctx'",
+            )
+
+
+# --------------------------------------------------------------------- #
+# 10. trace-purity
 # --------------------------------------------------------------------- #
 
 

@@ -57,6 +57,8 @@ TAG_VOCABULARY = {
     "raw-collective-ok": "deliberate raw lax collective outside the "
                          "parallel/loops.py policy-aware wrappers "
                          "(raw-collective)",
+    "no-trace-ctx": "deliberate fleet/ post_json without trace "
+                    "headers (trace-propagation)",
 }
 
 _TAG_RES = {

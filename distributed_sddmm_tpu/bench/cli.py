@@ -1306,14 +1306,15 @@ def _dispatch_serve(args) -> int:
         submit_fn = None
         if serve_http:
             def submit_fn(payload, tenant="default", serial=False,
-                          timeout_s=30.0):
+                          timeout_s=30.0, trace_ctx=None):
                 # Wire decode is the workload's own clamp (np.asarray
                 # normalizes the JSON lists back to the exact dtypes),
                 # so an HTTP-submitted payload takes the IDENTICAL
                 # path an in-process one does — bit-identical replies.
                 if serial:
                     return eng.workload.serial(eng.workload.clamp(payload))
-                req = eng.submit(payload, tenant=tenant)
+                req = eng.submit(payload, tenant=tenant,
+                                 trace_ctx=trace_ctx)
                 # Reply accounting is the CLIENT's job (run_load does it
                 # in-process); over HTTP that client is this boundary —
                 # without it a replica's drained record reads 0 completed
@@ -1608,6 +1609,7 @@ def _dispatch_fleet(args) -> int:
     from distributed_sddmm_tpu.fleet import (
         FleetManager, FleetRouter, ScalerConfig,
     )
+    from distributed_sddmm_tpu.obs import trace as obs_trace
     from distributed_sddmm_tpu.obs.httpexp import _json_default, post_json
     from distributed_sddmm_tpu.obs.telemetry import LatencyHistogram
     from distributed_sddmm_tpu.resilience.chaos import ChaosEngine, ChaosSchedule
@@ -1615,6 +1617,22 @@ def _dispatch_fleet(args) -> int:
         SLOSpec, build_als_engine, build_gat_engine, parse_tenants,
     )
     from distributed_sddmm_tpu.serve.slo import attach_tenant_slo
+
+    # Fleet-wide tracing (PR 19): the global --trace already armed the
+    # tracer in main(); DSDDMM_FLEET_TRACE arms it for fleet runs
+    # specifically (1/on, or an explicit trace path). Either way the
+    # tracer exports DSDDMM_TRACE to the replicas spawned below, so
+    # every replica writes its own shard — harvested by the manager at
+    # reap/quarantine time and merged into one causal tree after the
+    # load window.
+    fleet_trace_spec = (os.environ.get("DSDDMM_FLEET_TRACE") or "").strip()
+    if (fleet_trace_spec.lower() not in ("", "0", "off", "false", "no")
+            and not obs_trace.enabled()):
+        _tr = obs_trace.enable(
+            None if fleet_trace_spec.lower() in ("1", "on", "true", "yes")
+            else fleet_trace_spec
+        )
+        print(f"[fleet] tracing -> {_tr.path}", file=sys.stderr)
 
     n_replicas = (
         args.replicas if args.replicas is not None
@@ -1757,6 +1775,18 @@ def _dispatch_fleet(args) -> int:
         router = FleetRouter(manager, **router_kw).start()
         print(f"[fleet] router at http://127.0.0.1:{router.port}",
               file=sys.stderr)
+        from distributed_sddmm_tpu.obs import flightrec as obs_flightrec
+
+        _fr = obs_flightrec.active()
+        if _fr is not None:
+            # The router as a flight-recorder source: an anomaly dump
+            # then carries the fleet topology (breaker states, depths,
+            # quarantines) and routing counters of the moment it fired,
+            # the same way serve dumps the engine snapshot.
+            _fr.register_source("fleet", lambda: {
+                "topology": router.topology(),
+                "stats": dict(router.stats),
+            })
         if schedule:
             chaos_engine = ChaosEngine(
                 schedule, manager, router, duration_s=args.duration,
@@ -1840,6 +1870,53 @@ def _dispatch_fleet(args) -> int:
         if router is not None:
             router.stop()
         manager.stop_all()
+
+    # -- fleet trace collection + chain reconstruction ------------------ #
+    # The router's own shard plus every replica shard the manager
+    # harvested merge into one causally-connected trace; the chain
+    # reconstruction over it is the run's trace-coverage verdict
+    # (`fleet:trace_coverage` hard gate axis: every DELIVERED reply
+    # must reconstruct a complete router→attempt→replica chain, the
+    # winning attempt's span agreeing with the router's recorded
+    # latency within 1 ms).
+    trace_info = None
+    if obs_trace.enabled():
+        from distributed_sddmm_tpu.obs import tracemerge
+        from distributed_sddmm_tpu.tools import tracereport
+
+        try:
+            shard_paths = list(dict.fromkeys(
+                [str(obs_trace.trace_path())]
+                + [str(s["path"]) for s in manager.trace_shards]
+            ))
+            # strict=False: a SIGKILLed replica can tear its final
+            # shard line mid-write; the merged output is re-serialised
+            # from the records that DID validate, so it stays
+            # schema-valid for `report-trace`.
+            merged_path, merged = tracemerge.write_merged(
+                shard_paths, strict=False
+            )
+            chains = tracereport.fleet_request_chains(merged)
+            trace_info = {
+                "coverage": chains["coverage"],
+                "requests": len(chains["requests"]),
+                "delivered": chains["delivered"],
+                "complete": chains["complete"],
+                "failed": chains["failed"],
+                "hedged": chains["hedged"],
+                "audited": chains["audited"],
+                "shards": len(merged["begin"].get("shards") or ()),
+                "fleet_links": merged["begin"].get("fleet_links", 0),
+                "merged_path": str(merged_path),
+            }
+            print(f"[fleet] merged trace {merged_path} "
+                  f"({trace_info['shards']} shards, "
+                  f"{trace_info['fleet_links']} cross-process links, "
+                  f"coverage {trace_info['coverage']:.3f})",
+                  file=sys.stderr)
+        except Exception as e:  # noqa: BLE001 — tracing never fails the run
+            print(f"[fleet] trace merge failed: {e}", file=sys.stderr)
+            trace_info = {"error": f"{type(e).__name__}: {e}"}
 
     # -- judgment ------------------------------------------------------- #
     counts = {"ok": 0, "shed": 0, "deferred": 0, "error": 0, "lost": 0}
@@ -2037,6 +2114,11 @@ def _dispatch_fleet(args) -> int:
     }
     if plan is not None:
         record["plan"] = plan.to_dict()
+    if trace_info is not None:
+        record["fleet"]["trace"] = trace_info
+    if obs_trace.enabled():
+        record["run_id"] = obs_trace.run_id()
+        record["trace_path"] = obs_trace.trace_path()
 
     print(json.dumps({
         "app": record["app"],
@@ -2057,6 +2139,7 @@ def _dispatch_fleet(args) -> int:
         "hedges": router_stats.get("hedges", 0),
         "detection_ok": detection_ok,
         "burn_rate": record["burn_rate"],
+        "trace_coverage": (trace_info or {}).get("coverage"),
         "router": router_stats,
     }))
     if args.output_file:

@@ -136,6 +136,34 @@ class FleetManager:
         #: the chaos drill's detection-deadline judge reads this.
         self.quarantine_log: list[dict] = []
         self._quarantine_lock = threading.Lock()
+        #: Trace shards harvested from replicas (reap/quarantine time):
+        #: ``{"name", "generation", "pid", "path", "at"}`` rows, dedup'd
+        #: by path. ``bench fleet`` merges these with the router's own
+        #: trace into the fleet-wide causal tree.
+        self.trace_shards: list[dict] = []
+
+    def _harvest_shard(self, rep: Replica, at: str) -> None:
+        """Record ``rep``'s per-process trace shard if the fleet run is
+        traced. Replica tracers are line-buffered, so a shard is
+        readable mid-flight (quarantine autopsy) and complete once the
+        process exited (reap). Idempotent per path — a quarantined
+        replica is harvested again at teardown without duplicating."""
+        from distributed_sddmm_tpu.obs import trace as obs_trace
+
+        shard_dir = obs_trace.shard_dir()
+        if shard_dir is None:
+            return
+        path = obs_trace.find_shard(shard_dir, rep.proc.pid)
+        if path is None:
+            return
+        if any(s["path"] == path for s in self.trace_shards):
+            return
+        self.trace_shards.append({
+            "name": rep.name, "generation": rep.generation,
+            "pid": rep.proc.pid, "path": path, "at": at,
+        })
+        obs_log.info("fleet", "trace shard harvested", name=rep.name,
+                     at=at, path=path)
 
     # -- introspection -------------------------------------------------- #
 
@@ -159,6 +187,7 @@ class FleetManager:
             "losses": self.losses,
             "quarantines": self.quarantines,
             "records_collected": len(self.records),
+            "trace_shards": len(self.trace_shards),
         }
 
     def _tuner_armed(self) -> bool:
@@ -234,6 +263,7 @@ class FleetManager:
         out, err = collect_output(rep.proc)
         rep.rc = rep.proc.returncode
         rep.record = last_json_line(out)
+        self._harvest_shard(rep, at="reap")
         if rep.record is not None:
             self.records.append(rep.record)
         if not expected:
@@ -332,6 +362,7 @@ class FleetManager:
             })
         metrics.GLOBAL.add("fleet_quarantines")
         obs_trace.event("fleet_quarantine", replica=name, reason=reason)
+        self._harvest_shard(rep, at="quarantine")
         obs_log.warn("fleet", "replica quarantined", name=name,
                      reason=reason, generation=rep.generation)
         fr = flightrec.active()

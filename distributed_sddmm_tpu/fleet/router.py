@@ -66,12 +66,14 @@ need detectors:
 from __future__ import annotations
 
 import collections
+import itertools
 import json
 import os
 import threading
 import time
 from typing import Callable, Optional
 
+from distributed_sddmm_tpu.obs import clock as obs_clock
 from distributed_sddmm_tpu.obs import log as obs_log
 from distributed_sddmm_tpu.obs import metrics as obs_metrics
 from distributed_sddmm_tpu.obs import trace as obs_trace
@@ -103,6 +105,13 @@ def _breaker_cooldown_default() -> float:
 def _audit_frac_default() -> float:
     v = os.environ.get("DSDDMM_FLEET_AUDIT_FRAC")
     return min(max(float(v), 0.0), 1.0) if v not in (None, "") else 0.0
+
+
+def _trace_debug_default() -> int:
+    """``DSDDMM_FLEET_TRACE_DEBUG``: how many recent fleet request
+    chains the router keeps for ``/debug/requests``."""
+    v = os.environ.get("DSDDMM_FLEET_TRACE_DEBUG")
+    return int(v) if v not in (None, "") else 64
 
 
 def _hedge_default() -> float:
@@ -244,6 +253,19 @@ class FleetRouter:
         self._port = int(port)
         self._lat: collections.deque = collections.deque(maxlen=256)
         self._audit_seq = 0
+        #: Fleet-level request ids: unique across router restarts (the
+        #: prefix embeds pid + random salt) and monotonic within one.
+        #: Minted even when tracing is off — replica logs stay
+        #: correlatable by ``X-DSDDMM-Trace`` regardless.
+        self._fleet_prefix = (
+            f"fr{os.getpid():x}-{os.urandom(2).hex()}"
+        )
+        self._fleet_ids = itertools.count(1)
+        #: Recent fleet request chains (attempt fan-out + routing
+        #: annotations), served live at ``/debug/requests``.
+        self._debug_chains: collections.deque = collections.deque(
+            maxlen=_trace_debug_default()
+        )
         #: Breaker transitions in arrival order (the chaos judge reads
         #: open events against the injected-fault timeline).
         self.breaker_events: list = []
@@ -415,8 +437,28 @@ class FleetRouter:
 
         return json.dumps(reply, sort_keys=True, default=_json_default)
 
+    @staticmethod
+    def _note_attempt(rctx: Optional[dict], st: ReplicaState, kind: str,
+                      ordinal: int, outcome: str,
+                      lat_s: Optional[float] = None,
+                      dropped: bool = False) -> None:
+        """Append one attempt row to the request's debug chain (list
+        append — safe from the hedge/audit side threads)."""
+        chain = (rctx or {}).get("chain")
+        if chain is None:
+            return
+        rec = {"replica": st.name, "kind": kind, "ordinal": ordinal,
+               "outcome": outcome, "breaker": st.breaker,
+               "depth_frac": st.depth_frac}
+        if lat_s is not None:
+            rec["lat_s"] = round(lat_s, 6)
+        if dropped:
+            rec["chaos_drop"] = True
+        chain["attempts"].append(rec)
+
     def _submit_once(self, st: ReplicaState, body: dict,
-                     timeout_s: float):
+                     timeout_s: float, rctx: Optional[dict] = None,
+                     kind: str = "primary", ordinal: int = 0):
         """One wire attempt against one replica. Outcomes::
 
             ("ok", reply)          200 with a well-formed body
@@ -430,6 +472,15 @@ class FleetRouter:
         partition window turns the attempt into a local error (the
         wire is down for us, whatever the replica thinks), a slow
         window delays it.
+
+        Tracing: every wire attempt is a ``fleet:attempt`` span
+        annotated with the routing decision (replica, kind, ordinal,
+        depth_frac, burn, breaker, bucket fit) and its fleet parent
+        (``fleet_req``/``fleet_shard``/``fleet_span``), and the fleet
+        context rides the ``X-DSDDMM-Trace`` header so the replica's
+        own chain records this attempt's span as parent. The span is
+        opened AFTER the chaos hook: an injected delay is not wire
+        latency, and ``lat_s`` must agree with the span duration.
         """
         from distributed_sddmm_tpu.obs.httpexp import post_json
 
@@ -440,55 +491,95 @@ class FleetRouter:
                 time.sleep(float(act["delay_s"]))
             if act.get("drop"):
                 self._strike(st, "chaos-drop")
+                self._note_attempt(rctx, st, kind, ordinal, "error",
+                                   dropped=True)
                 return ("error", f"chaos partition: {st.name} dropped")
-        t_send = time.monotonic()
-        try:
-            code, decoded, headers = post_json(
-                "127.0.0.1", st.port, "/submit", body,
-                timeout_s=timeout_s,
-            )
-        except OSError as e:
-            # Connection-level failure: the replica is gone (chaos
-            # kill) or wedged. Mark it — the caller fails over.
-            with self._lock:
-                st.ready = False
-            self._strike(st, "submit")
-            return ("error", f"{type(e).__name__}: {e}")
-        except ValueError as e:
-            # 200 whose body does not decode as JSON: the replica is
-            # answering garbage — replica failure, not client error.
-            with self._lock:
-                self.stats["decode_failovers"] += 1
-            self._strike(st, "decode")
-            return ("error", f"undecodable reply body: {e}")
-        if code == 200:
+        attrs = {"replica": st.name, "kind": kind, "ordinal": ordinal,
+                 "depth_frac": st.depth_frac, "burn": st.burn or 0.0,
+                 "breaker": st.breaker}
+        ctx = {"kind": kind, "ord": ordinal}
+        if rctx is not None:
+            ctx["req"] = rctx.get("req")
+            ctx["shard"] = rctx.get("shard")
+            attrs["fleet_req"] = rctx.get("req")
+            if rctx.get("shard"):
+                attrs["fleet_shard"] = rctx.get("shard")
+            if rctx.get("span") is not None:
+                # Cross-thread parent: hedge/audit attempts run on side
+                # threads whose span stack is empty — the merge pass
+                # re-parents on this attr, not the in-thread stack.
+                attrs["fleet_span"] = rctx.get("span")
+            inner = rctx.get("inner")
+            if inner is not None and st.inner_buckets:
+                attrs["bucket_fit"] = bool(
+                    bucket_for(inner, st.inner_buckets) >= inner
+                )
+        with obs_trace.span("fleet:attempt", **attrs) as sp:
+            ctx["span"] = getattr(sp, "id", None)
+            hdr = {
+                obs_trace.TRACE_HEADER: obs_trace.encode_fleet_ctx(ctx),
+            }
+            t_send = time.monotonic()
             try:
-                reply = decoded["reply"]
-            except (TypeError, KeyError):
-                # Well-formed JSON, wrong shape — same verdict as an
-                # undecodable body: fail over, never a client 500.
+                code, decoded, headers = post_json(
+                    "127.0.0.1", st.port, "/submit", body,
+                    timeout_s=timeout_s, headers=hdr,
+                )
+            except OSError as e:
+                # Connection-level failure: the replica is gone (chaos
+                # kill) or wedged. Mark it — the caller fails over.
+                with self._lock:
+                    st.ready = False
+                self._strike(st, "submit")
+                sp.set(outcome="error", error_kind="transport")
+                self._note_attempt(rctx, st, kind, ordinal, "error")
+                return ("error", f"{type(e).__name__}: {e}")
+            except ValueError as e:
+                # 200 whose body does not decode as JSON: the replica is
+                # answering garbage — replica failure, not client error.
                 with self._lock:
                     self.stats["decode_failovers"] += 1
                 self._strike(st, "decode")
-                return ("error", "malformed reply body: no 'reply' key")
-            with self._lock:
-                self._lat.append(time.monotonic() - t_send)
-            self._settle(st)
-            return ("ok", reply)
-        if code == 429:
-            hint = 0.0
-            raw = headers.get("Retry-After") or (
-                decoded.get("retry_after_s", 0.0)
-                if isinstance(decoded, dict) else 0.0
-            )
-            try:
-                hint = float(raw)
-            except (TypeError, ValueError):
-                pass
-            return ("shed", hint)
-        detail = (decoded.get("error", decoded)
-                  if isinstance(decoded, dict) else decoded)
-        return ("http", code, detail)
+                sp.set(outcome="error", error_kind="decode")
+                self._note_attempt(rctx, st, kind, ordinal, "error")
+                return ("error", f"undecodable reply body: {e}")
+            if code == 200:
+                try:
+                    reply = decoded["reply"]
+                except (TypeError, KeyError):
+                    # Well-formed JSON, wrong shape — same verdict as an
+                    # undecodable body: fail over, never a client 500.
+                    with self._lock:
+                        self.stats["decode_failovers"] += 1
+                    self._strike(st, "decode")
+                    sp.set(outcome="error", error_kind="decode")
+                    self._note_attempt(rctx, st, kind, ordinal, "error")
+                    return ("error", "malformed reply body: no 'reply' key")
+                lat = time.monotonic() - t_send
+                with self._lock:
+                    self._lat.append(lat)
+                self._settle(st)
+                sp.set(outcome="ok", lat_s=round(lat, 9))
+                self._note_attempt(rctx, st, kind, ordinal, "ok", lat)
+                return ("ok", reply)
+            if code == 429:
+                hint = 0.0
+                raw = headers.get("Retry-After") or (
+                    decoded.get("retry_after_s", 0.0)
+                    if isinstance(decoded, dict) else 0.0
+                )
+                try:
+                    hint = float(raw)
+                except (TypeError, ValueError):
+                    pass
+                sp.set(outcome="shed", retry_after_s=hint)
+                self._note_attempt(rctx, st, kind, ordinal, "shed")
+                return ("shed", hint)
+            detail = (decoded.get("error", decoded)
+                      if isinstance(decoded, dict) else decoded)
+            sp.set(outcome="http", code=code)
+            self._note_attempt(rctx, st, kind, ordinal, "http")
+            return ("http", code, detail)
 
     # -- hedging -------------------------------------------------------- #
 
@@ -506,7 +597,8 @@ class FleetRouter:
         return self.hedge_delay_s
 
     def _attempt(self, primary: ReplicaState, hedge_pool: list,
-                 body: dict, timeout_s: float):
+                 body: dict, timeout_s: float,
+                 rctx: Optional[dict] = None, ordinal: int = 0):
         """Primary submit with an optional hedge: if the primary has
         not answered within the hedge delay, fire the same request at
         the next candidate and take the first success. Returns
@@ -515,19 +607,23 @@ class FleetRouter:
         byzantine signal."""
         delay = self._hedge_delay() if hedge_pool else 0.0
         if delay <= 0.0:
-            return self._submit_once(primary, body, timeout_s), primary.name
+            return self._submit_once(
+                primary, body, timeout_s, rctx, kind="primary",
+                ordinal=ordinal,
+            ), primary.name
 
         cond = threading.Condition()
         arrivals: list = []  # (key, outcome) in completion order
 
-        def run(key: str, st: ReplicaState) -> None:
-            out = self._submit_once(st, body, timeout_s)
+        def run(key: str, st: ReplicaState, kind: str) -> None:
+            out = self._submit_once(st, body, timeout_s, rctx,
+                                    kind=kind, ordinal=ordinal)
             with cond:
                 arrivals.append((key, out))
                 cond.notify_all()
 
-        threading.Thread(target=run, args=("p", primary), daemon=True,
-                         name="fleet-submit").start()
+        threading.Thread(target=run, args=("p", primary, "primary"),
+                         daemon=True, name="fleet-submit").start()
         with cond:
             cond.wait_for(lambda: arrivals, timeout=delay)
             early = arrivals[0] if arrivals else None
@@ -541,9 +637,10 @@ class FleetRouter:
             self.stats["hedges"] += 1
         obs_metrics.GLOBAL.add("fleet_hedges")
         obs_trace.event("fleet_hedge", primary=primary.name,
-                        backup=backup.name)
-        threading.Thread(target=run, args=("h", backup), daemon=True,
-                         name="fleet-hedge").start()
+                        backup=backup.name,
+                        fleet_req=(rctx or {}).get("req"))
+        threading.Thread(target=run, args=("h", backup, "hedge"),
+                         daemon=True, name="fleet-hedge").start()
         with cond:
             cond.wait_for(
                 lambda: any(o[0] == "ok" for _, o in arrivals)
@@ -554,7 +651,7 @@ class FleetRouter:
         first_ok = next(((k, o) for k, o in snapshot if o[0] == "ok"),
                         None)
         self._compare_when_both_land(cond, arrivals, primary, backup,
-                                     body, timeout_s)
+                                     body, timeout_s, rctx)
         if first_ok is None:
             # Neither landed usable: report the primary's outcome when
             # it exists (keeps the failover loop's accounting honest).
@@ -570,7 +667,8 @@ class FleetRouter:
         return out, (backup.name if key == "h" else primary.name)
 
     def _compare_when_both_land(self, cond, arrivals, primary, backup,
-                                body, timeout_s) -> None:
+                                body, timeout_s,
+                                rctx: Optional[dict] = None) -> None:
         """Both-land agreement check: when the loser eventually
         answers too, the two replies must be bit-identical. Runs on a
         side thread so the winning reply is never delayed."""
@@ -586,7 +684,7 @@ class FleetRouter:
             if self._canon(p[1]) == self._canon(h[1]):
                 return
             self._byzantine(primary.name, p[1], backup.name, h[1],
-                            body, timeout_s, where="hedge")
+                            body, timeout_s, where="hedge", rctx=rctx)
 
         threading.Thread(target=work, daemon=True,
                          name="fleet-hedge-compare").start()
@@ -605,7 +703,8 @@ class FleetRouter:
         return int(n * self.audit_frac) > int((n - 1) * self.audit_frac)
 
     def _audit(self, server_name: str, reply, body: dict,
-               timeout_s: float, candidates: list):
+               timeout_s: float, candidates: list,
+               rctx: Optional[dict] = None):
         """Synchronous sampled audit: re-execute on a DIFFERENT
         replica and compare bit-for-bit before the reply leaves the
         router. On mismatch, arbitration picks the majority reply —
@@ -617,18 +716,27 @@ class FleetRouter:
         auditor = pool[0]
         with self._lock:
             self.stats["audits"] += 1
-        out = self._submit_once(auditor, body, timeout_s)
+        out = self._submit_once(auditor, body, timeout_s, rctx,
+                                kind="audit")
+        chain = (rctx or {}).get("chain")
         if out[0] != "ok":
             return reply  # audit inconclusive; primary reply stands
-        if self._canon(out[1]) == self._canon(reply):
+        agree = self._canon(out[1]) == self._canon(reply)
+        if chain is not None:
+            chain["audit"] = {"auditor": auditor.name, "agree": agree}
+        obs_trace.event("fleet_audit", auditor=auditor.name,
+                        audited=server_name, agree=agree,
+                        fleet_req=(rctx or {}).get("req"))
+        if agree:
             return reply
         return self._byzantine(server_name, reply, auditor.name, out[1],
                                body, timeout_s, where="audit",
-                               candidates=candidates)
+                               candidates=candidates, rctx=rctx)
 
     def _byzantine(self, name_a: str, reply_a, name_b: str, reply_b,
                    body: dict, timeout_s: float, where: str,
-                   candidates: Optional[list] = None):
+                   candidates: Optional[list] = None,
+                   rctx: Optional[dict] = None):
         """Two replicas disagree bit-for-bit on the same request — one
         of them is lying. A third replica arbitrates: whichever side
         the tiebreak contradicts is quarantined, and the majority
@@ -639,16 +747,20 @@ class FleetRouter:
             self.stats["audit_mismatches"] += 1
         obs_metrics.GLOBAL.add("fleet_audit_mismatches")
         obs_trace.event("fleet_audit_mismatch", a=name_a, b=name_b,
-                        where=where)
+                        where=where, fleet_req=(rctx or {}).get("req"))
         obs_log.warn("fleet", "byzantine reply mismatch",
                      a=name_a, b=name_b, where=where)
+        chain = (rctx or {}).get("chain")
+        if chain is not None:
+            chain["mismatch"] = {"a": name_a, "b": name_b, "where": where}
         if candidates is None:
             candidates = self._candidates(serial=False)
         canon_a, canon_b = self._canon(reply_a), self._canon(reply_b)
         for tie in candidates:
             if tie.name in (name_a, name_b):
                 continue
-            out = self._submit_once(tie, body, timeout_s)
+            out = self._submit_once(tie, body, timeout_s, rctx,
+                                    kind="arbitrate")
             if out[0] != "ok":
                 continue
             canon_t = self._canon(out[1])
@@ -661,11 +773,14 @@ class FleetRouter:
                              "no quorum", a=name_a, b=name_b,
                              tiebreak=tie.name)
                 return reply_a
+            if chain is not None:
+                chain["verdict"] = {"liar": liar, "tiebreak": tie.name}
             self._quarantine(liar, where, evidence={
                 "request_tenant": body.get("tenant"),
                 "disagreed_with": [n for n in (name_a, name_b, tie.name)
                                    if n != liar],
                 "where": where,
+                "fleet_req": (rctx or {}).get("req"),
             })
             return verdict
         obs_log.warn("fleet", "byzantine mismatch with no tiebreak "
@@ -692,13 +807,62 @@ class FleetRouter:
     # -- the routing decision ------------------------------------------- #
 
     def route(self, payload: dict, tenant: str = DEFAULT_TENANT,
-              serial: bool = False, timeout_s: Optional[float] = None
-              ) -> dict:
+              serial: bool = False, timeout_s: Optional[float] = None,
+              trace_ctx: Optional[dict] = None) -> dict:
         """The ``submit_fn`` contract: returns the reply dict, raises
         :class:`ShedError` (→ 429 + Retry-After at the edge) when no
-        replica admits the request."""
+        replica admits the request.
+
+        Every request is a ``fleet:request`` span plus a debug-chain
+        entry (``/debug/requests``); each wire attempt below it is a
+        ``fleet:attempt`` span carrying the routing decision.
+        ``trace_ctx`` is an upstream fleet context decoded off the
+        router's own front door — its request id is reused so chained
+        routers stay one causal tree; otherwise the router mints one."""
         timeout_s = self.request_timeout_s if timeout_s is None else timeout_s
+        fleet_req = (trace_ctx or {}).get("req") or (
+            f"{self._fleet_prefix}-{next(self._fleet_ids)}"
+        )
+        chain = {"fleet_req": fleet_req, "tenant": tenant,
+                 "t_epoch": obs_clock.epoch(), "attempts": [],
+                 "outcome": "error"}
+        t_route = time.monotonic()
+        with obs_trace.span("fleet:request", fleet_req=fleet_req,
+                            tenant=tenant) as sp:
+            rctx = {"req": fleet_req, "shard": obs_trace.run_id(),
+                    "span": getattr(sp, "id", None), "chain": chain,
+                    "sp": sp}
+            try:
+                reply, server, serial_used = self._route_attempts(
+                    payload, tenant, serial, timeout_s, rctx,
+                )
+            except ShedError as e:
+                chain["outcome"] = "shed"
+                chain["retry_after_s"] = round(e.retry_after_s, 6)
+                sp.set(outcome="shed",
+                       retry_after_s=round(e.retry_after_s, 6))
+                raise
+            except Exception as e:
+                chain["error"] = f"{type(e).__name__}: {e}"
+                sp.set(outcome="error")
+                raise
+            else:
+                chain["outcome"] = "ok"
+                chain["winner"] = server
+                chain["serial"] = serial_used
+                sp.set(outcome="ok", winner=server, serial=serial_used)
+                return reply
+            finally:
+                chain["dur_s"] = round(time.monotonic() - t_route, 6)
+                self._debug_chains.append(chain)
+
+    def _route_attempts(self, payload: dict, tenant: str, serial: bool,
+                        timeout_s: float, rctx: dict):
+        """The routing decision proper: candidate selection, the
+        failover loop, hedging and the sampled audit. Returns
+        ``(reply, winner_name, serial_used)``."""
         inner = self.inner_size_fn(payload)
+        rctx["inner"] = inner
         candidates = self._candidates(serial)
         if not serial and candidates:
             # Pathological outlier: larger than every candidate's
@@ -720,6 +884,8 @@ class FleetRouter:
                        and bucket_for(inner, s.inner_buckets) >= inner]
             candidates = fitting or candidates
 
+        rctx["serial"] = serial
+        rctx["sp"].set(inner=inner)
         body = {"payload": payload, "tenant": tenant,
                 "serial": serial, "timeout_s": timeout_s}
         shed_hint = 0.0
@@ -729,17 +895,18 @@ class FleetRouter:
             # the batched path by design (float64), so neither hedging
             # nor audit applies to it.
             hedge_pool = [] if serial else candidates[i + 1:]
-            out, server = self._attempt(st, hedge_pool, body, timeout_s)
+            out, server = self._attempt(st, hedge_pool, body, timeout_s,
+                                        rctx, ordinal=i)
             if out[0] == "ok":
                 reply = out[1]
                 if not serial and self._audit_roll():
                     reply = self._audit(server, reply, body, timeout_s,
-                                        candidates)
+                                        candidates, rctx)
                 with self._lock:
                     self.stats["routed"] += 1
                     if serial:
                         self.stats["serial_routed"] += 1
-                return reply
+                return reply, server, serial
             if out[0] == "shed":
                 saw_shed = True
                 self.stats["replica_sheds_seen"] += 1
@@ -781,6 +948,21 @@ class FleetRouter:
             out["manager"] = self.manager.describe()
         return out
 
+    def debug_chains(self) -> dict:
+        """Live fleet request chains — the ``/debug/requests`` body on
+        the router's own admin surface: one row per recent request with
+        its attempt fan-out (primary/hedge/audit/arbitrate), outcomes,
+        per-attempt routing annotations, and any audit/byzantine
+        verdicts."""
+        rows = list(self._debug_chains)
+        return {
+            "router": True,
+            "capacity": self._debug_chains.maxlen,
+            "complete": sum(1 for r in rows if r.get("outcome") == "ok"),
+            "requests": rows,
+            "stats": dict(self.stats),
+        }
+
     @property
     def port(self) -> int:
         return self._server.port if self._server is not None else self._port
@@ -798,7 +980,7 @@ class FleetRouter:
         self._thread.start()
         self._server = AdminServer(
             snapshot_fn=self.topology, submit_fn=self.route,
-            port=self._port,
+            debug_fn=self.debug_chains, port=self._port,
         ).start()
         obs_log.info("fleet", "router serving",
                      url=f"http://127.0.0.1:{self._server.port}")

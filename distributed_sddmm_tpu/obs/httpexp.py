@@ -48,6 +48,7 @@ Clock discipline: reads ``obs.clock`` only (lint-enforced).
 
 from __future__ import annotations
 
+import inspect
 import json
 import math
 import threading
@@ -342,6 +343,7 @@ class AdminServer:
         debug_requests_limit: int = 64,
         submit_fn: Optional[Callable] = None,
         chaos_fn: Optional[Callable[[dict], dict]] = None,
+        debug_fn: Optional[Callable[[], dict]] = None,
     ):
         self.engine = engine
         self.op_metrics = op_metrics
@@ -349,7 +351,25 @@ class AdminServer:
         self.snapshot_fn = snapshot_fn
         #: ``submit_fn(payload, tenant=..., serial=..., timeout_s=...)``
         #: → reply dict. None keeps the server read-only (no /submit).
+        #: A submit_fn that also accepts ``trace_ctx=`` receives the
+        #: decoded ``X-DSDDMM-Trace`` fleet context (probed once here —
+        #: existing submit_fns without the kwarg keep working unchanged).
         self.submit_fn = submit_fn
+        self._submit_accepts_trace = False
+        if submit_fn is not None:
+            try:
+                params = inspect.signature(submit_fn).parameters.values()
+                self._submit_accepts_trace = any(
+                    p.kind is inspect.Parameter.VAR_KEYWORD
+                    or p.name == "trace_ctx"
+                    for p in params
+                )
+            except (TypeError, ValueError):
+                pass
+        #: ``debug_fn()`` → dict served at ``/debug/requests`` instead of
+        #: the span-ring reconstruction — the fleet router injects its
+        #: live fleet request chains here.
+        self.debug_fn = debug_fn
         #: ``chaos_fn(body)`` → ack dict, serving ``POST /chaos`` — the
         #: runtime arming hook chaos drills use to install a fault plan
         #: in an already-running replica (env knobs cannot change after
@@ -558,7 +578,10 @@ class AdminServer:
             code, body = self.readiness()
             self._send_json(handler, code, body)
         elif path == "/debug/requests":
-            self._send_json(handler, 200, self.debug_requests())
+            if self.debug_fn is not None:
+                self._send_json(handler, 200, self.debug_fn())
+            else:
+                self._send_json(handler, 200, self.debug_requests())
         elif path == "/snapshot":
             snap = self.snapshot()
             if snap is None:
@@ -607,9 +630,13 @@ class AdminServer:
         tenant = str(body.get("tenant") or "default")
         serial = bool(body.get("serial"))
         timeout_s = float(body.get("timeout_s") or 30.0)
+        kwargs = {"tenant": tenant, "serial": serial, "timeout_s": timeout_s}
+        if self._submit_accepts_trace:
+            kwargs["trace_ctx"] = obs_trace.decode_fleet_ctx(
+                handler.headers.get(obs_trace.TRACE_HEADER)
+            )
         try:
-            reply = self.submit_fn(payload, tenant=tenant, serial=serial,
-                                   timeout_s=timeout_s)
+            reply = self.submit_fn(payload, **kwargs)
         except ShedError as e:
             # The backpressure hint crosses the process boundary as the
             # standard header; the fleet router forwards it verbatim.
@@ -687,22 +714,25 @@ def fetch_json(host: str, port: int, path: str = "/snapshot",
 
 def post_json(
     host: str, port: int, path: str, body: dict, timeout_s: float = 30.0,
+    headers: Optional[dict] = None,
 ) -> tuple[int, dict, dict]:
     """POST JSON to a local admin/router server; returns ``(status,
     decoded_body, headers)``. HTTP error statuses (429/4xx/5xx) are
     returned, not raised — a shed IS a reply and its ``Retry-After``
     header is in the caller's contract. Connection-level failures
     (refused, reset, timeout) still raise the ``OSError`` family —
-    that is how a router tells a dead replica from a shedding one."""
+    that is how a router tells a dead replica from a shedding one.
+    ``headers`` are merged over the Content-Type default — the fleet
+    router passes the ``X-DSDDMM-Trace`` context this way."""
     import urllib.error
     import urllib.request
 
     url = f"http://{host}:{port}{path}"
     data = json.dumps(body, default=_json_default).encode()
-    req = urllib.request.Request(
-        url, data=data, method="POST",
-        headers={"Content-Type": "application/json"},
-    )
+    hdrs = {"Content-Type": "application/json"}
+    if headers:
+        hdrs.update(headers)
+    req = urllib.request.Request(url, data=data, method="POST", headers=hdrs)
     try:
         with urllib.request.urlopen(req, timeout=timeout_s) as resp:
             return (resp.status, json.loads(resp.read().decode()),

@@ -45,6 +45,13 @@ GATE_NO_DATA = 3
 #: wraps the whole run; comparing it double-counts its children).
 _SKIP_PHASES = ("bench",)
 
+#: Zero-tolerance axes: any nonzero ``t_call`` regresses, no noise
+#: band, no baseline required. ``fleet:audit_mismatch`` counts
+#: byzantine replies; ``fleet:trace_coverage`` carries the UNCOVERED
+#: fraction of delivered requests whose trace chain failed to
+#: reconstruct (1 - coverage).
+_HARD_AXES = ("fleet:audit_mismatch", "fleet:trace_coverage")
+
 
 def _optional_axis(name: str) -> bool:
     """Axes that only exist when optional telemetry ran (SLO burn rate
@@ -220,6 +227,19 @@ def _fleet_rows(doc: dict) -> dict[str, dict]:
         rows["fleet:audit_mismatch"] = _pseudo_row(
             offered, float(fleet["audit_mismatches"])
         )
+    trace = fleet.get("trace") or {}
+    if trace.get("coverage") is not None:
+        # HARD axis (PR 19): every DELIVERED reply must reconstruct a
+        # complete causal chain in the merged fleet trace (router
+        # request span → winning attempt, duration agreeing with the
+        # router's own recorded latency within 1 ms → replica
+        # enqueue/batch/reply). ``t_call`` is the UNCOVERED fraction
+        # ``1 - coverage`` so any nonzero value regresses — a dropped
+        # span is lost observability, no threshold, no baseline band.
+        rows["fleet:trace_coverage"] = _pseudo_row(
+            max(int(trace.get("delivered") or 0), 1),
+            max(1.0 - float(trace["coverage"]), 0.0),
+        )
     hedges = int(fleet.get("hedges") or 0)
     if hedges > 0:
         # A RISING hedge-win rate means primaries increasingly miss the
@@ -355,10 +375,11 @@ def compare(
     regressions, improvements, missing, new_phases = [], [], [], []
     for name in sorted(set(stats_a) | set(stats_b)):
         a, b = stats_a.get(name), stats_b.get(name)
-        if name == "fleet:audit_mismatch" and b is not None:
-            # Zero-tolerance hard axis: the band machinery would let a
-            # "stable" nonzero mismatch count pass — but one byzantine
-            # reply is one too many, baseline or no baseline.
+        if name in _HARD_AXES and b is not None:
+            # Zero-tolerance hard axes: the band machinery would let a
+            # "stable" nonzero value pass — but one byzantine reply (or
+            # one delivered request whose trace chain failed to
+            # reconstruct) is one too many, baseline or no baseline.
             bad = b["t_call"] > 0
             if bad:
                 verdict = "regression"

@@ -402,11 +402,71 @@ def newest_stream(root=None) -> pathlib.Path | None:
     return files[-1] if files else None
 
 
+def _render_top_fleet(snapshots: list[dict], cur: dict) -> str:
+    """The ``bench top`` screen for a ROUTER snapshot (``/snapshot`` on
+    a :class:`~distributed_sddmm_tpu.fleet.router.FleetRouter`'s admin
+    port, tagged ``router: true``): per-replica health/breaker/depth
+    table plus the routing, hedging and audit counters."""
+    stats = cur.get("stats") or {}
+    lines = [
+        f"fleet router · sample {len(snapshots)} · "
+        f"hedge {cur.get('hedge_delay_s')}s · "
+        f"audit {cur.get('audit_frac')}",
+        "",
+        f"  {'replica':<10} {'ready':<6} {'breaker':<8} {'depth':>6} "
+        f"{'burn':>6} {'strikes':>7}  buckets",
+    ]
+    for rep in cur.get("replicas") or []:
+        state = "drain" if rep.get("draining") else (
+            "yes" if rep.get("ready") else "no")
+        lines.append(
+            f"  {str(rep.get('name')):<10} {state:<6} "
+            f"{str(rep.get('breaker', '-')):<8} "
+            f"{100.0 * (rep.get('depth_frac') or 0.0):>5.0f}% "
+            f"{rep.get('burn') if rep.get('burn') is not None else '-':>6} "
+            f"{rep.get('strikes', 0):>7}  {rep.get('inner_buckets')}"
+        )
+    lines += [
+        "",
+        f"  routed    {stats.get('routed', 0):>6}   serial "
+        f"{stats.get('serial_routed', 0)}   failovers "
+        f"{stats.get('failovers', 0)}   decode_failovers "
+        f"{stats.get('decode_failovers', 0)}",
+        f"  hedges    {stats.get('hedges', 0):>6}   wins "
+        f"{stats.get('hedge_wins', 0)}   audits {stats.get('audits', 0)}   "
+        f"mismatches {stats.get('audit_mismatches', 0)}",
+        f"  sheds     edge={stats.get('edge_sheds', 0)} "
+        f"replica={stats.get('replica_sheds_seen', 0)}   breaker_opens "
+        f"{stats.get('breaker_opens', 0)}   quarantines "
+        f"{stats.get('quarantines', 0)}",
+    ]
+    mgr = cur.get("manager") or {}
+    if mgr:
+        # describe() ships replicas as the full dict list — the top
+        # line wants the count, not the blob.
+        lines.append(
+            "  manager   "
+            + "   ".join(
+                f"{k}={len(mgr[k]) if isinstance(mgr[k], list) else mgr[k]}"
+                for k in ("replicas", "spawns", "losses", "quarantines",
+                          "trace_shards")
+                if mgr.get(k) is not None
+            )
+        )
+    return "\n".join(lines)
+
+
 def render_top(snapshots: list[dict]) -> str:
-    """The ``bench top`` screen: latest snapshot + short-window rates."""
+    """The ``bench top`` screen: latest snapshot + short-window rates.
+
+    Renders the engine view for replica snapshots and the fleet view
+    (replica table + routing counters) when the snapshot came from a
+    front router's admin port."""
     if not snapshots:
         return "no telemetry samples yet"
     cur = snapshots[-1]
+    if cur.get("router"):
+        return _render_top_fleet(snapshots, cur)
     lines = [
         f"run {cur.get('run_id')} · sample {len(snapshots)} · "
         f"t={cur.get('t_epoch')}",

@@ -488,6 +488,80 @@ def trace_path() -> Optional[str]:
     return str(tr.path) if tr is not None and tr.path is not None else None
 
 
+# --------------------------------------------------------------------- #
+# Fleet trace context — the cross-process propagation format.
+# --------------------------------------------------------------------- #
+
+#: HTTP header carrying fleet trace context on ``POST /submit``.
+TRACE_HEADER = "X-DSDDMM-Trace"
+
+#: Header format generation; decoders ignore versions they don't know.
+TRACE_HEADER_VERSION = "v1"
+
+#: Context fields, in wire order. ``req`` is the fleet-level request id
+#: (always present, minted by the router even when tracing is off so
+#: replica logs stay correlatable), ``shard`` the router's trace run_id,
+#: ``span`` the router-side attempt span id the replica's records should
+#: parent to, ``kind`` the attempt kind (primary/hedge/audit/arbitrate),
+#: ``ord`` the failover ordinal of the attempt.
+_CTX_FIELDS = ("req", "shard", "span", "kind", "ord")
+_CTX_INT_FIELDS = ("span", "ord")
+
+
+def encode_fleet_ctx(ctx: dict) -> str:
+    """Serialize a fleet trace context to the ``X-DSDDMM-Trace`` wire
+    value: ``v1;req=..;shard=..;span=..;kind=..;ord=..`` (fields with a
+    None value are omitted; unknown keys are dropped)."""
+    parts = [TRACE_HEADER_VERSION]
+    for key in _CTX_FIELDS:
+        val = ctx.get(key)
+        if val is None:
+            continue
+        parts.append(f"{key}={val}")
+    return ";".join(parts)
+
+
+def decode_fleet_ctx(value) -> Optional[dict]:
+    """Parse an ``X-DSDDMM-Trace`` header value back into a context
+    dict, or None for a missing/garbage/unknown-version value. Integer
+    fields (``span``, ``ord``) are coerced; a field that fails to parse
+    is dropped rather than poisoning the rest (partial context is still
+    useful for correlation)."""
+    if not value or not isinstance(value, str):
+        return None
+    parts = value.strip().split(";")
+    if not parts or parts[0] != TRACE_HEADER_VERSION:
+        return None
+    ctx: dict = {}
+    for part in parts[1:]:
+        key, sep, raw = part.partition("=")
+        if not sep or key not in _CTX_FIELDS or not raw:
+            continue
+        if key in _CTX_INT_FIELDS:
+            try:
+                ctx[key] = int(raw)
+            except ValueError:
+                continue
+        else:
+            ctx[key] = raw
+    return ctx if ctx.get("req") else None
+
+
+def find_shard(directory, pid: int) -> Optional[str]:
+    """The trace shard in ``directory`` whose begin record was written
+    by ``pid``, or None. The fleet manager uses this to harvest a
+    replica's shard at reap/quarantine time — the shard file name embeds
+    the replica's run_id (which embeds its pid), but the begin record is
+    the authoritative owner stamp."""
+    d = pathlib.Path(directory)
+    if not d.is_dir():
+        return None
+    for path in sorted(d.glob("*.jsonl")):
+        if _owning_pid(path) == pid:
+            return str(path)
+    return None
+
+
 def span(name: str, **attrs):
     """A context manager timing a nested region; no-op when disabled.
 

@@ -23,7 +23,13 @@ Mapping:
   event, a ``serve:batch`` span listing it, and a reply event, a
   ``s``/``t``/``f`` flow triple (one disjoint flow id per request)
   stitches enqueue → batch → reply across threads — the same joins
-  ``tools/tracereport.request_chains`` verifies, drawn as arrows.
+  ``tools/tracereport.request_chains`` verifies, drawn as arrows;
+* **fleet links become cross-process flows**: every record the merge
+  pass re-parented across shards (``attrs.fleet_parent`` — a router
+  attempt's replica-side enqueue, a side-thread hedge/audit attempt
+  under its request span) gets its own arrow from the parent span's
+  lane to the linked record's lane, so a fleet request reads as one
+  tree spanning the router's process and every replica it touched.
 
 CLI: ``python -m distributed_sddmm_tpu.bench trace-export TRACE.jsonl
 [-o OUT.json]`` (exit 2 on a schema-invalid trace, like report-trace).
@@ -168,6 +174,71 @@ def _request_flows(trace: dict, lanes: _Lanes) -> list[dict]:
     return flows
 
 
+#: Flow-id offset keeping fleet arrows disjoint from request flows.
+_FLEET_FLOW_BASE = 10_000_000
+
+
+def _slice_mid_us(t0_s: float, t1_s: float) -> float:
+    """A timestamp strictly inside a slice, for flow binding."""
+    return round((_us(t0_s) + _us(t1_s)) / 2, 3)
+
+
+def _fleet_flows(trace: dict, lanes: _Lanes) -> list[dict]:
+    """One ``s``/``f`` flow pair per cross-process fleet link.
+
+    The merge pass re-parents a record onto its causal parent in
+    another shard (or thread) and records the merged id as
+    ``attrs.fleet_parent``; each such re-parented record — the
+    replica's enqueue marker under the router's attempt span, a
+    side-thread hedge/audit attempt under its request span — gets an
+    arrow from the parent span's lane. Records whose in-process parent
+    survived the merge (``serve:reply`` under ``serve:batch``) keep
+    their nesting and need no arrow.
+    """
+    span_by_id = {sp["id"]: sp for sp in trace["spans"]}
+    linked = [
+        rec for rec in trace["spans"] + trace["events"]
+        if isinstance(rec.get("attrs"), dict)
+        and rec["attrs"].get("fleet_parent") is not None
+        and rec.get("parent") == rec["attrs"]["fleet_parent"]
+        and (rec["type"] == "span" or rec["name"] in _MARKER_EVENTS)
+    ]
+    flows = []
+    fid = _FLEET_FLOW_BASE
+    for rec in sorted(linked, key=lambda r: r["id"]):
+        parent = span_by_id.get(rec["attrs"]["fleet_parent"])
+        if parent is None:
+            continue
+        fid += 1
+        common = {
+            "name": "fleet", "cat": "fleet", "id": fid,
+            "args": {"fleet_req": rec["attrs"].get("fleet_req"),
+                     "to": rec["name"]},
+        }
+        if rec["type"] == "span":
+            ts = _slice_mid_us(rec["t0"], rec["t1"])
+        else:
+            ts = _us(rec["t"]) + _MARKER_DUR_US / 2
+        # The arrow starts just inside the parent slice's opening edge
+        # (a slice midpoint could land AFTER the child record — e.g. a
+        # long attempt span whose replica enqueued early — and Chrome
+        # flows must run forward in time); it still binds to the
+        # parent slice, and never past the child's anchor.
+        flows.append({
+            **common, "ph": "s",
+            "pid": lanes.pid(parent.get("shard")),
+            "tid": lanes.tid(parent.get("shard"), parent["tid"]),
+            "ts": min(_us(parent["t0"]) + 1, ts),
+        })
+        flows.append({
+            **common, "ph": "f", "bp": "e",
+            "pid": lanes.pid(rec.get("shard")),
+            "tid": lanes.tid(rec.get("shard"), rec["tid"]),
+            "ts": ts,
+        })
+    return flows
+
+
 def to_chrome(trace: dict) -> dict:
     """A ``tracereport.load_trace`` dict → Chrome trace-event JSON."""
     begin = trace.get("begin") or {}
@@ -206,10 +277,15 @@ def to_chrome(trace: dict) -> dict:
             }))
     for fl in _request_flows(trace, lanes):
         out.append(((fl["ts"], 1, 0), fl))
+    for fl in _fleet_flows(trace, lanes):
+        out.append(((fl["ts"], 1, 0), fl))
 
     out.sort(key=lambda pair: pair[0])
     events = lanes.meta + [rec for _key, rec in out]
-    n_flows = sum(1 for e in events if e.get("ph") == "s")
+    n_flows = sum(1 for e in events
+                  if e.get("ph") == "s" and e.get("cat") == "request")
+    n_fleet = sum(1 for e in events
+                  if e.get("ph") == "s" and e.get("cat") == "fleet")
     return {
         "traceEvents": events,
         "displayTimeUnit": "ms",
@@ -221,6 +297,7 @@ def to_chrome(trace: dict) -> dict:
             "spans": len(trace["spans"]),
             "events": len(trace["events"]),
             "request_flows": n_flows,
+            "fleet_flows": n_fleet,
         },
     }
 

@@ -98,6 +98,9 @@ def merge(paths, strict: bool = True) -> dict:
         float(tr["begin"].get("t0_epoch") or 0.0) for _, tr in loaded
     )
     spans, events, shards_meta = [], [], []
+    #: (source run_id, original span id) -> merged span id: the lookup
+    #: the cross-process parent rewrite below resolves fleet links with.
+    spanmap: dict = {}
     id_base = 0
     for path, tr in loaded:
         b = tr["begin"]
@@ -107,6 +110,7 @@ def merge(paths, strict: bool = True) -> dict:
         for sp in tr["spans"]:
             sp = dict(sp)
             max_id = max(max_id, int(sp["id"]))
+            spanmap[(rid, int(sp["id"]))] = int(sp["id"]) + id_base
             sp["id"] = int(sp["id"]) + id_base
             if sp.get("parent") is not None:
                 sp["parent"] = int(sp["parent"]) + id_base
@@ -148,6 +152,37 @@ def merge(paths, strict: bool = True) -> dict:
         })
         id_base += max_id
 
+    # Second pass — cross-process causality. A record carrying a
+    # ``fleet_span`` attr names its causal parent span in another shard
+    # (``fleet_shard``, the router's run_id; absent = its own shard —
+    # the router's side-thread attempt spans). The merged id is
+    # published as ``attrs.fleet_parent`` on every linked record, and a
+    # record with no in-process parent (the replica's enqueue event,
+    # hedge/audit attempts on parentless side threads) is re-parented
+    # onto it — one causally-connected tree per fleet request, without
+    # disturbing in-process nesting where it exists (``serve:reply``
+    # stays under its ``serve:batch`` span).
+    fleet_links = 0
+    for rec in spans + events:
+        attrs = rec.get("attrs")
+        if not isinstance(attrs, dict):
+            continue
+        fspan = attrs.get("fleet_span")
+        if fspan is None:
+            continue
+        try:
+            key = ((attrs.get("fleet_shard") or rec.get("shard")),
+                   int(fspan))
+        except (TypeError, ValueError):
+            continue
+        target = spanmap.get(key)
+        if target is None:
+            continue
+        rec["attrs"] = {**attrs, "fleet_parent": target}
+        if rec.get("parent") is None:
+            rec["parent"] = target
+        fleet_links += 1
+
     spans.sort(key=lambda r: r["t0"])
     events.sort(key=lambda r: r["t"])
     digest = hashlib.sha256(
@@ -159,6 +194,7 @@ def merge(paths, strict: bool = True) -> dict:
         "run_id": f"merged-{digest}",
         "t0_epoch": base_epoch,
         "shards": shards_meta,
+        "fleet_links": fleet_links,
     }
     return {"begin": begin, "spans": spans, "events": events,
             "errors": errors}

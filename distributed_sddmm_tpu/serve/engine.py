@@ -306,12 +306,15 @@ class ServingEngine:
     # Client surface
     # ------------------------------------------------------------------ #
 
-    def submit(self, payload: dict, tenant: str = DEFAULT_TENANT) -> Request:
+    def submit(self, payload: dict, tenant: str = DEFAULT_TENANT,
+               trace_ctx: Optional[dict] = None) -> Request:
         """Admit one request (sheds with
         :class:`~distributed_sddmm_tpu.serve.queue.ShedError` when the
         queue is at depth). ``tenant`` must be a class declared at
         construction; the queue's weighted-fair scheduler isolates the
-        classes from each other."""
+        classes from each other. ``trace_ctx`` is the decoded fleet
+        trace context (``X-DSDDMM-Trace``) forwarded into the queue so
+        the request's trace chain records its fleet parent."""
         from distributed_sddmm_tpu.serve.queue import ShedError
 
         wd = obs_watchdog.active()
@@ -331,7 +334,7 @@ class ServingEngine:
                 ) from None
         try:
             return self.queue.submit(self.workload.clamp(payload),
-                                     tenant=tenant)
+                                     tenant=tenant, trace_ctx=trace_ctx)
         except ShedError:
             self.recorder.record_shed(tenant)
             obs_metrics.GLOBAL.add("serve_shed")
@@ -439,12 +442,20 @@ class ServingEngine:
                     # stamps in trace-relative time: the event's `t` is
                     # its emission instant, which can lag set_result by
                     # a scheduling delay once the client thread wakes.
+                    fleet_attrs = {}
+                    if req.fleet:
+                        fleet_attrs = {
+                            "fleet_req": req.fleet.get("req"),
+                            "fleet_shard": req.fleet.get("shard"),
+                            "fleet_span": req.fleet.get("span"),
+                        }
                     obs_trace.event(
                         "serve:reply", req=req.req_id, degraded=degraded,
                         t_enqueue=obs_trace.rel_time(req.t_enqueue),
                         t_reply=obs_trace.rel_time(req.t_reply),
                         **{k: round(v, 6)
                            for k, v in req.stage_latencies_s().items()},
+                        **fleet_attrs,
                     )
             self.served += len(group)
             mirror = self._mirror

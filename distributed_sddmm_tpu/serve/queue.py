@@ -127,15 +127,19 @@ class Request:
     """
 
     __slots__ = (
-        "req_id", "payload", "tenant", "t_enqueue", "t_admit", "t_execute",
-        "t_reply", "degraded", "_done", "_value", "_error",
+        "req_id", "payload", "tenant", "fleet", "t_enqueue", "t_admit",
+        "t_execute", "t_reply", "degraded", "_done", "_value", "_error",
     )
 
     def __init__(self, req_id: int, payload: Any,
-                 tenant: str = DEFAULT_TENANT):
+                 tenant: str = DEFAULT_TENANT,
+                 fleet: Optional[dict] = None):
         self.req_id = req_id
         self.payload = payload
         self.tenant = tenant
+        #: Decoded fleet trace context (``X-DSDDMM-Trace``) this request
+        #: arrived with, or None for a direct (non-fleet) submission.
+        self.fleet = fleet
         self.t_enqueue: float = 0.0
         self.t_admit: Optional[float] = None
         self.t_execute: Optional[float] = None
@@ -250,13 +254,18 @@ class RequestQueue:
     # Client side
     # ------------------------------------------------------------------ #
 
-    def submit(self, payload: Any, tenant: str = DEFAULT_TENANT) -> Request:
+    def submit(self, payload: Any, tenant: str = DEFAULT_TENANT,
+               trace_ctx: Optional[dict] = None) -> Request:
         """Admit one request (raises :class:`ShedError` when full, or
         ``RuntimeError`` after :meth:`close`). Admissions and sheds emit
         ``serve:enqueue`` / ``serve:shed`` trace events carrying the
         request id — the head of the request's trace chain. An unknown
         ``tenant`` raises ``ValueError`` — a typo'd class silently
-        scheduled at default weight would defeat the QoS contract."""
+        scheduled at default weight would defeat the QoS contract.
+        ``trace_ctx`` is the decoded fleet context a router attached to
+        this request; the enqueue event records it so the replica chain
+        carries its fleet parent (``fleet_req``/``fleet_shard``/
+        ``fleet_span``) into the merged trace."""
         if tenant not in self.tenants:
             raise ValueError(
                 f"unknown tenant {tenant!r}; declared: "
@@ -276,7 +285,8 @@ class RequestQueue:
                 )
                 shed_id = next(self._ids)
             else:
-                req = Request(next(self._ids), payload, tenant=tenant)
+                req = Request(next(self._ids), payload, tenant=tenant,
+                              fleet=trace_ctx)
                 req.t_enqueue = clock.now()
                 q = self._queues[tenant]
                 if not q:
@@ -296,10 +306,18 @@ class RequestQueue:
                 depth = self._depth
                 self._not_empty.notify()
                 shed_id = None
+        fleet_attrs = {}
+        if trace_ctx:
+            fleet_attrs = {
+                "fleet_req": trace_ctx.get("req"),
+                "fleet_shard": trace_ctx.get("shard"),
+                "fleet_span": trace_ctx.get("span"),
+            }
         if shed_id is not None:
             obs_trace.event("serve:shed", req=shed_id, depth=depth,
                             tenant=tenant,
-                            retry_after_s=round(retry_after, 6))
+                            retry_after_s=round(retry_after, 6),
+                            **fleet_attrs)
             raise ShedError(
                 f"queue full ({depth}/{self.max_depth}); "
                 f"retry after ~{retry_after:.3f}s",
@@ -307,7 +325,7 @@ class RequestQueue:
             )
         if obs_trace.enabled():
             obs_trace.event("serve:enqueue", req=req.req_id, depth=depth,
-                            tenant=tenant)
+                            tenant=tenant, **fleet_attrs)
         return req
 
     def depth(self) -> int:

@@ -241,6 +241,198 @@ def request_chains(trace: dict) -> dict:
     }
 
 
+def fleet_request_chains(trace: dict) -> dict:
+    """Reconstruct fleet-level request trees (router → replica) from a
+    merged trace.
+
+    Joins each ``fleet:request`` span to its ``fleet:attempt`` spans
+    (on the ``fleet_req`` attr) and — through the merge pass's
+    cross-process ``fleet_parent`` links — to the replica-side
+    enqueue→batch→reply chain the winning attempt caused. A DELIVERED
+    request (outcome ``ok``) is COMPLETE when:
+
+    * a winning attempt span exists (kind primary/hedge, outcome ok,
+      replica == the request span's recorded ``winner``), its duration
+      agreeing with the router's own recorded submit latency
+      (``lat_s`` — the exact value in the router's hedge-delay window)
+      within :data:`REQUEST_CHAIN_TOL_S`;
+    * the winning attempt is causally connected to its request span
+      (parent or ``fleet_parent`` link);
+    * unless the request went to the serial tier, the winner's
+      replica-side chain is present, complete and consistent
+      (:func:`request_chains`' own 1 ms partition check), and linked
+      back to the winning attempt.
+
+    Hedge losers, failed/failover attempts, audits and arbitrations
+    appear as annotated ``attempts`` branches — informational, never
+    required for completeness.
+
+    Returns ``{"requests": {fleet_req: chain}, "delivered",
+    "complete", "failed", "hedged", "audited", "coverage"}`` where
+    ``coverage`` is complete/delivered (1.0 when nothing delivered —
+    the regress gate's clean-run value).
+    """
+    attempts_by_req: dict = {}
+    req_spans = []
+    for sp in trace["spans"]:
+        if sp["name"] == "fleet:request":
+            req_spans.append(sp)
+        elif sp["name"] == "fleet:attempt":
+            fr = sp["attrs"].get("fleet_req")
+            if fr is not None:
+                attempts_by_req.setdefault(fr, []).append(sp)
+    # Replica-side chains keyed by fleet request id: the enqueue/reply
+    # events carry the fleet attrs, joining request_chains' per-shard
+    # (shard, req) keys back onto the fleet tree. ``link`` is the
+    # merged id of the attempt span that caused the chain (absent in
+    # an unmerged single-process trace).
+    replica = request_chains(trace)
+    rep_by_fleet: dict = {}
+    for ev in trace["events"]:
+        if ev["name"] not in ("serve:enqueue", "serve:reply"):
+            continue
+        a = ev["attrs"]
+        fr = a.get("fleet_req")
+        if fr is None:
+            continue
+        key = req_key(ev, a.get("req"))
+        ch = replica["requests"].get(key)
+        if ch is None:
+            continue
+        ent = rep_by_fleet.setdefault(fr, {}).setdefault(
+            key, {"chain": ch, "link": None}
+        )
+        if a.get("fleet_parent") is not None:
+            ent["link"] = a["fleet_parent"]
+
+    requests: dict = {}
+    delivered = complete = hedged = audited = failed = 0
+    for rsp in sorted(req_spans, key=lambda s: s["t0"]):
+        a = rsp["attrs"]
+        fr = a.get("fleet_req")
+        rows = []
+        for att in sorted(attempts_by_req.get(fr, ()),
+                          key=lambda s: (s["attrs"].get("ordinal", 0),
+                                         s["t0"])):
+            aa = att["attrs"]
+            row = {
+                "replica": aa.get("replica"), "kind": aa.get("kind"),
+                "ordinal": aa.get("ordinal"), "outcome": aa.get("outcome"),
+                "depth_frac": aa.get("depth_frac"), "burn": aa.get("burn"),
+                "breaker": aa.get("breaker"),
+                "bucket_fit": aa.get("bucket_fit"),
+                "dur_s": att["dur_s"], "lat_s": aa.get("lat_s"),
+                "span": att["id"],
+                "connected": (att.get("parent") == rsp["id"]
+                              or aa.get("fleet_parent") == rsp["id"]),
+            }
+            if row["lat_s"] is not None:
+                row["lat_agree"] = (
+                    abs(att["dur_s"] - row["lat_s"]) <= REQUEST_CHAIN_TOL_S
+                )
+            rows.append(row)
+        outcome = a.get("outcome")
+        serial = bool(a.get("serial"))
+        winner = a.get("winner")
+        ch = {
+            "fleet_req": fr, "outcome": outcome, "winner": winner,
+            "serial": serial, "tenant": a.get("tenant"),
+            "dur_s": rsp["dur_s"], "span": rsp["id"], "attempts": rows,
+            "hedged": any(r["kind"] == "hedge" for r in rows),
+            "audited": any(r["kind"] in ("audit", "arbitrate")
+                           for r in rows),
+            "complete": False,
+        }
+        if outcome == "ok":
+            delivered += 1
+            winner_row = next(
+                (r for r in rows
+                 if r["outcome"] == "ok" and r["replica"] == winner
+                 and r["kind"] in ("primary", "hedge")),
+                None,
+            )
+            ok = (winner_row is not None and winner_row["connected"]
+                  and winner_row.get("lat_agree", False))
+            rep_ok = serial
+            if ok and not serial:
+                for ent in (rep_by_fleet.get(fr) or {}).values():
+                    if (ent["link"] is not None
+                            and ent["link"] != winner_row["span"]):
+                        continue  # a hedge loser's or audit's chain
+                    rc = ent["chain"]
+                    if rc.get("complete") and rc.get("consistent"):
+                        rep_ok = True
+                        ch["replica_chain"] = {
+                            "req": rc.get("req"),
+                            "segments": rc.get("segments"),
+                            "total_s": rc.get("total_s"),
+                            "degraded": rc.get("degraded", False),
+                        }
+                        break
+            ch["complete"] = bool(ok and rep_ok)
+            if ch["complete"]:
+                complete += 1
+                # Per-segment attribution: router decision/failover
+                # overhead vs wire+serialization vs the replica's own
+                # queue/batch/execute partition.
+                seg = {"router_s": round(
+                    rsp["dur_s"] - winner_row["dur_s"], 9)}
+                rc = ch.get("replica_chain")
+                if rc and rc.get("total_s") is not None:
+                    seg["wire_s"] = round(
+                        (winner_row["lat_s"] or winner_row["dur_s"])
+                        - rc["total_s"], 9,
+                    )
+                    for k, v in (rc.get("segments") or {}).items():
+                        seg[k] = v
+                ch["segments"] = seg
+        else:
+            failed += 1
+        if ch["hedged"]:
+            hedged += 1
+        if ch["audited"]:
+            audited += 1
+        requests[fr] = ch
+    coverage = (complete / delivered) if delivered else 1.0
+    return {
+        "requests": requests, "delivered": delivered,
+        "complete": complete, "failed": failed, "hedged": hedged,
+        "audited": audited, "coverage": round(coverage, 6),
+    }
+
+
+def _fleet_summary(trace: dict) -> dict | None:
+    """The aggregate's ``fleet`` block (None when the trace has no
+    fleet request spans): chain counts, coverage, and the mean
+    router/wire/replica segment attribution."""
+    chains = fleet_request_chains(trace)
+    if not chains["requests"]:
+        return None
+    seg_tot: dict[str, float] = {}
+    n = 0
+    for ch in chains["requests"].values():
+        if not ch.get("complete"):
+            continue
+        n += 1
+        for k, v in (ch.get("segments") or {}).items():
+            if isinstance(v, (int, float)):
+                seg_tot[k] = seg_tot.get(k, 0.0) + v
+    out = {
+        "total": len(chains["requests"]),
+        "delivered": chains["delivered"],
+        "complete": chains["complete"],
+        "failed": chains["failed"],
+        "hedged": chains["hedged"],
+        "audited": chains["audited"],
+        "coverage": chains["coverage"],
+    }
+    if n:
+        out["mean_segments_ms"] = {
+            k: round(v / n * 1e3, 3) for k, v in sorted(seg_tot.items())
+        }
+    return out
+
+
 def _request_summary(trace: dict) -> dict | None:
     """The aggregate's ``requests`` block (None for non-serving
     traces): chain counts plus mean segment decomposition."""
@@ -396,6 +588,9 @@ def aggregate(trace: dict) -> dict:
     requests = _request_summary(trace)
     if requests:
         summary["requests"] = requests
+    fleet = _fleet_summary(trace)
+    if fleet:
+        summary["fleet"] = fleet
     programs = _program_store_summary(trace["events"])
     if programs:
         summary["program_store"] = programs
@@ -450,6 +645,17 @@ def render(report: dict) -> str:
             f"requests: {req['complete']}/{req['total']} complete chains"
             f" ({req['inconsistent']} inconsistent, "
             f"{req['incomplete']} incomplete, {req['shed']} shed)"
+            + ("; mean " + " ".join(
+                f"{k[:-2]}={v}ms" for k, v in seg.items()) if seg else "")
+        )
+    fl = report.get("fleet")
+    if fl:
+        seg = fl.get("mean_segments_ms") or {}
+        lines.append(
+            f"fleet: {fl['complete']}/{fl['delivered']} delivered chains"
+            f" complete (coverage {fl['coverage']:.3f}; "
+            f"{fl['hedged']} hedged, {fl['audited']} audited, "
+            f"{fl['failed']} failed)"
             + ("; mean " + " ".join(
                 f"{k[:-2]}={v}ms" for k, v in seg.items()) if seg else "")
         )

@@ -129,6 +129,13 @@ KNOBS: dict[str, Knob] = {k.name: k for k in [
     _K("DSDDMM_FLEET_REPLICAS", "int", "2",
        "`bench fleet` serve-role replica count when --replicas is "
        "unset (bench/cli.py)"),
+    _K("DSDDMM_FLEET_TRACE", "spec", "off",
+       "`bench fleet` distributed tracing: 1 (default trace dir) or an "
+       "explicit trace path; replicas shard, the run merges one "
+       "causal tree and records fleet trace coverage"),
+    _K("DSDDMM_FLEET_TRACE_DEBUG", "int", "64",
+       "front router: recent fleet request chains kept live for the "
+       "/debug/requests surface (fleet/router.py)"),
     _K("DSDDMM_FLIGHTREC", "spec", "off",
        "anomaly-triggered flight recorder: 1 or a dump directory"),
     _K("DSDDMM_GUARD_MODE", "str", "raise",

@@ -18,7 +18,13 @@ must hold:
 * nothing lost, warm respawns only, availability above the floor —
   the PR-16 contract holds under a much nastier schedule;
 * the same seed reproduces the same timeline: the recorded chaos
-  events replay the schedule this script re-derives locally.
+  events replay the schedule this script re-derives locally;
+* (PR 19) fleet-wide tracing rides along: the run's harvested trace
+  shards merge into one causal tree, every DELIVERED reply
+  reconstructs a complete router→attempt→replica chain whose winning
+  span agrees with the router's recorded latency within 1 ms
+  (``fleet.trace.coverage == 1.0``), and ``bench report-trace`` holds
+  its 0/2 exit contract on the merged trace.
 
 Usage::
 
@@ -31,6 +37,8 @@ Prints one JSON report; exit 0 when every check passes, 2 otherwise
 from __future__ import annotations
 
 import argparse
+import contextlib
+import io
 import json
 import os
 import pathlib
@@ -62,6 +70,12 @@ def check_chaos_drill(tmp: pathlib.Path) -> dict:
     from distributed_sddmm_tpu.bench.cli import main as bench_main
     from distributed_sddmm_tpu.obs.regress import phase_stats
     from distributed_sddmm_tpu.resilience.chaos import ChaosSchedule
+
+    # Arm fleet-wide tracing for the drill (the tier-1 test scrubs the
+    # environment, so the knob must be set HERE): router + replicas
+    # shard into the path's sibling dir, the run merges them and
+    # records chain coverage in the fleet record.
+    os.environ["DSDDMM_FLEET_TRACE"] = str(tmp / "fleet_trace.jsonl")
 
     out = tmp / "chaos.json"
     rc = bench_main([
@@ -99,6 +113,40 @@ def check_chaos_drill(tmp: pathlib.Path) -> dict:
         and fleet.get("chaos_seed") == SEED
     )
 
+    # PR-19 tracing leg: the merged trace explains every delivered
+    # reply — one complete cross-process chain each, the winning
+    # attempt's span agreeing with the router's recorded latency
+    # within 1 ms — and `report-trace` keeps its 0/2 exit contract
+    # (0 on the schema-valid merged trace, 2 on a violated copy).
+    trace_info = fleet.get("trace") or {}
+    merged_path = trace_info.get("merged_path")
+    rc_report = rc_bad = None
+    report_text = ""
+    if merged_path:
+        buf = io.StringIO()
+        with contextlib.redirect_stdout(buf):
+            rc_report = bench_main(["report-trace", str(merged_path)])
+        report_text = buf.getvalue()
+        bad = tmp / "violated_trace.jsonl"
+        bad.write_text(pathlib.Path(merged_path).read_text()
+                       + '{"type": "span", "name": "torn"}\n')
+        with contextlib.redirect_stdout(io.StringIO()), \
+                contextlib.redirect_stderr(io.StringIO()):
+            rc_bad = bench_main(["report-trace", str(bad)])
+    trace_ok = bool(
+        trace_info.get("coverage") == 1.0
+        and (trace_info.get("delivered") or 0) > 0
+        and trace_info.get("complete") == trace_info.get("delivered")
+        # Router shard + one per replica (at least the three seeds).
+        and (trace_info.get("shards") or 0) >= 3
+        and (trace_info.get("fleet_links") or 0) > 0
+        and rc_report == 0
+        and rc_bad == 2
+        and "fleet:" in report_text
+        # The zero-tolerance coverage axis is derived from the record.
+        and "fleet:trace_coverage" in axes
+    )
+
     detection = fleet.get("detection") or []
     return {
         "name": "chaos-drill",
@@ -129,6 +177,7 @@ def check_chaos_drill(tmp: pathlib.Path) -> dict:
             # and the hedge telemetry are derived record phases.
             and "fleet:audit_mismatch" in axes
             and "fleet:availability" in axes
+            and trace_ok
         ),
         "exit_code": rc,
         "chaos": fleet.get("chaos"),
@@ -148,6 +197,13 @@ def check_chaos_drill(tmp: pathlib.Path) -> dict:
         "killed": fleet.get("killed"),
         "availability": fleet.get("availability"),
         "replacement_live_compiles": fleet.get("replacement_live_compiles"),
+        "trace_ok": trace_ok,
+        "trace_coverage": trace_info.get("coverage"),
+        "trace_delivered": trace_info.get("delivered"),
+        "trace_shards": trace_info.get("shards"),
+        "trace_fleet_links": trace_info.get("fleet_links"),
+        "report_trace_exit": rc_report,
+        "report_trace_bad_exit": rc_bad,
         "gate_axes": sorted(k for k in axes if k.startswith("fleet:")),
     }
 

@@ -132,6 +132,24 @@ CASES = {
                    "def f(x):\n"
                    "    return x + time.time()  # trace-impure-ok\n"),
     },
+    "trace-propagation": {
+        "path": f"{PKG}/fleet/x.py",
+        "clean": ("from distributed_sddmm_tpu.obs.httpexp import "
+                  "post_json\n"
+                  "def f(port, body, hdr):\n"
+                  "    return post_json('127.0.0.1', port, '/submit', "
+                  "body, headers=hdr)\n"),
+        "bad": ("from distributed_sddmm_tpu.obs.httpexp import "
+                "post_json\n"
+                "def f(port, body):\n"
+                "    return post_json('127.0.0.1', port, '/submit', "
+                "body)\n"),
+        "tagged": ("from distributed_sddmm_tpu.obs.httpexp import "
+                   "post_json\n"
+                   "def f(port, body):\n"
+                   "    return post_json('127.0.0.1', port, '/healthz', "
+                   "body)  # no-trace-ctx\n"),
+    },
     "raw-collective": {
         "path": f"{PKG}/parallel/x.py",
         "clean": ("from distributed_sddmm_tpu.parallel.loops import "
@@ -427,7 +445,7 @@ def test_registry_covers_the_six_disciplines():
     assert set(analysis.CHECKERS) == {
         "bare-print", "monotonic-clock", "export-completeness",
         "atomic-write", "env-knob", "lock-discipline", "key-grammar",
-        "trace-purity", "raw-collective",
+        "trace-purity", "raw-collective", "trace-propagation",
     }
 
 

@@ -57,8 +57,20 @@ def test_chaos_smoke_script(tmp_path):
     assert drill["availability"] >= 0.9
     # Same seed, same timeline: the run replayed the local derivation.
     assert drill["timeline_ok"] is True
-    # The zero-tolerance gate axis is derived from the record.
+    # The zero-tolerance gate axes are derived from the record.
     assert "fleet:audit_mismatch" in drill["gate_axes"]
+    # PR-19: fleet-wide tracing rode along under the full four-fault
+    # schedule — every delivered reply reconstructs one complete
+    # cross-process chain (winning span within 1 ms of the router's
+    # recorded latency), and report-trace held its 0/2 exit contract.
+    assert drill["trace_ok"] is True
+    assert drill["trace_coverage"] == 1.0
+    assert drill["trace_delivered"] > 0
+    assert drill["trace_shards"] >= 3
+    assert drill["trace_fleet_links"] > 0
+    assert drill["report_trace_exit"] == 0
+    assert drill["report_trace_bad_exit"] == 2
+    assert "fleet:trace_coverage" in drill["gate_axes"]
 
 
 def test_exit_code_contract():

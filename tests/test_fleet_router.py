@@ -52,9 +52,10 @@ class StubReplica:
         }
 
     def _submit(self, payload, tenant="default", serial=False,
-                timeout_s=30.0):
+                timeout_s=30.0, trace_ctx=None):
         self.submits.append(
-            {"payload": payload, "tenant": tenant, "serial": serial}
+            {"payload": payload, "tenant": tenant, "serial": serial,
+             "trace_ctx": trace_ctx}
         )
         if self.delay_s:
             time.sleep(self.delay_s)
@@ -518,3 +519,52 @@ class TestChaosHook:
         assert not a.submits  # dropped before the wire
         st = {s.name: s for s in router.states()}
         assert st["a"].strikes == 1  # chaos drops strike the breaker
+
+
+class TestFleetTracing:
+    """PR-19: the routing decision crosses `POST /submit` as the
+    ``X-DSDDMM-Trace`` header — the replica's AdminServer decodes it
+    and hands the fleet context to its submit_fn — and the router
+    keeps its recent request chains live for ``/debug/requests``."""
+
+    def test_trace_ctx_reaches_replica_submit(self, pool):
+        rep = pool("r0")
+        router = _router(rep)
+        reply = router.route({"q": [1]})
+        assert reply["by"] == "r0"
+        (sub,) = rep.submits
+        ctx = sub["trace_ctx"]
+        assert ctx is not None
+        assert ctx["kind"] == "primary" and ctx["ord"] == 0
+        assert ctx["req"]  # the router minted a fleet request id
+
+    def test_upstream_request_id_is_reused(self, pool):
+        """A chained router reuses the upstream fleet request id, so
+        stacked tiers stay one causal tree."""
+        rep = pool("r0")
+        router = _router(rep)
+        router.route({"q": [1]}, trace_ctx={"req": "up-77"})
+        assert rep.submits[0]["trace_ctx"]["req"] == "up-77"
+
+    def test_debug_chains_records_the_decision(self, pool):
+        rep = pool("r0")
+        router = _router(rep)
+        router.route({"q": [1]})
+        dbg = router.debug_chains()
+        assert dbg["router"] is True and dbg["complete"] == 1
+        (row,) = dbg["requests"]
+        assert row["outcome"] == "ok" and row["winner"] == "r0"
+        assert row["fleet_req"] == rep.submits[0]["trace_ctx"]["req"]
+        primary = [a for a in row["attempts"] if a["kind"] == "primary"]
+        assert primary and primary[0]["replica"] == "r0"
+        assert primary[0]["outcome"] == "ok"
+        assert primary[0]["lat_s"] >= 0
+
+    def test_shed_request_chain_keeps_the_hint(self, pool):
+        full = pool("full", shed_after=1.5)
+        router = _router(full)
+        with pytest.raises(ShedError):
+            router.route({"q": [1]})
+        (row,) = router.debug_chains()["requests"]
+        assert row["outcome"] == "shed"
+        assert row["retry_after_s"] == pytest.approx(1.5)

@@ -319,3 +319,44 @@ class TestReportTraceExit:
         )
         assert cli.main(["report-trace", str(p), "--no-strict"]) == 0
         capsys.readouterr()
+
+
+def make_trace_doc(run_id, *, coverage=1.0, delivered=50):
+    doc = make_fleet_doc(run_id)
+    doc["record"]["fleet"]["trace"] = {
+        "coverage": coverage, "delivered": delivered,
+        "complete": int(round(coverage * delivered)),
+    }
+    return doc
+
+
+class TestTraceCoverageAxis:
+    """PR-19 gate axis: ``fleet:trace_coverage`` is the SECOND
+    zero-tolerance hard axis — a delivered reply whose merged fleet
+    trace cannot reconstruct a complete router→attempt→replica chain
+    is a lost-observability event, not noise."""
+
+    def test_full_coverage_is_clean(self):
+        rep = regress.compare(make_trace_doc("b"),
+                              doc_a=make_trace_doc("a"))
+        assert rep["verdict"] == "ok"
+        assert rep["phases"]["fleet:trace_coverage"]["verdict"] == "ok"
+
+    def test_coverage_loss_is_hard_regression(self):
+        rep = regress.compare(make_trace_doc("b", coverage=0.98),
+                              doc_a=make_trace_doc("a"))
+        assert rep["verdict"] == "regression"
+        assert "fleet:trace_coverage" in rep["regressions"]
+        row = rep["phases"]["fleet:trace_coverage"]
+        assert row["hard_axis"] is True
+        assert row["attribution"] == "fleet"
+
+    def test_coverage_loss_regresses_even_without_baseline(self):
+        rep = regress.compare(make_trace_doc("b", coverage=0.5),
+                              doc_a=make_doc("a"))
+        assert "fleet:trace_coverage" in rep["regressions"]
+
+    def test_untraced_fleet_doc_grows_no_axis(self):
+        rep = regress.compare(make_fleet_doc("b"),
+                              doc_a=make_fleet_doc("a"))
+        assert "fleet:trace_coverage" not in rep["phases"]

@@ -277,3 +277,44 @@ class TestStoreColumns:
         wd2.check_xla_costs(metrics, {"fusedSpMM": {
             "flops_per_call": 1.1e9}})
         assert wd2.events == []
+
+
+class TestRenderTopFleet:
+    """PR-19: `bench top` pointed at a front ROUTER snapshot (tagged
+    ``router: true``) renders the fleet view — replica health/breaker
+    table + routing/hedge/audit counters — not the engine view."""
+
+    def _router_snapshot(self):
+        return {
+            "router": True,
+            "hedge_delay_s": 0.25,
+            "audit_frac": 0.1,
+            "replicas": [
+                {"name": "r0", "ready": True, "breaker": "closed",
+                 "depth_frac": 0.25, "burn": 0.5, "strikes": 0,
+                 "inner_buckets": [64]},
+                {"name": "r1", "ready": False, "draining": True,
+                 "breaker": "open", "depth_frac": 1.0, "burn": None,
+                 "strikes": 3, "inner_buckets": [64]},
+            ],
+            "stats": {"routed": 10, "serial_routed": 1, "failovers": 2,
+                      "decode_failovers": 0, "hedges": 3,
+                      "hedge_wins": 1, "audits": 4,
+                      "audit_mismatches": 0, "edge_sheds": 0,
+                      "replica_sheds_seen": 1, "breaker_opens": 1,
+                      "quarantines": 0},
+            "manager": {"replicas": 2, "spawns": 3, "losses": 1,
+                        "quarantines": 0, "trace_shards": 2},
+        }
+
+    def test_router_snapshot_renders_fleet_view(self):
+        text = telemetry.render_top([self._router_snapshot()])
+        assert "fleet router" in text
+        assert "r0" in text and "closed" in text
+        assert "drain" in text  # r1 is draining, not just unready
+        assert "routed" in text and "hedges" in text
+        assert "trace_shards=2" in text
+
+    def test_minimal_router_snapshot_does_not_crash(self):
+        text = telemetry.render_top([{"router": True}])
+        assert "fleet router" in text

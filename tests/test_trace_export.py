@@ -228,3 +228,56 @@ class TestMergedRoundTrip:
         assert all(v == 0 for v in depth.values())
         assert chrome["metadata"]["spans"] == len(loaded["spans"])
         assert chrome["metadata"]["request_flows"] == 8
+
+
+class TestFleetFlows:
+    """PR-19: cross-process fleet links become Chrome flow arrows
+    (category ``fleet``) from the router attempt span to the replica
+    records the merge re-parented onto it."""
+
+    def _merged_fleet_trace(self):
+        return {
+            "begin": {"run_id": "merged-x", "t0_epoch": 0.0,
+                      "shards": [{"run_id": "rt"}, {"run_id": "rp"}],
+                      "fleet_links": 2},
+            "spans": [
+                {"type": "span", "name": "fleet:attempt", "id": 2,
+                 "parent": 1, "tid": 1, "t0": 0.0, "t1": 0.3,
+                 "dur_s": 0.3, "shard": "rt",
+                 "attrs": {"fleet_req": "fr-1"}},
+                # In-process nesting preserved: fleet_parent recorded
+                # as an attr only, parent points elsewhere -> NO arrow.
+                {"type": "span", "name": "serve:batch", "id": 5,
+                 "parent": 4, "tid": 1, "t0": 0.1, "t1": 0.2,
+                 "dur_s": 0.1, "shard": "rp",
+                 "attrs": {"fleet_parent": 2}},
+            ],
+            "events": [
+                # True re-parent point (parent == fleet_parent): arrow.
+                {"type": "event", "name": "serve:enqueue", "id": 4,
+                 "parent": 2, "tid": 1, "t": 0.05, "shard": "rp",
+                 "attrs": {"req": 0, "fleet_req": "fr-1",
+                           "fleet_parent": 2}},
+            ],
+            "errors": [],
+        }
+
+    def test_fleet_links_become_flow_arrows(self):
+        chrome = traceexport.to_chrome(self._merged_fleet_trace())
+        flows = [e for e in chrome["traceEvents"]
+                 if e.get("cat") == "fleet"]
+        # One s/f pair for the enqueue re-parent, none for the batch
+        # span whose parent is in-process.
+        assert [e["ph"] for e in sorted(flows, key=lambda e: e["ts"])] \
+            == ["s", "f"]
+        assert all(e["args"]["fleet_req"] == "fr-1" for e in flows)
+        assert chrome["metadata"]["fleet_flows"] == 1
+
+    def test_untraced_fleet_metadata_zero(self):
+        doc = self._merged_fleet_trace()
+        for r in doc["spans"] + doc["events"]:
+            r["attrs"].pop("fleet_parent", None)
+        chrome = traceexport.to_chrome(doc)
+        assert chrome["metadata"]["fleet_flows"] == 0
+        assert not [e for e in chrome["traceEvents"]
+                    if e.get("cat") == "fleet"]

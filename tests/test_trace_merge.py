@@ -231,3 +231,216 @@ class TestCLIRoundTrip:
         bad = tmp_path / "bad.jsonl"
         bad.write_text("garbage\n")
         assert cli.main(["trace-merge", str(bad)]) == 2
+
+
+# --------------------------------------------------------------------- #
+# Fleet tracing (PR 19): wire context, cross-shard links, chains
+# --------------------------------------------------------------------- #
+
+
+def _raw_shard(path, run_id, t0_epoch, pid, records):
+    recs = [{"type": "begin", "schema": 1, "run_id": run_id,
+             "t0_epoch": t0_epoch, "pid": pid}] + list(records)
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text("".join(json.dumps(r) + "\n" for r in recs))
+    return path
+
+
+def _router_shard(path, lat_s=0.2998, outcome="ok"):
+    """Router-side shard: a fleet:request span, its caller-thread
+    primary attempt, and a parentless side-thread hedge attempt that
+    names its request via ``fleet_span`` (own shard)."""
+    return _raw_shard(path, "rt", 1000.0, 11, [
+        {"type": "span", "name": "fleet:request", "id": 1, "parent": None,
+         "tid": 1, "t0": 0.0, "t1": 0.5, "dur_s": 0.5,
+         "attrs": {"fleet_req": "fr-1", "tenant": "default",
+                   "outcome": outcome, "winner": "r0", "serial": False}},
+        {"type": "span", "name": "fleet:attempt", "id": 2, "parent": 1,
+         "tid": 1, "t0": 0.01, "t1": 0.31, "dur_s": 0.3,
+         "attrs": {"fleet_req": "fr-1", "replica": "r0",
+                   "kind": "primary", "ordinal": 0, "outcome": "ok",
+                   "lat_s": lat_s}},
+        {"type": "span", "name": "fleet:attempt", "id": 3, "parent": None,
+         "tid": 2, "t0": 0.05, "t1": 0.25, "dur_s": 0.2,
+         "attrs": {"fleet_req": "fr-1", "fleet_span": 1,
+                   "replica": "r1", "kind": "hedge", "ordinal": 0,
+                   "outcome": "hedge_loser"}},
+    ])
+
+
+def _replica_shard(path):
+    """Replica-side shard (clock origin 2.5s later): the enqueue and
+    reply events carry the fleet context decoded off the submit
+    header — ``fleet_shard``/``fleet_span`` name the router attempt."""
+    return _raw_shard(path, "rp", 1002.5, 22, [
+        {"type": "event", "name": "serve:enqueue", "id": 1,
+         "parent": None, "tid": 1, "t": 0.02,
+         "attrs": {"req": "q7", "tenant": "default", "fleet_req": "fr-1",
+                   "fleet_shard": "rt", "fleet_span": 2}},
+        {"type": "span", "name": "serve:batch", "id": 2, "parent": None,
+         "tid": 1, "t0": 0.05, "t1": 0.25, "dur_s": 0.2,
+         "attrs": {"req_ids": ["q7"]}},
+        {"type": "event", "name": "serve:reply", "id": 3, "parent": 2,
+         "tid": 1, "t": 0.25,
+         "attrs": {"req": "q7", "fleet_req": "fr-1", "fleet_shard": "rt",
+                   "fleet_span": 2, "t_enqueue": 0.02, "t_reply": 0.25,
+                   "queue_s": 0.03, "batch_wait_s": 0.0,
+                   "execute_s": 0.2, "total_s": 0.23}},
+    ])
+
+
+class TestFleetCtxHeader:
+    def test_roundtrip(self):
+        ctx = {"req": "fr-9", "shard": "rt", "span": 17, "kind": "hedge",
+               "ord": 2}
+        assert trace.decode_fleet_ctx(trace.encode_fleet_ctx(ctx)) == ctx
+
+    def test_none_fields_omitted(self):
+        hdr = trace.encode_fleet_ctx({"req": "fr-1", "span": None})
+        assert "span" not in hdr
+        assert trace.decode_fleet_ctx(hdr) == {"req": "fr-1"}
+
+    def test_garbage_and_missing_req_decode_to_none(self):
+        assert trace.decode_fleet_ctx(None) is None
+        assert trace.decode_fleet_ctx("") is None
+        assert trace.decode_fleet_ctx("zzz") is None
+        assert trace.decode_fleet_ctx("v2;req=x") is None  # unknown ver
+        assert trace.decode_fleet_ctx("v1;shard=rt") is None  # no req
+
+    def test_bad_int_field_dropped_not_fatal(self):
+        got = trace.decode_fleet_ctx("v1;req=fr-1;span=abc;ord=3")
+        assert got == {"req": "fr-1", "ord": 3}
+
+
+class TestFleetLinks:
+    def test_cross_shard_enqueue_reparented_onto_attempt(self, tmp_path):
+        merged = tracemerge.merge([
+            _router_shard(tmp_path / "rt.jsonl"),
+            _replica_shard(tmp_path / "rp.jsonl"),
+        ])
+        sp = {s["name"]: s for s in merged["spans"]
+              if s["name"] != "fleet:attempt"}
+        att = {s["attrs"]["kind"]: s for s in merged["spans"]
+               if s["name"] == "fleet:attempt"}
+        ev = {e["name"]: e for e in merged["events"]}
+        # The replica's enqueue (no in-process parent) re-parents onto
+        # the router's attempt span across shards.
+        assert ev["serve:enqueue"]["parent"] == att["primary"]["id"]
+        assert (ev["serve:enqueue"]["attrs"]["fleet_parent"]
+                == att["primary"]["id"])
+        # serve:reply keeps its in-process nesting under serve:batch —
+        # the link is recorded as an attr only.
+        assert ev["serve:reply"]["parent"] == sp["serve:batch"]["id"]
+        assert (ev["serve:reply"]["attrs"]["fleet_parent"]
+                == att["primary"]["id"])
+        # The side-thread hedge attempt re-parents onto its request
+        # span within its OWN shard (no fleet_shard attr).
+        assert att["hedge"]["parent"] == sp["fleet:request"]["id"]
+        assert merged["begin"]["fleet_links"] == 3
+
+    def test_skewed_origins_ids_disjoint_and_links_precise(self, tmp_path):
+        # Both shards use original span id 1 — the per-shard spanmap
+        # must resolve the hedge's fleet_span=1 to the ROUTER's request
+        # span, never the replica's record that reused the id.
+        merged = tracemerge.merge([
+            _router_shard(tmp_path / "rt.jsonl"),
+            _replica_shard(tmp_path / "rp.jsonl"),
+        ])
+        ids = [r["id"] for r in merged["spans"] + merged["events"]]
+        assert len(ids) == len(set(ids))
+        req = next(s for s in merged["spans"]
+                   if s["name"] == "fleet:request")
+        hedge = next(s for s in merged["spans"]
+                     if s["attrs"].get("kind") == "hedge")
+        assert hedge["parent"] == req["id"] and req["shard"] == "rt"
+        # The replica's records shifted by the +2.5s origin skew.
+        enq = next(e for e in merged["events"]
+                   if e["name"] == "serve:enqueue")
+        assert enq["t"] == pytest.approx(2.52)
+        # write_merged revalidates: the rewrite produced a valid trace.
+        out, _ = tracemerge.write_merged(
+            [tmp_path / "rt.jsonl", tmp_path / "rp.jsonl"],
+            tmp_path / "m.jsonl",
+        )
+        assert tracereport.load_trace(out, strict=True)["errors"] == []
+
+    def test_unresolvable_fleet_link_left_alone(self, tmp_path):
+        a = _raw_shard(tmp_path / "a.jsonl", "ra", 1.0, 1, [
+            {"type": "event", "name": "serve:enqueue", "id": 1,
+             "parent": None, "tid": 1, "t": 0.1,
+             "attrs": {"req": "q1", "fleet_req": "fr-1",
+                       "fleet_shard": "nope", "fleet_span": 99}},
+        ])
+        merged = tracemerge.merge([a])
+        ev = merged["events"][0]
+        assert ev["parent"] is None
+        assert "fleet_parent" not in ev["attrs"]
+        assert merged["begin"]["fleet_links"] == 0
+
+
+class TestFleetChains:
+    def _merged(self, tmp_path, **router_kw):
+        return tracemerge.merge([
+            _router_shard(tmp_path / "rt.jsonl", **router_kw),
+            _replica_shard(tmp_path / "rp.jsonl"),
+        ])
+
+    def test_complete_chain_full_coverage(self, tmp_path):
+        chains = tracereport.fleet_request_chains(self._merged(tmp_path))
+        assert chains["delivered"] == 1 and chains["complete"] == 1
+        assert chains["coverage"] == 1.0 and chains["hedged"] == 1
+        ch = chains["requests"]["fr-1"]
+        assert ch["complete"] and ch["winner"] == "r0"
+        kinds = [r["kind"] for r in ch["attempts"]]
+        assert kinds == ["primary", "hedge"]
+        # Segment attribution: router overhead + wire + the replica's
+        # own queue/batch/execute partition.
+        assert ch["segments"]["router_s"] == pytest.approx(0.2)
+        assert ch["segments"]["wire_s"] == pytest.approx(0.0698)
+        assert ch["replica_chain"]["segments"]["execute_s"] == 0.2
+
+    def test_lat_disagreement_breaks_coverage(self, tmp_path):
+        # Router recorded 200ms but the span measured 300ms: the >1ms
+        # disagreement means the trace no longer explains the latency
+        # the router acted on — the chain must NOT count as complete.
+        chains = tracereport.fleet_request_chains(
+            self._merged(tmp_path, lat_s=0.2)
+        )
+        assert chains["delivered"] == 1 and chains["complete"] == 0
+        assert chains["coverage"] == 0.0
+
+    def test_failed_request_is_annotated_not_counted(self, tmp_path):
+        chains = tracereport.fleet_request_chains(
+            self._merged(tmp_path, outcome="error")
+        )
+        assert chains["delivered"] == 0 and chains["failed"] == 1
+        assert chains["coverage"] == 1.0  # nothing delivered = clean
+
+    def test_serial_tier_needs_no_replica_chain(self, tmp_path):
+        a = _raw_shard(tmp_path / "rt.jsonl", "rt", 1.0, 1, [
+            {"type": "span", "name": "fleet:request", "id": 1,
+             "parent": None, "tid": 1, "t0": 0.0, "t1": 0.4, "dur_s": 0.4,
+             "attrs": {"fleet_req": "fr-2", "tenant": "default",
+                       "outcome": "ok", "winner": "r0", "serial": True}},
+            {"type": "span", "name": "fleet:attempt", "id": 2,
+             "parent": 1, "tid": 1, "t0": 0.01, "t1": 0.31, "dur_s": 0.3,
+             "attrs": {"fleet_req": "fr-2", "replica": "r0",
+                       "kind": "primary", "ordinal": 0, "outcome": "ok",
+                       "lat_s": 0.2999}},
+        ])
+        chains = tracereport.fleet_request_chains(tracemerge.merge([a]))
+        assert chains["coverage"] == 1.0
+        assert chains["requests"]["fr-2"]["complete"]
+
+    def test_aggregate_and_render_carry_fleet_block(self, tmp_path):
+        out, _ = tracemerge.write_merged(
+            [_router_shard(tmp_path / "rt.jsonl"),
+             _replica_shard(tmp_path / "rp.jsonl")],
+            tmp_path / "m.jsonl",
+        )
+        trace_doc = tracereport.load_trace(out, strict=True)
+        report = tracereport.aggregate(trace_doc)
+        fl = report["fleet"]
+        assert fl["coverage"] == 1.0 and fl["delivered"] == 1
+        assert "router_s" in fl["mean_segments_ms"]
+        assert "fleet" in tracereport.render(report)
