@@ -29,6 +29,7 @@ import hashlib
 import json
 import pathlib
 
+from distributed_sddmm_tpu.utils import buckets
 from distributed_sddmm_tpu.utils.buckets import pow2_bucket
 
 _PKG = pathlib.Path(__file__).resolve().parents[1]
@@ -167,13 +168,25 @@ def make_fingerprint(
     backend: str,
     kernels: tuple[str, ...] = ("xla",),
     code: str | None = None,
+    capacity_bucket: bool = False,
 ) -> Fingerprint:
-    """Build the canonical fingerprint for (problem, machine, code)."""
+    """Build the canonical fingerprint for (problem, machine, code).
+
+    ``capacity_bucket=True`` (PR 20, ``dynstruct/``) fingerprints the
+    problem at its pow2 capacity rung instead of its exact nnz, and
+    stamps a mode marker: every pattern whose nnz lands in the same rung
+    shares a fingerprint — the plan-reuse granularity of a bucketed
+    build, whose compiled programs are sized to the rung, not the
+    pattern. Default off keeps every field (and hence every existing
+    plan key) byte-identical, and the marker means a bucketed
+    fingerprint can never collide with an exact one.
+    """
     fields = (
         ("fingerprint_version", FINGERPRINT_VERSION),
         ("M", problem.M),
         ("N", problem.N),
-        ("nnz", problem.nnz),
+        ("nnz", buckets.pow2_at_least(problem.nnz)
+         if capacity_bucket else problem.nnz),
         ("npr_bucket", problem.npr_bucket),
         ("R", problem.R),
         ("dtype", problem.dtype),
@@ -182,6 +195,8 @@ def make_fingerprint(
         ("kernels", tuple(sorted(kernels))),
         ("code_hash", code if code is not None else code_hash()),
     )
+    if capacity_bucket:
+        fields += (("capacity_mode", "pow2"),)
     blob = json.dumps(
         [[k, list(v) if isinstance(v, tuple) else v] for k, v in fields],
         separators=(",", ":"),

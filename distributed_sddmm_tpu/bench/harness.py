@@ -345,6 +345,15 @@ def benchmark_algorithm(
         for k in ("program_store_hits", "program_store_misses",
                   "live_compiles")
     }
+    # Dynamic-structure attribution: rebind/spill/retrace deltas for
+    # runs that churn the sparse pattern (dynstruct builds; zero for
+    # static runs, and the record section still appears so the
+    # ``dynstruct:`` gate axes have a denominator).
+    _dyn_before = {
+        k: obs_metrics.GLOBAL.get(k)
+        for k in ("dynstruct_rebinds", "dynstruct_bucket_spills",
+                  "structure_retraces")
+    }
     # XLA-cost cursor: only programs THIS run resolved contribute to
     # its analytic-vs-XLA FLOP cross-check (a sweep's earlier cells
     # compiled at other geometries).
@@ -448,6 +457,10 @@ def benchmark_algorithm(
         "program_store": {
             k: obs_metrics.GLOBAL.get(k) - v
             for k, v in _prog_before.items()
+        },
+        "dynstruct": {
+            k: obs_metrics.GLOBAL.get(k) - v
+            for k, v in _dyn_before.items()
         },
         **app_stats,
         **(extra_info or {}),

@@ -32,9 +32,11 @@ import dataclasses
 import numpy as np
 
 from distributed_sddmm_tpu.ops.blocked import (
-    CHUNK, BlockedMeta, build_blocked, pick_block, pad_frame, unpack_meta,
+    CHUNK, BlockedMeta, build_blocked, pad_chunk_count, pick_block,
+    pad_frame, unpack_meta,
 )
 from distributed_sddmm_tpu.codegen.variants import KernelVariant
+from distributed_sddmm_tpu.utils import buckets
 
 #: Density target for auto-width (``block_cols=0``) bands: widen the
 #: band's column blocks (power-of-two merges of generic blocks, up to
@@ -276,6 +278,15 @@ def build_banded(
             rows_pad, cols_pad,
             block_rows=bms[i], block_cols=bns[i], group=spec.group,
         )
+        # Dyn-capacity builds (PR 20): pad each band's chunk count to a
+        # pow2 rung BEFORE concatenation, so the Band (c0, c1) offsets —
+        # static metadata in the traced program — are quantized and
+        # survive pattern churn within the rung. Body resolution runs on
+        # the padded meta: rung padding adds chunks per group, so
+        # single-step is only provable against the realized count.
+        cap = buckets.dyn_rung(bmeta.n_chunks, multiple=bmeta.group)
+        if cap is not None and cap > bmeta.n_chunks:
+            bmeta = pad_chunk_count(bmeta, cap)
         body = spec.body
         if body in ("batched", "single"):
             body = "single" if _single_step_provable(bmeta) else "batched"

@@ -1,0 +1,58 @@
+"""Capacity policy for dynstruct builds: row rungs and env knobs.
+
+The nnz-side capacities (flat max_nnz, chunk counts, band ranges) are
+consumed inside the tile builders via the ``utils.buckets.dyn_capacity``
+scope; this module owns the ROW side — the declared matrix height is
+itself a capacity, because every dense frame (``M_pad``, local row
+partitions) derives from it and would retrace on ``append_rows``
+growth. A dynstruct build therefore wraps S in a declared-height
+:class:`~distributed_sddmm_tpu.utils.coo.HostCOO` whose ``M`` is the
+row rung; the real row count only matters to the host-side oracle and
+travels in the :class:`~distributed_sddmm_tpu.dynstruct.rebind.DynHandle`.
+"""
+
+from __future__ import annotations
+
+import os
+
+from distributed_sddmm_tpu.utils.buckets import pow2_at_least
+from distributed_sddmm_tpu.utils.coo import HostCOO
+
+
+def default_headroom() -> float:
+    """Capacity headroom multiplier (``DSDDMM_DYNSTRUCT_HEADROOM``):
+    each raw structure requirement is multiplied by this before rung
+    selection. 1.0 relies on pow2 rounding alone for churn slack (none
+    when a requirement is already an exact power of two)."""
+    return float(os.environ.get("DSDDMM_DYNSTRUCT_HEADROOM", "1.0"))
+
+
+def default_grow_rows() -> bool:
+    """Whether builds reserve a row-growth rung by default
+    (``DSDDMM_DYNSTRUCT_ROWS``, default on): the declared height
+    becomes ``pow2_at_least(M + 1)`` so ``append_rows`` growth rebinds
+    instead of spilling. Off sizes frames to the exact M — right for
+    matrices that only churn edges, never grow rows."""
+    return os.environ.get("DSDDMM_DYNSTRUCT_ROWS", "1") != "0"
+
+
+def row_capacity(m: int, grow: bool = True) -> int:
+    """The declared-height rung for a matrix with ``m`` real rows.
+
+    ``grow=True`` guarantees strict slack above ``m`` (a power-of-two
+    ``m`` jumps to the next rung — otherwise the commonest benchmark
+    heights would spill on their first appended row); ``grow=False``
+    keeps the exact height.
+    """
+    return pow2_at_least(int(m) + 1) if grow else int(m)
+
+
+def with_row_capacity(S: HostCOO, row_cap: int) -> HostCOO:
+    """``S`` re-declared at height ``row_cap`` (same triplets, shared
+    arrays). The extra rows are structurally empty — every tile builder
+    already handles rows with no nonzeros."""
+    if row_cap < S.M:
+        raise ValueError(
+            f"row capacity {row_cap} below the matrix height {S.M}"
+        )
+    return HostCOO(S.rows, S.cols, S.vals, int(row_cap), S.N)

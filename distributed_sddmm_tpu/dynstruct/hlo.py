@@ -1,0 +1,133 @@
+"""Offline structural gate for dynamic structure (PR 20).
+
+``test_codegen_gate.py``-style evidence, for the dynstruct claim: one
+compiled module serves two DIFFERENT patterns of the same capacity
+bucket. A dynstruct-built strategy is AOT-compiled for a real v5e
+topology (``jax.experimental.topologies`` — no chips needed, the
+``codegen/hlo.py`` retarget pattern), its pattern is mutated by
+``append_rows`` growth and rebound with :func:`dynstruct.rebind`
+(which must FIT — same rungs), and the program is AOT-compiled again:
+the two scheduled modules must be byte-identical and share one program
+cache key carrying the ``cap=`` capacity segment — structure moved as
+*data*, the *code* did not change. The committed ``DYNSTRUCT_HLO.json``
+is this probe's banked record; a third, exact (non-dynstruct) build of
+the same pattern pins the key-aliasing rule: its key has no ``cap=``
+segment and never collides with the bucketed key.
+
+Environment note (same as the other gates): on machines without TPU
+instance metadata export ``TPU_SKIP_MDS_QUERY=1`` before first
+jax/libtpu init or the topology lookup stalls in metadata retries.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+
+import numpy as np
+
+from distributed_sddmm_tpu.codegen.hlo import (
+    _aot_compile_ops,
+    _topology,
+    count_pallas_calls,
+)
+
+
+def _grown(S, n_rows: int, seed: int):
+    """``S`` plus ``n_rows`` appended sparse rows — a genuinely
+    different pattern (more rows, more nonzeros) meant to land in the
+    same capacity bucket."""
+    from distributed_sddmm_tpu.utils.coo import HostCOO
+
+    S2 = HostCOO(
+        S.rows.copy(), S.cols.copy(), S.vals.copy(), S.M, S.N
+    )
+    rng = np.random.default_rng(seed)
+    for _ in range(n_rows):
+        n = int(rng.integers(1, 4))
+        cols = rng.choice(S.N, size=n, replace=False).astype(np.int64)
+        S2.append_rows([cols], [rng.standard_normal(n)], mode="repair")
+    return S2
+
+
+def _fused_args(alg):
+    from distributed_sddmm_tpu.common import MatMode
+
+    vals = alg.like_s_values(1.0)
+    return (
+        alg.dummy_initialize(MatMode.A),
+        alg.dummy_initialize(MatMode.B),
+        *alg._tile_args(alg.S_tiles, vals),
+    )
+
+
+def dynstruct_hlo_report(
+    topology_name: str = "v5e:2x4",
+    log_m: int = 9,
+    edge_factor: int = 4,
+    R: int = 128,
+    c: int = 1,
+    grow_rows: int = 3,
+    output_file: str | None = None,
+) -> dict:
+    """Compile one dynstruct-built fused program for a TPU topology,
+    rebind a grown pattern into it, compile again, and report whether
+    the two modules (and their cache keys) are identical.
+    """
+    import jax
+
+    from distributed_sddmm_tpu import dynstruct
+    from distributed_sddmm_tpu.utils.coo import HostCOO
+
+    topo = _topology(topology_name, len(jax.devices()))
+
+    S1 = HostCOO.rmat(log_m=log_m, edge_factor=edge_factor, seed=0)
+    S2 = _grown(S1, grow_rows, seed=1)
+
+    alg = dynstruct.build(
+        "15d_fusion2", S1, R, c, headroom=2.0, grow_rows=True
+    )
+    key1 = ":".join(str(s) for s in alg._program_cache_key("fused", False))
+    caps1 = alg._dynstruct.floors
+    hlo1 = _aot_compile_ops(alg, _fused_args(alg), topo, ("fused",))["fused"]
+
+    update = dynstruct.rebind(alg, S2)
+    key2 = ":".join(str(s) for s in alg._program_cache_key("fused", False))
+    hlo2 = _aot_compile_ops(alg, _fused_args(alg), topo, ("fused",))["fused"]
+
+    # The exact-structure control: a static build of the SAME pattern
+    # must key WITHOUT the capacity segment — bucketed keys never alias
+    # exact ones.
+    from distributed_sddmm_tpu.bench.harness import make_algorithm
+
+    exact = make_algorithm("15d_fusion2", S1, R, c)
+    key_exact = ":".join(
+        str(s) for s in exact._program_cache_key("fused", False)
+    )
+
+    record = {
+        "experiment": "dynstruct-hlo",
+        "topology": topology_name,
+        "p": alg.p,
+        "R": R,
+        "c": c,
+        "pattern_a": {"M": S1.M, "nnz": S1.nnz},
+        "pattern_b": {"M": S2.M, "nnz": S2.nnz},
+        "caps": list(caps1),
+        "row_cap": alg._dynstruct.row_cap,
+        "rebind_fit": bool(update.fit),
+        "key_has_cap_segment": "cap=" in key1,
+        "keys_identical": key1 == key2,
+        "exact_key_has_cap_segment": "cap=" in key_exact,
+        "exact_key_aliases_bucketed": key_exact == key1,
+        "module_sha256_a": hashlib.sha256(hlo1.encode()).hexdigest()[:16],
+        "module_sha256_b": hashlib.sha256(hlo2.encode()).hexdigest()[:16],
+        "modules_identical": hlo1 == hlo2,
+        "pallas_calls": count_pallas_calls(hlo1),
+        "is_scheduled": "is_scheduled=true" in hlo1,
+    }
+    if output_file:
+        # non-atomic-ok: append-only record stream (the -o contract).
+        with open(output_file, "a") as f:
+            f.write(json.dumps(record) + "\n")
+    return record

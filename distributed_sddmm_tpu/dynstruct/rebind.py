@@ -1,0 +1,234 @@
+"""The host-side rebind path: ``rebind(S') -> StructureUpdate``.
+
+:func:`build` constructs a named strategy under a capacity scope and
+stamps a :class:`DynHandle` (the build recipe + realized rungs) on it;
+:func:`rebind` re-derives every chunk list and band assignment for a
+mutated pattern — a pure host-side rebuild, no traces — and, when the
+new structure lands in the same rungs, swaps the fresh tile state into
+the EXISTING strategy object while keeping its compiled-program cache.
+The structure arrays are program inputs, so the very next op call runs
+the already-traced, already-compiled program against the new pattern:
+zero retraces, counted as ``dynstruct_rebinds``.
+
+A pattern that outgrows any rung spills: the fresh build (at the next
+rungs) replaces the old strategy wholesale, its programs warm from the
+ProgramStore when a binder is attached, and the event counts a
+``dynstruct_bucket_spills`` plus a ``structure_retraces`` — the
+currency the ``dynstruct:rebind`` gate axis and the structure-churn
+smoke watch.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from distributed_sddmm_tpu.dynstruct.capacity import (
+    default_grow_rows,
+    default_headroom,
+    row_capacity,
+    with_row_capacity,
+)
+from distributed_sddmm_tpu.utils.buckets import dyn_capacity
+from distributed_sddmm_tpu.utils.coo import HostCOO
+
+#: Strategy state that survives a fit rebind: the compiled-program
+#: cache and its store binder (the whole point of rebinding), the
+#: cumulative op metrics, and the dynstruct handle itself.
+_KEEP_ON_REBIND = ("_programs", "_program_binder", "metrics", "_dynstruct")
+
+
+@dataclasses.dataclass(frozen=True)
+class DynHandle:
+    """The build recipe + realized capacities of a dynstruct strategy —
+    everything :func:`rebind` needs to reproduce the build against a
+    mutated pattern."""
+
+    name: str
+    R: int
+    c: int
+    kw: dict
+    headroom: float
+    grow_rows: bool
+    row_cap: int
+    true_m: int
+    n: int
+    floors: tuple  # realized capacity rungs, in build (ordinal) order
+
+
+@dataclasses.dataclass(frozen=True)
+class StructureUpdate:
+    """Outcome of one :func:`rebind`. ``alg`` is the SAME object that
+    was passed in on a fit (rebound in place) and the replacement
+    strategy on a spill — callers serving through a reference they own
+    must re-point it when ``fit`` is False."""
+
+    fit: bool
+    alg: object
+    nnz_before: int
+    nnz_after: int
+    row_cap: int
+    caps: tuple
+    reason: str | None = None
+
+    @property
+    def spilled(self) -> bool:
+        return not self.fit
+
+
+def note_rebind(fit: bool) -> None:
+    """Count one structure change: a fit is a ``dynstruct_rebinds``; a
+    spill is a ``dynstruct_bucket_spills`` AND a ``structure_retraces``
+    (the replacement's programs must be traced — against the store they
+    compile offline, but the trace itself is the cost the counter
+    watches). Shared by :func:`rebind` and the serve-side hooks so the
+    counter semantics cannot drift."""
+    from distributed_sddmm_tpu.obs import metrics as obs_metrics
+
+    if fit:
+        obs_metrics.GLOBAL.add("dynstruct_rebinds")
+    else:
+        obs_metrics.GLOBAL.add("dynstruct_bucket_spills")
+        obs_metrics.GLOBAL.add("structure_retraces")
+
+
+def build(
+    name: str,
+    S: HostCOO,
+    R: int,
+    c: int,
+    *,
+    headroom: float | None = None,
+    grow_rows: bool | None = None,
+    **kw,
+):
+    """Construct strategy ``name`` sized to capacity rungs, rebindable.
+
+    Same contract as ``bench.harness.make_algorithm`` (``kw`` passes
+    through: kernel, devices, overlap, wire, ...), plus the capacity
+    policy: ``headroom`` multiplies every raw structure requirement
+    before rung selection (default ``DSDDMM_DYNSTRUCT_HEADROOM``),
+    ``grow_rows`` reserves a row-growth rung for the declared height
+    (default ``DSDDMM_DYNSTRUCT_ROWS``). The returned strategy carries
+    a :class:`DynHandle` on ``_dynstruct`` and its tiles carry
+    ``dyn_cap`` — which routes every program key through the
+    capacity-bucket segment.
+    """
+    from distributed_sddmm_tpu.bench.harness import make_algorithm
+
+    headroom = default_headroom() if headroom is None else float(headroom)
+    grow_rows = default_grow_rows() if grow_rows is None else bool(grow_rows)
+    row_cap = row_capacity(S.M, grow_rows)
+    with dyn_capacity(headroom=headroom) as scope:
+        alg = make_algorithm(name, with_row_capacity(S, row_cap), R, c, **kw)
+    alg._dynstruct = DynHandle(
+        name=name, R=int(R), c=int(c), kw=dict(kw), headroom=headroom,
+        grow_rows=grow_rows, row_cap=row_cap, true_m=S.M, n=S.N,
+        floors=tuple(scope.realized),
+    )
+    return alg
+
+
+def rebind(alg, S_new: HostCOO) -> StructureUpdate:
+    """Bind a mutated pattern into an existing dynstruct strategy.
+
+    Re-derives the full tile state for ``S_new`` under the original
+    build's capacity floors (host-side only — strategy construction
+    never traces), then fit-checks the realized structure signature
+    against the live one. Fit: the fresh state is swapped into ``alg``
+    in place, keeping the compiled-program cache — the existing traced
+    programs serve the new pattern on their next call. No fit (any rung
+    or the row capacity outgrown, or the band structure changed): the
+    fresh build — at its new rungs — IS the result, returned as the
+    replacement strategy with its own handle.
+    """
+    h: DynHandle | None = getattr(alg, "_dynstruct", None)
+    if h is None:
+        raise ValueError(
+            "rebind needs a dynstruct-built strategy (dynstruct.build); "
+            f"{type(alg).__name__} has no _dynstruct handle"
+        )
+    if S_new.N != h.n:
+        raise ValueError(
+            f"rebind cannot change the column count ({h.n} -> {S_new.N}); "
+            "column growth needs a fresh build"
+        )
+    from distributed_sddmm_tpu.bench.harness import make_algorithm
+
+    row_spill = S_new.M > h.row_cap
+    row_cap = h.row_cap if not row_spill else row_capacity(
+        S_new.M, h.grow_rows
+    )
+    # Floors only replay against unchanged geometry — after a row spill
+    # every tile frame moved and the ordinals describe nothing.
+    floors = h.floors if not row_spill else ()
+    with dyn_capacity(headroom=h.headroom, floors=floors) as scope:
+        fresh = make_algorithm(
+            h.name, with_row_capacity(S_new, row_cap), h.R, h.c, **h.kw
+        )
+    reason = None
+    if row_spill:
+        reason = f"row capacity {h.row_cap} < {S_new.M}"
+    else:
+        reason = _mismatch(alg, fresh)
+    fit = reason is None
+    note_rebind(fit)
+    caps = tuple(scope.realized)
+    nnz_before = _live_nnz(alg)
+    if fit:
+        for k, v in fresh.__dict__.items():
+            if k not in _KEEP_ON_REBIND:
+                alg.__dict__[k] = v
+        alg._dynstruct = dataclasses.replace(
+            h, true_m=S_new.M, floors=caps
+        )
+        return StructureUpdate(
+            fit=True, alg=alg, nnz_before=nnz_before, nnz_after=S_new.nnz,
+            row_cap=row_cap, caps=caps,
+        )
+    fresh._dynstruct = dataclasses.replace(
+        h, row_cap=row_cap, true_m=S_new.M, floors=caps
+    )
+    return StructureUpdate(
+        fit=False, alg=fresh, nnz_before=nnz_before, nnz_after=S_new.nnz,
+        row_cap=row_cap, caps=caps, reason=reason,
+    )
+
+
+def _live_nnz(alg) -> int:
+    tiles = getattr(alg, "S_tiles", None)
+    return int(getattr(tiles, "nnz", 0))
+
+
+def _tile_sig(tiles) -> tuple | None:
+    """Everything about a tile set the traced programs depend on: array
+    shapes (the avals) and the static jit metadata (block geometry,
+    band tuples, realized variant, capacity rungs)."""
+    if tiles is None:
+        return None
+    sig = (
+        type(tiles).__name__,
+        tuple(tiles.rows.shape),
+        tiles.tile_rows,
+        tiles.tile_cols,
+        getattr(tiles, "owned_len", None),
+        tiles.blk_geom,
+        tiles.blk_bands,
+        tiles.blk_variant,
+        tiles.dyn_cap,
+    )
+    if tiles.has_blocked:
+        sig += (tuple(tiles.blk_lr.shape), tuple(tiles.blk_meta.shape))
+    return sig
+
+
+def _mismatch(old, new) -> str | None:
+    """None when every compiled program of ``old`` can serve ``new``'s
+    structure; else a one-line reason for the spill."""
+    if type(old) is not type(new):
+        return f"strategy class changed ({type(old).__name__})"
+    for attr in ("S_tiles", "ST_tiles"):
+        a = _tile_sig(getattr(old, attr, None))
+        b = _tile_sig(getattr(new, attr, None))
+        if a != b:
+            return f"{attr} structure signature changed"
+    return None

@@ -110,7 +110,7 @@ def from_spec(
     """
     fam, _, rest = spec.partition(":")
     if fam == "window":
-        return sliding_window(n, int(rest or "8"))
+        return sliding_window(n, _int_param(spec, "window", rest, "w", 8))
     if fam == "bigbird":
         kw = {"w": 8, "g": 2, "r": 2}
         for part in filter(None, rest.split(",")):
@@ -127,7 +127,103 @@ def from_spec(
         if graph is None:
             raise ValueError("mask spec 'graph' needs a source matrix")
         return graph_mask(graph)
+    if fam == "topk":
+        raise ValueError(
+            f"mask spec {spec!r} is request-time dynamic (the kept "
+            "positions depend on the computed scores) — it has no static "
+            "mask matrix; serve it through a dynamic-mask workload "
+            "(parse_dynamic_spec)"
+        )
     raise ValueError(
         f"unknown mask spec {spec!r}; expected window:<w>, "
-        "bigbird:w=..,g=..,r=.., or graph"
+        "bigbird:w=..,g=..,r=.., graph, or topk:<k>"
     )
+
+
+# --------------------------------------------------------------------- #
+# Request-time dynamic mask specs (PR 20, ``dynstruct/``)
+# --------------------------------------------------------------------- #
+
+#: The families a dynamic-mask serving workload resolves per request —
+#: parameterized window narrowing and score top-k. Both are *runtime*
+#: program inputs of a capacity-sized program, never trace constants.
+DYNAMIC_FAMILIES = ("window", "topk")
+
+
+def _int_param(spec: str, fam: str, rest: str, key: str, default) -> int:
+    """One strict integer parameter: ``fam:<v>`` or ``fam:key=<v>``;
+    unknown keys and non-integers error in the SLOSpec style."""
+    rest = rest.strip()
+    if not rest:
+        if default is None:
+            raise ValueError(
+                f"mask spec {spec!r} needs a value "
+                f"({fam}:<{key}> or {fam}:{key}=<{key}>)"
+            )
+        return int(default)
+    if "=" in rest:
+        k, _, v = rest.partition("=")
+        if k != key:
+            raise ValueError(
+                f"unknown {fam} key {k!r} in mask spec {spec!r}"
+            )
+        rest = v
+    try:
+        return int(rest)
+    except ValueError:
+        raise ValueError(
+            f"mask spec {spec!r}: {key} must be an integer, got {rest!r}"
+        ) from None
+
+
+def parse_dynamic_spec(
+    spec: str,
+    w_max: int | None = None,
+    k_max: int | None = None,
+) -> tuple[str, int]:
+    """Parse one per-request dynamic mask spec -> ``(kind, param)``.
+
+    Grammar: ``window:<w>`` / ``window:w=<w>`` (attend to the ±w
+    neighborhood, ``w >= 0``) and ``topk:<k>`` / ``topk:k=<k>`` (keep
+    the k highest-scoring in-capacity positions, ``k >= 1``; ties at
+    the threshold are all kept — deterministic, order-free). ``w_max``
+    / ``k_max`` bound the parameters to the serving program's capacity:
+    a request can narrow its mask at runtime but never widen past what
+    the compiled program gathered.
+    """
+    fam, _, rest = spec.partition(":")
+    if fam == "window":
+        w = _int_param(spec, "window", rest, "w", None)
+        if w < 0:
+            raise ValueError(f"mask spec {spec!r}: w must be >= 0")
+        if w_max is not None and w > w_max:
+            raise ValueError(
+                f"mask spec {spec!r}: w exceeds the serving capacity "
+                f"w_max={w_max}"
+            )
+        return "window", w
+    if fam == "topk":
+        k = _int_param(spec, "topk", rest, "k", None)
+        if k < 1:
+            raise ValueError(f"mask spec {spec!r}: k must be >= 1")
+        if k_max is not None and k > k_max:
+            raise ValueError(
+                f"mask spec {spec!r}: k exceeds the serving capacity "
+                f"k_max={k_max}"
+            )
+        return "topk", k
+    raise ValueError(
+        f"unknown dynamic mask spec {spec!r}; expected one of "
+        f"{[f + ':<n>' for f in DYNAMIC_FAMILIES]}"
+    )
+
+
+def format_dynamic_spec(kind: str, param: int) -> str:
+    """Canonical printable form of a dynamic mask: round-trips through
+    :func:`parse_dynamic_spec` (the form records and payloads carry)."""
+    if kind not in DYNAMIC_FAMILIES:
+        raise ValueError(
+            f"unknown dynamic mask kind {kind!r}; expected one of "
+            f"{DYNAMIC_FAMILIES}"
+        )
+    return f"{kind}:{int(param)}"

@@ -102,6 +102,12 @@ KNOWN_GLOBAL_COUNTERS: dict = {
         "cross-replica reply comparisons that disagreed bit-for-bit",
     "fleet_breaker_opens": "per-replica circuit breakers tripped open",
     "fleet_quarantines": "replicas quarantined for autopsy (byzantine/gray)",
+    "dynstruct_rebinds":
+        "structure changes bound into live programs with zero retraces",
+    "dynstruct_bucket_spills":
+        "structure changes that outgrew a capacity rung (full rebuild)",
+    "structure_retraces":
+        "program retraces forced by a structure change (the spill cost)",
 }
 
 #: Exposition metric-name prefix.

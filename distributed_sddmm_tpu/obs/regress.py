@@ -68,6 +68,7 @@ def _optional_axis(name: str) -> bool:
         or name.startswith("comm:")
         or name.startswith("fleet:")
         or name.startswith("serve:burn_rate")
+        or name.startswith("dynstruct:")
     )
 
 
@@ -144,6 +145,7 @@ def phase_stats(doc: dict) -> dict[str, dict]:
     out.update(_tuner_rows(doc))
     out.update(_comm_bytes_rows(doc))
     out.update(_fleet_rows(doc))
+    out.update(_dynstruct_rows(doc))
     return out
 
 
@@ -251,6 +253,29 @@ def _fleet_rows(doc: dict) -> dict[str, dict]:
             max(float(fleet.get("hedge_wins") or 0) / hedges, 0.01),
         )
     return rows
+
+
+def _dynstruct_rows(doc: dict) -> dict[str, dict]:
+    """The dynamic-structure verdict axis (PR 20):
+    ``dynstruct:rebind`` as a pseudo-phase whose ``t_call`` is the
+    retrace rate per structure change — ``retraces / changes``, floored
+    at 0.01 so an all-fit baseline (the whole point of dynstruct) does
+    not turn the first legitimate spill into an infinite regression.
+    OPTIONAL in compare(): only records that actually churned structure
+    (``record["dynstruct"]`` with nonzero changes) carry the axis;
+    pre-PR-20 and static docs are "not measured", never a verdict."""
+    dyn = (doc.get("record") or {}).get("dynstruct") or {}
+    changes = int(dyn.get("dynstruct_rebinds") or 0) + int(
+        dyn.get("dynstruct_bucket_spills") or 0
+    )
+    if not changes:
+        return {}
+    retraces = float(dyn.get("structure_retraces") or 0)
+    return {
+        "dynstruct:rebind": _pseudo_row(
+            changes, max(retraces / changes, 0.01)
+        ),
+    }
 
 
 def _xla_rows(doc: dict) -> dict[str, dict]:

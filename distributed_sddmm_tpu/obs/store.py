@@ -78,6 +78,9 @@ _INDEX_FIELDS = (
     # over per-op metrics; None on pre-PR-15 docs and metric-less
     # records — "not measured", never a verdict).
     "wire", "comm_bytes",
+    # Dynamic structure (PR 20): zero-retrace structure rebinds this
+    # run performed (None on pre-PR-20 docs — "not measured").
+    "dynstruct_rebinds",
 )
 
 #: Configuration axes (beyond the fingerprint key) two runs must share
@@ -391,6 +394,11 @@ def _index_row(doc: dict) -> dict:
             (rec.get("program_store") or {}).get("live_compiles")
             if rec.get("program_store") is not None
             else (rec.get("engine") or {}).get("live_compiles")
+        ),
+        "dynstruct_rebinds": (
+            (rec.get("dynstruct") or {}).get("dynstruct_rebinds")
+            if rec.get("dynstruct") is not None
+            else None
         ),
     }
     return {k: row[k] for k in _INDEX_FIELDS}

@@ -376,6 +376,21 @@ class DistributedSparse(abc.ABC):
         wseg = self.wire.key_segment()
         if wseg:
             key += (wseg,)
+        # Dyn-capacity segment (PR 20, ``dynstruct/``): a bucketed build
+        # sizes its arrays to pow2 rungs, so the realized rungs — not
+        # the exact pattern — are what the traced program depends on.
+        # Exact builds have no dyn_cap and append NOTHING (old store
+        # entries keep hitting); a bucketed key can never alias an exact
+        # one. The band digest above stays in the key but is itself
+        # rung-quantized for dyn builds (bands pad to rungs before
+        # concatenation), so it survives pattern churn within a bucket
+        # while still separating genuinely different band structure —
+        # dropping it would let two same-rung, different-band patterns
+        # answer for each other's programs.
+        tiles = self.ST_tiles if use_st else self.S_tiles
+        cap = getattr(tiles, "dyn_cap", None)
+        if cap:
+            key += ("cap=" + "x".join(str(c) for c in cap),)
         return key
 
     def inject_program(self, op: str, use_st: bool, loaded) -> None:

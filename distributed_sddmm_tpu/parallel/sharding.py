@@ -25,6 +25,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from distributed_sddmm_tpu.common import divide_round_up
 from distributed_sddmm_tpu.parallel.mesh import GridSpec
+from distributed_sddmm_tpu.utils import buckets
 from distributed_sddmm_tpu.utils.coo import HostCOO
 
 TILE_SPEC = P("rows", "cols", "layers", None, None)
@@ -93,6 +94,11 @@ class TileSet:
     #: generic or when a requested variant guard-felled to generic) —
     #: what records and program keys report, vs the kernel's identity.
     blk_variant: str = None
+    #: Realized dyn-capacity rungs when this set was built under an
+    #: active ``utils.buckets.dyn_capacity`` scope (dynstruct builds,
+    #: PR 20); None for exact (static) builds. Feeds the capacity
+    #: segment of program keys and the rebind fit-check.
+    dyn_cap: tuple = None
 
     @property
     def has_blocked(self) -> bool:
@@ -174,6 +180,9 @@ class ReplicatedTiles:
     #: generic or when a requested variant guard-felled to generic) —
     #: what records and program keys report, vs the kernel's identity.
     blk_variant: str = None
+    #: Realized dyn-capacity rungs (see TileSet.dyn_cap); None for
+    #: exact builds.
+    dyn_cap: tuple = None
 
     STRUCT_SPEC = P("rows", "cols", None)
     VALUES_SPEC = P("rows", "cols", "layers", None)
@@ -232,6 +241,9 @@ def build_replicated_tiles(
     dev = res.i * nc + res.j
     n_buckets = nr * nc
 
+    _dyn = buckets.dyn_capacity_state()
+    _dyn_mark = len(_dyn.realized) if _dyn is not None else 0
+
     blocked = None
     if block:
         if variant is not None and getattr(variant, "banked", False):
@@ -250,6 +262,9 @@ def build_replicated_tiles(
             lcm_chunks = nh // math.gcd(CHUNK, nh)
             lcm_chunks *= blocked.group // math.gcd(lcm_chunks, blocked.group)
             C = divide_round_up(blocked.n_chunks, lcm_chunks) * lcm_chunks
+            cap = buckets.dyn_rung(C, multiple=lcm_chunks)
+            if cap is not None:
+                C = max(C, cap)
             blocked = pad_chunk_count(blocked, C)
 
     if blocked is not None:
@@ -267,6 +282,9 @@ def build_replicated_tiles(
         # Pad to a multiple of the fiber depth so value slices are equal-sized.
         raw_max = max(int(counts.max(initial=0)), 1)
         max_nnz = divide_round_up(raw_max, nh) * nh
+        cap = buckets.dyn_rung(max_nnz, multiple=nh)
+        if cap is not None:
+            max_nnz = cap
         starts = np.zeros(n_buckets, dtype=np.int64)
         np.cumsum(counts[:-1], out=starts[1:])
         within = np.arange(S.nnz, dtype=np.int64) - starts[dev[order]]
@@ -328,6 +346,7 @@ def build_replicated_tiles(
         nnz=S.nnz,
         grid=grid,
         nnz_per_device=counts.reshape(nr, nc, 1),
+        dyn_cap=(tuple(_dyn.realized[_dyn_mark:]) if _dyn is not None else None),
         **blocked_fields,
     )
 
@@ -384,12 +403,24 @@ def build_tiles(
     bucket = dev * T + res.tile
     n_buckets = nr * nc * nh * T
 
+    _dyn = buckets.dyn_capacity_state()
+    _dyn_mark = len(_dyn.realized) if _dyn is not None else 0
+
     blocked = None
     if block:
         blocked, blk_variant = _try_build_blocked(
             n_buckets, bucket, res, tile_rows, tile_cols, swap=block_swap,
             variant=variant,
         )
+        # Banded encodings consume their rungs per band inside
+        # build_banded; the generic encoding takes one rung on its total
+        # chunk count here.
+        if blocked is not None and getattr(blocked, "bands", None) is None:
+            cap = buckets.dyn_rung(blocked.n_chunks, multiple=blocked.group)
+            if cap is not None and cap > blocked.n_chunks:
+                from distributed_sddmm_tpu.ops.blocked import pad_chunk_count
+
+                blocked = pad_chunk_count(blocked, cap)
 
     if blocked is not None:
         # The chunk layout IS the flat layout: value vectors serve both the
@@ -411,6 +442,9 @@ def build_tiles(
         counts, order = native.bucket_sort(bucket, n_buckets)
         sorted_bucket = bucket[order]
         max_nnz = max(int(counts.max(initial=0)), min_pad)
+        cap = buckets.dyn_rung(max_nnz)
+        if cap is not None:
+            max_nnz = cap
         starts = np.zeros(n_buckets, dtype=np.int64)
         np.cumsum(counts[:-1], out=starts[1:])
 
@@ -470,6 +504,7 @@ def build_tiles(
         nnz=S.nnz,
         grid=grid,
         nnz_per_device=nnz_per_device,
+        dyn_cap=(tuple(_dyn.realized[_dyn_mark:]) if _dyn is not None else None),
         **blocked_fields,
     )
 

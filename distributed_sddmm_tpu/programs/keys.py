@@ -173,6 +173,7 @@ def serve_program_key(
     sig: str | None = None,
     variant: str | None = None,
     wire: str | None = None,
+    cap: str | None = None,
     dist: str | None = None,
 ) -> str:
     """Cache key for one serving bucket cell — the grammar the engine
@@ -194,7 +195,14 @@ def serve_program_key(
     :func:`dist_segment` of the compiling worker (PR 14) — serving
     executables are per-process exactly like plan programs, so a pod
     worker's ladder entries must never answer for another slot's;
-    single-process keys append nothing and stay byte-identical."""
+    single-process keys append nothing and stay byte-identical.
+    ``cap`` (PR 20, ``dynstruct/``) is the capacity-bucket segment
+    (``c<caps>``) of a dynamic-structure workload: the traced program is
+    sized to pow2 capacity rungs, not the exact structure, so the rungs
+    — not the pattern — identify it. Static workloads pass None and
+    append nothing (old keys byte-identical); a bucketed key can never
+    alias an exact-build key because only dyn builds carry the
+    segment."""
     if code is None:
         from distributed_sddmm_tpu.autotune.fingerprint import serve_code_hash
 
@@ -211,6 +219,8 @@ def serve_program_key(
         key += f":v{_seg(variant)}"
     if wire and wire != "f32":
         key += f":w{_seg(wire)}"
+    if cap:
+        key += f":c{_seg(cap)}"
     if dist:
         key += f":{_seg(dist)}"
     return key
@@ -218,7 +228,7 @@ def serve_program_key(
 
 def parse_serve_key(key: str) -> dict | None:
     parts = key.split(":")
-    if not (7 <= len(parts) <= 12) or parts[0] != "serve":
+    if not (7 <= len(parts) <= 13) or parts[0] != "serve":
         return None
     if not (parts[2].startswith("b") and parts[3].startswith("i")
             and parts[4].startswith("r")):
@@ -245,6 +255,8 @@ def parse_serve_key(key: str) -> dict | None:
             out["variant"] = extra[1:]
         elif extra.startswith("w"):
             out["wire"] = extra[1:]
+        elif extra.startswith("c"):
+            out["cap"] = extra[1:]
         else:
             return None
     return out

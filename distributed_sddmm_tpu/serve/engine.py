@@ -143,6 +143,9 @@ class ServingEngine:
         #: Challenger hot-swaps applied to this ladder (tuner
         #: promotions; ``stats()`` surfaces it).
         self.ladder_swaps = 0
+        #: Structure changes bound into this engine (PR 20
+        #: ``rebind_structure``; ``stats()`` surfaces it).
+        self.structure_rebinds = 0
         #: Backref set by an attached ``BackgroundTuner`` (telemetry
         #: snapshots read tuner state through it; None = no tuner).
         self.tuner = None
@@ -184,6 +187,12 @@ class ServingEngine:
             # 15): bf16-wire ladder entries never alias f32's; None/
             # f32 appends nothing, keeping default keys byte-identical.
             wire=getattr(self.workload, "wire", None),
+            # Capacity-bucket segment (PR 20): a dynamic-structure
+            # workload's programs are sized to capacity rungs, not the
+            # exact pattern — the rungs identify them. Static workloads
+            # have no capacity_segment and append nothing (keys
+            # byte-identical), so bucketed keys never alias exact ones.
+            cap=getattr(self.workload, "capacity_segment", None),
             # Serving executables are per-process like plan programs:
             # on a pod each worker's ladder keys carry its dN.pK slot
             # (empty single-process — keys byte-identical to PR 5-13).
@@ -646,6 +655,43 @@ class ServingEngine:
             variant=variant, cells=len(cells), swaps=self.ladder_swaps,
         )
 
+    def rebind_structure(self, *args, **kw) -> dict:
+        """Bind a mutated structure into the live ladder (PR 20).
+
+        Delegates to the workload's ``rebind_structure`` hook — the
+        workload owns what "structure" means (the attention workload's
+        context matrix, the fold-in workload's ratings matrix + model
+        strategy) and performs the host-side rebind. On a fit (the new
+        structure lands in the compiled capacity rungs) the existing
+        ladder keeps serving untouched: structure rides in as program
+        arguments with unchanged avals, so the change costs zero
+        compiles and zero dropped requests. On a bucket spill the
+        ladder's avals changed — the stale cells are dropped atomically
+        and the ladder re-warms at the new capacity (store-warmed when
+        a program store is bound), OFF the request path like any
+        warmup. Returns the hook's report (``{"fit": bool, ...}``).
+        """
+        hook = getattr(self.workload, "rebind_structure", None)
+        if hook is None:
+            raise ValueError(
+                f"workload {self.workload.name!r} has no structure "
+                "rebind hook"
+            )
+        report = hook(*args, **kw)
+        with self._cache_lock:
+            self.structure_rebinds += 1
+        if not report.get("fit", True):
+            with self._cache_lock:
+                self._cell_programs.clear()
+                self._programs.clear()
+                self.warmed = False
+            self.warmup()
+        obs_trace.event(
+            "serve_structure_rebind", workload=self.workload.name,
+            fit=bool(report.get("fit", True)),
+        )
+        return report
+
     # ------------------------------------------------------------------ #
 
     def stats(self) -> dict:
@@ -659,5 +705,6 @@ class ServingEngine:
                 "served": self.served,
                 "degraded_batches": self.degraded_batches,
                 "ladder_swaps": self.ladder_swaps,
+                "structure_rebinds": self.structure_rebinds,
                 "queue_shed": self.queue.shed_count,
             }

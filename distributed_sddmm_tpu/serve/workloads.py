@@ -421,6 +421,33 @@ class ALSFoldInTopK(ServingWorkload):
                 mode="repair",
             )
 
+    # -- structure rebind ----------------------------------------------- #
+
+    def rebind_structure(self, S: Optional[HostCOO] = None) -> dict:
+        """Bind the ingest-grown ratings pattern into the model's
+        distributed strategy (which must be a ``dynstruct.build``
+        product — a plain strategy has no capacity rungs to rebind
+        into). Defaults to ``S_live``, the matrix :meth:`ingest` grows.
+        On a bucket spill the replacement strategy is re-pointed into
+        the model, so training/serving handles stay valid either way.
+        """
+        from distributed_sddmm_tpu import dynstruct
+
+        if S is None:
+            S = self.S_live
+        if S is None:
+            raise ValueError("no live ratings matrix to rebind")
+        with self._ingest_lock:
+            update = dynstruct.rebind(self.model.d_ops, S)
+            if update.spilled:
+                self.model.d_ops = update.alg
+        return {
+            "fit": update.fit,
+            "nnz": update.nnz_after,
+            "row_cap": update.row_cap,
+            "reason": update.reason,
+        }
+
 
 # --------------------------------------------------------------------- #
 # Attention: token scoring over cached context embeddings
@@ -460,10 +487,9 @@ class AttentionTokenScore(ServingWorkload):
         token_buckets: tuple[int, ...] = ATTN_TOKEN_BUCKETS,
         head_seed: int = 0,
         kernel_variant: Optional[str] = None,
+        dynamic: bool = False,
     ):
         import os
-
-        import jax.numpy as jnp
 
         if kernel_variant is None and d_ops is not None:
             from distributed_sddmm_tpu.parallel.base import (
@@ -480,13 +506,37 @@ class AttentionTokenScore(ServingWorkload):
             raise ValueError(f"window must be >= 0, got {window}")
         self.window = int(window)
         self.inner_buckets = tuple(sorted(int(b) for b in token_buckets))
-        self._K_host = np.ascontiguousarray(context, dtype=np.float32)
-        self.n_ctx, self.R = self._K_host.shape
-        self._K_dev = jnp.asarray(self._K_host)
+        self.dynamic = bool(dynamic)
         rng = np.random.default_rng(head_seed)
         self._w_host = (
-            rng.standard_normal(self.R) / np.sqrt(self.R)
+            rng.standard_normal(context.shape[1]) / np.sqrt(context.shape[1])
         ).astype(np.float32)
+        self._bind_context(np.ascontiguousarray(context, dtype=np.float32))
+
+    def _bind_context(self, K: np.ndarray) -> None:
+        """(Re)bind the cached context matrix. In dynamic mode ``K`` is
+        padded up to the capacity rung ``ctx_cap`` (extra rows zero) and
+        the real row count rides in as the runtime scalar ``n_valid`` —
+        context growth within the rung rebinds without a retrace."""
+        import jax.numpy as jnp
+
+        self.n_ctx, self.R = K.shape
+        if self.dynamic:
+            from distributed_sddmm_tpu.utils.buckets import pow2_at_least
+
+            self.ctx_cap = pow2_at_least(self.n_ctx + 1)
+            pad = np.zeros((self.ctx_cap, self.R), dtype=np.float32)
+            pad[: self.n_ctx] = K
+            self._K_host = K
+            self._K_pad = pad
+            self._K_dev = jnp.asarray(pad)
+            self._n_valid_dev = jnp.asarray(
+                np.int32(self.n_ctx)
+            )
+        else:
+            self.ctx_cap = self.n_ctx
+            self._K_host = K
+            self._K_dev = jnp.asarray(K)
         self._w_dev = jnp.asarray(self._w_host)
 
     # -- payload shaping ----------------------------------------------- #
@@ -495,25 +545,60 @@ class AttentionTokenScore(ServingWorkload):
         return int(len(payload["tokens"]))
 
     def clamp(self, payload: dict) -> dict:
+        if self.dynamic and "mask" in payload:
+            from distributed_sddmm_tpu import masks
+
+            # Admission-time validation: a malformed or capacity-
+            # exceeding spec is rejected here, before it can reach a
+            # padded batch (the SLOSpec discipline — strict keys, loud
+            # errors).
+            masks.parse_dynamic_spec(
+                payload["mask"],
+                w_max=self.window,
+                k_max=2 * self.window + 1,
+            )
         cap = self.inner_buckets[-1]
         if len(payload["tokens"]) <= cap:
             return payload
-        return {"tokens": np.asarray(payload["tokens"])[:cap]}
+        out = dict(payload)
+        out["tokens"] = np.asarray(payload["tokens"])[:cap]
+        return out
 
     def sample_payload(self, rng: np.random.Generator) -> dict:
         n = int(min(1 + rng.poisson(2), self.inner_buckets[-1]))
-        return {
+        out = {
             "tokens": rng.choice(
                 self.n_ctx, size=n, replace=False
             ).astype(np.int64)
         }
+        if self.dynamic:
+            # Mask-churn traffic: every request narrows differently, and
+            # none of it may retrace (the whole point of dynamic mode).
+            pick = rng.integers(0, 3)
+            if pick == 1:
+                out["mask"] = f"window:{int(rng.integers(0, self.window + 1))}"
+            elif pick == 2:
+                out["mask"] = f"topk:{int(rng.integers(1, 2 * self.window + 2))}"
+        return out
 
     def program_params(self) -> str:
         # The window width is a trace-time constant of the scoring
         # program; the context matrix and head vector ride in as
         # arguments (shapes covered by avals), so a refreshed context
-        # never invalidates the ladder.
-        return f"w{self.window}"
+        # never invalidates the ladder. Dynamic mode bakes the same
+        # window as a CAPACITY and is a different program (runtime
+        # n_valid/kind/param arguments), so it must not alias.
+        return f"w{self.window}-dyn" if self.dynamic else f"w{self.window}"
+
+    @property
+    def capacity_segment(self) -> Optional[str]:
+        """The serve-key capacity-bucket segment (None for static
+        builds, whose keys must stay byte-identical): the window
+        capacity and the context rung — everything the traced program's
+        structure depends on that isn't an aval."""
+        if not self.dynamic:
+            return None
+        return f"w{self.window}.n{self.ctx_cap}"
 
     # -- device program ------------------------------------------------ #
 
@@ -524,33 +609,99 @@ class AttentionTokenScore(ServingWorkload):
         from distributed_sddmm_tpu.ops.kernels import ATTN_NEG
 
         w = self.window
-        n_ctx = self.n_ctx
         inv_sqrt_r = 1.0 / float(np.sqrt(self.R))
 
-        def score(K, head, tokens, mask):
-            # (b, L, 2w+1) sliding-window neighborhood, edge-clipped via
-            # a validity mask (clip keeps the gather in range; the mask
-            # keeps the softmax honest).
+        if not self.dynamic:
+            n_ctx = self.n_ctx
+
+            def score(K, head, tokens, mask):
+                # (b, L, 2w+1) sliding-window neighborhood, edge-clipped
+                # via a validity mask (clip keeps the gather in range;
+                # the mask keeps the softmax honest).
+                offs = jnp.arange(-w, w + 1, dtype=jnp.int32)
+                nb = tokens[..., None] + offs
+                valid = (nb >= 0) & (nb < n_ctx)
+                nb = jnp.clip(nb, 0, n_ctx - 1)
+                q = K[tokens]                                  # (b, L, R)
+                kn = K[nb]                                     # (b, L, W, R)
+                logits = (
+                    jnp.sum(q[..., None, :] * kn, axis=-1) * inv_sqrt_r
+                )
+                zsafe = jnp.where(
+                    valid, logits, jnp.asarray(ATTN_NEG, K.dtype)
+                )
+                m = jnp.max(zsafe, axis=-1, keepdims=True)     # last-axis
+                e = jnp.where(valid, jnp.exp(zsafe - m), 0.0)  # batch-inv
+                d = jnp.sum(e, axis=-1)
+                vals = jnp.sum(kn * head, axis=-1)             # (b, L, W)
+                num = jnp.sum(e * vals, axis=-1)
+                # The token itself is always in-window, so d > 0 at
+                # every real row; padded rows divide by 1 and are
+                # masked to 0.
+                return num / jnp.where(d > 0, d, 1.0) * mask
+
+            return jax.jit(score)
+
+        ctx_cap = self.ctx_cap
+        W = 2 * w + 1
+
+        def score_dyn(K, n_valid, head, tokens, mask, kind, param):
+            # Capacity-shaped gather: K is padded to the ctx_cap rung,
+            # the real row count is the RUNTIME scalar n_valid, and the
+            # per-request mask (kind 0 = window:<p>, kind 1 = topk:<p>)
+            # narrows the fixed ±w neighborhood with data, never with a
+            # trace constant — every op below is batch-dim-invariant
+            # (gathers, elementwise, per-row last-axis sort/reductions).
             offs = jnp.arange(-w, w + 1, dtype=jnp.int32)
             nb = tokens[..., None] + offs
-            valid = (nb >= 0) & (nb < n_ctx)
-            nb = jnp.clip(nb, 0, n_ctx - 1)
-            q = K[tokens]                                  # (b, L, R)
-            kn = K[nb]                                     # (b, L, W, R)
-            logits = (
-                jnp.sum(q[..., None, :] * kn, axis=-1) * inv_sqrt_r
+            valid = (nb >= 0) & (nb < n_valid)
+            nb = jnp.clip(nb, 0, ctx_cap - 1)
+            q = K[tokens]                                      # (b, L, R)
+            kn = K[nb]                                         # (b, L, W, R)
+            logits = jnp.sum(q[..., None, :] * kn, axis=-1) * inv_sqrt_r
+            neg = jnp.asarray(ATTN_NEG, K.dtype)
+            zsafe0 = jnp.where(valid, logits, neg)
+            p = param[:, None, None]
+            keep_window = jnp.abs(offs)[None, None, :] <= p
+            # topk: per-row descending sort, threshold at the p-th
+            # value; ties AT the threshold are all kept — deterministic
+            # and order-free, unlike an argsort tie-break.
+            sorted_desc = -jnp.sort(-zsafe0, axis=-1)
+            kidx = jnp.clip(p, 1, W) - 1
+            thr = jnp.take_along_axis(
+                sorted_desc, jnp.broadcast_to(kidx, zsafe0.shape[:-1] + (1,)),
+                axis=-1,
             )
-            zsafe = jnp.where(valid, logits, jnp.asarray(ATTN_NEG, K.dtype))
-            m = jnp.max(zsafe, axis=-1, keepdims=True)     # last-axis ops:
-            e = jnp.where(valid, jnp.exp(zsafe - m), 0.0)  # batch-invariant
+            keep_topk = zsafe0 >= thr
+            keep = jnp.where(kind[:, None, None] == 1, keep_topk, keep_window)
+            valid = valid & keep
+            zsafe = jnp.where(valid, zsafe0, neg)
+            m = jnp.max(zsafe, axis=-1, keepdims=True)
+            e = jnp.where(valid, jnp.exp(zsafe - m), 0.0)
             d = jnp.sum(e, axis=-1)
-            vals = jnp.sum(kn * head, axis=-1)             # (b, L, W)
+            vals = jnp.sum(kn * head, axis=-1)
             num = jnp.sum(e * vals, axis=-1)
-            # The token itself is always in-window, so d > 0 at every
-            # real row; padded rows divide by 1 and are masked to 0.
             return num / jnp.where(d > 0, d, 1.0) * mask
 
-        return jax.jit(score)
+        return jax.jit(score_dyn)
+
+    def _mask_arrays(
+        self, payloads: list[dict], b: int
+    ) -> tuple[np.ndarray, np.ndarray]:
+        from distributed_sddmm_tpu import masks
+
+        kind = np.zeros(b, dtype=np.int32)
+        param = np.full(b, self.window, dtype=np.int32)
+        for i, p in enumerate(payloads):
+            spec = p.get("mask")
+            if spec is None:
+                continue
+            fam, val = masks.parse_dynamic_spec(
+                spec, w_max=self.window, k_max=2 * self.window + 1
+            )
+            kind[i] = 1 if fam == "topk" else 0
+            param[i] = val
+        return kind, param
 
     def pad_batch(
         self, payloads: list[dict], batch_bucket: int, inner_bucket: int
@@ -562,7 +713,13 @@ class AttentionTokenScore(ServingWorkload):
             n = len(p["tokens"])
             tokens[i, :n] = p["tokens"]
             mask[i, :n] = 1.0
-        return (self._K_dev, self._w_dev, tokens, mask)
+        if not self.dynamic:
+            return (self._K_dev, self._w_dev, tokens, mask)
+        kind, param = self._mask_arrays(payloads, b)
+        return (
+            self._K_dev, self._n_valid_dev, self._w_dev,
+            tokens, mask, kind, param,
+        )
 
     def unpad(self, outputs, payloads: list[dict]) -> list[dict]:
         scores = np.asarray(outputs)[: len(payloads)]
@@ -591,11 +748,71 @@ class AttentionTokenScore(ServingWorkload):
             K.dtype.type(self.R)
         )
         zsafe = np.where(valid, logits, K.dtype.type(ATTN_NEG))
+        if self.dynamic and payload.get("mask") is not None:
+            from distributed_sddmm_tpu import masks
+
+            fam, val = masks.parse_dynamic_spec(
+                payload["mask"],
+                w_max=self.window,
+                k_max=2 * self.window + 1,
+            )
+            if fam == "window":
+                valid = valid & (np.abs(offs)[None, :] <= val)
+            else:
+                sorted_desc = -np.sort(-zsafe, axis=-1)
+                kidx = min(max(val, 1), offs.size) - 1
+                thr = sorted_desc[:, kidx : kidx + 1]
+                valid = valid & (zsafe >= thr)
+            zsafe = np.where(valid, zsafe, K.dtype.type(ATTN_NEG))
         m = np.max(zsafe, axis=-1, keepdims=True)
         e = np.where(valid, np.exp(zsafe - m), 0.0).astype(K.dtype)
         d = np.sum(e, axis=-1)
         vals = np.sum(kn * head, axis=-1)
         return np.sum(e * vals, axis=-1) / np.where(d > 0, d, 1.0)
+
+    # -- structure rebind ----------------------------------------------- #
+
+    def rebind_structure(self, context: np.ndarray) -> dict:
+        """Bind a grown/refreshed context matrix (``dynamic=True`` only).
+
+        Growth within the ``ctx_cap`` rung rebinds in place: the padded
+        device matrix and the runtime ``n_valid`` scalar change, the
+        program avals do not — every compiled cell keeps serving
+        (counted ``dynstruct_rebinds``). Growth past the rung spills:
+        the capacity re-derives, the serve keys change through
+        :attr:`capacity_segment`, and the engine re-warms the ladder
+        (counted ``dynstruct_bucket_spills`` + ``structure_retraces``).
+        """
+        from distributed_sddmm_tpu.dynstruct import note_rebind
+
+        if not self.dynamic:
+            raise ValueError(
+                "attention structure rebind needs dynamic=True (a static "
+                "build bakes n_ctx into the traced program)"
+            )
+        K = np.ascontiguousarray(context, dtype=np.float32)
+        if K.ndim != 2 or K.shape[1] != self.R:
+            raise ValueError(
+                f"context must be (n, {self.R}), got {K.shape}"
+            )
+        fit = K.shape[0] <= self.ctx_cap
+        if fit:
+            import jax.numpy as jnp
+
+            self._K_host = K
+            self.n_ctx = K.shape[0]
+            self._K_pad[:] = 0.0
+            self._K_pad[: self.n_ctx] = K
+            self._K_dev = jnp.asarray(self._K_pad)
+            self._n_valid_dev = jnp.asarray(np.int32(self.n_ctx))
+        else:
+            self._bind_context(K)
+        note_rebind(fit)
+        return {
+            "fit": fit,
+            "n_ctx": self.n_ctx,
+            "ctx_cap": self.ctx_cap,
+        }
 
     def serial(self, payload: dict) -> dict:
         tokens = np.asarray(payload["tokens"], dtype=np.int64)

@@ -22,6 +22,10 @@ subprocesses and offline tooling without jax.
 
 from __future__ import annotations
 
+import contextlib
+import math
+import threading
+
 
 def pow2_bucket(x: float) -> int:
     """``x`` rounded to the nearest power of two (>= 1), rounding at the
@@ -54,3 +58,106 @@ def bucket_for(n: int, ladder: tuple[int, ...]) -> int:
         if n <= b:
             return b
     return ladder[-1]
+
+
+def pow2_at_least(n: int) -> int:
+    """Smallest power of two >= ``max(n, 1)`` — the dynstruct capacity
+    rung rule. Unlike :func:`pow2_bucket` this never rounds DOWN: a
+    capacity must hold the requirement, so 5 -> 8 (not 4)."""
+    n = max(int(n), 1)
+    b = 1
+    while b < n:
+        b *= 2
+    return b
+
+
+# --------------------------------------------------------------------- #
+# Dynamic-structure capacity scope (PR 20, ``dynstruct/``)
+# --------------------------------------------------------------------- #
+#
+# The tile/chunk builders (``parallel/sharding.build_tiles``,
+# ``build_replicated_tiles``, ``codegen/banded.build_banded``) size their
+# structure arrays exactly: flat ``max_nnz`` is the per-device maximum,
+# blocked chunk counts are whatever the pattern needed. Exact sizes make
+# every pattern mutation a new aval set -> a retrace. Under an active
+# capacity scope each such sizing decision is instead padded up to a
+# power-of-two rung (times whatever alignment multiple the builder
+# already requires), so any pattern whose requirements land in the same
+# rungs produces byte-identical array shapes and static metadata — the
+# precondition for rebinding new structure into an existing compiled
+# program with zero retraces.
+#
+# Decisions are consumed in build order (one ordinal per sizing site).
+# ``floors`` replays a previous build's realized capacities so a rebind
+# of a *smaller* pattern pads back up to the old rungs instead of
+# producing smaller (incompatible -> spill) arrays. A floor sequence
+# that no longer lines up (band structure changed) simply yields
+# different capacities; the rebind fit-check catches that and spills —
+# the correct outcome, since static band metadata changed anyway.
+
+_DYN = threading.local()
+
+
+class DynCapacityState:
+    """Mutable per-thread state of one active capacity scope."""
+
+    __slots__ = ("headroom", "floors", "seq", "realized")
+
+    def __init__(self, headroom: float, floors: tuple[int, ...]):
+        self.headroom = float(headroom)
+        self.floors = tuple(int(f) for f in floors)
+        self.seq = 0
+        self.realized: list[int] = []
+
+
+def dyn_capacity_state() -> DynCapacityState | None:
+    """The active capacity scope of this thread, or None."""
+    return getattr(_DYN, "state", None)
+
+
+@contextlib.contextmanager
+def dyn_capacity(headroom: float = 1.0, floors: tuple[int, ...] = ()):
+    """Activate bucketed-capacity sizing for tile/chunk builds.
+
+    ``headroom`` multiplies each raw requirement before rung selection
+    (growth slack beyond what pow2 rounding already provides);
+    ``floors`` replays the realized capacities of a previous build of
+    the same algorithm (rebind path). Scopes do not nest — a rebuild
+    inside a scope would desynchronize the ordinal floor replay.
+    """
+    if dyn_capacity_state() is not None:
+        raise RuntimeError("dyn_capacity scopes do not nest")
+    if headroom < 1.0:
+        raise ValueError(f"dyn_capacity headroom must be >= 1.0, got {headroom}")
+    st = DynCapacityState(headroom, floors)
+    _DYN.state = st
+    try:
+        yield st
+    finally:
+        _DYN.state = None
+
+
+def dyn_rung(raw: int, multiple: int = 1) -> int | None:
+    """Consume one capacity decision of the active scope.
+
+    Returns the capacity to size for (``>= raw``, a pow2 rung rounded up
+    to ``multiple``, never below this ordinal's floor), or None when no
+    scope is active (builders then keep their exact sizing). A floor is
+    reused verbatim when the new requirement fits under it — it already
+    satisfies the alignment of this site from the previous build of the
+    same geometry.
+    """
+    st = dyn_capacity_state()
+    if st is None:
+        return None
+    floor = st.floors[st.seq] if st.seq < len(st.floors) else 0
+    st.seq += 1
+    raw = max(int(raw), 0)
+    need = math.ceil(raw * st.headroom)
+    cap = pow2_at_least(max(need, raw, 1))
+    multiple = max(int(multiple), 1)
+    cap = -(-cap // multiple) * multiple
+    if floor and cap <= floor:
+        cap = floor
+    st.realized.append(cap)
+    return cap

@@ -87,6 +87,13 @@ KNOBS: dict[str, Knob] = {k.name: k for k in [
     _K("DSDDMM_DONATE", "flag", "1",
        "donate CG/GAT loop buffers to their compiled programs (0 "
        "stands donation down)"),
+    _K("DSDDMM_DYNSTRUCT_HEADROOM", "float", "1.0",
+       "dynstruct capacity headroom: every raw structure requirement "
+       "is multiplied by this before pow2 rung selection "
+       "(dynstruct/capacity.py)"),
+    _K("DSDDMM_DYNSTRUCT_ROWS", "flag", "1",
+       "dynstruct builds reserve a row-growth rung (declared height "
+       "pow2_at_least(M+1)); 0 sizes frames to the exact M"),
     _K("DSDDMM_EXEC_RETRIES", "int", "1",
        "dispatch retries at the parallel/base.py resilience choke "
        "point"),

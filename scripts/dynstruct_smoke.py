@@ -1,0 +1,309 @@
+"""CPU-mesh dynamic-structure smoke: the dynstruct/ layer end to end.
+
+Four checks on the same virtual 8-device CPU mesh the test suite uses
+(fast enough for CI; a tier-1 test runs this as a subprocess):
+
+1. **growth_storm** — a dynstruct-built strategy absorbs a storm of
+   ``append_rows`` growth steps through :func:`dynstruct.rebind`: every
+   step fits its capacity rung, the compiled programs keep serving
+   (ZERO live compiles after the warmup trace — the ``live_compiles``
+   GLOBAL currency), and the final SDDMM output is bit-identical to a
+   freshly-traced cold rebuild at the same capacity.
+2. **mask_churn_storm** — a ``dynamic=True`` attention engine serves a
+   storm of per-request ``window:<w>`` / ``topk:<k>`` mask changes with
+   zero post-warmup cache misses, every reply matching the float64
+   oracle and bit-identical to a freshly-traced engine of the same
+   capacity.
+3. **context_rebind** — ``engine.rebind_structure`` binds a grown
+   context in place (fit: zero new compiles), then a rung-outgrowing
+   one (spill: ladder re-warms, replies stay correct) — and the
+   rebind/spill/retrace counters tell the story.
+4. **als_ingest_rebind** — the online-learning loop: a serving ALS
+   fold-in engine ingests live traffic (``append_rows`` on S_live),
+   rebinds the grown pattern into the model's training strategy, and
+   keeps serving with zero new compiles.
+
+Usage::
+
+    python scripts/dynstruct_smoke.py [-o out.json]
+
+Prints one JSON summary; exits nonzero if any check fails.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import pathlib
+import sys
+import time
+
+REPO = pathlib.Path(__file__).resolve().parents[1]
+sys.path.insert(0, str(REPO))
+
+
+def _live_compiles() -> float:
+    from distributed_sddmm_tpu.obs import metrics as obs_metrics
+
+    return obs_metrics.GLOBAL.get("live_compiles")
+
+
+def _sddmm_out(alg):
+    """One SDDMM through the strategy's compiled program; gathered host
+    values in canonical nonzero order (the bit-identity currency)."""
+    from distributed_sddmm_tpu.parallel.base import KernelMode, MatMode
+
+    A = alg.dummy_initialize(MatMode.A)
+    B = alg.dummy_initialize(MatMode.B)
+    A_s, B_s = alg.initial_shift(A, B, KernelMode.SDDMM_A)
+    mid = alg.sddmm_a(A_s, B_s, alg.like_s_values(1.0))
+    return alg.gather_s_values(mid)
+
+
+def check_growth_storm(rounds: int = 6) -> dict:
+    import numpy as np
+
+    from distributed_sddmm_tpu import dynstruct
+    from distributed_sddmm_tpu.utils.coo import HostCOO
+
+    S = HostCOO.erdos_renyi(100, 64, 4, seed=0, values="normal")
+    alg = dynstruct.build("15d_fusion2", S, 16, 2, headroom=4.0)
+    _sddmm_out(alg)  # warmup trace
+    live0 = _live_compiles()
+    rng = np.random.default_rng(1)
+    fits = 0
+    for _ in range(rounds):
+        n = int(rng.integers(1, 4))
+        cols = rng.choice(S.N, size=n, replace=False).astype(np.int64)
+        S.append_rows([cols], [rng.standard_normal(n)], mode="repair")
+        update = dynstruct.rebind(alg, S)
+        fits += bool(update.fit)
+        _sddmm_out(alg)
+    live_delta = _live_compiles() - live0
+    # Bit-identity vs a COLD rebuild at the same capacity: a fresh
+    # build + fresh trace over the grown pattern must reproduce the
+    # rebound program's output exactly.
+    cold = dynstruct.build("15d_fusion2", S, 16, 2, headroom=4.0)
+    bit_identical = bool(np.array_equal(_sddmm_out(alg), _sddmm_out(cold)))
+    return {
+        "name": "growth_storm",
+        "ok": bool(fits == rounds and live_delta == 0 and bit_identical),
+        "rounds": rounds,
+        "fits": fits,
+        "live_compiles_after_warmup": live_delta,
+        "bit_identical_vs_cold": bit_identical,
+    }
+
+
+def _attention_engine(ctx, window: int = 4, dynamic: bool = True):
+    from distributed_sddmm_tpu.serve import ServingEngine
+    from distributed_sddmm_tpu.serve.workloads import AttentionTokenScore
+
+    workload = AttentionTokenScore(
+        ctx, window=window, token_buckets=(4, 8), dynamic=dynamic
+    )
+    engine = ServingEngine(
+        workload, max_batch=4, max_depth=16, max_wait_ms=2.0
+    )
+    engine.warmup()
+    return workload, engine
+
+
+def _churn_payloads(rng, n_ctx: int, window: int, count: int) -> list:
+    import numpy as np
+
+    out = []
+    for i in range(count):
+        n = int(rng.integers(1, 5))
+        p = {"tokens": rng.choice(n_ctx, size=n, replace=False).astype(
+            np.int64
+        )}
+        if i % 3 == 1:
+            p["mask"] = f"window:{int(rng.integers(0, window + 1))}"
+        elif i % 3 == 2:
+            p["mask"] = f"topk:{int(rng.integers(1, 2 * window + 2))}"
+        out.append(p)
+    return out
+
+
+def check_mask_churn_storm() -> dict:
+    import numpy as np
+
+    rng = np.random.default_rng(2)
+    ctx = rng.standard_normal((200, 16)).astype(np.float32)
+    workload, engine = _attention_engine(ctx)
+    misses0 = engine.stats()["cache_misses"]
+    live0 = _live_compiles()
+    payloads = _churn_payloads(rng, workload.n_ctx, workload.window, 30)
+    replies = []
+    for i in range(0, len(payloads), 3):
+        replies.extend(engine.execute_now(payloads[i : i + 3]))
+    oracle_ok = all(
+        workload.check_reply(p, r) for p, r in zip(payloads, replies)
+    )
+    stats = engine.stats()
+    # Freshly-traced twin at the same capacity: same context, fresh
+    # programs — replies must agree bit-for-bit.
+    _, engine2 = _attention_engine(ctx)
+    replies2 = []
+    for i in range(0, len(payloads), 3):
+        replies2.extend(engine2.execute_now(payloads[i : i + 3]))
+    bit_identical = all(
+        np.array_equal(a["scores"], b["scores"])
+        for a, b in zip(replies, replies2)
+    )
+    return {
+        "name": "mask_churn_storm",
+        "ok": bool(
+            oracle_ok and bit_identical
+            and stats["cache_misses"] == misses0
+            and _live_compiles() - live0 == 0
+        ),
+        "requests": len(payloads),
+        "cache_misses_after_warmup": stats["cache_misses"] - misses0,
+        "oracle_ok": oracle_ok,
+        "bit_identical_vs_fresh": bit_identical,
+    }
+
+
+def check_context_rebind() -> dict:
+    import numpy as np
+
+    from distributed_sddmm_tpu.obs import metrics as obs_metrics
+
+    rng = np.random.default_rng(3)
+    ctx = rng.standard_normal((200, 16)).astype(np.float32)
+    workload, engine = _attention_engine(ctx)
+    cap0 = workload.ctx_cap
+    misses0 = engine.stats()["cache_misses"]
+    # Fit: grow within the rung; the compiled cells keep serving.
+    grown = np.concatenate(
+        [ctx, rng.standard_normal((40, 16)).astype(np.float32)]
+    )
+    rep_fit = engine.rebind_structure(grown)
+    p = {"tokens": np.array([205, 239], dtype=np.int64)}
+    reply = engine.execute_now([p])[0]
+    fit_ok = (
+        rep_fit["fit"]
+        and workload.ctx_cap == cap0
+        and engine.stats()["cache_misses"] == misses0
+        and workload.check_reply(p, reply)
+    )
+    # Spill: outgrow the rung; the engine re-warms its ladder and the
+    # spill is the counted retrace.
+    huge = np.concatenate(
+        [grown, rng.standard_normal((300, 16)).astype(np.float32)]
+    )
+    rep_spill = engine.rebind_structure(huge)
+    p2 = {"tokens": np.array([500, 539], dtype=np.int64), "mask": "topk:3"}
+    reply2 = engine.execute_now([p2])[0]
+    spill_ok = (
+        not rep_spill["fit"]
+        and workload.ctx_cap > cap0
+        and engine.stats()["cache_misses"] > misses0
+        and workload.check_reply(p2, reply2)
+    )
+    snap = obs_metrics.GLOBAL.snapshot()
+    counters_ok = (
+        snap.get("dynstruct_rebinds", 0) >= 1
+        and snap.get("dynstruct_bucket_spills", 0) >= 1
+        and snap.get("structure_retraces", 0) >= 1
+    )
+    return {
+        "name": "context_rebind",
+        "ok": bool(fit_ok and spill_ok and counters_ok),
+        "fit": rep_fit,
+        "spill": rep_spill,
+        "counters": {
+            k: snap.get(k, 0)
+            for k in ("dynstruct_rebinds", "dynstruct_bucket_spills",
+                      "structure_retraces")
+        },
+    }
+
+
+def check_als_ingest_rebind() -> dict:
+    import numpy as np
+
+    from distributed_sddmm_tpu import dynstruct
+    from distributed_sddmm_tpu.models.als import DistributedALS
+    from distributed_sddmm_tpu.serve import ALSFoldInTopK, ServingEngine
+    from distributed_sddmm_tpu.utils.coo import HostCOO
+
+    S = HostCOO.erdos_renyi(64, 48, 6, seed=4, values="normal")
+    alg = dynstruct.build("15d_fusion2", S, 8, 1, headroom=4.0)
+    model = DistributedALS(alg, S_host=S)
+    model.run_cg(2, cg_iters=4)
+    workload = ALSFoldInTopK(model, k=5, item_buckets=(4, 8))
+    engine = ServingEngine(
+        workload, max_batch=4, max_depth=16, max_wait_ms=2.0
+    )
+    engine.warmup()
+    rng = np.random.default_rng(5)
+    payloads = [workload.sample_payload(rng) for _ in range(6)]
+    misses0 = engine.stats()["cache_misses"]
+    live0 = _live_compiles()
+    nnz0 = S.nnz
+    replies = engine.execute_now(payloads)
+    workload.ingest(payloads)
+    report = engine.rebind_structure()
+    replies_after = engine.execute_now(payloads)
+    oracle_ok = all(
+        workload.check_reply(p, r)
+        for p, r in zip(payloads, replies_after)
+    )
+    bit_identical = all(
+        np.array_equal(a["items"], b["items"])
+        and np.array_equal(a["scores"], b["scores"])
+        for a, b in zip(replies, replies_after)
+    )
+    stats = engine.stats()
+    return {
+        "name": "als_ingest_rebind",
+        "ok": bool(
+            report["fit"]
+            and S.nnz > nnz0
+            and oracle_ok
+            and bit_identical
+            and stats["cache_misses"] == misses0
+            and _live_compiles() - live0 == 0
+            and stats["structure_rebinds"] == 1
+        ),
+        "ingested_nnz": S.nnz - nnz0,
+        "rebind": report,
+        "cache_misses_after_warmup": stats["cache_misses"] - misses0,
+        "oracle_ok": oracle_ok,
+        "bit_identical_across_rebind": bit_identical,
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("-o", "--output-file", default=None)
+    args = ap.parse_args(argv)
+
+    from distributed_sddmm_tpu.utils.platform import force_cpu_platform
+
+    force_cpu_platform(n_devices=8, replace=True)
+
+    t0 = time.perf_counter()
+    checks = [
+        check_growth_storm(),
+        check_mask_churn_storm(),
+        check_context_rebind(),
+        check_als_ingest_rebind(),
+    ]
+    report = {
+        "ok": all(c["ok"] for c in checks),
+        "elapsed_s": round(time.perf_counter() - t0, 2),
+        "checks": checks,
+    }
+    text = json.dumps(report, indent=1)
+    print(text)
+    if args.output_file:
+        pathlib.Path(args.output_file).write_text(text)
+    return 0 if report["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,312 @@
+"""dynstruct/ unit + integration coverage (PR 20).
+
+The capacity ladder (``pow2_at_least`` / ``dyn_rung`` scopes), the
+dynamic mask grammar round-trips, the serve/fingerprint key surgery
+(bucketed keys carry the ``cap`` segment, exact keys stay byte-
+identical and never alias), and the tentpole loop itself:
+``append_rows`` → :func:`dynstruct.rebind` across all four named
+strategies, bit-identical to a cold rebuild at the same capacity, with
+the zero-new-nnz and bucket-spill edges — plus the structure-churn
+smoke (``scripts/dynstruct_smoke.py``) as a tier-1 subprocess.
+"""
+
+import json
+import pathlib
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from distributed_sddmm_tpu import dynstruct, masks
+from distributed_sddmm_tpu.utils import buckets
+from distributed_sddmm_tpu.utils.coo import HostCOO
+
+REPO = pathlib.Path(__file__).resolve().parents[1]
+
+
+# --------------------------------------------------------------------- #
+# Capacity ladder
+# --------------------------------------------------------------------- #
+
+
+def test_pow2_at_least_never_rounds_down():
+    assert buckets.pow2_at_least(1) == 1
+    assert buckets.pow2_at_least(2) == 2
+    assert buckets.pow2_at_least(3) == 4
+    assert buckets.pow2_at_least(1025) == 2048
+    for n in range(1, 300):
+        cap = buckets.pow2_at_least(n)
+        assert cap >= n and cap & (cap - 1) == 0
+
+
+def test_dyn_rung_outside_scope_is_inert():
+    assert buckets.dyn_rung(100) is None
+    assert buckets.dyn_capacity_state() is None
+
+
+def test_dyn_rung_scope_realizes_and_replays_floors():
+    with buckets.dyn_capacity(headroom=1.0) as scope:
+        assert buckets.dyn_rung(100) == 128
+        assert buckets.dyn_rung(5, multiple=3) == 9   # pow2 8 -> 3-multiple
+    assert scope.realized == [128, 9]
+    # Floors replay the previous build's rungs: a SMALLER requirement
+    # pads back up to the same capacity (ordinal-sequenced).
+    with buckets.dyn_capacity(floors=tuple(scope.realized)) as scope2:
+        assert buckets.dyn_rung(60) == 128
+        assert buckets.dyn_rung(2, multiple=3) == 9
+    assert scope2.realized == [128, 9]
+
+
+def test_dyn_capacity_scope_guards():
+    with pytest.raises(ValueError):
+        with buckets.dyn_capacity(headroom=0.5):
+            pass
+    with buckets.dyn_capacity():
+        with pytest.raises(RuntimeError):
+            with buckets.dyn_capacity():
+                pass
+
+
+def test_row_capacity_reserves_growth_rung():
+    assert dynstruct.row_capacity(100) == 128
+    assert dynstruct.row_capacity(128) == 256   # strict slack above pow2
+    assert dynstruct.row_capacity(100, grow=False) == 100
+    S = HostCOO(np.array([0, 2]), np.array([1, 3]), np.ones(2), 3, 4)
+    S_cap = dynstruct.with_row_capacity(S, 8)
+    assert S_cap.M == 8 and S_cap.N == 4 and S_cap.nnz == 2
+    with pytest.raises(ValueError):
+        dynstruct.with_row_capacity(S, 2)
+
+
+# --------------------------------------------------------------------- #
+# Dynamic mask grammar
+# --------------------------------------------------------------------- #
+
+
+def test_dynamic_spec_roundtrip():
+    for spec, want in [
+        ("window:3", ("window", 3)),
+        ("window:w=5", ("window", 5)),
+        ("topk:7", ("topk", 7)),
+        ("topk:k=1", ("topk", 1)),
+    ]:
+        kind, param = masks.parse_dynamic_spec(spec)
+        assert (kind, param) == want
+        canon = masks.format_dynamic_spec(kind, param)
+        assert masks.parse_dynamic_spec(canon) == want
+
+
+@pytest.mark.parametrize("bad", [
+    "window:", "topk:", "window:w=x", "topk:q=3", "window:-1", "topk:0",
+    "gauss:3",
+])
+def test_dynamic_spec_strict_errors(bad):
+    with pytest.raises(ValueError):
+        masks.parse_dynamic_spec(bad)
+
+
+def test_dynamic_spec_capacity_bounds():
+    assert masks.parse_dynamic_spec("window:4", w_max=4) == ("window", 4)
+    with pytest.raises(ValueError, match="serving capacity"):
+        masks.parse_dynamic_spec("window:5", w_max=4)
+    with pytest.raises(ValueError, match="serving capacity"):
+        masks.parse_dynamic_spec("topk:10", k_max=9)
+
+
+def test_from_spec_window_param_and_topk_rejection():
+    S = masks.from_spec("window:w=2", 16)
+    assert S.nnz == masks.sliding_window(16, 2).nnz
+    with pytest.raises(ValueError, match="request-time dynamic"):
+        masks.from_spec("topk:4", 16)
+    with pytest.raises(ValueError, match="unknown window key"):
+        masks.from_spec("window:q=2", 16)
+
+
+def test_format_dynamic_spec_rejects_unknown_kind():
+    with pytest.raises(ValueError, match="unknown dynamic mask kind"):
+        masks.format_dynamic_spec("gauss", 3)
+
+
+# --------------------------------------------------------------------- #
+# Key surgery
+# --------------------------------------------------------------------- #
+
+
+def test_serve_key_cap_segment_roundtrip():
+    from distributed_sddmm_tpu.programs.keys import (
+        parse_serve_key,
+        serve_program_key,
+    )
+
+    base = serve_program_key("attention", 4, 8, 16, "cpu", code="abc123")
+    bucketed = serve_program_key(
+        "attention", 4, 8, 16, "cpu", code="abc123", cap="w4.n128"
+    )
+    # Exact keys stay byte-identical (no cap segment); bucketed keys
+    # never alias them.
+    assert "c" + "w4.n128" not in base
+    assert bucketed != base
+    assert bucketed.startswith(base)
+    parsed = parse_serve_key(bucketed)
+    assert parsed is not None and parsed["cap"] == "w4.n128"
+    assert "cap" not in (parse_serve_key(base) or {})
+
+
+def test_fingerprint_capacity_bucket_mode():
+    from distributed_sddmm_tpu.autotune.fingerprint import (
+        Problem,
+        make_fingerprint,
+    )
+
+    S1 = HostCOO.erdos_renyi(64, 64, 4, seed=0)
+    p1 = Problem.from_coo(S1, R=16)
+    machine = dict(p=8, backend="cpu", code="deadbeef")
+    # Default off: byte-identical to the pre-PR-20 call shape, nnz exact.
+    fp_exact = make_fingerprint(p1, **machine)
+    assert fp_exact == make_fingerprint(p1, capacity_bucket=False, **machine)
+    assert dict(fp_exact.fields)["nnz"] == p1.nnz
+    fp_cap = make_fingerprint(p1, capacity_bucket=True, **machine)
+    assert fp_cap != fp_exact
+    assert dict(fp_cap.fields)["capacity_mode"] == "pow2"
+    # Same pow2 bucket, different exact nnz -> same capacity fingerprint.
+    S2 = HostCOO.erdos_renyi(64, 64, 4, seed=1)
+    p2 = Problem.from_coo(S2, R=16)
+    assert p1.nnz != p2.nnz
+    assert buckets.pow2_at_least(p1.nnz) == buckets.pow2_at_least(p2.nnz)
+    assert make_fingerprint(p2, capacity_bucket=True, **machine) == fp_cap
+    assert make_fingerprint(p2, **machine) != fp_exact
+
+
+# --------------------------------------------------------------------- #
+# Rebind across the four strategies
+# --------------------------------------------------------------------- #
+
+STRATEGIES = (
+    "15d_fusion2", "15d_sparse", "25d_dense_replicate",
+    "25d_sparse_replicate",
+)
+
+
+def _sddmm_values(alg):
+    """(host values, device aval shape). The gathered host array trims to
+    the LIVE nnz; the device result keeps the padded capacity shape — the
+    aval jit actually keys on."""
+    from distributed_sddmm_tpu.parallel.base import KernelMode, MatMode
+
+    A = alg.dummy_initialize(MatMode.A)
+    B = alg.dummy_initialize(MatMode.B)
+    A_s, B_s = alg.initial_shift(A, B, KernelMode.SDDMM_A)
+    mid = alg.sddmm_a(A_s, B_s, alg.like_s_values(1.0))
+    return alg.gather_s_values(mid), tuple(mid.shape)
+
+
+def _grow(S: HostCOO, rounds: int, seed: int) -> None:
+    rng = np.random.default_rng(seed)
+    for _ in range(rounds):
+        n = int(rng.integers(1, 4))
+        cols = rng.choice(S.N, size=n, replace=False).astype(np.int64)
+        S.append_rows([cols], [rng.standard_normal(n)], mode="repair")
+
+
+@pytest.mark.parametrize("name", STRATEGIES)
+def test_append_rebind_bit_identical_to_cold_rebuild(name):
+    S = HostCOO.erdos_renyi(96, 96, 4, seed=7, values="normal")
+    alg = dynstruct.build(name, S, 16, 2, headroom=4.0)
+    handle = alg._dynstruct
+    assert handle.row_cap == 128 and handle.floors
+    assert alg.S_tiles.dyn_cap, "tiles must carry the capacity rungs"
+    before, aval_before = _sddmm_values(alg)
+
+    _grow(S, rounds=3, seed=8)
+    update = dynstruct.rebind(alg, S)
+    assert update.fit, update.reason
+    assert update.alg is alg
+    assert update.nnz_after == S.nnz > update.nnz_before
+    after, aval_after = _sddmm_values(alg)
+    assert aval_after == aval_before  # capacity-stable aval
+    assert after.shape[0] > before.shape[0]  # host gather tracks live nnz
+
+    cold = dynstruct.build(name, S, 16, 2, headroom=4.0)
+    assert cold._dynstruct.floors == alg._dynstruct.floors
+    assert np.array_equal(after, _sddmm_values(cold)[0]), (
+        "rebound program output must be bit-identical to a cold rebuild"
+    )
+
+
+def test_zero_new_nnz_rebind_is_noop_fit():
+    S = HostCOO.erdos_renyi(96, 96, 4, seed=9, values="normal")
+    alg = dynstruct.build("15d_fusion2", S, 16, 2, headroom=2.0)
+    before = _sddmm_values(alg)[0]
+    update = dynstruct.rebind(alg, S)     # same pattern, nothing new
+    assert update.fit and update.nnz_after == update.nnz_before
+    assert np.array_equal(before, _sddmm_values(alg)[0])
+
+
+def test_bucket_spill_returns_replacement():
+    S = HostCOO.erdos_renyi(96, 96, 4, seed=10, values="normal")
+    alg = dynstruct.build("15d_fusion2", S, 16, 2, headroom=1.0)
+    row_cap = alg._dynstruct.row_cap
+    # Outgrow the ROW rung: more rows than the reserved capacity.
+    _grow(S, rounds=row_cap - S.M + 1, seed=11)
+    assert S.M > row_cap
+    update = dynstruct.rebind(alg, S)
+    assert update.spilled and update.alg is not alg
+    assert update.reason and "row capacity" in update.reason
+    assert update.alg._dynstruct.row_cap > row_cap
+    # The replacement serves the grown pattern; the old strategy still
+    # carries its original (stale) capacity handle.
+    fresh_vals = _sddmm_values(update.alg)[0]
+    cold = dynstruct.build("15d_fusion2", S, 16, 2, headroom=1.0)
+    assert np.array_equal(fresh_vals, _sddmm_values(cold)[0])
+
+
+def test_rebind_rejects_foreign_strategy_and_column_growth():
+    from distributed_sddmm_tpu.bench.harness import make_algorithm
+
+    S = HostCOO.erdos_renyi(64, 64, 4, seed=12, values="normal")
+    plain = make_algorithm("15d_fusion2", S, 16, 2)
+    with pytest.raises(ValueError, match="_dynstruct handle"):
+        dynstruct.rebind(plain, S)
+    alg = dynstruct.build("15d_fusion2", S, 16, 2)
+    S_wide = HostCOO(S.rows, S.cols, S.vals, S.M, S.N + 8)
+    with pytest.raises(ValueError, match="column count"):
+        dynstruct.rebind(alg, S_wide)
+
+
+def test_verify_algorithms_on_grown_matrix():
+    """The grown pattern is a first-class matrix: the standard verify
+    protocol (fresh exact builds vs the float64 oracle) passes on it
+    across all four strategies."""
+    from distributed_sddmm_tpu.utils.verify import verify_algorithms
+
+    S = HostCOO.erdos_renyi(96, 96, 4, seed=13, values="normal")
+    _grow(S, rounds=4, seed=14)
+    assert verify_algorithms(
+        R=16, c=2, alg_names=list(STRATEGIES), S=S
+    )
+
+
+# --------------------------------------------------------------------- #
+# Structure-churn smoke (tier-1 subprocess)
+# --------------------------------------------------------------------- #
+
+
+def test_dynstruct_smoke():
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "scripts" / "dynstruct_smoke.py")],
+        capture_output=True, text=True, timeout=540,
+        env={"PATH": "/usr/bin:/bin:/usr/local/bin", "HOME": "/tmp",
+             "JAX_PLATFORMS": "cpu", "DSDDMM_RUNSTORE": "0",
+             "DSDDMM_PROGRAMS": "0"},
+    )
+    assert proc.returncode == 0, proc.stdout[-2000:] + proc.stderr[-2000:]
+    rep = json.loads(proc.stdout[proc.stdout.index("{"):])
+    assert rep["ok"] is True
+    by_name = {c["name"]: c for c in rep["checks"]}
+    assert by_name["growth_storm"]["live_compiles_after_warmup"] == 0
+    assert by_name["growth_storm"]["bit_identical_vs_cold"] is True
+    assert by_name["mask_churn_storm"]["cache_misses_after_warmup"] == 0
+    assert by_name["mask_churn_storm"]["bit_identical_vs_fresh"] is True
+    assert by_name["context_rebind"]["counters"]["structure_retraces"] >= 1
+    assert by_name["als_ingest_rebind"]["bit_identical_across_rebind"] is True

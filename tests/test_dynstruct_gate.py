@@ -1,0 +1,74 @@
+"""Structural HLO gate for dynamic structure (tier-1 acceptance,
+``test_codegen_gate.py`` style): a dynstruct-built fused program,
+AOT-compiled for a real v5e topology, must serve two different-geometry
+patterns of the same capacity bucket with ONE module — the rebind fits,
+the second compile is byte-identical to the first, the shared cache key
+carries the ``cap=`` capacity segment, and an exact (static) build of
+the same pattern keys WITHOUT that segment and never aliases the
+bucketed key. The committed ``DYNSTRUCT_HLO.json`` is this probe's
+banked record.
+
+The compile runs in a subprocess: libtpu reads its environment once at
+first init, and without TPU instance metadata the topology lookup
+stalls in metadata retries unless ``TPU_SKIP_MDS_QUERY=1`` is exported
+first (this container's case).
+"""
+
+import json
+import os
+import pathlib
+import subprocess
+import sys
+
+REPO = pathlib.Path(__file__).resolve().parents[1]
+
+_PROBE = """
+import json, sys
+sys.path.insert(0, {repo!r})
+from distributed_sddmm_tpu.utils.platform import force_cpu_platform
+force_cpu_platform(n_devices=8, replace=True)
+from distributed_sddmm_tpu.dynstruct.hlo import dynstruct_hlo_report
+print("RESULT " + json.dumps(dynstruct_hlo_report()))
+"""
+
+
+def _assert_gate(rec: dict) -> None:
+    assert rec["topology"] == "v5e:2x4" and rec["p"] == 8
+    # Two genuinely different patterns of the same bucket...
+    assert rec["pattern_a"] != rec["pattern_b"], rec
+    assert rec["rebind_fit"] is True, rec
+    # ...served by ONE module under ONE bucketed key.
+    assert rec["keys_identical"] is True, rec
+    assert rec["key_has_cap_segment"] is True, rec
+    assert rec["modules_identical"] is True, rec
+    assert rec["module_sha256_a"] == rec["module_sha256_b"], rec
+    assert rec["is_scheduled"] is True, rec
+    # Exact-structure keys stay capacity-free and never alias.
+    assert rec["exact_key_has_cap_segment"] is False, rec
+    assert rec["exact_key_aliases_bucketed"] is False, rec
+
+
+def test_dynstruct_one_module_two_patterns_v5e_gate():
+    env = dict(os.environ)
+    env.update({
+        "TPU_SKIP_MDS_QUERY": "1",
+        "DSDDMM_PROGRAMS": "0",
+        "DSDDMM_RUNSTORE": "0",
+        "PYTHONPATH": str(REPO),
+    })
+    proc = subprocess.run(
+        [sys.executable, "-c", _PROBE.format(repo=str(REPO))],
+        capture_output=True, text=True, timeout=540, env=env,
+    )
+    assert proc.returncode == 0, proc.stdout[-2000:] + proc.stderr[-2000:]
+    line = [l for l in proc.stdout.splitlines() if l.startswith("RESULT ")]
+    assert line, proc.stdout[-2000:]
+    _assert_gate(json.loads(line[0][len("RESULT "):]))
+
+
+def test_committed_dynstruct_record_passes_gate():
+    """The banked DYNSTRUCT_HLO.json must itself satisfy the gate — a
+    hand-edited or stale record fails tier-1, not just a fresh probe."""
+    rec = json.loads((REPO / "DYNSTRUCT_HLO.json").read_text())
+    assert rec["experiment"] == "dynstruct-hlo"
+    _assert_gate(rec)
